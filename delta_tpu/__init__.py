@@ -1,0 +1,17 @@
+"""delta-tpu: a TPU-native lakehouse framework.
+
+Same capabilities as Delta Lake (reference mounted at ``/root/reference``):
+an ACID transaction log over Parquet with optimistic concurrency, snapshot
+isolation, time travel, schema enforcement/evolution, constraints, streaming
+source/sink, and MERGE/UPDATE/DELETE/VACUUM — with the data plane rebuilt
+for TPUs on JAX/XLA (sharded log replay, device-evaluated data skipping,
+columnar MERGE kernels) instead of Spark. The on-disk transaction-log format
+is byte-compatible with the Delta protocol.
+"""
+
+__version__ = "0.1.0"
+
+from delta_tpu.log.deltalog import DeltaLog  # noqa: F401
+from delta_tpu.utils.config import conf  # noqa: F401
+
+__all__ = ["DeltaLog", "conf", "__version__"]

@@ -1,0 +1,367 @@
+"""Checkpoints: Parquet compaction of reconciled log state.
+
+Format-compatible with the reference (``Checkpoints.scala``; schema spec
+``PROTOCOL.md`` "Checkpoint Schema"): a checkpoint Parquet file holds one row
+per action with nullable struct columns ``txn``/``add``/``remove``/
+``metaData``/``protocol``, plus the ``_last_checkpoint`` pointer JSON.
+
+Unlike the reference — which funnels the whole state through a
+``repartition(1)`` single-task write (``Checkpoints.scala:262-303``) — the
+writer here shards multi-part checkpoints across parts deterministically and
+writes parts in parallel threads, which is both faster and exactly what the
+multi-part naming scheme was designed for.
+"""
+from __future__ import annotations
+
+import json
+import math
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import (
+    Action,
+    AddFile,
+    Metadata,
+    Format,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+)
+from delta_tpu.storage.logstore import LogStore
+from delta_tpu.utils.errors import DeltaIllegalStateError
+
+__all__ = [
+    "CheckpointMetaData",
+    "read_last_checkpoint",
+    "write_last_checkpoint",
+    "write_checkpoint",
+    "read_checkpoint_actions",
+    "find_last_complete_checkpoint_before",
+    "CheckpointInstance",
+    "latest_complete_checkpoint",
+]
+
+
+@dataclass(frozen=True)
+class CheckpointMetaData:
+    """Content of ``_last_checkpoint`` (``Checkpoints.scala:51-58``)."""
+
+    version: int
+    size: int
+    parts: Optional[int] = None
+
+    def to_json(self) -> str:
+        d: Dict[str, Any] = {"version": self.version, "size": self.size}
+        if self.parts is not None:
+            d["parts"] = self.parts
+        return json.dumps(d, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(s: str) -> "CheckpointMetaData":
+        d = json.loads(s)
+        return CheckpointMetaData(int(d["version"]), int(d.get("size", -1)), d.get("parts"))
+
+
+@dataclass(frozen=True, order=True)
+class CheckpointInstance:
+    """A (version, parts) candidate checkpoint (``Checkpoints.scala:60-106``).
+    Ordering: higher version wins; at same version, multi-part > single-part
+    is NOT the rule — the reference prefers fewer parts (None sorts last in
+    its ordering); we order by (version, -num_parts-is-None) to match its
+    ``isNotLaterThan`` usage where exact semantics only need version order."""
+
+    version: int
+    parts: Optional[int] = None
+
+    def paths(self, log_path: str) -> List[str]:
+        if self.parts is None:
+            return [f"{log_path}/{filenames.checkpoint_file_single(self.version)}"]
+        return [f"{log_path}/{p}" for p in filenames.checkpoint_file_with_parts(self.version, self.parts)]
+
+
+def read_last_checkpoint(store: LogStore, log_path: str) -> Optional[CheckpointMetaData]:
+    """Read the ``_last_checkpoint`` pointer; on corruption/partial write fall
+    back to None so callers re-list (``Checkpoints.scala:148-175``)."""
+    p = f"{log_path}/{filenames.LAST_CHECKPOINT}"
+    try:
+        lines = store.read(p)
+    except FileNotFoundError:
+        return None
+    try:
+        return CheckpointMetaData.from_json("".join(lines))
+    except (ValueError, KeyError):
+        return None
+
+
+def write_last_checkpoint(store: LogStore, log_path: str, md: CheckpointMetaData) -> None:
+    store.write(f"{log_path}/{filenames.LAST_CHECKPOINT}", [md.to_json()], overwrite=True)
+
+
+def latest_complete_checkpoint(
+    instances: Sequence[CheckpointInstance], not_later_than: Optional[int] = None
+) -> Optional[CheckpointInstance]:
+    """Pick the latest checkpoint all of whose parts are present
+    (``Checkpoints.scala:210-218``). ``instances`` are per-file candidates:
+    single-part files appear once with parts=None; a multi-part file with
+    (part i of n) appears as CheckpointInstance(version, n) once per part."""
+    from collections import Counter
+
+    if not_later_than is not None:
+        instances = [c for c in instances if c.version <= not_later_than]
+    counts = Counter(instances)
+    complete = [
+        inst
+        for inst, cnt in counts.items()
+        if (inst.parts is None and cnt >= 1) or (inst.parts is not None and cnt >= inst.parts)
+    ]
+    if not complete:
+        return None
+    # Highest version; tie → prefer single-part (simpler read path).
+    return max(complete, key=lambda c: (c.version, -(c.parts or 0)))
+
+
+def find_last_complete_checkpoint_before(
+    store: LogStore, log_path: str, version: int
+) -> Optional[CheckpointInstance]:
+    """Backward scan in 1000-version windows (``Checkpoints.scala:187-204``)."""
+    cur = max(0, version)
+    while cur >= 0:
+        start = max(0, cur - 1000)
+        prefix = f"{log_path}/{filenames.check_version_prefix(start)}"
+        candidates: List[CheckpointInstance] = []
+        try:
+            for st in store.list_from(prefix):
+                name = st.name
+                if filenames.is_checkpoint_file(name) and st.size > 0:
+                    v = filenames.checkpoint_version(name)
+                    if v < version if cur == version else v <= cur:
+                        part = filenames.checkpoint_part(name)
+                        candidates.append(
+                            CheckpointInstance(v, part[1] if part else None)
+                        )
+        except FileNotFoundError:
+            return None
+        upper = version - 1 if cur == version else cur
+        found = latest_complete_checkpoint(candidates, not_later_than=upper)
+        if found:
+            return found
+        if start == 0:
+            return None
+        cur = start - 1
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Parquet serialization (SingleAction rows)
+# ---------------------------------------------------------------------------
+
+def _arrow_checkpoint_schema():
+    import pyarrow as pa
+
+    str_map = pa.map_(pa.string(), pa.string())
+    return pa.schema(
+        [
+            pa.field(
+                "txn",
+                pa.struct(
+                    [
+                        pa.field("appId", pa.string()),
+                        pa.field("version", pa.int64()),
+                        pa.field("lastUpdated", pa.int64()),
+                    ]
+                ),
+            ),
+            pa.field(
+                "add",
+                pa.struct(
+                    [
+                        pa.field("path", pa.string()),
+                        pa.field("partitionValues", str_map),
+                        pa.field("size", pa.int64()),
+                        pa.field("modificationTime", pa.int64()),
+                        pa.field("dataChange", pa.bool_()),
+                        pa.field("stats", pa.string()),
+                        pa.field("tags", str_map),
+                    ]
+                ),
+            ),
+            pa.field(
+                "remove",
+                pa.struct(
+                    [
+                        pa.field("path", pa.string()),
+                        pa.field("deletionTimestamp", pa.int64()),
+                        pa.field("dataChange", pa.bool_()),
+                        pa.field("extendedFileMetadata", pa.bool_()),
+                        pa.field("partitionValues", str_map),
+                        pa.field("size", pa.int64()),
+                        pa.field("tags", str_map),
+                    ]
+                ),
+            ),
+            pa.field(
+                "metaData",
+                pa.struct(
+                    [
+                        pa.field("id", pa.string()),
+                        pa.field("name", pa.string()),
+                        pa.field("description", pa.string()),
+                        pa.field(
+                            "format",
+                            pa.struct(
+                                [
+                                    pa.field("provider", pa.string()),
+                                    pa.field("options", str_map),
+                                ]
+                            ),
+                        ),
+                        pa.field("schemaString", pa.string()),
+                        pa.field("partitionColumns", pa.list_(pa.string())),
+                        pa.field("configuration", str_map),
+                        pa.field("createdTime", pa.int64()),
+                    ]
+                ),
+            ),
+            pa.field(
+                "protocol",
+                pa.struct(
+                    [
+                        pa.field("minReaderVersion", pa.int32()),
+                        pa.field("minWriterVersion", pa.int32()),
+                    ]
+                ),
+            ),
+        ]
+    )
+
+
+def _action_to_row(a: Action) -> Dict[str, Any]:
+    if isinstance(a, AddFile):
+        d = a.to_dict()
+        d.setdefault("stats", None)
+        d.setdefault("tags", None)
+        return {"add": d}
+    if isinstance(a, RemoveFile):
+        d = a.to_dict()
+        for k in ("deletionTimestamp", "extendedFileMetadata", "partitionValues", "size", "tags"):
+            d.setdefault(k, None)
+        return {"remove": d}
+    if isinstance(a, Metadata):
+        d = a.to_dict()
+        for k in ("name", "description", "createdTime"):
+            d.setdefault(k, None)
+        return {"metaData": d}
+    if isinstance(a, Protocol):
+        return {"protocol": a.to_dict()}
+    if isinstance(a, SetTransaction):
+        d = a.to_dict()
+        d.setdefault("lastUpdated", None)
+        return {"txn": d}
+    raise ValueError(f"Action not checkpointable: {a!r}")
+
+
+def write_checkpoint(
+    store: LogStore,
+    log_path: str,
+    version: int,
+    actions: Sequence[Action],
+    parts: Optional[int] = None,
+    part_size: int = 1_000_000,
+) -> CheckpointMetaData:
+    """Write a checkpoint for ``version`` holding ``actions`` (the reconciled
+    state from :meth:`LogReplay.checkpoint_actions`).
+
+    Single-part by default; multi-part when ``parts`` given or the state
+    exceeds ``part_size`` actions. Parts are written concurrently (the
+    reference's multi-part support is read-only in this version — its writer
+    is a single-task ``repartition(1)``; we go wider). Files are staged and
+    atomically renamed when the store shows partial writes
+    (``Checkpoints.scala:271-303``)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = len(actions)
+    if parts is None:
+        parts = 1 if n <= part_size else math.ceil(n / part_size)
+
+    schema = _arrow_checkpoint_schema()
+
+    def _write_one(path: str, acts: Sequence[Action]) -> None:
+        rows = [_action_to_row(a) for a in acts]
+        cols = {}
+        for field_ in schema:
+            cols[field_.name] = [r.get(field_.name) for r in rows]
+        table = pa.Table.from_pydict(cols, schema=schema)
+        sink = pa.BufferOutputStream()
+        pq.write_table(table, sink, compression="snappy")
+        store.write_bytes(path, sink.getvalue().to_pybytes(), overwrite=True)
+
+    if parts == 1:
+        path = f"{log_path}/{filenames.checkpoint_file_single(version)}"
+        _write_one(path, actions)
+        md = CheckpointMetaData(version, n, None)
+    else:
+        paths = [f"{log_path}/{p}" for p in filenames.checkpoint_file_with_parts(version, parts)]
+        chunk = math.ceil(n / parts) if n else 0
+        slices = [actions[i * chunk:(i + 1) * chunk] for i in range(parts)]
+        with ThreadPoolExecutor(max_workers=min(parts, 16)) as ex:
+            list(ex.map(lambda pz: _write_one(pz[0], pz[1]), zip(paths, slices)))
+        md = CheckpointMetaData(version, n, parts)
+    write_last_checkpoint(store, log_path, md)
+    return md
+
+
+def _row_to_action(name: str, d: Dict[str, Any]) -> Optional[Action]:
+    if d is None:
+        return None
+    d = dict(d)
+    if name == "add":
+        d = _fix_maps(d, ("partitionValues", "tags"))
+        return AddFile.from_dict(d)
+    if name == "remove":
+        d = _fix_maps(d, ("partitionValues", "tags"))
+        return RemoveFile.from_dict(d)
+    if name == "metaData":
+        d = _fix_maps(d, ("configuration",))
+        fmt = d.get("format")
+        if fmt:
+            d["format"] = _fix_maps(dict(fmt), ("options",))
+        return Metadata.from_dict(d)
+    if name == "protocol":
+        return Protocol.from_dict(d)
+    if name == "txn":
+        return SetTransaction.from_dict(d)
+    return None
+
+
+def _fix_maps(d: Dict[str, Any], keys) -> Dict[str, Any]:
+    # pyarrow renders map columns as list-of-(key,value)-tuples in to_pylist().
+    for k in keys:
+        v = d.get(k)
+        if isinstance(v, list):
+            d[k] = dict(v)
+    return d
+
+
+def read_checkpoint_actions(store: LogStore, paths: Sequence[str]) -> List[Action]:
+    """Read one checkpoint (all its part files) back into actions."""
+    import pyarrow.parquet as pq
+    import pyarrow as pa
+
+    out: List[Action] = []
+    for path in paths:
+        data = store.read_bytes(path)
+        table = pq.read_table(pa.BufferReader(data))
+        for name in ("protocol", "metaData", "txn", "remove", "add"):
+            if name not in table.column_names:
+                continue
+            col = table.column(name)
+            for v in col.to_pylist():
+                a = _row_to_action(name, v)
+                if a is not None:
+                    out.append(a)
+    if not out:
+        raise DeltaIllegalStateError(f"Empty checkpoint read from {list(paths)}")
+    return out

@@ -1,0 +1,106 @@
+"""Per-version state checksums (``<v>.crc``), reference ``Checksum.scala``.
+
+Written best-effort after each commit; on read, validated against the
+snapshot's computed state — a cheap guard against state-reconstruction bugs
+and log corruption.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from delta_tpu.protocol import filenames
+from delta_tpu.storage.logstore import LogStore
+from delta_tpu.utils.config import conf
+from delta_tpu.utils.errors import DeltaIllegalStateError
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["VersionChecksum", "write_checksum", "read_checksum", "validate_checksum"]
+
+
+@dataclass(frozen=True)
+class VersionChecksum:
+    table_size_bytes: int
+    num_files: int
+    num_metadata: int
+    num_protocol: int
+    num_transactions: int
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "tableSizeBytes": self.table_size_bytes,
+                "numFiles": self.num_files,
+                "numMetadata": self.num_metadata,
+                "numProtocol": self.num_protocol,
+                "numTransactions": self.num_transactions,
+            },
+            separators=(",", ":"),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "VersionChecksum":
+        d = json.loads(s)
+        return VersionChecksum(
+            int(d.get("tableSizeBytes", 0)),
+            int(d.get("numFiles", 0)),
+            int(d.get("numMetadata", 0)),
+            int(d.get("numProtocol", 0)),
+            int(d.get("numTransactions", 0)),
+        )
+
+    @staticmethod
+    def of_snapshot(snapshot) -> "VersionChecksum":
+        return VersionChecksum(
+            table_size_bytes=snapshot.size_in_bytes,
+            num_files=snapshot.num_of_files,
+            num_metadata=snapshot.num_of_metadata,
+            num_protocol=snapshot.num_of_protocol,
+            num_transactions=snapshot.num_of_set_transactions,
+        )
+
+
+def write_checksum(store: LogStore, log_path: str, version: int, checksum: VersionChecksum) -> None:
+    """Best-effort write (``Checksum.scala:55-93``)."""
+    if not conf.get("delta.tpu.writeChecksum.enabled"):
+        return
+    try:
+        store.write(
+            f"{log_path}/{filenames.checksum_file(version)}", [checksum.to_json()], overwrite=True
+        )
+    except Exception:  # noqa: BLE001 — checksum write must never fail a commit
+        logger.warning("Failed to write checksum for version %s", version, exc_info=True)
+
+
+def read_checksum(store: LogStore, log_path: str, version: int) -> Optional[VersionChecksum]:
+    try:
+        lines = store.read(f"{log_path}/{filenames.checksum_file(version)}")
+        return VersionChecksum.from_json("".join(lines))
+    except FileNotFoundError:
+        return None
+    except (ValueError, KeyError):
+        logger.warning("Corrupt checksum file for version %s", version)
+        return None
+
+
+def validate_checksum(snapshot) -> None:
+    """Compare stored vs computed state (``Checksum.scala:153-193``)."""
+    stored = read_checksum(snapshot.store, snapshot.delta_log.log_path, snapshot.version)
+    if stored is None:
+        return
+    computed = VersionChecksum.of_snapshot(snapshot)
+    mismatches = []
+    for name in ("table_size_bytes", "num_files", "num_metadata", "num_protocol"):
+        if getattr(stored, name) != getattr(computed, name):
+            mismatches.append(f"{name}: stored={getattr(stored, name)} computed={getattr(computed, name)}")
+    if mismatches:
+        msg = (
+            f"State of version {snapshot.version} doesn't match its checksum: "
+            + "; ".join(mismatches)
+        )
+        if conf.get("delta.tpu.state.corruptionIsFatal"):
+            raise DeltaIllegalStateError(msg)
+        logger.error(msg)

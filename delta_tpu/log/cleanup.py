@@ -1,0 +1,72 @@
+"""Metadata (log) cleanup — delete expired commit/checkpoint files.
+
+Reference: ``MetadataCleanup.scala:27-98`` + ``BufferingLogDeletionIterator``
+in ``DeltaHistoryManager.scala``. A delta/checkpoint file is deletable when
+it is older than the log retention period AND a later checkpoint exists
+covering it. The cutoff is truncated to day granularity, and deletion never
+breaks the monotonized-timestamp invariant: we only delete a prefix of
+versions strictly below the last checkpoint whose file timestamps are below
+the cutoff.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.protocol import filenames
+from delta_tpu.utils.config import DeltaConfigs
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["cleanup_expired_logs"]
+
+MS_PER_DAY = 86_400_000
+
+
+def cleanup_expired_logs(delta_log, snapshot) -> int:
+    """Delete expired log files; returns number deleted."""
+    retention_ms = DeltaConfigs.LOG_RETENTION.from_metadata(snapshot.metadata)
+    now = delta_log.clock()
+    # Day-truncated cutoff (MetadataCleanup.scala:91-97).
+    cutoff = ((now - retention_ms) // MS_PER_DAY) * MS_PER_DAY
+
+    last_ckpt = ckpt_mod.read_last_checkpoint(delta_log.store, delta_log.log_path)
+    if last_ckpt is None:
+        return 0
+    ckpt_version = last_ckpt.version
+
+    prefix = f"{delta_log.log_path}/{filenames.check_version_prefix(0)}"
+    try:
+        statuses = list(delta_log.store.list_from(prefix))
+    except FileNotFoundError:
+        return 0
+
+    # Candidate files: version < last checkpoint version, mtime <= cutoff.
+    # Keep timestamps monotone: stop at the first file (by version) that is
+    # too new — deleting around it would leave holes.
+    by_version: dict = {}
+    for fs in statuses:
+        name = fs.name
+        if filenames.is_delta_file(name) or filenames.is_checkpoint_file(name) or filenames.is_checksum_file(name):
+            v = filenames.get_file_version(name)
+            if v is not None:
+                by_version.setdefault(v, []).append(fs)
+
+    deletable: List = []
+    for v in sorted(by_version):
+        if v >= ckpt_version:
+            break
+        files = by_version[v]
+        if all(f.modification_time <= cutoff for f in files):
+            deletable.extend(files)
+        else:
+            break  # monotonicity: stop at first too-new version
+
+    deleted = 0
+    for fs in deletable:
+        if delta_log.store.delete(fs.path):
+            deleted += 1
+    if deleted:
+        logger.info("Deleted %d expired log files older than %d in %s", deleted, cutoff, delta_log.log_path)
+    return deleted

@@ -1,0 +1,285 @@
+"""DeltaLog: the per-table handle composing the whole log stack.
+
+Reference: ``DeltaLog.scala:59-548``. Composes snapshot management,
+checkpointing, metadata cleanup, checksum, transactions, and log tailing
+behind one object, with a per-resolved-path singleton cache.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.log import checksum as crc_mod
+from delta_tpu.log import snapshot_management as sm
+from delta_tpu.log.snapshot import InitialSnapshot, LogSegment, Snapshot
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import (
+    READER_VERSION,
+    WRITER_VERSION,
+    Action,
+    Metadata,
+    Protocol,
+    actions_from_lines,
+)
+from delta_tpu.storage.logstore import LogStore, get_log_store
+from delta_tpu.utils.config import DeltaConfigs, conf
+from delta_tpu.utils.errors import (
+    DeltaIllegalStateError,
+    ProtocolError,
+    versions_not_contiguous,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DeltaLog"]
+
+
+class DeltaLog:
+    _cache: Dict[str, "DeltaLog"] = {}
+    _cache_lock = threading.Lock()
+
+    def __init__(self, data_path: str, store: Optional[LogStore] = None, clock=None):
+        self.data_path = data_path.rstrip("/")
+        self.log_path = f"{self.data_path}/_delta_log"
+        self.store = store or get_log_store(self.data_path)
+        # Single in-process commit lock (DeltaLog.scala:84). Cross-process
+        # exclusion comes from the LogStore's atomic create.
+        self.lock = threading.RLock()
+        self.clock = clock or (lambda: int(time.time() * 1000))
+        self._snapshot: Optional[Snapshot] = None
+        self._last_update_ms: int = 0
+        self._update_lock = threading.Lock()
+        self._initialize()
+
+    # -- singleton cache (DeltaLog.scala:373-387) -----------------------
+
+    @classmethod
+    def for_table(cls, data_path: str, store: Optional[LogStore] = None, clock=None) -> "DeltaLog":
+        key = data_path.rstrip("/")
+        with cls._cache_lock:
+            dl = cls._cache.get(key)
+            if dl is None or clock is not None or (store is not None and dl.store is not store):
+                dl = DeltaLog(key, store=store, clock=clock)
+                cls._cache[key] = dl
+            return dl
+
+    @classmethod
+    def clear_cache(cls) -> None:
+        with cls._cache_lock:
+            cls._cache.clear()
+
+    @classmethod
+    def invalidate_cache(cls, data_path: str) -> None:
+        with cls._cache_lock:
+            cls._cache.pop(data_path.rstrip("/"), None)
+
+    # -- snapshots -------------------------------------------------------
+
+    def _initialize(self) -> None:
+        self.update()
+
+    @property
+    def unsafe_volatile_snapshot(self) -> Optional[Snapshot]:
+        return self._snapshot
+
+    @property
+    def snapshot(self) -> Snapshot:
+        s = self._snapshot
+        if s is None:
+            s = self.update()
+        return s
+
+    def update(self, stale_ok: bool = False) -> Snapshot:
+        """Re-list the log and install a new Snapshot if the segment changed
+        (``SnapshotManagement.scala:244-330``). With ``stale_ok`` and a fresh
+        enough snapshot, return immediately (the reference's async stale-ok
+        path; we keep it synchronous but honor the staleness limit)."""
+        if stale_ok:
+            limit = conf.get("delta.tpu.stalenessLimitMs")
+            if (
+                limit
+                and self._snapshot is not None
+                and self.clock() - self._last_update_ms < limit
+            ):
+                return self._snapshot
+        with self._update_lock:
+            previous = self._snapshot
+            start_ckpt = None
+            last = ckpt_mod.read_last_checkpoint(self.store, self.log_path)
+            if last is not None:
+                start_ckpt = last.version
+            segment = sm.get_log_segment_for_version(
+                self.store, self.log_path, start_checkpoint=start_ckpt
+            )
+            if segment is None:
+                snap: Snapshot = InitialSnapshot(self)
+            elif previous is not None and previous.segment == segment:
+                self._last_update_ms = self.clock()
+                return previous
+            else:
+                snap = Snapshot(self, segment.version, segment)
+                # Table-id drift detection (SnapshotManagement.scala:305-315) is
+                # done lazily — only when the previous snapshot's state was
+                # already materialized, so update() never forces a full replay.
+                if (
+                    previous is not None
+                    and previous.version >= 0
+                    and "_replay" in previous.__dict__
+                    and "metadata" in previous.__dict__
+                ):
+                    prev_id = previous.metadata.id
+                    new_id = snap.metadata.id
+                    if prev_id != new_id:
+                        logger.warning(
+                            "Change in the table id detected for %s: was %s, now %s",
+                            self.data_path, prev_id, new_id,
+                        )
+            self._snapshot = snap
+            self._last_update_ms = self.clock()
+            return snap
+
+    def get_snapshot_at(self, version: int) -> Snapshot:
+        return sm.get_snapshot_at(self, version)
+
+    @property
+    def table_exists(self) -> bool:
+        return self.snapshot.version >= 0
+
+    # -- transactions ----------------------------------------------------
+
+    def start_transaction(self):
+        from delta_tpu.txn.transaction import OptimisticTransaction
+
+        self.update()
+        return OptimisticTransaction(self)
+
+    def with_new_transaction(self, thunk):
+        """Run ``thunk(txn)`` with the active-transaction ambient set
+        (``DeltaLog.scala:183-191``)."""
+        from delta_tpu.txn.transaction import OptimisticTransaction
+
+        txn = self.start_transaction()
+        token = OptimisticTransaction.set_active(txn)
+        try:
+            return thunk(txn)
+        finally:
+            OptimisticTransaction.clear_active(token)
+
+    # -- log tailing (DeltaLog.scala:222-238) ----------------------------
+
+    def get_changes(
+        self, start_version: int, fail_on_data_loss: bool = False
+    ) -> Iterator[Tuple[int, List[Action]]]:
+        """Yield (version, actions) for every commit >= start_version."""
+        prefix = f"{self.log_path}/{filenames.check_version_prefix(start_version)}"
+        last_seen: Optional[int] = None
+        try:
+            statuses = list(self.store.list_from(prefix))
+        except FileNotFoundError:
+            statuses = []
+        for fs in statuses:
+            if not filenames.is_delta_file(fs.name):
+                continue
+            v = filenames.delta_version(fs.name)
+            if fail_on_data_loss and last_seen is None and v > start_version:
+                raise DeltaIllegalStateError(
+                    f"Events were deleted: expected version {start_version}, first available {v}"
+                )
+            if last_seen is not None and v > last_seen + 1:
+                raise versions_not_contiguous([last_seen, v])
+            last_seen = v
+            yield v, actions_from_lines(self.store.read_iter(fs.path))
+
+    # -- protocol gating (DeltaLog.scala:248-275) ------------------------
+
+    def assert_protocol_read(self, protocol: Protocol) -> None:
+        if protocol is not None and READER_VERSION < protocol.min_reader_version:
+            raise ProtocolError(
+                f"Table requires reader version {protocol.min_reader_version}, "
+                f"but this client supports up to {READER_VERSION}."
+            )
+
+    def assert_protocol_write(self, protocol: Protocol, log_upgrade_message: bool = True) -> None:
+        if protocol is not None and WRITER_VERSION < protocol.min_writer_version:
+            raise ProtocolError(
+                f"Table requires writer version {protocol.min_writer_version}, "
+                f"but this client supports up to {WRITER_VERSION}."
+            )
+
+    def upgrade_protocol(self, new_protocol: Protocol) -> None:
+        """Explicit protocol upgrade (DeltaLog.scala:198-216)."""
+        snap = self.update()
+        current = snap.protocol
+        if (
+            current.min_reader_version >= new_protocol.min_reader_version
+            and current.min_writer_version >= new_protocol.min_writer_version
+        ):
+            logger.info("Table already at protocol %s; skipping upgrade", current)
+            return
+        if (
+            new_protocol.min_reader_version < current.min_reader_version
+            or new_protocol.min_writer_version < current.min_writer_version
+        ):
+            raise ProtocolError(
+                f"Protocol version cannot be downgraded from {current} to {new_protocol}"
+            )
+        from delta_tpu.txn.transaction import OptimisticTransaction
+        from delta_tpu.commands.operations import UpgradeProtocol
+
+        txn = self.start_transaction()
+        txn.new_protocol = new_protocol
+        txn.commit([], UpgradeProtocol(new_protocol))
+
+    # -- checkpointing ---------------------------------------------------
+
+    def checkpoint(self, snapshot: Optional[Snapshot] = None) -> ckpt_mod.CheckpointMetaData:
+        """Write a checkpoint of ``snapshot`` (default: current) and update
+        ``_last_checkpoint`` (``Checkpoints.scala:221-260``)."""
+        snap = snapshot or self.update()
+        if snap.version < 0:
+            raise DeltaIllegalStateError("Cannot checkpoint an uninitialized table")
+        actions = snap.checkpoint_actions()
+        part_size = conf.get("delta.tpu.checkpointPartSize")
+        md = ckpt_mod.write_checkpoint(
+            self.store, self.log_path, snap.version, actions, part_size=part_size
+        )
+        self.cleanup_expired_logs(snap)
+        return md
+
+    def cleanup_expired_logs(self, snapshot: Snapshot) -> None:
+        from delta_tpu.log.cleanup import cleanup_expired_logs
+
+        try:
+            if DeltaConfigs.ENABLE_EXPIRED_LOG_CLEANUP.from_metadata(snapshot.metadata):
+                cleanup_expired_logs(self, snapshot)
+        except Exception:  # noqa: BLE001 — cleanup must not fail commits
+            logger.warning("Metadata cleanup failed", exc_info=True)
+
+    # -- post-commit hook from transactions ------------------------------
+
+    def update_after_commit(self, committed_version: int, new_snapshot_hint: Optional[Snapshot] = None) -> Snapshot:
+        snap = self.update()
+        if snap.version < committed_version:
+            raise DeltaIllegalStateError(
+                f"The committed version is {committed_version} but the current version is {snap.version}"
+            )
+        return snap
+
+    def write_checksum_for(self, snapshot: Snapshot) -> None:
+        crc_mod.write_checksum(
+            self.store, self.log_path, snapshot.version, crc_mod.VersionChecksum.of_snapshot(snapshot)
+        )
+
+    # -- history ---------------------------------------------------------
+
+    @property
+    def history(self):
+        from delta_tpu.log.history import DeltaHistoryManager
+
+        return DeltaHistoryManager(self)
+
+    def __repr__(self) -> str:
+        return f"DeltaLog({self.data_path!r}, v={self._snapshot.version if self._snapshot else '?'})"

@@ -1,0 +1,135 @@
+"""Action reconciliation ("log replay").
+
+Pure semantics, matching ``PROTOCOL.md`` "Action Reconciliation" and the
+reference's ``actions/InMemoryLogReplay.scala:35-78``:
+
+* latest ``Protocol`` wins;
+* latest ``Metadata`` wins;
+* latest ``SetTransaction`` per ``appId`` wins;
+* last ``AddFile`` per path wins; a ``RemoveFile`` tombstones an Add;
+* an ``AddFile`` after a ``RemoveFile`` un-tombstones the path;
+* tombstones older than ``min_file_retention_timestamp`` are dropped from
+  the output state (they only exist so VACUUM and concurrent readers can
+  see recently-deleted files).
+
+This host-side replay is the correctness reference; the device-sharded
+replay kernel (``delta_tpu.ops.replay_kernel``) computes the same fixpoint
+as a segmented sort + last-wins reduce and is validated against this one.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from delta_tpu.protocol.actions import (
+    Action,
+    AddCDCFile,
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+)
+
+__all__ = ["LogReplay"]
+
+
+class LogReplay:
+    def __init__(self, min_file_retention_timestamp: int = 0):
+        self.min_file_retention_timestamp = min_file_retention_timestamp
+        self.current_protocol: Optional[Protocol] = None
+        self.current_metadata: Optional[Metadata] = None
+        self.current_version: int = -1
+        self.transactions: Dict[str, SetTransaction] = {}
+        self.active_files: Dict[str, AddFile] = {}
+        self._tombstones: Dict[str, RemoveFile] = {}
+
+    def append(self, version: int, actions: Iterable[Action]) -> None:
+        """Replay one commit's actions. Versions must be fed in order."""
+        assert self.current_version == -1 or version == self.current_version + 1, (
+            f"Attempted to replay version {version} after {self.current_version}"
+        )
+        self.current_version = version
+        for a in actions:
+            if isinstance(a, SetTransaction):
+                self.transactions[a.app_id] = a
+            elif isinstance(a, Metadata):
+                self.current_metadata = a
+            elif isinstance(a, Protocol):
+                self.current_protocol = a
+            elif isinstance(a, AddFile):
+                canonical = canonicalize_path(a.path)
+                # Add wins over any prior state of the path.
+                self.active_files[canonical] = (
+                    a if a.path == canonical else _with_path(a, canonical)
+                )
+                self._tombstones.pop(canonical, None)
+            elif isinstance(a, RemoveFile):
+                canonical = canonicalize_path(a.path)
+                self.active_files.pop(canonical, None)
+                self._tombstones[canonical] = (
+                    a if a.path == canonical else _remove_with_path(a, canonical)
+                )
+            elif isinstance(a, (CommitInfo, AddCDCFile)):
+                pass  # not part of reconciled state
+            elif a is None:
+                pass
+            else:
+                raise ValueError(f"Unknown action during replay: {a!r}")
+
+    # -- outputs ---------------------------------------------------------
+
+    def get_tombstones(self) -> List[RemoveFile]:
+        """Un-expired tombstones (InMemoryLogReplay.scala:66-69)."""
+        return [
+            r
+            for r in self._tombstones.values()
+            if r.delete_timestamp > self.min_file_retention_timestamp
+        ]
+
+    def checkpoint_actions(self) -> List[Action]:
+        """The complete reconciled state, the content of a checkpoint
+        (InMemoryLogReplay.scala:71-77): protocol, metadata, txns, tombstones,
+        active files (with ``dataChange=False`` normalization)."""
+        out: List[Action] = []
+        if self.current_protocol is not None:
+            out.append(self.current_protocol)
+        if self.current_metadata is not None:
+            out.append(self.current_metadata)
+        out.extend(self.transactions.values())
+        out.extend(
+            _remove_no_datachange(r) for r in self.get_tombstones()
+        )
+        out.extend(a.with_data_change(False) for a in self.active_files.values())
+        return out
+
+
+def canonicalize_path(path: str) -> str:
+    """Normalize a file path for replay identity (≈ ``Snapshot.canonicalizePath``).
+
+    Relative paths stay as-is (they are relative to the table root and
+    percent-decoded by scan time, not here); absolute URIs are kept whole so
+    shallow-cloned / converted tables still reconcile correctly."""
+    # Strip a redundant "./" prefix; leave everything else untouched. Path
+    # identity in the log is exact-string based apart from this.
+    while path.startswith("./"):
+        path = path[2:]
+    return path
+
+
+def _with_path(a: AddFile, path: str) -> AddFile:
+    from dataclasses import replace
+
+    return replace(a, path=path)
+
+
+def _remove_with_path(r: RemoveFile, path: str) -> RemoveFile:
+    from dataclasses import replace
+
+    return replace(r, path=path)
+
+
+def _remove_no_datachange(r: RemoveFile) -> RemoveFile:
+    from dataclasses import replace
+
+    return replace(r, data_change=False)

@@ -1,0 +1,258 @@
+"""Snapshot: immutable table state at a version.
+
+Reference: ``Snapshot.scala:55-410``. The reference reconstructs state as a
+50-partition Spark Dataset replay; here state reconstruction has two paths:
+
+* **host path** (this module): stream checkpoint Parquet + delta JSON through
+  :class:`delta_tpu.log.replay.LogReplay` — exact, used for all transactional
+  decisions;
+* **device path** (``delta_tpu.ops.replay_kernel``): the AddFile metadata is
+  exported as fixed-width columns (:meth:`Snapshot.files_arrays`) and the
+  last-writer-wins reconciliation / pruning run as sharded JAX kernels over a
+  device mesh — used for scan planning and the checkpoint-replay benchmark.
+"""
+from __future__ import annotations
+
+import json
+import time
+from functools import cached_property
+from typing import Any, Dict, List, Optional, Sequence, TYPE_CHECKING
+
+from delta_tpu.log.replay import LogReplay
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import (
+    Action,
+    AddFile,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    actions_from_lines,
+)
+from delta_tpu.storage.logstore import FileStatus, LogStore
+from delta_tpu.utils.errors import DeltaIllegalStateError
+from delta_tpu.utils.config import DeltaConfigs
+
+if TYPE_CHECKING:
+    from delta_tpu.log.deltalog import DeltaLog
+
+__all__ = ["LogSegment", "Snapshot", "InitialSnapshot"]
+
+
+class LogSegment:
+    """The files that define a version: checkpoint parts + contiguous deltas
+    after it (``SnapshotManagement.scala:394-421``)."""
+
+    def __init__(
+        self,
+        log_path: str,
+        version: int,
+        deltas: Sequence[FileStatus],
+        checkpoint_files: Sequence[FileStatus] = (),
+        checkpoint_version: Optional[int] = None,
+        last_commit_timestamp: int = 0,
+    ):
+        self.log_path = log_path
+        self.version = version
+        self.deltas = list(deltas)
+        self.checkpoint_files = list(checkpoint_files)
+        self.checkpoint_version = checkpoint_version
+        self.last_commit_timestamp = last_commit_timestamp
+
+    def __eq__(self, other: Any) -> bool:
+        """Segment equivalence for early-exit update
+        (``SnapshotManagement.scala:286-330``)."""
+        if not isinstance(other, LogSegment):
+            return False
+        return (
+            self.log_path == other.log_path
+            and self.version == other.version
+            and [f.path for f in self.deltas] == [f.path for f in other.deltas]
+            and [f.path for f in self.checkpoint_files] == [f.path for f in other.checkpoint_files]
+        )
+
+    @staticmethod
+    def empty(log_path: str) -> "LogSegment":
+        return LogSegment(log_path, -1, [])
+
+    def __repr__(self) -> str:
+        return (
+            f"LogSegment(v={self.version}, ckpt={self.checkpoint_version}, "
+            f"deltas={[f.name for f in self.deltas]})"
+        )
+
+
+class Snapshot:
+    def __init__(
+        self,
+        delta_log: "DeltaLog",
+        version: int,
+        segment: LogSegment,
+        min_file_retention_timestamp: Optional[int] = None,
+        timestamp: Optional[int] = None,
+    ):
+        self.delta_log = delta_log
+        self.version = version
+        self.segment = segment
+        self.timestamp = timestamp if timestamp is not None else segment.last_commit_timestamp
+        self._min_file_retention_timestamp = min_file_retention_timestamp
+
+    # -- state reconstruction -------------------------------------------
+
+    @property
+    def store(self) -> LogStore:
+        return self.delta_log.store
+
+    def min_file_retention_timestamp(self) -> int:
+        if self._min_file_retention_timestamp is not None:
+            return self._min_file_retention_timestamp
+        retention = DeltaConfigs.TOMBSTONE_RETENTION.from_metadata(self.metadata)
+        return self.delta_log.clock() - retention
+
+    @cached_property
+    def _replay(self) -> LogReplay:
+        """Replay checkpoint + deltas (``Snapshot.scala:88-111``)."""
+        # Tombstone expiry needs metadata (retention conf) which itself comes
+        # from replay; do a first pass with retention 0 then compute cutoff.
+        replay = LogReplay(min_file_retention_timestamp=0)
+        ckpt_actions = self._checkpoint_actions()
+        if ckpt_actions:
+            base_version = self.segment.checkpoint_version
+            replay.current_version = base_version - 1 if base_version is not None else -1
+            replay.append(base_version if base_version is not None else 0, ckpt_actions)
+        for fs in self.segment.deltas:
+            v = filenames.delta_version(fs.name)
+            replay.append(v, actions_from_lines(self.store.read_iter(fs.path)))
+        if replay.current_version == -1 and self.version >= 0:
+            replay.current_version = self.version
+        return replay
+
+    def _checkpoint_actions(self) -> List[Action]:
+        if not self.segment.checkpoint_files:
+            return []
+        return ckpt_mod.read_checkpoint_actions(
+            self.store, [f.path for f in self.segment.checkpoint_files]
+        )
+
+    # -- reconciled state ------------------------------------------------
+
+    @cached_property
+    def protocol(self) -> Protocol:
+        p = self._replay.current_protocol
+        if p is None:
+            return Protocol()
+        return p
+
+    @cached_property
+    def metadata(self) -> Metadata:
+        m = self._replay.current_metadata
+        if m is None:
+            return Metadata()
+        return m
+
+    @cached_property
+    def set_transactions(self) -> Dict[str, SetTransaction]:
+        return dict(self._replay.transactions)
+
+    def transaction_version(self, app_id: str) -> int:
+        t = self.set_transactions.get(app_id)
+        return t.version if t else -1
+
+    @cached_property
+    def all_files(self) -> List[AddFile]:
+        """Active AddFiles sorted by path (deterministic scan order)."""
+        return sorted(self._replay.active_files.values(), key=lambda a: a.path)
+
+    @cached_property
+    def tombstones(self) -> List[RemoveFile]:
+        cutoff = self.min_file_retention_timestamp()
+        return [r for r in self._replay.get_tombstones() if r.delete_timestamp > cutoff]
+
+    @property
+    def num_of_files(self) -> int:
+        return len(self.all_files)
+
+    @property
+    def size_in_bytes(self) -> int:
+        return sum(a.size for a in self.all_files)
+
+    @property
+    def num_of_metadata(self) -> int:
+        return 1 if self._replay.current_metadata is not None else 0
+
+    @property
+    def num_of_protocol(self) -> int:
+        return 1 if self._replay.current_protocol is not None else 0
+
+    @property
+    def num_of_removes(self) -> int:
+        return len(self.tombstones)
+
+    @property
+    def num_of_set_transactions(self) -> int:
+        return len(self.set_transactions)
+
+    @property
+    def schema(self):
+        return self.metadata.schema
+
+    @property
+    def partition_columns(self) -> List[str]:
+        return self.metadata.partition_columns
+
+    def checkpoint_actions(self) -> List[Action]:
+        replay = self._replay
+        replay.min_file_retention_timestamp = self.min_file_retention_timestamp()
+        return replay.checkpoint_actions()
+
+    def checkpoint_size_estimate(self) -> int:
+        return (
+            self.num_of_files
+            + self.num_of_removes
+            + self.num_of_set_transactions
+            + self.num_of_metadata
+            + self.num_of_protocol
+        )
+
+    # -- columnar export for the device path -----------------------------
+
+    def files_arrays(self, stats_columns: Optional[Sequence[str]] = None):
+        """Export AddFile metadata as numpy columns for the device scan planner
+        (path dictionary stays on host; hashes/sizes/stats go to HBM).
+        See ``delta_tpu.ops.pruning``."""
+        from delta_tpu.ops.state_export import files_to_arrays
+
+        return files_to_arrays(self.all_files, self.metadata, stats_columns)
+
+    def __repr__(self) -> str:
+        return f"Snapshot(version={self.version}, files={len(self.all_files)})"
+
+
+class InitialSnapshot(Snapshot):
+    """Snapshot of a table that has no commits yet
+    (``Snapshot.scala:392-410``)."""
+
+    def __init__(self, delta_log: "DeltaLog", metadata: Optional[Metadata] = None):
+        super().__init__(
+            delta_log,
+            version=-1,
+            segment=LogSegment.empty(delta_log.log_path),
+            min_file_retention_timestamp=0,
+            timestamp=-1,
+        )
+        self._initial_metadata = metadata or Metadata(
+            configuration=DeltaConfigs.merge_global_configs({})
+        )
+
+    @cached_property
+    def _replay(self) -> LogReplay:
+        return LogReplay(0)
+
+    @cached_property
+    def metadata(self) -> Metadata:
+        return self._initial_metadata
+
+    @cached_property
+    def protocol(self) -> Protocol:
+        return Protocol()

@@ -1,0 +1,155 @@
+"""Log-segment computation and snapshot updates.
+
+Reference: ``SnapshotManagement.scala:44-373``. Given a log directory, work
+out which checkpoint parts + contiguous delta files define a version, verify
+contiguity/completeness, and build Snapshots — including time travel
+(``getSnapshotAt``) and cheap ``update()`` with early exit when the segment
+is unchanged.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, TYPE_CHECKING
+
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.log.checkpoints import CheckpointInstance
+from delta_tpu.log.snapshot import LogSegment, Snapshot
+from delta_tpu.protocol import filenames
+from delta_tpu.storage.logstore import FileStatus, LogStore
+from delta_tpu.utils.errors import (
+    DeltaFileNotFoundError,
+    DeltaIllegalStateError,
+    VersionNotFoundError,
+    versions_not_contiguous,
+)
+
+if TYPE_CHECKING:
+    from delta_tpu.log.deltalog import DeltaLog
+
+__all__ = ["list_log_files", "get_log_segment_for_version", "verify_delta_versions"]
+
+
+def list_log_files(store: LogStore, log_path: str, start_version: int) -> List[FileStatus]:
+    """List delta/checkpoint files with version >= start_version
+    (``SnapshotManagement.scala:57-65``)."""
+    prefix = f"{log_path}/{filenames.check_version_prefix(start_version)}"
+    out: List[FileStatus] = []
+    try:
+        for fs in store.list_from(prefix):
+            if filenames.is_delta_file(fs.name) or filenames.is_checkpoint_file(fs.name):
+                out.append(fs)
+    except FileNotFoundError:
+        return []
+    return out
+
+
+def verify_delta_versions(versions: List[int], expected_start: Optional[int] = None,
+                          expected_end: Optional[int] = None) -> None:
+    """Contiguity check (``SnapshotManagement.scala:365-372``)."""
+    if versions:
+        if versions != list(range(versions[0], versions[-1] + 1)):
+            raise versions_not_contiguous(versions)
+    if expected_start is not None and (not versions or versions[0] != expected_start):
+        raise DeltaIllegalStateError(
+            f"Did not get the first delta file version {expected_start} to compute snapshot"
+        )
+    if expected_end is not None and (not versions or versions[-1] != expected_end):
+        raise DeltaIllegalStateError(
+            f"Did not get the last delta file version {expected_end} to compute snapshot"
+        )
+
+
+def get_log_segment_for_version(
+    store: LogStore,
+    log_path: str,
+    version_to_load: Optional[int] = None,
+    start_checkpoint: Optional[int] = None,
+) -> Optional[LogSegment]:
+    """Compute the LogSegment for a version (latest if None), starting the
+    listing at ``start_checkpoint`` (from ``_last_checkpoint``) when given
+    (``SnapshotManagement.scala:82-179``). Returns None when the directory
+    has no delta files at all (uninitialized table)."""
+    if version_to_load is not None and start_checkpoint is not None and start_checkpoint > version_to_load:
+        start_checkpoint = None  # pointer is past the requested version: list from scratch
+    list_start = start_checkpoint or 0
+    files = [f for f in list_log_files(store, log_path, list_start) if f.size > 0 or filenames.is_delta_file(f.name)]
+
+    if version_to_load is not None:
+        files = [f for f in files if (filenames.get_file_version(f.name) or 0) <= version_to_load]
+
+    if not files:
+        if start_checkpoint:
+            # _last_checkpoint points at a vanished checkpoint: re-list from 0
+            # (SnapshotManagement.scala:118-126).
+            return get_log_segment_for_version(store, log_path, version_to_load, None)
+        return None
+
+    checkpoint_candidates: List[CheckpointInstance] = []
+    checkpoint_statuses = {}
+    deltas: List[FileStatus] = []
+    for f in files:
+        if filenames.is_checkpoint_file(f.name) and f.size > 0:
+            v = filenames.checkpoint_version(f.name)
+            part = filenames.checkpoint_part(f.name)
+            inst = CheckpointInstance(v, part[1] if part else None)
+            checkpoint_candidates.append(inst)
+            checkpoint_statuses.setdefault(inst, []).append(f)
+        elif filenames.is_delta_file(f.name):
+            deltas.append(f)
+
+    latest_checkpoint = ckpt_mod.latest_complete_checkpoint(
+        checkpoint_candidates, not_later_than=version_to_load
+    )
+
+    if latest_checkpoint is not None:
+        ckpt_version = latest_checkpoint.version
+        ckpt_files = sorted(checkpoint_statuses[latest_checkpoint], key=lambda s: s.name)
+        deltas_after = [f for f in deltas if filenames.delta_version(f.name) > ckpt_version]
+        versions = sorted(filenames.delta_version(f.name) for f in deltas_after)
+        deltas_after.sort(key=lambda f: filenames.delta_version(f.name))
+        if versions:
+            verify_delta_versions(versions, expected_start=ckpt_version + 1)
+            new_version = versions[-1]
+        else:
+            new_version = ckpt_version
+        if version_to_load is not None and new_version != version_to_load:
+            # requested version not reachable
+            raise DeltaIllegalStateError(
+                f"Trying to load version {version_to_load} but log only goes to {new_version}"
+            )
+        last_ts = deltas_after[-1].modification_time if deltas_after else (
+            ckpt_files[-1].modification_time if ckpt_files else 0
+        )
+        return LogSegment(log_path, new_version, deltas_after, ckpt_files, ckpt_version, last_ts)
+
+    # No complete checkpoint in the listing. If we trusted a _last_checkpoint
+    # pointer, it lied (checkpoint deleted/corrupt): recover by re-listing the
+    # whole log from 0 (``SnapshotManagement.scala:118-126``).
+    if start_checkpoint:
+        return get_log_segment_for_version(store, log_path, version_to_load, None)
+    deltas.sort(key=lambda f: filenames.delta_version(f.name))
+    versions = [filenames.delta_version(f.name) for f in deltas]
+    if not versions:
+        return None
+    verify_delta_versions(versions, expected_start=0, expected_end=version_to_load)
+    return LogSegment(
+        log_path, versions[-1], deltas, [], None, deltas[-1].modification_time
+    )
+
+
+def get_snapshot_at(delta_log: "DeltaLog", version: int) -> Snapshot:
+    """Time travel to ``version`` (``SnapshotManagement.scala:342-360``)."""
+    current = delta_log.unsafe_volatile_snapshot
+    if current is not None and current.version == version:
+        return current
+    start_ckpt = None
+    found = ckpt_mod.find_last_complete_checkpoint_before(
+        delta_log.store, delta_log.log_path, version + 1
+    )
+    if found is not None and found.version <= version:
+        start_ckpt = found.version
+    segment = get_log_segment_for_version(
+        delta_log.store, delta_log.log_path, version_to_load=version, start_checkpoint=start_ckpt
+    )
+    if segment is None:
+        raise VersionNotFoundError(version, 0, current.version if current else -1)
+    return Snapshot(delta_log, segment.version, segment)

@@ -1,0 +1,98 @@
+"""Canonical log-file naming (reference: ``util/FileNames.scala:23-109``).
+
+Kept byte-identical for on-disk compatibility:
+  ``%020d.json``                                — delta commit
+  ``%020d.checkpoint.parquet``                  — single-part checkpoint
+  ``%020d.checkpoint.%010d.%010d.parquet``      — multi-part checkpoint
+  ``%020d.crc``                                 — version checksum
+  ``_last_checkpoint``                          — checkpoint pointer
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+DELTA_FILE_RE = re.compile(r"^(\d+)\.json$")
+CHECKSUM_FILE_RE = re.compile(r"^(\d+)\.crc$")
+CHECKPOINT_FILE_RE = re.compile(r"^(\d+)\.checkpoint(\.(\d+)\.(\d+))?\.parquet$")
+
+LAST_CHECKPOINT = "_last_checkpoint"
+
+
+def delta_file(version: int) -> str:
+    return "%020d.json" % version
+
+
+def checksum_file(version: int) -> str:
+    return "%020d.crc" % version
+
+
+def checkpoint_file_single(version: int) -> str:
+    return "%020d.checkpoint.parquet" % version
+
+
+def checkpoint_file_with_parts(version: int, num_parts: int) -> List[str]:
+    return [
+        "%020d.checkpoint.%010d.%010d.parquet" % (version, i + 1, num_parts)
+        for i in range(num_parts)
+    ]
+
+
+def is_delta_file(name: str) -> bool:
+    return DELTA_FILE_RE.match(_basename(name)) is not None
+
+
+def is_checkpoint_file(name: str) -> bool:
+    return CHECKPOINT_FILE_RE.match(_basename(name)) is not None
+
+
+def is_checksum_file(name: str) -> bool:
+    return CHECKSUM_FILE_RE.match(_basename(name)) is not None
+
+
+def delta_version(name: str) -> int:
+    m = DELTA_FILE_RE.match(_basename(name))
+    if not m:
+        raise ValueError(f"not a delta file: {name}")
+    return int(m.group(1))
+
+
+def checkpoint_version(name: str) -> int:
+    m = CHECKPOINT_FILE_RE.match(_basename(name))
+    if not m:
+        raise ValueError(f"not a checkpoint file: {name}")
+    return int(m.group(1))
+
+
+def checkpoint_part(name: str) -> Optional[Tuple[int, int]]:
+    """Returns (part, num_parts) for a multi-part checkpoint file, else None."""
+    m = CHECKPOINT_FILE_RE.match(_basename(name))
+    if not m or m.group(2) is None:
+        return None
+    return int(m.group(3)), int(m.group(4))
+
+
+def checksum_version(name: str) -> int:
+    m = CHECKSUM_FILE_RE.match(_basename(name))
+    if not m:
+        raise ValueError(f"not a checksum file: {name}")
+    return int(m.group(1))
+
+
+def get_file_version(name: str) -> Optional[int]:
+    base = _basename(name)
+    for rx in (DELTA_FILE_RE, CHECKSUM_FILE_RE, CHECKPOINT_FILE_RE):
+        m = rx.match(base)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def _basename(name: str) -> str:
+    return name.rsplit("/", 1)[-1]
+
+
+def check_version_prefix(low: int) -> str:
+    """Prefix string such that listing from it returns all files with
+    version >= low (files are zero-padded so lexicographic order == numeric)."""
+    return "%020d." % low

@@ -1,0 +1,436 @@
+"""Schema type system for delta-tpu.
+
+A minimal, self-contained implementation of the Spark-SQL JSON schema format that
+Delta's ``Metadata.schemaString`` uses (reference: ``PROTOCOL.md`` "Schema
+Serialization Format"; consumed in ``actions/actions.scala:348-393``). We keep the
+serialized form byte-compatible so tables written by the reference can be read and
+vice versa, but the in-memory representation is our own and maps onto pyarrow (host
+columnar) and numpy/JAX dtypes (device columnar).
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DataType",
+    "AtomicType",
+    "ArrayType",
+    "MapType",
+    "StructField",
+    "StructType",
+    "parse_data_type",
+    "schema_from_json",
+    "BooleanType",
+    "ByteType",
+    "ShortType",
+    "IntegerType",
+    "LongType",
+    "FloatType",
+    "DoubleType",
+    "StringType",
+    "BinaryType",
+    "DateType",
+    "TimestampType",
+    "DecimalType",
+    "NullType",
+]
+
+
+class DataType:
+    """Base of the type hierarchy."""
+
+    #: Spark-SQL JSON name, e.g. "integer"
+    name: str = ""
+
+    def json_value(self) -> Any:
+        return self.name
+
+    def to_json(self) -> str:
+        return json.dumps(self.json_value(), separators=(",", ":"))
+
+    def simple_string(self) -> str:
+        return self.name
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, tuple(sorted(self.__dict__.items(), key=lambda kv: kv[0]))))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+class AtomicType(DataType):
+    pass
+
+
+class NullType(AtomicType):
+    name = "null"
+
+
+class BooleanType(AtomicType):
+    name = "boolean"
+
+
+class ByteType(AtomicType):
+    name = "byte"
+
+
+class ShortType(AtomicType):
+    name = "short"
+
+
+class IntegerType(AtomicType):
+    name = "integer"
+
+
+class LongType(AtomicType):
+    name = "long"
+
+
+class FloatType(AtomicType):
+    name = "float"
+
+
+class DoubleType(AtomicType):
+    name = "double"
+
+
+class StringType(AtomicType):
+    name = "string"
+
+
+class BinaryType(AtomicType):
+    name = "binary"
+
+
+class DateType(AtomicType):
+    name = "date"
+
+
+class TimestampType(AtomicType):
+    name = "timestamp"
+
+
+class DecimalType(AtomicType):
+    def __init__(self, precision: int = 10, scale: int = 0):
+        self.precision = precision
+        self.scale = scale
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    def __repr__(self) -> str:
+        return f"DecimalType({self.precision},{self.scale})"
+
+
+class ArrayType(DataType):
+    def __init__(self, element_type: DataType, contains_null: bool = True):
+        self.element_type = element_type
+        self.contains_null = contains_null
+
+    def json_value(self) -> Any:
+        return {
+            "type": "array",
+            "elementType": self.element_type.json_value(),
+            "containsNull": self.contains_null,
+        }
+
+    def simple_string(self) -> str:
+        return f"array<{self.element_type.simple_string()}>"
+
+    def __repr__(self) -> str:
+        return f"ArrayType({self.element_type!r}, {self.contains_null})"
+
+
+class MapType(DataType):
+    def __init__(self, key_type: DataType, value_type: DataType, value_contains_null: bool = True):
+        self.key_type = key_type
+        self.value_type = value_type
+        self.value_contains_null = value_contains_null
+
+    def json_value(self) -> Any:
+        return {
+            "type": "map",
+            "keyType": self.key_type.json_value(),
+            "valueType": self.value_type.json_value(),
+            "valueContainsNull": self.value_contains_null,
+        }
+
+    def simple_string(self) -> str:
+        return f"map<{self.key_type.simple_string()},{self.value_type.simple_string()}>"
+
+    def __repr__(self) -> str:
+        return f"MapType({self.key_type!r}, {self.value_type!r}, {self.value_contains_null})"
+
+
+@dataclass
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def json_value(self) -> Any:
+        return {
+            "name": self.name,
+            "type": self.data_type.json_value(),
+            "nullable": self.nullable,
+            "metadata": self.metadata,
+        }
+
+
+class StructType(DataType):
+    def __init__(self, fields: Optional[List[StructField]] = None):
+        self.fields: List[StructField] = list(fields or [])
+
+    def json_value(self) -> Any:
+        return {"type": "struct", "fields": [f.json_value() for f in self.fields]}
+
+    def simple_string(self) -> str:
+        inner = ",".join(f"{f.name}:{f.data_type.simple_string()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def add(self, name: str, data_type: DataType, nullable: bool = True,
+            metadata: Optional[Dict[str, Any]] = None) -> "StructType":
+        self.fields.append(StructField(name, data_type, nullable, dict(metadata or {})))
+        return self
+
+    @property
+    def field_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def __getitem__(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, StructType) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.to_json())
+
+    def __repr__(self) -> str:
+        return f"StructType({self.fields!r})"
+
+
+_ATOMIC_TYPES: Dict[str, DataType] = {
+    t.name: t()
+    for t in (
+        NullType,
+        BooleanType,
+        ByteType,
+        ShortType,
+        IntegerType,
+        LongType,
+        FloatType,
+        DoubleType,
+        StringType,
+        BinaryType,
+        DateType,
+        TimestampType,
+    )
+}
+# Spark accepts a few aliases in schema JSON.
+_ATOMIC_ALIASES = {
+    "int": "integer",
+    "bigint": "long",
+    "smallint": "short",
+    "tinyint": "byte",
+}
+
+_DECIMAL_RE = re.compile(r"decimal\(\s*(\d+)\s*,\s*(-?\d+)\s*\)")
+
+
+def parse_data_type(obj: Any) -> DataType:
+    """Parse the JSON value form of a data type (string or nested dict)."""
+    if isinstance(obj, str):
+        s = _ATOMIC_ALIASES.get(obj, obj)
+        if s in _ATOMIC_TYPES:
+            return _ATOMIC_TYPES[s]
+        m = _DECIMAL_RE.fullmatch(s)
+        if m:
+            return DecimalType(int(m.group(1)), int(m.group(2)))
+        if s == "decimal":
+            return DecimalType(10, 0)
+        raise ValueError(f"Unsupported data type: {obj!r}")
+    if isinstance(obj, dict):
+        t = obj.get("type")
+        if t == "struct":
+            return StructType(
+                [
+                    StructField(
+                        f["name"],
+                        parse_data_type(f["type"]),
+                        bool(f.get("nullable", True)),
+                        dict(f.get("metadata") or {}),
+                    )
+                    for f in obj.get("fields", [])
+                ]
+            )
+        if t == "array":
+            return ArrayType(parse_data_type(obj["elementType"]), bool(obj.get("containsNull", True)))
+        if t == "map":
+            return MapType(
+                parse_data_type(obj["keyType"]),
+                parse_data_type(obj["valueType"]),
+                bool(obj.get("valueContainsNull", True)),
+            )
+        if t == "udt":  # not supported; treat underlying sql type if present
+            if "sqlType" in obj:
+                return parse_data_type(obj["sqlType"])
+    raise ValueError(f"Unsupported data type JSON: {obj!r}")
+
+
+def schema_from_json(s: str) -> StructType:
+    dt = parse_data_type(json.loads(s))
+    if not isinstance(dt, StructType):
+        raise ValueError("schema JSON must be a struct type")
+    return dt
+
+
+# ---------------------------------------------------------------------------
+# pyarrow interop
+# ---------------------------------------------------------------------------
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        # Spark timestamps are microsecond-precision UTC-normalized.
+        return pa.timestamp("us")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, NullType):
+        return pa.null()
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow_type(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.key_type), to_arrow_type(dt.value_type))
+    if isinstance(dt, StructType):
+        return pa.struct([(f.name, to_arrow_type(f.data_type)) for f in dt.fields])
+    raise ValueError(f"No arrow mapping for {dt!r}")
+
+
+def to_arrow_schema(schema: StructType):
+    import pyarrow as pa
+
+    return pa.schema([pa.field(f.name, to_arrow_type(f.data_type), f.nullable) for f in schema.fields])
+
+
+def from_arrow_type(at) -> DataType:
+    import pyarrow as pa
+    import pyarrow.types as pat
+
+    if pat.is_boolean(at):
+        return BooleanType()
+    if pat.is_int8(at):
+        return ByteType()
+    if pat.is_int16(at):
+        return ShortType()
+    if pat.is_int32(at):
+        return IntegerType()
+    if pat.is_int64(at):
+        return LongType()
+    if pat.is_uint8(at):
+        return ShortType()
+    if pat.is_uint16(at):
+        return IntegerType()
+    if pat.is_uint32(at) or pat.is_uint64(at):
+        return LongType()
+    if pat.is_float32(at):
+        return FloatType()
+    if pat.is_float64(at):
+        return DoubleType()
+    if pat.is_string(at) or pat.is_large_string(at):
+        return StringType()
+    if pat.is_binary(at) or pat.is_large_binary(at) or pat.is_fixed_size_binary(at):
+        return BinaryType()
+    if pat.is_date(at):
+        return DateType()
+    if pat.is_timestamp(at):
+        return TimestampType()
+    if pat.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pat.is_null(at):
+        return NullType()
+    if pat.is_list(at) or pat.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type))
+    if pat.is_map(at):
+        return MapType(from_arrow_type(at.key_type), from_arrow_type(at.item_type))
+    if pat.is_struct(at):
+        return StructType(
+            [StructField(f.name, from_arrow_type(f.type), f.nullable) for f in at]
+        )
+    raise ValueError(f"No delta mapping for arrow type {at!r}")
+
+
+def from_arrow_schema(aschema) -> StructType:
+    return StructType(
+        [StructField(f.name, from_arrow_type(f.type), f.nullable) for f in aschema]
+    )
+
+
+# ---------------------------------------------------------------------------
+# numpy / device interop (for columns shipped to TPU HBM)
+# ---------------------------------------------------------------------------
+
+_NUMPY_MAP: Dict[str, Any] = {
+    "boolean": np.bool_,
+    "byte": np.int8,
+    "short": np.int16,
+    "integer": np.int32,
+    "long": np.int64,
+    "float": np.float32,
+    "double": np.float64,
+    "date": np.int32,       # days since epoch
+    "timestamp": np.int64,  # micros since epoch
+}
+
+
+def to_numpy_dtype(dt: DataType):
+    """Device-representable dtype, or None if the type must stay on host
+    (strings/binary/decimal/nested) and be dictionary-encoded or hashed first."""
+    return _NUMPY_MAP.get(getattr(dt, "name", None))
+
+
+def is_device_representable(dt: DataType) -> bool:
+    return to_numpy_dtype(dt) is not None

@@ -1,0 +1,308 @@
+"""Configuration system.
+
+Three tiers, mirroring the reference (SURVEY §5 "Config / flag system"):
+
+1. **Session confs** (:class:`SqlConf`) ≈ ``sources/DeltaSQLConf.scala`` —
+   process-wide engine knobs under ``delta.tpu.*``.
+2. **Table properties** (:class:`DeltaConfigs`) ≈ ``DeltaConfig.scala:114-433``
+   — typed, validated ``delta.*`` keys persisted in ``Metadata.configuration``,
+   with session-level defaults via ``delta.tpu.properties.defaults.*``.
+3. Per-operation reader/writer options live in ``delta_tpu.api.options``.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+from delta_tpu.utils.errors import DeltaIllegalArgumentError
+
+T = TypeVar("T")
+
+__all__ = ["SqlConf", "conf", "DeltaConfig", "DeltaConfigs", "parse_interval_ms"]
+
+
+# ---------------------------------------------------------------------------
+# Session conf
+# ---------------------------------------------------------------------------
+
+class SqlConf:
+    """Process-wide conf with defaults; thread-safe; supports ``with
+    conf.set_temporarily(...)`` for tests (≈ SQLConf + withSQLConf)."""
+
+    _DEFAULTS: Dict[str, Any] = {
+        # ≈ DeltaSQLConf.DELTA_SNAPSHOT_PARTITIONS (replay shards on device)
+        "delta.tpu.snapshotPartitions": 8,
+        # ≈ DELTA_MAX_RETRY_COMMIT_ATTEMPTS (DeltaSQLConf.scala:182)
+        "delta.tpu.maxCommitAttempts": 10_000_000,
+        # ≈ DELTA_CHECKPOINT_PART_SIZE — actions per checkpoint part
+        "delta.tpu.checkpointPartSize": 1_000_000,
+        # ≈ MERGE_INSERT_ONLY_ENABLED
+        "delta.tpu.merge.optimizeInsertOnlyMerge.enabled": True,
+        # ≈ MERGE_MATCHED_ONLY_ENABLED
+        "delta.tpu.merge.optimizeMatchedOnlyMerge.enabled": True,
+        # ≈ DELTA_STATS_SKIPPING (DeltaSQLConf.scala:150) — we actually wire it
+        "delta.tpu.stats.skipping": True,
+        # ≈ DELTA_COLLECT_STATS — collect per-file min/max/nullCount on write
+        "delta.tpu.stats.collect": True,
+        # ≈ DELTA_VACUUM_RETENTION_CHECK_ENABLED
+        "delta.tpu.retentionDurationCheck.enabled": True,
+        # ≈ DELTA_STATE_CORRUPTION_IS_FATAL
+        "delta.tpu.state.corruptionIsFatal": True,
+        # ≈ DELTA_ASYNC_UPDATE_STALENESS_TIME_LIMIT (DeltaSQLConf.scala:262)
+        "delta.tpu.stalenessLimitMs": 0,
+        # ≈ DELTA_SCHEMA_AUTO_MIGRATE (merge schema on write by default off)
+        "delta.tpu.schema.autoMerge.enabled": False,
+        # ≈ DELTA_HISTORY_METRICS_ENABLED
+        "delta.tpu.history.metricsEnabled": True,
+        # ≈ DELTA_CHECKPOINT_V2_ENABLED (struct stats columns in checkpoints)
+        "delta.tpu.checkpointV2.enabled": False,
+        # ≈ DELTA_WRITE_CHECKSUM_ENABLED
+        "delta.tpu.writeChecksum.enabled": True,
+        # Target max rows per written data file (write-path sharding unit).
+        "delta.tpu.write.targetFileRows": 4_000_000,
+        # Device mesh axis name used by sharded kernels.
+        "delta.tpu.mesh.axis": "shards",
+        # Use the JAX device path for scan planning / pruning when possible.
+        "delta.tpu.device.pruning": True,
+        # ≈ DELTA_CONVERT_METADATA_CHECK_ENABLED and misc
+        "delta.tpu.import.batchSize.statsCollection": 50_000,
+        # partition-dir listing parallelism for vacuum/convert
+        "delta.tpu.parallelDelete.parallelism": 16,
+    }
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            if key in self._values:
+                return self._values[key]
+        if key in self._DEFAULTS:
+            return self._DEFAULTS[key]
+        return default
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            self._values.pop(key, None)
+
+    def set_temporarily(self, **kv: Any):
+        """Context manager: ``with conf.set_temporarily(**{'k': v}): ...``"""
+        outer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._saved = {}
+                for k, v in kv.items():
+                    key = k.replace("__", ".")
+                    with outer._lock:
+                        self._saved[key] = outer._values.get(key, _MISSING)
+                        outer._values[key] = v
+                return outer
+
+            def __exit__(self, *exc):
+                for key, old in self._saved.items():
+                    with outer._lock:
+                        if old is _MISSING:
+                            outer._values.pop(key, None)
+                        else:
+                            outer._values[key] = old
+                return False
+
+        return _Ctx()
+
+
+_MISSING = object()
+conf = SqlConf()
+
+
+# ---------------------------------------------------------------------------
+# Interval parsing (CalendarInterval subset: "interval N unit [N unit ...]")
+# ---------------------------------------------------------------------------
+
+_UNIT_MS = {
+    "millisecond": 1,
+    "second": 1000,
+    "minute": 60_000,
+    "hour": 3_600_000,
+    "day": 86_400_000,
+    "week": 7 * 86_400_000,
+}
+
+_INTERVAL_RE = re.compile(r"(-?\d+)\s+(millisecond|second|minute|hour|day|week)s?", re.IGNORECASE)
+
+
+def parse_interval_ms(s: str) -> int:
+    """Parse ``"interval 30 days"``-style durations to millis. Months/years are
+    rejected, matching ``DeltaConfigs.isValidIntervalConfigValue`` which bans
+    non-fixed durations."""
+    text = s.strip()
+    if text.lower().startswith("interval"):
+        text = text[len("interval"):]
+    ms = 0
+    matched = False
+    for m in _INTERVAL_RE.finditer(text):
+        matched = True
+        ms += int(m.group(1)) * _UNIT_MS[m.group(2).lower()]
+    if not matched:
+        raise DeltaIllegalArgumentError(f"Invalid interval: {s!r}")
+    if ms < 0:
+        raise DeltaIllegalArgumentError(f"Interval must be non-negative: {s!r}")
+    return ms
+
+
+# ---------------------------------------------------------------------------
+# Table properties
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DeltaConfig(Generic[T]):
+    key: str  # full key incl. "delta." prefix
+    default: str
+    from_string: Callable[[str], T]
+    validate: Optional[Callable[[T], bool]] = None
+    help: str = ""
+
+    def from_metadata(self, metadata) -> T:
+        raw = (metadata.configuration or {}).get(self.key)
+        if raw is None:
+            raw = conf.get(f"delta.tpu.properties.defaults.{self.key[len('delta.'):]}" )
+        if raw is None:
+            raw = self.default
+        try:
+            value = self.from_string(str(raw))
+        except DeltaIllegalArgumentError:
+            raise
+        except (ValueError, TypeError) as e:
+            raise DeltaIllegalArgumentError(
+                f"Invalid value {raw!r} for table property {self.key}: {e}"
+            )
+        if self.validate and not self.validate(value):
+            raise DeltaIllegalArgumentError(
+                f"Invalid value {raw!r} for table property {self.key}"
+            )
+        return value
+
+
+def _bool(s: str) -> bool:
+    if s.lower() in ("true", "1"):
+        return True
+    if s.lower() in ("false", "0"):
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+class DeltaConfigs:
+    """Registry of table properties (``DeltaConfig.scala:227-433``)."""
+
+    LOG_RETENTION = DeltaConfig(
+        "delta.logRetentionDuration", "interval 30 days", parse_interval_ms,
+        help="How long commit/checkpoint files are kept before cleanup.",
+    )
+    TOMBSTONE_RETENTION = DeltaConfig(
+        "delta.deletedFileRetentionDuration", "interval 1 week", parse_interval_ms,
+        help="How long RemoveFile tombstones (and their data files) are kept.",
+    )
+    CHECKPOINT_INTERVAL = DeltaConfig(
+        "delta.checkpointInterval", "10", int, lambda v: v > 0,
+        help="Checkpoint every N commits.",
+    )
+    ENABLE_EXPIRED_LOG_CLEANUP = DeltaConfig(
+        "delta.enableExpiredLogCleanup", "true", _bool,
+    )
+    IS_APPEND_ONLY = DeltaConfig(
+        "delta.appendOnly", "false", _bool,
+        help="When true, deletes/updates are rejected (protocol writer v2 feature).",
+    )
+    CHECKPOINT_WRITE_STATS_AS_JSON = DeltaConfig(
+        "delta.checkpoint.writeStatsAsJson", "true", _bool,
+    )
+    CHECKPOINT_WRITE_STATS_AS_STRUCT = DeltaConfig(
+        "delta.checkpoint.writeStatsAsStruct", "false", _bool,
+    )
+    DATA_SKIPPING_NUM_INDEXED_COLS = DeltaConfig(
+        "delta.dataSkippingNumIndexedCols", "32", int, lambda v: v >= -1,
+        help="First N schema columns get min/max/nullCount stats (-1 = all).",
+    )
+    SYMLINK_FORMAT_MANIFEST_ENABLED = DeltaConfig(
+        "delta.compatibility.symlinkFormatManifest.enabled", "false", _bool,
+    )
+    RANDOMIZE_FILE_PREFIXES = DeltaConfig(
+        "delta.randomizeFilePrefixes", "false", _bool,
+    )
+    RANDOM_PREFIX_LENGTH = DeltaConfig(
+        "delta.randomPrefixLength", "2", int, lambda v: v > 0,
+    )
+    CHANGE_DATA_FEED = DeltaConfig(
+        "delta.enableChangeDataFeed", "false", _bool,
+        help="Write change-data files for UPDATE/DELETE/MERGE.",
+    )
+    MIN_READER_VERSION = DeltaConfig(
+        "delta.minReaderVersion", "1", int, lambda v: v > 0,
+    )
+    MIN_WRITER_VERSION = DeltaConfig(
+        "delta.minWriterVersion", "2", int, lambda v: v > 0,
+    )
+
+    _ALL: Dict[str, DeltaConfig] = {}
+
+    @classmethod
+    def all_configs(cls) -> Dict[str, DeltaConfig]:
+        if not cls._ALL:
+            for name in dir(cls):
+                v = getattr(cls, name)
+                if isinstance(v, DeltaConfig):
+                    cls._ALL[v.key.lower()] = v
+        return cls._ALL
+
+    @classmethod
+    def validate_configuration(cls, configuration: Dict[str, str]) -> Dict[str, str]:
+        """Type-check user-provided ``delta.*`` keys; unknown ``delta.`` keys
+        are rejected (``DeltaConfig.scala verifyTableProperties``)."""
+        registry = cls.all_configs()
+        out = {}
+        for k, v in configuration.items():
+            lk = k.lower()
+            if lk.startswith("delta."):
+                cfg = registry.get(lk)
+                if cfg is None:
+                    # The reference allows unknown keys through when they match
+                    # no validator only for forward-compat "delta.constraints.*"
+                    # and arbitrary user keys are kept; constraints use this.
+                    if lk.startswith("delta.constraints."):
+                        out[k] = v
+                        continue
+                    raise DeltaIllegalArgumentError(f"Unknown configuration was specified: {k}")
+                # run the parser for validation, store canonical key
+                probe = Metadata_probe(configuration={cfg.key: v})
+                cfg.from_metadata(probe)
+                out[cfg.key] = v
+            else:
+                out[k] = v
+        return out
+
+    @classmethod
+    def merge_global_configs(cls, configuration: Dict[str, str]) -> Dict[str, str]:
+        """Apply session-level defaults ``delta.tpu.properties.defaults.*``
+        for keys the user didn't set (``DeltaConfig.mergeGlobalConfigs``)."""
+        out = dict(configuration)
+        for cfg in cls.all_configs().values():
+            if cfg.key in out:
+                continue
+            default = conf.get(f"delta.tpu.properties.defaults.{cfg.key[len('delta.'):]}" )
+            if default is not None:
+                out[cfg.key] = str(default)
+        return out
+
+
+class Metadata_probe:
+    """Minimal object exposing .configuration for DeltaConfig.from_metadata."""
+
+    def __init__(self, configuration: Dict[str, str]):
+        self.configuration = configuration

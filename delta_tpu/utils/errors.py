@@ -1,0 +1,163 @@
+"""Error taxonomy, mirroring the reference's user-facing error factory
+(``DeltaErrors.scala``) and the public concurrency exception hierarchy
+(``io/delta/exceptions/DeltaConcurrentExceptions.scala``, also surfaced to
+Python in the reference via ``python/delta/exceptions.py``)."""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+__all__ = [
+    "DeltaError",
+    "DeltaAnalysisError",
+    "DeltaIllegalArgumentError",
+    "DeltaIllegalStateError",
+    "DeltaFileNotFoundError",
+    "DeltaIOError",
+    "DeltaUnsupportedOperationError",
+    "MetadataChangedException",
+    "ProtocolChangedException",
+    "ConcurrentWriteException",
+    "ConcurrentAppendException",
+    "ConcurrentDeleteReadException",
+    "ConcurrentDeleteDeleteException",
+    "ConcurrentTransactionException",
+    "DeltaConcurrentModificationException",
+    "InvariantViolationError",
+    "SchemaMismatchError",
+    "ProtocolError",
+    "VersionNotFoundError",
+    "TimestampEarlierThanCommitRetentionError",
+    "TemporallyUnstableInputError",
+]
+
+
+class DeltaError(Exception):
+    """Base for all delta-tpu errors."""
+
+
+class DeltaAnalysisError(DeltaError):
+    pass
+
+
+class DeltaIllegalArgumentError(DeltaError, ValueError):
+    pass
+
+
+class DeltaIllegalStateError(DeltaError, RuntimeError):
+    pass
+
+
+class DeltaFileNotFoundError(DeltaError, FileNotFoundError):
+    pass
+
+
+class DeltaIOError(DeltaError, IOError):
+    pass
+
+
+class DeltaUnsupportedOperationError(DeltaError, NotImplementedError):
+    pass
+
+
+class InvariantViolationError(DeltaError):
+    """Row-level constraint / NOT NULL violation
+    (``schema/InvariantViolationException.scala``)."""
+
+
+class SchemaMismatchError(DeltaAnalysisError):
+    """Write schema incompatible with table schema
+    (``DeltaErrors.failedToMergeFields`` etc.)."""
+
+
+class ProtocolError(DeltaError):
+    """Table requires a newer reader/writer than this client
+    (``DeltaErrors.InvalidProtocolVersionException``)."""
+
+
+class VersionNotFoundError(DeltaAnalysisError):
+    def __init__(self, user_version: int, earliest: int, latest: int):
+        super().__init__(
+            f"Cannot time travel Delta table to version {user_version}. "
+            f"Available versions: [{earliest}, {latest}]."
+        )
+        self.user_version = user_version
+        self.earliest = earliest
+        self.latest = latest
+
+
+class TimestampEarlierThanCommitRetentionError(DeltaAnalysisError):
+    pass
+
+
+class TemporallyUnstableInputError(DeltaAnalysisError):
+    """Requested timestamp is after the latest commit timestamp."""
+
+    def __init__(self, user_ts, commit_ts, latest_version: int):
+        super().__init__(
+            f"The provided timestamp ({user_ts}) is after the latest version "
+            f"available to this table ({commit_ts}, version {latest_version})."
+        )
+        self.commit_ts = commit_ts
+        self.latest_version = latest_version
+
+
+# ---------------------------------------------------------------------------
+# Concurrency exceptions (conflict-checker verdicts) — names match
+# io/delta/exceptions/DeltaConcurrentExceptions.scala so users can map 1:1.
+# ---------------------------------------------------------------------------
+
+class DeltaConcurrentModificationException(DeltaError):
+    """Base of the OCC conflict hierarchy."""
+
+    def __init__(self, message: str, conflicting_commit: Optional[dict] = None):
+        super().__init__(message)
+        self.conflicting_commit = conflicting_commit
+
+
+class ConcurrentWriteException(DeltaConcurrentModificationException):
+    """A concurrent transaction wrote new data the current transaction read
+    (or the commit file appeared non-atomically)."""
+
+
+class MetadataChangedException(DeltaConcurrentModificationException):
+    """The table metadata changed since the transaction's snapshot."""
+
+
+class ProtocolChangedException(DeltaConcurrentModificationException):
+    """The protocol version changed since the transaction's snapshot."""
+
+
+class ConcurrentAppendException(DeltaConcurrentModificationException):
+    """Files were added by a concurrent commit in a region this txn read."""
+
+
+class ConcurrentDeleteReadException(DeltaConcurrentModificationException):
+    """A concurrent commit deleted a file this transaction read."""
+
+
+class ConcurrentDeleteDeleteException(DeltaConcurrentModificationException):
+    """A concurrent commit deleted a file this transaction also deletes."""
+
+
+class ConcurrentTransactionException(DeltaConcurrentModificationException):
+    """Overlapping SetTransaction appId with a concurrent commit."""
+
+
+def concurrent_modification(kind: str, message: str, commit: Optional[dict] = None):
+    cls = {
+        "write": ConcurrentWriteException,
+        "metadata": MetadataChangedException,
+        "protocol": ProtocolChangedException,
+        "append": ConcurrentAppendException,
+        "deleteRead": ConcurrentDeleteReadException,
+        "deleteDelete": ConcurrentDeleteDeleteException,
+        "txn": ConcurrentTransactionException,
+    }[kind]
+    return cls(message, commit)
+
+
+def versions_not_contiguous(versions: Iterable[int]) -> DeltaIllegalStateError:
+    return DeltaIllegalStateError(
+        f"Versions ({list(versions)}) are not contiguous. This can happen when "
+        "files have been manually deleted from the transaction log."
+    )

@@ -1,0 +1,119 @@
+"""Action JSON round-trips vs literal strings (≈ ``ActionSerializerSuite``)."""
+import json
+
+from delta_tpu.protocol.actions import (
+    AddCDCFile,
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    action_from_json,
+)
+
+
+def roundtrip(action):
+    s = action.json()
+    back = action_from_json(s)
+    assert back == action, f"{back!r} != {action!r}"
+    return s
+
+
+def test_protocol():
+    s = roundtrip(Protocol(1, 2))
+    assert s == '{"protocol":{"minReaderVersion":1,"minWriterVersion":2}}'
+
+
+def test_set_transaction():
+    s = roundtrip(SetTransaction("app-1", 2, 3))
+    assert s == '{"txn":{"appId":"app-1","version":2,"lastUpdated":3}}'
+    s2 = roundtrip(SetTransaction("app-1", 2))
+    assert s2 == '{"txn":{"appId":"app-1","version":2}}'
+
+
+def test_add_file():
+    a = AddFile("a/b.parquet", {"x": "1"}, 100, 1234, True, stats='{"numRecords":5}')
+    s = roundtrip(a)
+    d = json.loads(s)["add"]
+    assert d["path"] == "a/b.parquet"
+    assert d["partitionValues"] == {"x": "1"}
+    assert d["size"] == 100
+    assert d["modificationTime"] == 1234
+    assert d["dataChange"] is True
+    assert d["stats"] == '{"numRecords":5}'
+    assert "tags" not in d
+
+
+def test_add_file_null_partition_value():
+    a = AddFile("f", {"x": None}, 1, 1, True)
+    s = roundtrip(a)
+    assert json.loads(s)["add"]["partitionValues"] == {"x": None}
+
+
+def test_remove_file():
+    r = AddFile("a", {}, 1, 1, True).remove(deletion_timestamp=99)
+    s = roundtrip(r)
+    d = json.loads(s)["remove"]
+    assert d["deletionTimestamp"] == 99
+    assert d["dataChange"] is True
+    assert d["extendedFileMetadata"] is True
+    assert d["size"] == 1
+
+
+def test_remove_minimal_fields_parse():
+    # Old writers emit remove without extended metadata.
+    r = action_from_json('{"remove":{"path":"abc","deletionTimestamp":123}}')
+    assert isinstance(r, RemoveFile)
+    assert r.path == "abc"
+    assert r.delete_timestamp == 123
+
+
+def test_metadata_roundtrip():
+    m = Metadata(
+        id="test-id",
+        schema_string='{"type":"struct","fields":[{"name":"id","type":"integer","nullable":true,"metadata":{}}]}',
+        partition_columns=["id"],
+        configuration={"delta.appendOnly": "true"},
+        created_time=1000,
+    )
+    s = roundtrip(m)
+    d = json.loads(s)["metaData"]
+    assert d["format"] == {"provider": "parquet", "options": {}}
+    assert d["partitionColumns"] == ["id"]
+    assert m.schema.field_names == ["id"]
+    assert m.partition_schema.field_names == ["id"]
+    assert m.data_schema.field_names == []
+
+
+def test_cdc_file():
+    c = AddCDCFile("cdc-0", {"p": "1"}, 10)
+    s = roundtrip(c)
+    d = json.loads(s)["cdc"]
+    assert d["dataChange"] is False
+
+
+def test_commit_info():
+    ci = CommitInfo(version=1, timestamp=123, operation="WRITE",
+                    operation_parameters={"mode": "Append"}, is_blind_append=True)
+    s = roundtrip(ci)
+    d = json.loads(s)["commitInfo"]
+    assert d["operation"] == "WRITE"
+    assert d["isBlindAppend"] is True
+    assert "engineInfo" not in d
+
+
+def test_reference_golden_lines_parse():
+    """Lines written by Delta 0.1.0 (reference golden table) parse exactly."""
+    line = (
+        '{"add":{"path":"part-00000-f4aeebd0.snappy.parquet","partitionValues":{},'
+        '"size":525,"modificationTime":1501109075000,"dataChange":true}}'
+    )
+    a = action_from_json(line)
+    assert isinstance(a, AddFile)
+    assert a.size == 525
+
+
+def test_unknown_action_ignored():
+    assert action_from_json('{"someFutureAction":{"x":1}}') is None
+    assert action_from_json("") is None

@@ -1,0 +1,86 @@
+"""Checkpoint write/read + _last_checkpoint semantics (≈ ``CheckpointsSuite``
+behaviors embedded in ``DeltaLogSuite``)."""
+import pytest
+
+from delta_tpu.log import checkpoints as ck
+from delta_tpu.log.checkpoints import CheckpointInstance, CheckpointMetaData
+from delta_tpu.protocol.actions import AddFile, Metadata, Protocol, RemoveFile, SetTransaction
+from delta_tpu.storage.logstore import MemoryLogStore
+
+LOG = "/tbl/_delta_log"
+
+
+def state_actions():
+    return [
+        Protocol(1, 2),
+        Metadata(id="m1", schema_string='{"type":"struct","fields":[]}'),
+        SetTransaction("app", 3, 5),
+        AddFile("f1", {"p": "1"}, 10, 100, False, stats='{"numRecords":2}'),
+        AddFile("f2", {"p": None}, 20, 200, False),
+        RemoveFile("f0", deletion_timestamp=50, data_change=False,
+                   extended_file_metadata=True, partition_values={"p": "0"}, size=5),
+    ]
+
+
+def test_single_part_roundtrip():
+    store = MemoryLogStore()
+    md = ck.write_checkpoint(store, LOG, 10, state_actions())
+    assert md == CheckpointMetaData(10, 6, None)
+    assert store.exists(f"{LOG}/00000000000000000010.checkpoint.parquet")
+
+    back = ck.read_checkpoint_actions(store, [f"{LOG}/00000000000000000010.checkpoint.parquet"])
+    assert sorted(type(a).__name__ for a in back) == sorted(type(a).__name__ for a in state_actions())
+    adds = {a.path: a for a in back if isinstance(a, AddFile)}
+    assert adds["f1"].partition_values == {"p": "1"}
+    assert adds["f1"].stats == '{"numRecords":2}'
+    assert adds["f2"].partition_values == {"p": None}
+    rem = next(a for a in back if isinstance(a, RemoveFile))
+    assert rem.deletion_timestamp == 50 and rem.partition_values == {"p": "0"}
+    txn = next(a for a in back if isinstance(a, SetTransaction))
+    assert (txn.app_id, txn.version, txn.last_updated) == ("app", 3, 5)
+
+
+def test_multipart_roundtrip():
+    store = MemoryLogStore()
+    md = ck.write_checkpoint(store, LOG, 4, state_actions(), parts=3)
+    assert md.parts == 3
+    paths = [
+        f"{LOG}/00000000000000000004.checkpoint.{i+1:010d}.{3:010d}.parquet" for i in range(3)
+    ]
+    for p in paths:
+        assert store.exists(p)
+    back = ck.read_checkpoint_actions(store, paths)
+    assert len(back) == 6
+
+
+def test_last_checkpoint_roundtrip_and_corruption():
+    store = MemoryLogStore()
+    assert ck.read_last_checkpoint(store, LOG) is None
+    ck.write_last_checkpoint(store, LOG, CheckpointMetaData(5, 100, None))
+    got = ck.read_last_checkpoint(store, LOG)
+    assert got == CheckpointMetaData(5, 100, None)
+    # corrupt the pointer: reader falls back to None (re-list), not an error
+    store.write_bytes(f"{LOG}/_last_checkpoint", b"{not-json", overwrite=True)
+    assert ck.read_last_checkpoint(store, LOG) is None
+
+
+def test_latest_complete_checkpoint():
+    insts = [
+        CheckpointInstance(2),
+        CheckpointInstance(5, 2), CheckpointInstance(5, 2),  # both parts present
+        CheckpointInstance(7, 3), CheckpointInstance(7, 3),  # 2 of 3 parts: incomplete
+    ]
+    assert ck.latest_complete_checkpoint(insts) == CheckpointInstance(5, 2)
+    assert ck.latest_complete_checkpoint(insts, not_later_than=4) == CheckpointInstance(2)
+    assert ck.latest_complete_checkpoint([], None) is None
+
+
+def test_find_last_complete_checkpoint_before():
+    store = MemoryLogStore()
+    ck.write_checkpoint(store, LOG, 10, state_actions())
+    ck.write_checkpoint(store, LOG, 20, state_actions(), parts=2)
+    found = ck.find_last_complete_checkpoint_before(store, LOG, 15)
+    assert found == CheckpointInstance(10, None)
+    found = ck.find_last_complete_checkpoint_before(store, LOG, 25)
+    assert found == CheckpointInstance(20, 2)
+    assert ck.find_last_complete_checkpoint_before(store, LOG, 10) is None

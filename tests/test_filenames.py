@@ -1,0 +1,46 @@
+"""≈ ``FileNamesSuite``."""
+import pytest
+
+from delta_tpu.protocol import filenames as fn
+
+
+def test_delta_file():
+    assert fn.delta_file(12) == "00000000000000000012.json"
+    assert fn.is_delta_file("00000000000000000012.json")
+    assert not fn.is_delta_file("12.json.tmp")
+    assert fn.delta_version("/a/b/_delta_log/00000000000000000012.json") == 12
+
+
+def test_checkpoint_single():
+    assert fn.checkpoint_file_single(3) == "00000000000000000003.checkpoint.parquet"
+    assert fn.is_checkpoint_file("00000000000000000003.checkpoint.parquet")
+    assert fn.checkpoint_version("00000000000000000003.checkpoint.parquet") == 3
+    assert fn.checkpoint_part("00000000000000000003.checkpoint.parquet") is None
+
+
+def test_checkpoint_multipart():
+    parts = fn.checkpoint_file_with_parts(5, 3)
+    assert parts == [
+        "00000000000000000005.checkpoint.0000000001.0000000003.parquet",
+        "00000000000000000005.checkpoint.0000000002.0000000003.parquet",
+        "00000000000000000005.checkpoint.0000000003.0000000003.parquet",
+    ]
+    assert fn.checkpoint_part(parts[1]) == (2, 3)
+    assert fn.checkpoint_version(parts[2]) == 5
+
+
+def test_checksum():
+    assert fn.checksum_file(7) == "00000000000000000007.crc"
+    assert fn.is_checksum_file("00000000000000000007.crc")
+    assert fn.checksum_version("00000000000000000007.crc") == 7
+
+
+def test_get_file_version():
+    assert fn.get_file_version("00000000000000000009.json") == 9
+    assert fn.get_file_version("00000000000000000009.crc") == 9
+    assert fn.get_file_version("_last_checkpoint") is None
+
+
+def test_version_prefix_ordering():
+    # zero padding makes lexicographic == numeric ordering
+    assert fn.delta_file(9) < fn.delta_file(10) < fn.delta_file(100)

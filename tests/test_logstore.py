@@ -1,0 +1,115 @@
+"""LogStore contract tests (≈ ``LogStoreSuite``): atomic visibility, mutual
+exclusion, sorted listing — including real multi-thread conflict detection."""
+import os
+import threading
+
+import pytest
+
+from delta_tpu.storage.logstore import (
+    FileStatus,
+    LocalLogStore,
+    MemoryLogStore,
+    ObjectStoreLogStore,
+)
+
+
+@pytest.fixture(params=["local", "memory", "objectstore"])
+def store_and_root(request, tmp_path):
+    if request.param == "local":
+        return LocalLogStore(), str(tmp_path)
+    if request.param == "memory":
+        return MemoryLogStore(), "/mem/tbl"
+    return ObjectStoreLogStore(LocalLogStore()), str(tmp_path)
+
+
+def test_read_write(store_and_root):
+    store, root = store_and_root
+    p = f"{root}/_delta_log/00000000000000000000.json"
+    store.write(p, ["zero", "none"])
+    assert store.read(p) == ["zero", "none"]
+    assert store.exists(p)
+
+
+def test_write_no_overwrite_fails(store_and_root):
+    store, root = store_and_root
+    p = f"{root}/_delta_log/00000000000000000000.json"
+    store.write(p, ["first"])
+    with pytest.raises(FileExistsError):
+        store.write(p, ["second"])
+    assert store.read(p) == ["first"]
+    store.write(p, ["third"], overwrite=True)
+    assert store.read(p) == ["third"]
+
+
+def test_list_from_sorted(store_and_root):
+    store, root = store_and_root
+    base = f"{root}/_delta_log"
+    for v in (2, 0, 1, 10):
+        store.write(f"{base}/{'%020d' % v}.json", [str(v)])
+    names = [s.name for s in store.list_from(f"{base}/{'%020d' % 1}.json")]
+    assert names == [
+        "00000000000000000001.json",
+        "00000000000000000002.json",
+        "00000000000000000010.json",
+    ]
+
+
+def test_list_from_missing_dir_raises(store_and_root):
+    store, root = store_and_root
+    with pytest.raises(FileNotFoundError):
+        list(store.list_from(f"{root}/nonexistent/00000000000000000000.json"))
+
+
+def test_concurrent_writers_exactly_one_wins(store_and_root):
+    """Mutual exclusion under real threads (≈ LogStoreSuite 'detects conflict')."""
+    store, root = store_and_root
+    p = f"{root}/_delta_log/00000000000000000001.json"
+    barrier = threading.Barrier(8)
+    results = []
+    lock = threading.Lock()
+
+    def writer(i):
+        barrier.wait()
+        try:
+            store.write(p, [f"writer-{i}"])
+            with lock:
+                results.append(("ok", i))
+        except FileExistsError:
+            with lock:
+                results.append(("conflict", i))
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wins = [r for r in results if r[0] == "ok"]
+    assert len(wins) == 1, f"expected exactly one winner, got {results}"
+    winner = wins[0][1]
+    assert store.read(p) == [f"writer-{winner}"]
+
+
+def test_local_store_no_temp_droppings(tmp_path):
+    store = LocalLogStore()
+    p = str(tmp_path / "_delta_log" / "00000000000000000000.json")
+    store.write(p, ["x"])
+    with pytest.raises(FileExistsError):
+        store.write(p, ["y"])
+    leftovers = [n for n in os.listdir(tmp_path / "_delta_log") if n.endswith(".tmp")]
+    assert leftovers == []
+
+
+def test_object_store_partial_write_invisible_flag(tmp_path):
+    assert ObjectStoreLogStore(LocalLogStore()).is_partial_write_visible("x") is False
+    assert LocalLogStore().is_partial_write_visible("x") is True
+
+
+def test_memory_store_fault_injection():
+    store = MemoryLogStore()
+    seen = []
+    store.before_write = lambda p: seen.append(p)
+    store.write("/t/_delta_log/f", ["1"])
+    assert seen == ["/t/_delta_log/f"]
+    store.set_mtime("/t/_delta_log/f", 42)
+    (status,) = list(store.list_from("/t/_delta_log/"))
+    assert status.modification_time == 42

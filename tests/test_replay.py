@@ -1,0 +1,90 @@
+"""Log replay semantics (≈ ``InMemoryLogReplay`` behavior + PROTOCOL.md
+"Action Reconciliation")."""
+from delta_tpu.log.replay import LogReplay
+from delta_tpu.protocol.actions import (
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+)
+
+
+def add(path, ts=0, size=1):
+    return AddFile(path, {}, size, ts, True)
+
+
+def test_last_add_wins():
+    r = LogReplay()
+    r.append(0, [Protocol(), Metadata(id="m"), add("f1", size=1)])
+    r.append(1, [add("f1", size=2)])
+    assert list(r.active_files) == ["f1"]
+    assert r.active_files["f1"].size == 2
+
+
+def test_remove_tombstones_add():
+    r = LogReplay(min_file_retention_timestamp=0)
+    r.append(0, [add("f1")])
+    r.append(1, [RemoveFile("f1", deletion_timestamp=100)])
+    assert r.active_files == {}
+    assert [t.path for t in r.get_tombstones()] == ["f1"]
+
+
+def test_add_after_remove_restores():
+    r = LogReplay()
+    r.append(0, [add("f1")])
+    r.append(1, [RemoveFile("f1", deletion_timestamp=100)])
+    r.append(2, [add("f1", size=9)])
+    assert r.active_files["f1"].size == 9
+    assert r.get_tombstones() == []
+
+
+def test_tombstone_expiry():
+    r = LogReplay(min_file_retention_timestamp=150)
+    r.append(0, [add("f1"), add("f2")])
+    r.append(1, [RemoveFile("f1", deletion_timestamp=100)])
+    r.append(2, [RemoveFile("f2", deletion_timestamp=200)])
+    assert [t.path for t in r.get_tombstones()] == ["f2"]
+
+
+def test_latest_metadata_protocol_win():
+    r = LogReplay()
+    r.append(0, [Protocol(1, 1), Metadata(id="a")])
+    r.append(1, [Protocol(1, 2), Metadata(id="b")])
+    assert r.current_protocol.min_writer_version == 2
+    assert r.current_metadata.id == "b"
+
+
+def test_set_transaction_per_app_id():
+    r = LogReplay()
+    r.append(0, [SetTransaction("app1", 1), SetTransaction("app2", 5)])
+    r.append(1, [SetTransaction("app1", 2)])
+    assert r.transactions["app1"].version == 2
+    assert r.transactions["app2"].version == 5
+
+
+def test_commit_info_ignored():
+    r = LogReplay()
+    r.append(0, [CommitInfo(operation="WRITE"), add("f1")])
+    assert list(r.active_files) == ["f1"]
+
+
+def test_checkpoint_actions_normalize_datachange():
+    r = LogReplay()
+    r.append(0, [Protocol(), Metadata(id="m"), add("f1")])
+    r.append(1, [RemoveFile("f2", deletion_timestamp=100, data_change=True)])
+    acts = r.checkpoint_actions()
+    adds = [a for a in acts if isinstance(a, AddFile)]
+    removes = [a for a in acts if isinstance(a, RemoveFile)]
+    assert all(a.data_change is False for a in adds)
+    assert all(rm.data_change is False for rm in removes)
+    kinds = [type(a).__name__ for a in acts]
+    assert kinds.count("Protocol") == 1 and kinds.count("Metadata") == 1
+
+
+def test_path_canonicalization():
+    r = LogReplay()
+    r.append(0, [add("./f1")])
+    r.append(1, [RemoveFile("f1", deletion_timestamp=1)])
+    assert r.active_files == {}
