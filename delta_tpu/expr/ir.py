@@ -1,0 +1,658 @@
+"""Expression IR — the engine's predicate/projection language.
+
+The reference leans on Spark Catalyst for predicates, update expressions,
+generated columns and constraints (SURVEY §7 "Hard parts"). This is our
+replacement: a small, SQL-semantics (3-valued logic, casts) expression tree
+with three evaluators:
+
+* :meth:`Expression.eval` — row-at-a-time over a ``dict`` (host, used for
+  partition-value pruning, conflict checking, constraint messages);
+* ``delta_tpu.expr.vectorized`` — pyarrow/numpy columnar evaluation (host
+  scan filtering, DML projection);
+* ``delta_tpu.expr.jaxeval`` — compile to ``jnp`` ops over device-resident
+  columns (stats pruning and DML kernels on TPU).
+
+NULL is represented as Python ``None`` / masked lanes; comparisons with NULL
+yield NULL; AND/OR use Kleene logic — matching Spark SQL.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from delta_tpu.schema.types import (
+    BooleanType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructType,
+    TimestampType,
+)
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = [
+    "Expression",
+    "Column",
+    "Literal",
+    "Alias",
+    "And",
+    "Or",
+    "Not",
+    "Eq",
+    "NullSafeEq",
+    "Ne",
+    "Lt",
+    "Le",
+    "Gt",
+    "Ge",
+    "In",
+    "IsNull",
+    "IsNotNull",
+    "Add",
+    "Sub",
+    "Mul",
+    "Div",
+    "Mod",
+    "Neg",
+    "Cast",
+    "Like",
+    "StartsWith",
+    "Coalesce",
+    "CaseWhen",
+    "Func",
+    "TRUE",
+    "FALSE",
+    "and_all",
+    "split_conjuncts",
+    "references",
+]
+
+
+class Expression:
+    children: Tuple["Expression", ...] = ()
+
+    def eval(self, row: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    # -- tree utilities --------------------------------------------------
+
+    def walk(self) -> Iterator["Expression"]:
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def transform(self, fn: Callable[["Expression"], Optional["Expression"]]) -> "Expression":
+        replaced = fn(self)
+        if replaced is not None:
+            return replaced
+        new_children = tuple(c.transform(fn) for c in self.children)
+        if new_children == self.children:
+            return self
+        clone = object.__new__(type(self))
+        clone.__dict__.update(self.__dict__)
+        clone.children = new_children
+        return clone
+
+    def sql(self) -> str:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.sql()
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.sql() == other.sql()
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.sql()))
+
+
+def references(expr: Expression) -> List[str]:
+    """Column names referenced (lower-cased for case-insensitive resolution)."""
+    out = []
+    for e in expr.walk():
+        if isinstance(e, Column):
+            out.append(e.name)
+    return out
+
+
+def split_conjuncts(expr: Expression) -> List[Expression]:
+    if isinstance(expr, And):
+        return split_conjuncts(expr.left) + split_conjuncts(expr.right)
+    return [expr]
+
+
+def and_all(exprs: Sequence[Expression]) -> Expression:
+    if not exprs:
+        return TRUE
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = And(out, e)
+    return out
+
+
+class Column(Expression):
+    def __init__(self, name: str):
+        self.name = name
+        self.children = ()
+
+    def eval(self, row: Dict[str, Any]) -> Any:
+        if self.name in row:
+            return row[self.name]
+        # case-insensitive fallback (Delta is case-insensitive by default)
+        lname = self.name.lower()
+        for k, v in row.items():
+            if k.lower() == lname:
+                return v
+        raise DeltaAnalysisError(f"Column not found: {self.name} in {list(row)}")
+
+    def sql(self) -> str:
+        if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", self.name):
+            return self.name
+        escaped = self.name.replace("`", "``")
+        return f"`{escaped}`"
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, data_type: Optional[DataType] = None):
+        self.value = value
+        self.data_type = data_type or _infer_type(value)
+        self.children = ()
+
+    def eval(self, row: Dict[str, Any]) -> Any:
+        return self.value
+
+    def sql(self) -> str:
+        if self.value is None:
+            return "NULL"
+        if isinstance(self.value, bool):
+            return "TRUE" if self.value else "FALSE"
+        if isinstance(self.value, str):
+            escaped = self.value.replace("'", "''")
+            return f"'{escaped}'"
+        return str(self.value)
+
+
+TRUE = Literal(True, BooleanType())
+FALSE = Literal(False, BooleanType())
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.name = name
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def eval(self, row):
+        return self.child.eval(row)
+
+    def sql(self) -> str:
+        return f"{self.child.sql()} AS {self.name}"
+
+
+def _infer_type(v: Any) -> DataType:
+    if v is None:
+        return StringType()
+    if isinstance(v, bool):
+        return BooleanType()
+    if isinstance(v, int):
+        return LongType()
+    if isinstance(v, float):
+        return DoubleType()
+    if isinstance(v, str):
+        return StringType()
+    return StringType()
+
+
+class _Binary(Expression):
+    op = ""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    def sql(self) -> str:
+        return f"({self.left.sql()} {self.op} {self.right.sql()})"
+
+
+class And(_Binary):
+    op = "AND"
+
+    def eval(self, row):
+        l = self.left.eval(row)
+        if l is False:
+            return False
+        r = self.right.eval(row)
+        if r is False:
+            return False
+        if l is None or r is None:
+            return None
+        return True
+
+
+class Or(_Binary):
+    op = "OR"
+
+    def eval(self, row):
+        l = self.left.eval(row)
+        if l is True:
+            return True
+        r = self.right.eval(row)
+        if r is True:
+            return True
+        if l is None or r is None:
+            return None
+        return False
+
+
+class Not(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval(self, row):
+        v = self.child.eval(row)
+        if v is None:
+            return None
+        return not v
+
+    def sql(self) -> str:
+        return f"(NOT {self.child.sql()})"
+
+
+def _coerce_pair(l: Any, r: Any) -> Tuple[Any, Any]:
+    """Numeric cross-type comparisons; strings compare as strings."""
+    if isinstance(l, bool) or isinstance(r, bool):
+        return l, r
+    if isinstance(l, (int, float)) and isinstance(r, (int, float)):
+        return l, r
+    return l, r
+
+
+class _Comparison(_Binary):
+    py = staticmethod(lambda l, r: None)
+
+    def eval(self, row):
+        l = self.left.eval(row)
+        r = self.right.eval(row)
+        if l is None or r is None:
+            return None
+        l, r = _coerce_pair(l, r)
+        try:
+            return self.py(l, r)
+        except TypeError:
+            raise DeltaAnalysisError(
+                f"Cannot compare {type(l).__name__} with {type(r).__name__} in {self.sql()}"
+            )
+
+
+class Eq(_Comparison):
+    op = "="
+    py = staticmethod(lambda l, r: l == r)
+
+
+class NullSafeEq(_Binary):
+    op = "<=>"
+
+    def eval(self, row):
+        l = self.left.eval(row)
+        r = self.right.eval(row)
+        return l == r  # None <=> None is True
+
+
+class Ne(_Comparison):
+    op = "!="
+    py = staticmethod(lambda l, r: l != r)
+
+
+class Lt(_Comparison):
+    op = "<"
+    py = staticmethod(lambda l, r: l < r)
+
+
+class Le(_Comparison):
+    op = "<="
+    py = staticmethod(lambda l, r: l <= r)
+
+
+class Gt(_Comparison):
+    op = ">"
+    py = staticmethod(lambda l, r: l > r)
+
+
+class Ge(_Comparison):
+    op = ">="
+    py = staticmethod(lambda l, r: l >= r)
+
+
+class In(Expression):
+    def __init__(self, value: Expression, options: Sequence[Expression]):
+        self.children = (value, *options)
+
+    @property
+    def value(self):
+        return self.children[0]
+
+    @property
+    def options(self):
+        return self.children[1:]
+
+    def eval(self, row):
+        v = self.value.eval(row)
+        if v is None:
+            return None
+        saw_null = False
+        for o in self.options:
+            ov = o.eval(row)
+            if ov is None:
+                saw_null = True
+            elif ov == v:
+                return True
+        return None if saw_null else False
+
+    def sql(self) -> str:
+        opts = ", ".join(o.sql() for o in self.options)
+        return f"({self.value.sql()} IN ({opts}))"
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval(self, row):
+        return self.child.eval(row) is None
+
+    def sql(self) -> str:
+        return f"({self.child.sql()} IS NULL)"
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval(self, row):
+        return self.child.eval(row) is not None
+
+    def sql(self) -> str:
+        return f"({self.child.sql()} IS NOT NULL)"
+
+
+class _Arith(_Binary):
+    py = staticmethod(lambda l, r: None)
+
+    def eval(self, row):
+        l = self.left.eval(row)
+        r = self.right.eval(row)
+        if l is None or r is None:
+            return None
+        try:
+            return self.py(l, r)
+        except TypeError:
+            raise DeltaAnalysisError(
+                f"Cannot apply {self.op!r} to {type(l).__name__} and {type(r).__name__} in {self.sql()}"
+            )
+
+
+class Add(_Arith):
+    op = "+"
+    py = staticmethod(lambda l, r: l + r)
+
+
+class Sub(_Arith):
+    op = "-"
+    py = staticmethod(lambda l, r: l - r)
+
+
+class Mul(_Arith):
+    op = "*"
+    py = staticmethod(lambda l, r: l * r)
+
+
+class Div(_Arith):
+    op = "/"
+
+    @staticmethod
+    def py(l, r):
+        if r == 0:
+            return None  # Spark: div by zero yields NULL (ansi off)
+        return l / r
+
+
+class Mod(_Arith):
+    op = "%"
+
+    @staticmethod
+    def py(l, r):
+        if r == 0:
+            return None
+        return math.fmod(l, r) if isinstance(l, float) or isinstance(r, float) else l % r
+
+
+class Neg(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval(self, row):
+        v = self.child.eval(row)
+        return None if v is None else -v
+
+    def sql(self) -> str:
+        return f"(- {self.child.sql()})"
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, data_type: DataType):
+        self.children = (child,)
+        self.data_type = data_type
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def eval(self, row):
+        return cast_value(self.child.eval(row), self.data_type)
+
+    def sql(self) -> str:
+        return f"CAST({self.child.sql()} AS {self.data_type.simple_string().upper()})"
+
+
+def cast_value(v: Any, dt: DataType) -> Any:
+    """Spark-style permissive cast; invalid casts yield NULL (ansi off)."""
+    if v is None:
+        return None
+    try:
+        name = dt.name if not isinstance(dt, DecimalType) else "decimal"
+        if isinstance(dt, BooleanType):
+            if isinstance(v, str):
+                s = v.strip().lower()
+                if s in ("true", "t", "yes", "y", "1"):
+                    return True
+                if s in ("false", "f", "no", "n", "0"):
+                    return False
+                return None
+            return bool(v)
+        if name in ("byte", "short", "integer", "long"):
+            if isinstance(v, bool):
+                return int(v)
+            if isinstance(v, str):
+                v = v.strip()
+                return int(float(v)) if "." in v or "e" in v.lower() else int(v)
+            return int(v)
+        if name in ("float", "double", "decimal"):
+            return float(v)
+        if isinstance(dt, StringType):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        if isinstance(dt, DateType):
+            if isinstance(v, int):
+                return v
+            import datetime as _dt
+
+            return (_dt.date.fromisoformat(str(v)[:10]) - _dt.date(1970, 1, 1)).days
+        if isinstance(dt, TimestampType):
+            if isinstance(v, int):
+                return v
+            import datetime as _dt
+
+            s = str(v).replace(" ", "T")
+            return int(_dt.datetime.fromisoformat(s).replace(tzinfo=_dt.timezone.utc).timestamp() * 1_000_000)
+    except (ValueError, TypeError):
+        return None
+    return v
+
+
+class Like(_Binary):
+    """SQL LIKE with % and _ wildcards."""
+
+    op = "LIKE"
+    _rx_cache: Optional[Tuple[str, Any]] = None
+
+    def eval(self, row):
+        v = self.left.eval(row)
+        p = self.right.eval(row)
+        if v is None or p is None:
+            return None
+        if not isinstance(v, str) or not isinstance(p, str):
+            raise DeltaAnalysisError(
+                f"LIKE requires string operands, got {type(v).__name__} in {self.sql()}"
+            )
+        cached = self._rx_cache
+        if cached is None or cached[0] != p:
+            rx = re.compile(
+                "".join(".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in p),
+                re.DOTALL,
+            )
+            self._rx_cache = cached = (p, rx)
+        return cached[1].fullmatch(v) is not None
+
+
+class StartsWith(_Binary):
+    op = "STARTSWITH"
+
+    def eval(self, row):
+        v = self.left.eval(row)
+        p = self.right.eval(row)
+        if v is None or p is None:
+            return None
+        return str(v).startswith(str(p))
+
+    def sql(self) -> str:
+        return f"startswith({self.left.sql()}, {self.right.sql()})"
+
+
+class Coalesce(Expression):
+    def __init__(self, *options: Expression):
+        self.children = tuple(options)
+
+    def eval(self, row):
+        for o in self.children:
+            v = o.eval(row)
+            if v is not None:
+                return v
+        return None
+
+    def sql(self) -> str:
+        return f"coalesce({', '.join(o.sql() for o in self.children)})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 [WHEN ...] ELSE d END. Children layout:
+    (c1, v1, c2, v2, ..., default)."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 default: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat.extend((c, v))
+        flat.append(default if default is not None else Literal(None))
+        self.children = tuple(flat)
+        self.n_branches = len(branches)
+
+    def eval(self, row):
+        for i in range(self.n_branches):
+            if self.children[2 * i].eval(row) is True:
+                return self.children[2 * i + 1].eval(row)
+        return self.children[-1].eval(row)
+
+    def sql(self) -> str:
+        parts = ["CASE"]
+        for i in range(self.n_branches):
+            parts.append(f"WHEN {self.children[2*i].sql()} THEN {self.children[2*i+1].sql()}")
+        parts.append(f"ELSE {self.children[-1].sql()} END")
+        return " ".join(parts)
+
+
+class Func(Expression):
+    """Named scalar function (whitelisted set, used by generated columns)."""
+
+    FUNCS: Dict[str, Callable[..., Any]] = {
+        "abs": lambda x: None if x is None else abs(x),
+        "length": lambda x: None if x is None else len(x),
+        "lower": lambda x: None if x is None else str(x).lower(),
+        "upper": lambda x: None if x is None else str(x).upper(),
+        "trim": lambda x: None if x is None else str(x).strip(),
+        "concat": lambda *xs: None if any(x is None for x in xs) else "".join(str(x) for x in xs),
+        "substring": lambda s, pos, ln=None: None if s is None else (
+            s[max(pos - 1, 0):] if ln is None else s[max(pos - 1, 0):max(pos - 1, 0) + ln]
+        ),
+        "year": lambda d: None if d is None else _epoch_day_field(d, "year"),
+        "month": lambda d: None if d is None else _epoch_day_field(d, "month"),
+        "day": lambda d: None if d is None else _epoch_day_field(d, "day"),
+        "hour": lambda t: None if t is None else ((t // 3_600_000_000) % 24),
+        "floor": lambda x: None if x is None else math.floor(x),
+        "ceil": lambda x: None if x is None else math.ceil(x),
+        "round": lambda x, n=0: None if x is None else round(x, n),
+    }
+
+    def __init__(self, name: str, args: Sequence[Expression]):
+        self.name = name.lower()
+        if self.name not in self.FUNCS:
+            raise DeltaAnalysisError(f"Unsupported function: {name}")
+        self.children = tuple(args)
+
+    def eval(self, row):
+        return self.FUNCS[self.name](*(a.eval(row) for a in self.children))
+
+    def sql(self) -> str:
+        return f"{self.name}({', '.join(a.sql() for a in self.children)})"
+
+
+def _epoch_day_field(days: Any, field: str) -> Optional[int]:
+    import datetime as _dt
+
+    if isinstance(days, _dt.date):
+        d = days
+    else:
+        d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(days))
+    return getattr(d, field)

@@ -1,0 +1,98 @@
+"""Partition-predicate evaluation over AddFile.partitionValues.
+
+The reference rewrites partition filters into ``partitionValues[col]`` map
+lookups with casts (``DeltaLog.rewritePartitionFilters``,
+``DeltaLog.scala:524-547``); here predicates are evaluated per-file against
+the typed partition values. Null/cast behavior matches: empty-string or
+missing values are NULL, cast failures are NULL, and a predicate evaluating
+to NULL does **not** match the file (Spark filter semantics) — except for
+conflict checking, where callers use :func:`matches_maybe` (NULL counts as a
+possible match, the conservative direction).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression
+from delta_tpu.protocol.actions import AddFile, Metadata
+from delta_tpu.schema.types import DataType, StringType, StructType
+
+__all__ = [
+    "typed_partition_row",
+    "eval_on_file",
+    "matches",
+    "matches_maybe",
+    "filter_files",
+    "is_partition_predicate",
+    "split_partition_and_data_predicates",
+]
+
+
+def typed_partition_row(add: AddFile, partition_schema: StructType) -> Dict[str, Any]:
+    """Partition values cast from their string form to the column types."""
+    row: Dict[str, Any] = {}
+    for f in partition_schema.fields:
+        raw: Optional[str] = None
+        for k, v in (add.partition_values or {}).items():
+            if k.lower() == f.name.lower():
+                raw = v
+                break
+        if raw is None or raw == "" or raw == "__HIVE_DEFAULT_PARTITION__":
+            row[f.name] = None
+        elif isinstance(f.data_type, StringType):
+            row[f.name] = raw
+        else:
+            row[f.name] = ir.cast_value(raw, f.data_type)
+    return row
+
+
+def eval_on_file(expr: ir.Expression, add: AddFile, partition_schema: StructType):
+    return expr.eval(typed_partition_row(add, partition_schema))
+
+
+def matches(expr: ir.Expression, add: AddFile, partition_schema: StructType) -> bool:
+    """Spark filter semantics: NULL → no match."""
+    return eval_on_file(expr, add, partition_schema) is True
+
+
+def matches_maybe(expr: ir.Expression, add: AddFile, partition_schema: StructType) -> bool:
+    """Conservative: NULL → possible match (used by the conflict checker)."""
+    return eval_on_file(expr, add, partition_schema) is not False
+
+
+def filter_files(
+    files: Iterable[AddFile],
+    predicates: Sequence[ir.Expression],
+    metadata: Metadata,
+) -> List[AddFile]:
+    """Files surviving the conjunction of partition predicates."""
+    if not predicates:
+        return list(files)
+    pschema = metadata.partition_schema
+    pred = ir.and_all(list(predicates))
+    return [f for f in files if matches(pred, f, pschema)]
+
+
+def is_partition_predicate(expr: ir.Expression, partition_columns: Sequence[str]) -> bool:
+    """True iff every referenced column is a partition column
+    (≈ ``DeltaTableUtils.isPredicatePartitionColumnsOnly``)."""
+    pset = {c.lower() for c in partition_columns}
+    # Reference-free predicates (e.g. TRUE) are partition predicates too.
+    return all(r.lower() in pset for r in ir.references(expr))
+
+
+def split_partition_and_data_predicates(
+    expr_or_str, partition_columns: Sequence[str]
+):
+    """Split a predicate's conjuncts into (partition-only, needs-data)
+    (≈ ``DeltaTableUtils.splitMetadataAndDataPredicates``)."""
+    expr = parse_expression(expr_or_str) if isinstance(expr_or_str, str) else expr_or_str
+    partition_preds: List[ir.Expression] = []
+    data_preds: List[ir.Expression] = []
+    for conj in ir.split_conjuncts(expr):
+        if is_partition_predicate(conj, partition_columns):
+            partition_preds.append(conj)
+        else:
+            data_preds.append(conj)
+    return partition_preds, data_preds

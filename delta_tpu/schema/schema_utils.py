@@ -1,0 +1,320 @@
+"""Schema enforcement & evolution rules.
+
+Reference: ``schema/SchemaUtils.scala`` (1,112 lines — the behavioral spec,
+per SURVEY §7 "Hard parts"). Key semantics reproduced here:
+
+* column-name hygiene (``checkFieldNames :1049``);
+* case-insensitive (but case-preserving) column resolution;
+* write-compatibility enforcement: data columns must exist in the table
+  schema unless ``mergeSchema`` evolution is requested;
+* ``merge_schemas`` (``:817``): recursive struct/array/map merge, new fields
+  appended at the end, NullType upgraded, type conflicts rejected (with an
+  opt-in widening lattice for CONVERT's parquet import);
+* ``is_read_compatible`` (``:265``) for streaming schema-change detection;
+* ALTER helpers: add/drop column at a position, ``can_change_data_type``.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from delta_tpu.schema.types import (
+    ArrayType,
+    AtomicType,
+    ByteType,
+    DataType,
+    IntegerType,
+    LongType,
+    MapType,
+    NullType,
+    ShortType,
+    StructField,
+    StructType,
+)
+from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+
+__all__ = [
+    "check_column_names",
+    "check_partition_columns",
+    "find_field",
+    "merge_schemas",
+    "enforce_write_compatibility",
+    "normalize_column_names",
+    "is_read_compatible",
+    "add_column",
+    "drop_column",
+    "can_change_data_type",
+    "column_path_to_name",
+]
+
+# checkFieldNames (SchemaUtils.scala:1049): these break Parquet/Hive paths.
+_INVALID_CHARS = set(' ,;{}()\n\t=')
+
+
+def check_column_names(schema: StructType) -> None:
+    def walk(dt: DataType, path: str):
+        if isinstance(dt, StructType):
+            for f in dt.fields:
+                bad = [c for c in f.name if c in _INVALID_CHARS]
+                if bad:
+                    raise DeltaAnalysisError(
+                        f"Attribute name \"{path + f.name}\" contains invalid character(s) "
+                        f"among \" ,;{{}}()\\n\\t=\". Please use alias to rename it."
+                    )
+                walk(f.data_type, path + f.name + ".")
+        elif isinstance(dt, ArrayType):
+            walk(dt.element_type, path)
+        elif isinstance(dt, MapType):
+            walk(dt.key_type, path)
+            walk(dt.value_type, path)
+
+    walk(schema, "")
+
+
+def check_partition_columns(partition_columns: Sequence[str], schema: StructType) -> None:
+    names = {f.name.lower() for f in schema.fields}
+    for c in partition_columns:
+        if c.lower() not in names:
+            raise DeltaAnalysisError(
+                f"Partition column `{c}` not found in schema {schema.simple_string()}"
+            )
+
+
+def find_field(schema: StructType, name: str) -> Optional[StructField]:
+    """Case-insensitive lookup; dotted names traverse nested structs."""
+    parts = name.split(".")
+    current: DataType = schema
+    field = None
+    for p in parts:
+        if not isinstance(current, StructType):
+            return None
+        field = next((f for f in current.fields if f.name.lower() == p.lower()), None)
+        if field is None:
+            return None
+        current = field.data_type
+    return field
+
+
+def column_path_to_name(path: Sequence[str]) -> str:
+    return ".".join(path)
+
+
+# ---------------------------------------------------------------------------
+# Schema merging (evolution)
+# ---------------------------------------------------------------------------
+
+# Opt-in widening for parquet imports (CONVERT TO DELTA), matching the
+# allowed conversions in mergeSchemas(allowImplicitConversions=true).
+_WIDENING: List[Tuple[type, type]] = [
+    (ByteType, ShortType),
+    (ByteType, IntegerType),
+    (ByteType, LongType),
+    (ShortType, IntegerType),
+    (ShortType, LongType),
+    (IntegerType, LongType),
+]
+
+
+def _can_widen(from_t: DataType, to_t: DataType) -> bool:
+    return any(isinstance(from_t, a) and isinstance(to_t, b) for a, b in _WIDENING)
+
+
+def merge_schemas(
+    current: StructType,
+    new: StructType,
+    allow_implicit_conversions: bool = False,
+    path: str = "",
+) -> StructType:
+    """Merge ``new`` into ``current``: existing columns keep the current
+    type/position/case, new columns are appended (``SchemaUtils.scala:817``)."""
+    merged: List[StructField] = []
+    new_by_lower = {f.name.lower(): f for f in new.fields}
+    for cur in current.fields:
+        incoming = new_by_lower.pop(cur.name.lower(), None)
+        if incoming is None:
+            merged.append(cur)
+            continue
+        merged_type = _merge_types(
+            cur.data_type, incoming.data_type, allow_implicit_conversions,
+            path + cur.name,
+        )
+        metadata = dict(cur.metadata)
+        if incoming.metadata:
+            metadata.update(incoming.metadata)
+        merged.append(
+            StructField(cur.name, merged_type, cur.nullable or incoming.nullable, metadata)
+        )
+    # Append genuinely new fields, preserving their order in `new`.
+    remaining = set(new_by_lower)
+    for f in new.fields:
+        if f.name.lower() in remaining:
+            merged.append(f)
+    return StructType(merged)
+
+
+def _merge_types(cur: DataType, new: DataType, widen: bool, path: str) -> DataType:
+    if isinstance(cur, StructType) and isinstance(new, StructType):
+        return merge_schemas(cur, new, widen, path + ".")
+    if isinstance(cur, ArrayType) and isinstance(new, ArrayType):
+        return ArrayType(
+            _merge_types(cur.element_type, new.element_type, widen, path + ".element"),
+            cur.contains_null or new.contains_null,
+        )
+    if isinstance(cur, MapType) and isinstance(new, MapType):
+        return MapType(
+            _merge_types(cur.key_type, new.key_type, widen, path + ".key"),
+            _merge_types(cur.value_type, new.value_type, widen, path + ".value"),
+            cur.value_contains_null or new.value_contains_null,
+        )
+    if isinstance(cur, NullType):
+        return new
+    if isinstance(new, NullType):
+        return cur
+    if cur == new:
+        return cur
+    if widen and _can_widen(new, cur):
+        return cur
+    if widen and _can_widen(cur, new):
+        return new
+    raise SchemaMismatchError(
+        f"Failed to merge fields '{path}': incompatible types "
+        f"{cur.simple_string()} and {new.simple_string()}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Write enforcement
+# ---------------------------------------------------------------------------
+
+def enforce_write_compatibility(table_schema: StructType, data_schema: StructType) -> None:
+    """Reject writes whose columns don't exist in the table (the
+    ``A schema mismatch detected`` error family). Missing table columns in
+    the data are fine (filled with nulls). Type equality is checked for
+    overlapping columns (after normalization casts are the writer's job)."""
+    extra = []
+    mismatched = []
+    table_by_lower = {f.name.lower(): f for f in table_schema.fields}
+    for f in data_schema.fields:
+        t = table_by_lower.get(f.name.lower())
+        if t is None:
+            extra.append(f.name)
+        elif not _write_type_compatible(f.data_type, t.data_type):
+            mismatched.append(
+                f"{f.name}: data {f.data_type.simple_string()} vs table {t.data_type.simple_string()}"
+            )
+    if extra or mismatched:
+        raise SchemaMismatchError(
+            "A schema mismatch detected when writing to the Delta table.\n"
+            + (f"Data columns not in the table schema: {extra}.\n" if extra else "")
+            + (f"Type mismatches: {mismatched}.\n" if mismatched else "")
+            + "To allow schema migration, set option mergeSchema=true."
+        )
+
+
+def _write_type_compatible(data_t: DataType, table_t: DataType) -> bool:
+    """Data can be written into the table column: equal type, NullType, or an
+    implicit numeric widening the write path will cast."""
+    if data_t == table_t or isinstance(data_t, NullType):
+        return True
+    if _can_widen(data_t, table_t):
+        return True
+    if isinstance(data_t, StructType) and isinstance(table_t, StructType):
+        table_by_lower = {f.name.lower(): f for f in table_t.fields}
+        for f in data_t.fields:
+            t = table_by_lower.get(f.name.lower())
+            if t is None or not _write_type_compatible(f.data_type, t.data_type):
+                return False
+        return True
+    if isinstance(data_t, ArrayType) and isinstance(table_t, ArrayType):
+        return _write_type_compatible(data_t.element_type, table_t.element_type)
+    if isinstance(data_t, MapType) and isinstance(table_t, MapType):
+        return _write_type_compatible(data_t.key_type, table_t.key_type) and _write_type_compatible(
+            data_t.value_type, table_t.value_type
+        )
+    return False
+
+
+def normalize_column_names(table_schema: StructType, data_schema: StructType) -> List[Tuple[str, str]]:
+    """(data_name, table_name) casing fixups (``normalizeColumnNames :223``)."""
+    out = []
+    table_by_lower = {f.name.lower(): f for f in table_schema.fields}
+    for f in data_schema.fields:
+        t = table_by_lower.get(f.name.lower())
+        if t is not None and t.name != f.name:
+            out.append((f.name, t.name))
+    return out
+
+
+def is_read_compatible(existing: StructType, new: StructType) -> bool:
+    """Can data written with ``existing`` still be read as ``new``?
+    (``isReadCompatible :265``) — new must contain every existing column with
+    the same type and must not tighten nullability."""
+    new_by_lower = {f.name.lower(): f for f in new.fields}
+    for f in existing.fields:
+        n = new_by_lower.get(f.name.lower())
+        if n is None:
+            return False
+        if not _type_read_compatible(f.data_type, n.data_type):
+            return False
+        if f.nullable and not n.nullable:
+            return False
+    return True
+
+
+def _type_read_compatible(old: DataType, new: DataType) -> bool:
+    if isinstance(old, StructType) and isinstance(new, StructType):
+        return is_read_compatible(old, new)
+    if isinstance(old, ArrayType) and isinstance(new, ArrayType):
+        return _type_read_compatible(old.element_type, new.element_type)
+    if isinstance(old, MapType) and isinstance(new, MapType):
+        return _type_read_compatible(old.key_type, new.key_type) and _type_read_compatible(
+            old.value_type, new.value_type
+        )
+    return old == new
+
+
+# ---------------------------------------------------------------------------
+# ALTER helpers
+# ---------------------------------------------------------------------------
+
+def add_column(schema: StructType, field: StructField, position: Optional[int] = None) -> StructType:
+    """Insert a top-level column at ``position`` (``addColumn :573``)."""
+    if any(f.name.lower() == field.name.lower() for f in schema.fields):
+        raise DeltaAnalysisError(f"Column {field.name} already exists")
+    fields = list(schema.fields)
+    if position is None or position >= len(fields):
+        fields.append(field)
+    else:
+        fields.insert(position, field)
+    return StructType(fields)
+
+
+def drop_column(schema: StructType, name: str) -> StructType:
+    """Remove a top-level column (``dropColumn :663``)."""
+    kept = [f for f in schema.fields if f.name.lower() != name.lower()]
+    if len(kept) == len(schema.fields):
+        raise DeltaAnalysisError(f"Column {name} does not exist")
+    if not kept:
+        raise DeltaAnalysisError("Cannot drop all columns from a table")
+    return StructType(kept)
+
+
+def can_change_data_type(from_t: DataType, to_t: DataType) -> bool:
+    """ALTER CHANGE COLUMN type changes (``canChangeDataType :694``): only
+    NullType→anything, or nested containers whose element change is legal.
+    (Comment/nullability-loosening changes are handled by the caller.)"""
+    if isinstance(from_t, NullType):
+        return True
+    if isinstance(from_t, StructType) and isinstance(to_t, StructType):
+        to_by_lower = {f.name.lower(): f for f in to_t.fields}
+        for f in from_t.fields:
+            t = to_by_lower.get(f.name.lower())
+            if t is None or not can_change_data_type(f.data_type, t.data_type):
+                return False
+        return True
+    if isinstance(from_t, ArrayType) and isinstance(to_t, ArrayType):
+        return can_change_data_type(from_t.element_type, to_t.element_type)
+    if isinstance(from_t, MapType) and isinstance(to_t, MapType):
+        return can_change_data_type(from_t.key_type, to_t.key_type) and can_change_data_type(
+            from_t.value_type, to_t.value_type
+        )
+    return from_t == to_t

@@ -1,0 +1,418 @@
+"""OptimisticTransaction — snapshot-pinned read/write with OCC commit.
+
+Reference: ``OptimisticTransaction.scala:84-936``. A transaction pins the
+table snapshot at creation, records what it reads (predicates, files, app
+ids), stages metadata changes, and commits by atomically creating the next
+``<v>.json``; on a lost race it replays winning commits through the conflict
+checker (``delta_tpu.txn.conflicts``) and retries.
+"""
+from __future__ import annotations
+
+import contextvars
+import logging
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from delta_tpu.expr import ir
+from delta_tpu.expr import partition as part
+from delta_tpu.expr.parser import parse_expression
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import (
+    Action,
+    AddCDCFile,
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+    actions_from_lines,
+)
+from delta_tpu.schema import schema_utils
+from delta_tpu.txn import conflicts as conflicts_mod
+from delta_tpu.txn import isolation
+from delta_tpu.utils.config import DeltaConfigs, conf
+from delta_tpu.utils import errors
+from delta_tpu.utils.telemetry import record_operation
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["OptimisticTransaction", "CommitStats"]
+
+_active_txn: "contextvars.ContextVar[Optional[OptimisticTransaction]]" = contextvars.ContextVar(
+    "active_delta_txn", default=None
+)
+
+
+@dataclass
+class CommitStats:
+    """Telemetry emitted per commit (``OptimisticTransaction.scala:45-71``)."""
+
+    start_version: int = -1
+    committed_version: int = -1
+    attempts: int = 0
+    txn_duration_ms: int = 0
+    commit_duration_ms: int = 0
+    num_add: int = 0
+    num_remove: int = 0
+    bytes_new: int = 0
+    num_files_total: int = 0
+    size_in_bytes_total: int = 0
+    isolation_level: str = ""
+    is_blind_append: bool = False
+
+
+class OptimisticTransaction:
+    def __init__(self, delta_log, snapshot=None):
+        self.delta_log = delta_log
+        self.snapshot = snapshot if snapshot is not None else delta_log.snapshot
+        self.read_version: int = self.snapshot.version
+        self._start_ms = delta_log.clock()
+
+        # read-set tracking (OptimisticTransaction.scala:167-179)
+        self.read_predicates: List[ir.Expression] = []
+        # keyed by path — AddFile carries dict fields and is not hashable
+        self.read_files: Dict[str, AddFile] = {}
+        self.read_the_whole_table: bool = False
+        self.read_txn: List[str] = []
+
+        # staged changes
+        self.new_metadata: Optional[Metadata] = None
+        self.new_protocol: Optional[Protocol] = None
+
+        self._committed = False
+        self.commit_isolation_level = isolation.WriteSerializable
+        self.staged_removes: List[RemoveFile] = []
+        self.post_commit_hooks: List = []
+        self.operation_metrics: Dict[str, str] = {}
+        self.user_metadata: Optional[str] = None
+        self.stats = CommitStats(start_version=self.read_version)
+
+    # -- ambient active transaction (scala:99-144) ----------------------
+
+    @staticmethod
+    def set_active(txn: "OptimisticTransaction"):
+        if _active_txn.get() is not None:
+            raise errors.DeltaIllegalStateError("Cannot set a new txn as active when one is already active")
+        return _active_txn.set(txn)
+
+    @staticmethod
+    def clear_active(token) -> None:
+        _active_txn.reset(token)
+
+    @staticmethod
+    def get_active() -> Optional["OptimisticTransaction"]:
+        return _active_txn.get()
+
+    # -- current view ----------------------------------------------------
+
+    @property
+    def metadata(self) -> Metadata:
+        return self.new_metadata if self.new_metadata is not None else self.snapshot.metadata
+
+    @property
+    def protocol(self) -> Protocol:
+        return self.new_protocol if self.new_protocol is not None else self.snapshot.protocol
+
+    def txn_version(self, app_id: str) -> int:
+        """Latest committed version for a streaming appId; records the read
+        for conflict detection (``DeltaSink`` idempotency)."""
+        self.read_txn.append(app_id)
+        return self.snapshot.transaction_version(app_id)
+
+    # -- metadata --------------------------------------------------------
+
+    def update_metadata(self, metadata: Metadata) -> None:
+        """Stage a metadata update; allowed once per txn, before writes
+        (``OptimisticTransaction.scala:232-361``)."""
+        if self._committed:
+            raise errors.DeltaIllegalStateError("Cannot update metadata in a committed txn")
+        if self.new_metadata is not None:
+            raise errors.DeltaIllegalStateError("Cannot change the metadata more than once in a transaction.")
+        if self.read_version == -1 or self.snapshot.metadata.schema_string is None:
+            metadata = replace(
+                metadata,
+                configuration=DeltaConfigs.merge_global_configs(metadata.configuration),
+            )
+        if metadata.schema_string is not None:
+            schema_utils.check_column_names(metadata.schema)
+            schema_utils.check_partition_columns(metadata.partition_columns, metadata.schema)
+        cfg = DeltaConfigs.validate_configuration(metadata.configuration)
+        metadata = replace(metadata, configuration=cfg)
+        # keep table id stable across metadata updates
+        if self.read_version >= 0 and self.snapshot.metadata.id:
+            metadata = replace(metadata, id=self.snapshot.metadata.id)
+        self.new_metadata = metadata
+        self.new_protocol = self._required_protocol_upgrade(metadata)
+
+    def _required_protocol_upgrade(self, metadata: Metadata) -> Optional[Protocol]:
+        """Feature-driven minimum protocol (``actions.scala:124-159``)."""
+        required_writer = 2
+        props = metadata.configuration or {}
+        schema = metadata.schema
+        uses_generated = any(
+            "delta.generationExpression" in (f.metadata or {}) for f in schema.fields
+        )
+        uses_constraints = any(k.lower().startswith("delta.constraints.") for k in props)
+        uses_cdf = props.get("delta.enableChangeDataFeed", "false").lower() == "true"
+        if uses_generated or uses_cdf:
+            required_writer = 4
+        elif uses_constraints:
+            required_writer = max(required_writer, 3)
+        pinned_reader = props.get("delta.minReaderVersion")
+        pinned_writer = props.get("delta.minWriterVersion")
+        cur = self.protocol
+        new_reader = max(cur.min_reader_version, int(pinned_reader) if pinned_reader else 1)
+        new_writer = max(cur.min_writer_version, required_writer if required_writer > 2 else cur.min_writer_version,
+                         int(pinned_writer) if pinned_writer else 1)
+        if self.read_version == -1:
+            # new table: start at spec default unless features demand more
+            new_writer = max(2, required_writer, int(pinned_writer) if pinned_writer else 0)
+            new_reader = max(1, int(pinned_reader) if pinned_reader else 0)
+            return Protocol(new_reader, new_writer)
+        if (new_reader, new_writer) != (cur.min_reader_version, cur.min_writer_version):
+            return Protocol(new_reader, new_writer)
+        return self.new_protocol
+
+    # -- reads -----------------------------------------------------------
+
+    def filter_files(self, predicates: Optional[Sequence] = None) -> List[AddFile]:
+        """Files matching partition ``predicates``; records the read set
+        (``OptimisticTransaction.scala:364-380``)."""
+        exprs = [parse_expression(p) if isinstance(p, str) else p for p in (predicates or [])]
+        pcols = self.metadata.partition_columns
+        partition_preds = [e for e in exprs if part.is_partition_predicate(e, pcols)]
+        if not exprs:
+            self.read_predicates.append(ir.TRUE)
+        else:
+            self.read_predicates.extend(partition_preds if partition_preds else [ir.TRUE])
+        matched = part.filter_files(self.snapshot.all_files, partition_preds, self.metadata)
+        self.read_files.update({f.path: f for f in matched})
+        return matched
+
+    def read_whole_table(self) -> None:
+        self.read_predicates.append(ir.TRUE)
+        self.read_the_whole_table = True
+
+    # -- commit ----------------------------------------------------------
+
+    def commit(self, actions: Sequence[Action], op, tags: Optional[Dict[str, str]] = None) -> int:
+        """Run the full commit pipeline; returns the committed version
+        (``OptimisticTransaction.scala:422-490``)."""
+        with record_operation("delta.commit", path=self.delta_log.data_path):
+            actions = self._prepare_commit(list(actions))
+
+            # Isolation pick (scala:432-440): data-changing commits use
+            # WriteSerializable; rearrange-only commits can use SnapshotIsolation.
+            no_data_changed = all(
+                not a.data_change for a in actions if isinstance(a, (AddFile, RemoveFile))
+            )
+            self.commit_isolation_level = (
+                isolation.SnapshotIsolation if no_data_changed else isolation.WriteSerializable
+            )
+
+            # Blind-append detection (scala:442-447)
+            only_add_files = all(
+                isinstance(a, AddFile)
+                for a in actions
+                if isinstance(a, (AddFile, RemoveFile, AddCDCFile))
+            )
+            depends_on_files = bool(self.read_predicates) or bool(self.read_files)
+            is_blind_append = only_add_files and not depends_on_files
+
+            self.staged_removes = [a for a in actions if isinstance(a, RemoveFile)]
+
+            commit_info = CommitInfo(
+                timestamp=self.delta_log.clock(),
+                operation=op.name,
+                operation_parameters=op.json_encoded_values,
+                read_version=self.read_version if self.read_version >= 0 else None,
+                isolation_level=self.commit_isolation_level.name,
+                is_blind_append=is_blind_append,
+                operation_metrics=self._final_metrics(op),
+                user_metadata=self.user_metadata or op.user_metadata,
+                engine_info="delta-tpu/0.1.0",
+            )
+            full_actions = [commit_info] + actions
+
+            commit_start = self.delta_log.clock()
+            version = self._do_commit_retry(full_actions)
+            self._committed = True
+
+            self.stats.committed_version = version
+            self.stats.commit_duration_ms = self.delta_log.clock() - commit_start
+            self.stats.txn_duration_ms = self.delta_log.clock() - self._start_ms
+            self.stats.isolation_level = self.commit_isolation_level.name
+            self.stats.is_blind_append = is_blind_append
+            self.stats.num_add = sum(isinstance(a, AddFile) for a in actions)
+            self.stats.num_remove = sum(isinstance(a, RemoveFile) for a in actions)
+            self.stats.bytes_new = sum(
+                a.size for a in actions if isinstance(a, AddFile) and a.data_change
+            )
+
+            self._post_commit(version)
+            return version
+
+    # -- commit internals ------------------------------------------------
+
+    def _prepare_commit(self, actions: List[Action]) -> List[Action]:
+        """Validation + first-commit injection
+        (``OptimisticTransaction.scala:496-579``)."""
+        if self._committed:
+            raise errors.DeltaIllegalStateError("Transaction already committed.")
+
+        metadata_actions = [a for a in actions if isinstance(a, Metadata)]
+        if self.new_metadata is not None:
+            if metadata_actions:
+                raise errors.DeltaIllegalStateError(
+                    "Cannot change the metadata more than once in a transaction."
+                )
+            actions = [self.new_metadata] + actions
+            metadata_actions = [self.new_metadata]
+        if len(metadata_actions) > 1:
+            raise errors.DeltaIllegalStateError(
+                "Cannot change the metadata more than once in a transaction."
+            )
+
+        if self.new_protocol is not None:
+            actions = [self.new_protocol] + actions
+
+        if self.read_version == -1:
+            # Initialize a brand-new table (scala:516-528)
+            if not any(isinstance(a, Metadata) for a in actions):
+                raise errors.DeltaIllegalStateError(
+                    "Couldn't find required Metadata action to create the table's first commit."
+                )
+            if not any(isinstance(a, Protocol) for a in actions):
+                actions = [self.protocol] + actions
+
+        current_metadata = next(
+            (a for a in actions if isinstance(a, Metadata)), self.metadata
+        )
+        if current_metadata.schema_string is None and any(
+            isinstance(a, AddFile) for a in actions
+        ):
+            raise errors.DeltaIllegalStateError(
+                "Table schema is not set. Write data to it or use CREATE TABLE to set the schema."
+            )
+
+        # AddFile partitioning consistency (scala:545-564)
+        pcols = current_metadata.partition_columns
+        for a in actions:
+            if isinstance(a, AddFile):
+                if sorted(a.partition_values.keys()) != sorted(pcols):
+                    raise errors.DeltaIllegalStateError(
+                        f"The AddFile contains partitioning schema different from the "
+                        f"table's partitioning schema: {sorted(a.partition_values)} vs {sorted(pcols)}"
+                    )
+
+        # Append-only enforcement (scala:575-576)
+        if DeltaConfigs.IS_APPEND_ONLY.from_metadata(current_metadata):
+            for a in actions:
+                if isinstance(a, RemoveFile) and a.data_change:
+                    raise errors.DeltaUnsupportedOperationError(
+                        "This table is configured to only allow appends (delta.appendOnly=true)."
+                    )
+
+        # Protocol write gate for the (possibly updated) protocol
+        proto = next((a for a in actions if isinstance(a, Protocol)), self.protocol)
+        self.delta_log.assert_protocol_write(proto)
+
+        # CDC writes are protocol-gated like the reference blocks them (actions.scala:151-156)
+        if any(isinstance(a, AddCDCFile) for a in actions):
+            if not DeltaConfigs.CHANGE_DATA_FEED.from_metadata(current_metadata):
+                raise errors.DeltaUnsupportedOperationError(
+                    "Cannot write change data files to a table without delta.enableChangeDataFeed=true"
+                )
+        return actions
+
+    def _do_commit_retry(self, actions: List[Action]) -> int:
+        """Retry loop (``doCommitRetryIteratively``, scala:610-642)."""
+        max_attempts = conf.get("delta.tpu.maxCommitAttempts")
+        attempt_version = self.read_version + 1
+        attempts = 0
+        with self.delta_log.lock:
+            while True:
+                attempts += 1
+                self.stats.attempts = attempts
+                if attempts > max_attempts:
+                    raise errors.DeltaIllegalStateError(
+                        f"This commit has failed as it has been tried {attempts - 1} times but did not succeed."
+                    )
+                try:
+                    self._write_commit(attempt_version, actions)
+                    return attempt_version
+                except FileExistsError:
+                    attempt_version = self._check_and_retry(attempt_version, actions)
+
+    def _write_commit(self, version: int, actions: List[Action]) -> None:
+        path = f"{self.delta_log.log_path}/{filenames.delta_file(version)}"
+        # Stamp CommitInfo with the version for history readers.
+        out = []
+        for a in actions:
+            if isinstance(a, CommitInfo):
+                a = a.with_version_timestamp(version)
+            out.append(a.json())
+        self.delta_log.store.write(path, out, overwrite=False)
+
+    def _check_and_retry(self, failed_version: int, actions: List[Action]) -> int:
+        """Replay winning commits through the conflict checker
+        (``checkForConflicts``); returns the next version to attempt."""
+        with record_operation("delta.commit.retry.conflictCheck", path=self.delta_log.data_path):
+            next_attempt = failed_version
+            while True:
+                path = f"{self.delta_log.log_path}/{filenames.delta_file(next_attempt)}"
+                try:
+                    winning = actions_from_lines(self.delta_log.store.read_iter(path))
+                except FileNotFoundError:
+                    break
+                conflicts_mod.check_for_conflicts(self, next_attempt, winning)
+                next_attempt += 1
+            if next_attempt == failed_version:
+                # The write failed but the file doesn't exist: storage lied about
+                # mutual exclusion (scala:683-691).
+                raise errors.ConcurrentWriteException(
+                    "A concurrent transaction has written new data since the current "
+                    "transaction read the table, and the commit file is not readable."
+                )
+            return next_attempt
+
+    def _post_commit(self, version: int) -> None:
+        """Checkpointing, checksum, hooks (scala:582-594, 880-915)."""
+        snapshot = self.delta_log.update_after_commit(version)
+        if snapshot.version == version:
+            self.delta_log.write_checksum_for(snapshot)
+        interval = DeltaConfigs.CHECKPOINT_INTERVAL.from_metadata(self.metadata)
+        if version != 0 and version % interval == 0:
+            try:
+                self.delta_log.checkpoint(
+                    snapshot if snapshot.version == version else self.delta_log.get_snapshot_at(version)
+                )
+            except Exception:  # noqa: BLE001 — checkpointing must not fail the commit
+                logger.warning("Post-commit checkpoint at version %s failed", version, exc_info=True)
+        for hook in self.post_commit_hooks:
+            try:
+                hook.run(self, version, snapshot)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("Post-commit hook %s failed: %s", getattr(hook, "name", hook), e)
+                handler = getattr(hook, "handle_error", None)
+                if handler:
+                    handler(e, version)
+
+    def register_post_commit_hook(self, hook) -> None:
+        if hook not in self.post_commit_hooks:
+            self.post_commit_hooks.append(hook)
+
+    def _final_metrics(self, op) -> Optional[Dict[str, str]]:
+        if not conf.get("delta.tpu.history.metricsEnabled"):
+            return None
+        if not self.operation_metrics:
+            return None
+        whitelist = set(op.metric_whitelist)
+        if not whitelist:
+            return dict(self.operation_metrics)
+        return {k: v for k, v in self.operation_metrics.items() if k in whitelist}
+
+    def report_metrics(self, **metrics: Any) -> None:
+        for k, v in metrics.items():
+            self.operation_metrics[k] = str(v)

@@ -1,0 +1,103 @@
+"""Structured telemetry — the usage-logging interface.
+
+Reference: ``metering/DeltaLogging.scala:50-109`` wraps every user action in
+``recordDeltaOperation(opType)`` / ``recordDeltaEvent`` with hierarchical op
+types (e.g. ``delta.commit.retry.conflictCheck``) and JSON payloads; the OSS
+backend is a no-op stub. Here the backend is real: events go to an in-process
+ring buffer (inspectable in tests / ops tooling) and to a standard ``logging``
+logger, and each operation is additionally wrapped in a JAX profiler trace
+annotation when JAX is initialized, so device timelines line up with engine
+operations.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+logger = logging.getLogger("delta_tpu.usage")
+
+__all__ = ["record_event", "record_operation", "recent_events", "clear_events", "UsageEvent"]
+
+
+@dataclass
+class UsageEvent:
+    op_type: str
+    timestamp_ms: int
+    duration_ms: Optional[int] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+    data: Dict[str, Any] = field(default_factory=dict)
+    error: Optional[str] = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "opType": self.op_type,
+                "timestamp": self.timestamp_ms,
+                "durationMs": self.duration_ms,
+                "tags": self.tags,
+                "data": self.data,
+                "error": self.error,
+            },
+            separators=(",", ":"),
+            default=str,
+        )
+
+
+_BUFFER: Deque[UsageEvent] = deque(maxlen=4096)
+_LOCK = threading.Lock()
+
+
+def record_event(op_type: str, data: Optional[Dict[str, Any]] = None, **tags: str) -> None:
+    ev = UsageEvent(op_type, int(time.time() * 1000), tags={k: str(v) for k, v in tags.items()},
+                    data=data or {})
+    with _LOCK:
+        _BUFFER.append(ev)
+    logger.debug("%s", ev.to_json())
+
+
+@contextlib.contextmanager
+def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags: str) -> Iterator[UsageEvent]:
+    """Wrap an operation: duration + error capture + JAX profiler annotation."""
+    ev = UsageEvent(op_type, int(time.time() * 1000), tags={k: str(v) for k, v in tags.items()},
+                    data=dict(data or {}))
+    start = time.monotonic()
+    trace_ctx = _maybe_jax_trace(op_type)
+    try:
+        with trace_ctx:
+            yield ev
+    except BaseException as e:
+        ev.error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        ev.duration_ms = int((time.monotonic() - start) * 1000)
+        with _LOCK:
+            _BUFFER.append(ev)
+        logger.debug("%s", ev.to_json())
+
+
+def _maybe_jax_trace(name: str):
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            return jax.named_scope(name.replace("delta.", "delta/"))
+    except Exception:  # noqa: BLE001
+        pass
+    return contextlib.nullcontext()
+
+
+def recent_events(op_prefix: str = "") -> List[UsageEvent]:
+    with _LOCK:
+        return [e for e in _BUFFER if e.op_type.startswith(op_prefix)]
+
+
+def clear_events() -> None:
+    with _LOCK:
+        _BUFFER.clear()

@@ -1,0 +1,151 @@
+"""Expression IR + parser + partition pruning semantics."""
+import pytest
+
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression
+from delta_tpu.expr import partition as part
+from delta_tpu.protocol.actions import AddFile, Metadata
+from delta_tpu.schema.types import (
+    DateType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructType,
+)
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+
+def ev(s, row=None):
+    return parse_expression(s).eval(row or {})
+
+
+class TestParserEval:
+    def test_literals(self):
+        assert ev("1 + 2") == 3
+        assert ev("2 * 3 + 4") == 10
+        assert ev("2 + 3 * 4") == 14
+        assert ev("(2 + 3) * 4") == 20
+        assert ev("'it''s'") == "it's"
+        assert ev("TRUE") is True
+        assert ev("NULL") is None
+        assert ev("1.5e2") == 150.0
+        assert ev("-3") == -3
+
+    def test_comparisons(self):
+        assert ev("1 < 2") is True
+        assert ev("1 >= 2") is False
+        assert ev("'a' = 'a'") is True
+        assert ev("'a' != 'b'") is True
+        assert ev("1 <> 2") is True
+
+    def test_three_valued_logic(self):
+        assert ev("NULL = 1") is None
+        assert ev("NULL AND FALSE") is False
+        assert ev("NULL AND TRUE") is None
+        assert ev("NULL OR TRUE") is True
+        assert ev("NULL OR FALSE") is None
+        assert ev("NOT NULL") is None
+        assert ev("NULL <=> NULL") is True
+        assert ev("1 <=> NULL") is False
+
+    def test_columns(self):
+        row = {"id": 5, "name": "x"}
+        assert ev("id > 3", row) is True
+        assert ev("ID > 3", row) is True  # case-insensitive
+        assert ev("name = 'x'", row) is True
+        with pytest.raises(DeltaAnalysisError):
+            ev("missing = 1", row)
+
+    def test_in_between_like(self):
+        assert ev("3 IN (1, 2, 3)") is True
+        assert ev("4 IN (1, 2, 3)") is False
+        assert ev("4 NOT IN (1, 2, 3)") is True
+        assert ev("NULL IN (1, 2)") is None
+        assert ev("5 IN (1, NULL)") is None  # null in list w/o match
+        assert ev("5 BETWEEN 1 AND 10") is True
+        assert ev("'abc' LIKE 'a%'") is True
+        assert ev("'abc' LIKE 'a_c'") is True
+        assert ev("'abc' NOT LIKE 'b%'") is True
+
+    def test_is_null(self):
+        assert ev("NULL IS NULL") is True
+        assert ev("1 IS NOT NULL") is True
+
+    def test_cast(self):
+        assert ev("CAST('12' AS INT)") == 12
+        assert ev("CAST(1 AS STRING)") == "1"
+        assert ev("CAST('abc' AS INT)") is None  # permissive
+        assert ev("CAST('true' AS BOOLEAN)") is True
+
+    def test_div_by_zero_null(self):
+        assert ev("1 / 0") is None
+        assert ev("1 % 0") is None
+
+    def test_case_when(self):
+        assert ev("CASE WHEN 1 < 2 THEN 'a' ELSE 'b' END") == "a"
+        assert ev("CASE WHEN 1 > 2 THEN 'a' END") is None
+
+    def test_functions(self):
+        assert ev("abs(-3)") == 3
+        assert ev("upper('ab')") == "AB"
+        assert ev("length('abc')") == 3
+        assert ev("concat('a', 'b')") == "ab"
+        assert ev("substring('hello', 2, 3)") == "ell"
+        assert ev("year(CAST('2021-03-05' AS DATE))") == 2021
+
+    def test_backtick_and_dotted(self):
+        assert ev("`weird col` = 1", {"weird col": 1}) is True
+        e = parse_expression("a.b = 1")
+        assert isinstance(e.left, ir.Column) and e.left.name == "a.b"
+
+    def test_errors(self):
+        with pytest.raises(DeltaAnalysisError):
+            parse_expression("1 +")
+        with pytest.raises(DeltaAnalysisError):
+            parse_expression("nosuchfunc(1)")
+        with pytest.raises(DeltaAnalysisError):
+            parse_expression("a = 1 extra")
+
+    def test_sql_roundtrip(self):
+        for s in ["((a > 1) AND (b = 'x'))", "(a IN (1, 2))", "(a IS NULL)"]:
+            assert parse_expression(parse_expression(s).sql()) == parse_expression(s)
+
+
+SCHEMA = (
+    StructType()
+    .add("id", LongType())
+    .add("date", StringType())
+    .add("part", IntegerType())
+)
+META = Metadata(schema_string=SCHEMA.to_json(), partition_columns=["part", "date"])
+
+
+def f(part_vals, path="f"):
+    return AddFile(path, part_vals, 1, 1, True)
+
+
+class TestPartitionPruning:
+    def test_typed_cast(self):
+        files = [f({"part": "1", "date": "a"}, "f1"), f({"part": "2", "date": "b"}, "f2")]
+        pred = parse_expression("part = 1")  # int literal vs string-stored value
+        out = part.filter_files(files, [pred], META)
+        assert [x.path for x in out] == ["f1"]
+
+    def test_null_partition_value(self):
+        files = [f({"part": None, "date": "a"}, "fnull"), f({"part": "3", "date": "a"}, "f3")]
+        assert [x.path for x in part.filter_files(files, [parse_expression("part IS NULL")], META)] == ["fnull"]
+        # null never matches an equality
+        assert [x.path for x in part.filter_files(files, [parse_expression("part = 3")], META)] == ["f3"]
+
+    def test_split_predicates(self):
+        ppreds, dpreds = part.split_partition_and_data_predicates(
+            "part = 1 AND id > 10 AND date = 'x'", ["part", "date"]
+        )
+        assert [p.sql() for p in ppreds] == ["(part = 1)", "(date = 'x')"]
+        assert [p.sql() for p in dpreds] == ["(id > 10)"]
+
+    def test_conservative_matching(self):
+        fl = f({"part": None, "date": "a"})
+        pred = parse_expression("part = 1")
+        assert part.matches(pred, fl, META.partition_schema) is False
+        assert part.matches_maybe(pred, fl, META.partition_schema) is True

@@ -1,0 +1,287 @@
+"""OCC transaction tests — the conflict matrix.
+
+Port of the *semantics* of ``OptimisticTransactionSuite.scala:36-516``
+("block/allow concurrent X vs Y") plus commit-pipeline behaviors
+(first-commit injection, retry, append-only, blind-append detection).
+"""
+import threading
+
+import pytest
+
+from tests.conftest import init_metadata
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.protocol.actions import AddFile, Metadata, Protocol, RemoveFile, SetTransaction
+from delta_tpu.schema.types import IntegerType, StringType, StructType
+from delta_tpu.utils import errors
+
+
+PART_SCHEMA = StructType().add("id", IntegerType()).add("part", StringType())
+
+
+def add(path, part=None, data_change=True):
+    pv = {} if part is None else {"part": part}
+    return AddFile(path, pv, 1, 1, data_change)
+
+
+def create_table(tmp_table, partitioned=False, configuration=None):
+    log = DeltaLog.for_table(tmp_table)
+    txn = log.start_transaction()
+    if partitioned:
+        md = Metadata(schema_string=PART_SCHEMA.to_json(), partition_columns=["part"],
+                      configuration=dict(configuration or {}))
+    else:
+        md = init_metadata(configuration=configuration)
+    txn.update_metadata(md)
+    txn.commit([], ops.ManualUpdate())
+    return log
+
+
+class TestCommitPipeline:
+    def test_first_commit_injects_protocol(self, tmp_table):
+        log = create_table(tmp_table)
+        snap = log.update()
+        assert snap.version == 0
+        assert snap.protocol.min_writer_version >= 2
+        assert snap.metadata.schema.field_names == ["id", "value"]
+
+    def test_versions_increment(self, tmp_table):
+        log = create_table(tmp_table)
+        for i in range(3):
+            txn = log.start_transaction()
+            v = txn.commit([add(f"f{i}")], ops.Write("Append"))
+            assert v == i + 1
+        assert len(log.update().all_files) == 3
+
+    def test_commit_info_written(self, tmp_table):
+        log = create_table(tmp_table)
+        txn = log.start_transaction()
+        txn.commit([add("f0")], ops.Write("Append"))
+        history = log.history.get_history()
+        assert history[0].operation == "WRITE"
+        assert history[0].is_blind_append is True
+        assert history[0].version == 1
+        assert history[1].operation == "Manual Update"
+
+    def test_cannot_commit_twice(self, tmp_table):
+        log = create_table(tmp_table)
+        txn = log.start_transaction()
+        txn.commit([add("f0")], ops.Write("Append"))
+        with pytest.raises(errors.DeltaIllegalStateError):
+            txn.commit([add("f1")], ops.Write("Append"))
+
+    def test_metadata_change_only_once(self, tmp_table):
+        log = create_table(tmp_table)
+        txn = log.start_transaction()
+        txn.update_metadata(init_metadata())
+        with pytest.raises(errors.DeltaIllegalStateError):
+            txn.update_metadata(init_metadata())
+
+    def test_first_commit_requires_metadata(self, tmp_table):
+        log = DeltaLog.for_table(tmp_table)
+        txn = log.start_transaction()
+        with pytest.raises(errors.DeltaIllegalStateError):
+            txn.commit([add("f0")], ops.Write("Append"))
+
+    def test_add_partition_values_must_match_schema(self, tmp_table):
+        log = create_table(tmp_table, partitioned=True)
+        txn = log.start_transaction()
+        with pytest.raises(errors.DeltaIllegalStateError):
+            txn.commit([add("f0")], ops.Write("Append"))  # missing part value
+        txn2 = log.start_transaction()
+        txn2.commit([add("f0", part="a")], ops.Write("Append"))
+
+    def test_append_only_table_blocks_deletes(self, tmp_table):
+        log = create_table(tmp_table, configuration={"delta.appendOnly": "true"})
+        txn = log.start_transaction()
+        txn.commit([add("f0")], ops.Write("Append"))
+        txn2 = log.start_transaction()
+        with pytest.raises(errors.DeltaUnsupportedOperationError):
+            txn2.commit([RemoveFile("f0", deletion_timestamp=1)], ops.Delete())
+
+    def test_checkpoint_written_at_interval(self, tmp_table):
+        log = create_table(tmp_table, configuration={"delta.checkpointInterval": "4"})
+        for i in range(5):
+            log.start_transaction().commit([add(f"f{i}")], ops.Write("Append"))
+        from delta_tpu.protocol import filenames
+
+        assert log.store.exists(f"{log.log_path}/{filenames.checkpoint_file_single(4)}")
+
+    def test_txn_version_roundtrip(self, tmp_table):
+        log = create_table(tmp_table)
+        txn = log.start_transaction()
+        assert txn.txn_version("stream-1") == -1
+        txn.commit([SetTransaction("stream-1", 7, None), add("f0")], ops.StreamingUpdate("Append", "stream-1", 7))
+        txn2 = log.start_transaction()
+        assert txn2.txn_version("stream-1") == 7
+
+
+class TestConflictMatrix:
+    """Each test: txn A starts & reads; txn B commits concurrently; A commits."""
+
+    def _two_txns(self, log):
+        a = log.start_transaction()
+        return a
+
+    def test_allow_disjoint_blind_appends(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        log.start_transaction().commit([add("b1")], ops.Write("Append"))
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 2
+        assert len(log.update().all_files) == 2
+
+    def test_read_whole_table_vs_nonblind_append_blocks(self, tmp_table):
+        log = create_table(tmp_table)
+        log.start_transaction().commit([add("f0")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files()  # read (taints whole table via TRUE predicate)
+        # B reads too (non-blind) then appends
+        b = log.start_transaction()
+        b.filter_files()
+        b.commit([add("b1")], ops.Write("Append"))
+        with pytest.raises(errors.ConcurrentAppendException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_read_whole_table_vs_blind_append_allowed_write_serializable(self, tmp_table):
+        # WriteSerializable (default): blind appends never conflict with reads
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        a.filter_files()
+        log.start_transaction().commit([add("b1")], ops.Write("Append"))  # blind
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 2
+
+    def test_disjoint_partitions_do_not_conflict(self, tmp_table):
+        log = create_table(tmp_table, partitioned=True)
+        log.start_transaction().commit([add("f0", part="x")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files(["part = 'x'"])
+        b = log.start_transaction()
+        b.filter_files(["part = 'y'"])
+        b.commit([add("b1", part="y")], ops.Write("Append"))
+        v = a.commit([add("a1", part="x")], ops.Write("Append"))
+        assert v == 3
+
+    def test_same_partition_conflicts(self, tmp_table):
+        log = create_table(tmp_table, partitioned=True)
+        log.start_transaction().commit([add("f0", part="x")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files(["part = 'x'"])
+        b = log.start_transaction()
+        b.filter_files(["part = 'x'"])
+        b.commit([add("b1", part="x")], ops.Write("Append"))
+        with pytest.raises(errors.ConcurrentAppendException):
+            a.commit([add("a1", part="x")], ops.Write("Append"))
+
+    def test_concurrent_delete_of_read_file(self, tmp_table):
+        log = create_table(tmp_table)
+        log.start_transaction().commit([add("f0")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files()
+        assert set(a.read_files) == {"f0"}
+        b = log.start_transaction()
+        b.filter_files()
+        b.commit([RemoveFile("f0", deletion_timestamp=1)], ops.Delete())
+        with pytest.raises(errors.ConcurrentDeleteReadException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_concurrent_delete_delete(self, tmp_table):
+        log = create_table(tmp_table)
+        log.start_transaction().commit([add("f0")], ops.Write("Append"))
+        a = log.start_transaction()
+        b = log.start_transaction()
+        b.commit([RemoveFile("f0", deletion_timestamp=1)], ops.Delete())
+        with pytest.raises(errors.ConcurrentDeleteDeleteException):
+            a.commit([RemoveFile("f0", deletion_timestamp=2)], ops.Delete())
+
+    def test_metadata_change_conflicts(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        b = log.start_transaction()
+        b.update_metadata(init_metadata(configuration={"delta.checkpointInterval": "20"}))
+        b.commit([], ops.SetTableProperties({"delta.checkpointInterval": "20"}))
+        with pytest.raises(errors.MetadataChangedException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_protocol_change_conflicts(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        b = log.start_transaction()
+        b.new_protocol = Protocol(1, 3)
+        b.commit([], ops.UpgradeProtocol(Protocol(1, 3)))
+        with pytest.raises(errors.ProtocolChangedException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_concurrent_set_transaction_conflicts(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        a.txn_version("app-1")
+        b = log.start_transaction()
+        b.commit([SetTransaction("app-1", 1, None)], ops.StreamingUpdate("Append", "app-1", 1))
+        with pytest.raises(errors.ConcurrentTransactionException):
+            a.commit([SetTransaction("app-1", 2, None), add("a1")],
+                     ops.StreamingUpdate("Append", "app-1", 2))
+
+    def test_snapshot_isolation_rearrange_only_vs_append(self, tmp_table):
+        # dataChange=False commit (OPTIMIZE-style) must not conflict with appends
+        log = create_table(tmp_table)
+        log.start_transaction().commit([add("f0")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files()
+        b = log.start_transaction()
+        b.filter_files()
+        b.commit([add("b1")], ops.Write("Append"))
+        v = a.commit(
+            [RemoveFile("f0", deletion_timestamp=1, data_change=False),
+             add("f0-compacted", data_change=False)],
+            ops.Optimize(),
+        )
+        assert v == 3
+
+    def test_delete_vs_rearrange_of_same_file_conflicts(self, tmp_table):
+        log = create_table(tmp_table)
+        log.start_transaction().commit([add("f0")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files()
+        b = log.start_transaction()
+        b.filter_files()
+        b.commit([RemoveFile("f0", deletion_timestamp=1)], ops.Delete())
+        with pytest.raises((errors.ConcurrentDeleteReadException, errors.ConcurrentDeleteDeleteException)):
+            a.commit(
+                [RemoveFile("f0", deletion_timestamp=2, data_change=False),
+                 add("f0-compacted", data_change=False)],
+                ops.Optimize(),
+            )
+
+    def test_multiple_winning_commits_replayed(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        for i in range(3):
+            log.start_transaction().commit([add(f"b{i}")], ops.Write("Append"))
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 4
+        assert a.stats.attempts >= 2
+
+
+class TestConcurrentThreads:
+    def test_many_threads_all_commit(self, tmp_table):
+        """8 threads × blind appends: all must land, versions unique."""
+        log = create_table(tmp_table)
+        results = []
+        lock = threading.Lock()
+
+        def worker(i):
+            txn = log.start_transaction()
+            v = txn.commit([add(f"t{i}")], ops.Write("Append"))
+            with lock:
+                results.append(v)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == list(range(1, 9))
+        assert len(log.update().all_files) == 8
