@@ -1,0 +1,260 @@
+"""File pruning: partition filters + min/max data skipping, device-evaluated.
+
+The reference only prunes on partition values (`PartitionFiltering.scala:27-42`)
+— per-column min/max skipping is spec'd (`PROTOCOL.md:441-480`) and stats are
+carried on every AddFile, but `filesForScan` never uses them (`stats/` holds
+only shells, SURVEY §2.3). We implement the full skipping path: a data
+predicate is rewritten into a *can-match* predicate over per-file stats
+columns (``min.c`` / ``max.c`` / ``nullCount.c`` / ``numRecords``) and
+evaluated either on device (jaxeval over `FileStateArrays`, numeric columns)
+or on host (Arrow kernels over `stats_table`, covers strings).
+
+Conservativeness invariant: a file is dropped only when the rewritten
+predicate is *definitely False*; NULL (missing stats) keeps the file. Kleene
+logic gives this for free: False AND unknown = False (safe to drop — the
+False conjunct alone excludes every row), False OR unknown = unknown (kept).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow.compute as pc
+
+from delta_tpu.expr import ir
+from delta_tpu.expr import partition as partition_expr
+from delta_tpu.protocol.actions import AddFile, Metadata
+from delta_tpu.ops import state_export
+
+__all__ = ["DataSize", "DeltaScan", "skipping_predicate", "prune_files", "files_for_scan"]
+
+
+@dataclass
+class DataSize:
+    bytes_compressed: Optional[int] = None
+    rows: Optional[int] = None
+    files: Optional[int] = None
+
+
+@dataclass
+class DeltaScan:
+    """Result of file pruning (shape of `stats/DeltaScan.scala:29-61`)."""
+
+    version: int
+    files: List[AddFile]
+    total: DataSize
+    partition: DataSize
+    scanned: DataSize
+    partition_filters: List[ir.Expression] = field(default_factory=list)
+    data_filters: List[ir.Expression] = field(default_factory=list)
+
+
+def _min(c: str) -> ir.Expression:
+    return ir.Column(f"min.{c}")
+
+
+def _max(c: str) -> ir.Expression:
+    return ir.Column(f"max.{c}")
+
+
+def _nulls(c: str) -> ir.Expression:
+    return ir.Column(f"nullCount.{c}")
+
+
+_UNKNOWN = ir.Literal(None)
+
+
+def skipping_predicate(e: ir.Expression) -> ir.Expression:
+    """Rewrite a data predicate into a can-match predicate over stats columns.
+    Returns ``Literal(None)`` (= keep) for unsupported shapes."""
+    t = type(e)
+    if t is ir.And:
+        return ir.And(skipping_predicate(e.left), skipping_predicate(e.right))
+    if t is ir.Or:
+        return ir.Or(skipping_predicate(e.left), skipping_predicate(e.right))
+    if t is ir.Not:
+        c = e.child
+        if isinstance(c, ir.IsNull):
+            return skipping_predicate(ir.IsNotNull(c.child))
+        if isinstance(c, ir.IsNotNull):
+            return skipping_predicate(ir.IsNull(c.child))
+        return _UNKNOWN
+    # normalize <col> <op> <lit>
+    cmp_map = {ir.Eq: ir.Eq, ir.Lt: ir.Lt, ir.Le: ir.Le, ir.Gt: ir.Gt, ir.Ge: ir.Ge}
+    if t in cmp_map:
+        l, r = e.left, e.right
+        flip = {ir.Lt: ir.Gt, ir.Le: ir.Ge, ir.Gt: ir.Lt, ir.Ge: ir.Le, ir.Eq: ir.Eq}
+        if isinstance(l, ir.Literal) and isinstance(r, ir.Column):
+            e = flip[t](r, l)  # type: ignore[operator]
+            t = type(e)
+            l, r = e.left, e.right
+        if not (isinstance(l, ir.Column) and isinstance(r, ir.Literal)):
+            return _UNKNOWN
+        c, lit = l.name, r
+        if lit.value is None:
+            return ir.Literal(False)  # col <op> NULL matches nothing
+        if t is ir.Eq:
+            return ir.And(ir.Le(_min(c), lit), ir.Ge(_max(c), lit))
+        if t is ir.Lt:
+            return ir.Lt(_min(c), lit)
+        if t is ir.Le:
+            return ir.Le(_min(c), lit)
+        if t is ir.Gt:
+            return ir.Gt(_max(c), lit)
+        if t is ir.Ge:
+            return ir.Ge(_max(c), lit)
+    if t is ir.In and isinstance(e.value, ir.Column):
+        opts = [o for o in e.options if isinstance(o, ir.Literal) and o.value is not None]
+        if len(opts) != len(e.options):
+            return _UNKNOWN
+        out: Optional[ir.Expression] = None
+        for o in opts:
+            one = skipping_predicate(ir.Eq(e.value, o))
+            out = one if out is None else ir.Or(out, one)
+        return out if out is not None else ir.Literal(False)
+    if t is ir.IsNull and isinstance(e.child, ir.Column):
+        return ir.Gt(_nulls(e.child.name), ir.Literal(0))
+    if t is ir.IsNotNull and isinstance(e.child, ir.Column):
+        return ir.Lt(_nulls(e.child.name), ir.Column("numRecords"))
+    if t is ir.StartsWith and isinstance(e.left, ir.Column) and isinstance(e.right, ir.Literal):
+        p = e.right.value
+        if isinstance(p, str) and p:
+            c = e.left.name
+            lower = ir.Ge(_max(c), ir.Literal(p))  # some value >= the prefix
+            hi = _prefix_upper_bound(p)
+            if hi is None:
+                return lower
+            # every string with prefix p is strictly < hi
+            return ir.And(ir.Lt(_min(c), ir.Literal(hi)), lower)
+    return _UNKNOWN
+
+
+def _prefix_upper_bound(p: str) -> Optional[str]:
+    """Smallest string greater than every string with prefix ``p`` (in
+    code-point order): bump the last bumpable char. None = unbounded."""
+    chars = list(p)
+    while chars:
+        cp = ord(chars[-1])
+        if cp < 0x10FFFF:
+            chars[-1] = chr(cp + 1)
+            return "".join(chars)
+        chars.pop()
+    return None
+
+
+def _prune_host(files: Sequence[AddFile], metadata: Metadata, pred: ir.Expression) -> np.ndarray:
+    from delta_tpu.expr.vectorized import evaluate
+
+    table = state_export.stats_table(files, metadata)
+    verdict = evaluate(pred, table)
+    # keep unless definitely False
+    keep = pc.fill_null(pc.cast(verdict, "bool"), True)
+    return np.asarray(keep)
+
+
+@lru_cache(maxsize=256)
+def _compiled_skipping(pred: ir.Expression):
+    """jit-compiled skipping predicate, cached per expression so repeat scans
+    reuse the executable (env shapes are the jit cache key)."""
+    import jax
+
+    from delta_tpu.expr.jaxeval import compile_expr
+
+    return jax.jit(compile_expr(pred))
+
+
+def _prune_device(arrays: state_export.FileStateArrays, pred: ir.Expression) -> Optional[np.ndarray]:
+    import jax
+
+    from delta_tpu.expr.jaxeval import NotDeviceCompilable
+
+    try:
+        fn = _compiled_skipping(pred)
+    except NotDeviceCompilable:
+        return None
+    try:
+        with jax.enable_x64():
+            col = fn(arrays.device_env())
+    except Exception:
+        return None
+    keep = np.asarray(col.values, bool) | ~np.asarray(col.valid, bool)  # NULL keeps
+    if keep.ndim == 0:
+        keep = np.full(arrays.num_files, bool(keep))
+    return keep
+
+
+def prune_files(
+    files: Sequence[AddFile],
+    metadata: Metadata,
+    data_filters: Sequence[ir.Expression],
+    prefer_device: bool = True,
+) -> List[AddFile]:
+    """Apply min/max skipping; returns the files that may contain matches."""
+    if not files or not data_filters:
+        return list(files)
+    pred = skipping_predicate(ir.and_all(list(data_filters)))
+    keep: Optional[np.ndarray] = None
+    if prefer_device:
+        arrays = state_export.files_to_arrays(files, metadata)
+        keep = _prune_device(arrays, pred)
+    if keep is None:
+        keep = _prune_host(files, metadata, pred)
+    return [f for f, k in zip(files, keep) if k]
+
+
+def files_for_scan(
+    snapshot,
+    filters: Sequence[ir.Expression] = (),
+    keep_num_indexed_cols: Optional[int] = None,
+) -> DeltaScan:
+    """Partition-prune then stats-prune the snapshot's files for a query.
+
+    The partition step matches `PartitionFiltering.scala:27-42`; the stats
+    step is the skipping path the reference leaves unwired.
+    """
+    metadata = snapshot.metadata
+    part_schema = metadata.partition_schema
+    part_cols = metadata.partition_columns
+    partition_filters: List[ir.Expression] = []
+    data_filters: List[ir.Expression] = []
+    for f in filters:
+        for conj in ir.split_conjuncts(f):
+            if partition_expr.is_partition_predicate(conj, part_cols):
+                partition_filters.append(conj)
+            else:
+                data_filters.append(conj)
+
+    all_files = snapshot.all_files
+    total = DataSize(
+        bytes_compressed=sum(f.size or 0 for f in all_files), files=len(all_files)
+    )
+    if partition_filters:
+        pred = ir.and_all(partition_filters)
+        # strict: a NULL partition verdict is constant for the whole file, so
+        # no row in it can satisfy the WHERE clause — prune it
+        after_part = [
+            f for f in all_files if partition_expr.matches(pred, f, part_schema)
+        ]
+    else:
+        after_part = list(all_files)
+    partition = DataSize(
+        bytes_compressed=sum(f.size or 0 for f in after_part), files=len(after_part)
+    )
+
+    kept = prune_files(after_part, metadata, data_filters)
+    scanned = DataSize(
+        bytes_compressed=sum(f.size or 0 for f in kept),
+        files=len(kept),
+        rows=sum(f.num_logical_records or 0 for f in kept) or None,
+    )
+    return DeltaScan(
+        version=snapshot.version,
+        files=kept,
+        total=total,
+        partition=partition,
+        scanned=scanned,
+        partition_filters=partition_filters,
+        data_filters=data_filters,
+    )

@@ -1,0 +1,273 @@
+"""Export table state as fixed-width columns for device computation.
+
+The reference keeps table state as a Spark ``Dataset[SingleAction]``
+(``Snapshot.scala:88-111``); scan planning filters it with Catalyst
+expressions. Here the host turns AddFile metadata into SoA numpy columns —
+paths and partition strings dictionary-encoded (int32 codes + host-side
+dictionaries), sizes/timestamps/stats as int64/float64 lanes — which ship to
+HBM for the pruning and replay kernels (``ops/pruning.py``,
+``ops/replay_kernel.py``). Variable-length bytes never reach the device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from delta_tpu.protocol.actions import Action, AddFile, Metadata, RemoveFile
+from delta_tpu.schema.types import (
+    BooleanType,
+    ByteType,
+    DataType,
+    DateType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    ShortType,
+    StringType,
+    StructType,
+    TimestampType,
+)
+
+__all__ = ["FileStateArrays", "files_to_arrays", "stats_table", "ReplayArrays", "actions_to_arrays"]
+
+_NUMERIC = (ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType,
+            DateType, TimestampType)
+
+
+def _stat_to_lane(v: Any, dt: DataType) -> Optional[float]:
+    """Normalize a JSON stats value to a comparable float64 lane value."""
+    if v is None:
+        return None
+    try:
+        if isinstance(dt, DateType) and isinstance(v, str):
+            import datetime as _dt
+
+            return float((_dt.date.fromisoformat(v[:10]) - _dt.date(1970, 1, 1)).days)
+        if isinstance(dt, TimestampType) and isinstance(v, str):
+            import datetime as _dt
+
+            s = v.replace(" ", "T").rstrip("Z")
+            return float(
+                _dt.datetime.fromisoformat(s).replace(tzinfo=_dt.timezone.utc).timestamp() * 1e6
+            )
+        return float(v)
+    except (ValueError, TypeError):
+        return None
+
+
+@dataclass
+class FileStateArrays:
+    """Snapshot AddFile metadata as device-shippable columns.
+
+    ``paths`` stays on host (the dictionary); everything else is numpy and can
+    be placed on device. Row i across all arrays describes ``paths[i]``.
+    """
+
+    paths: List[str]
+    size: np.ndarray  # int64
+    modification_time: np.ndarray  # int64
+    num_records: np.ndarray  # int64, -1 = unknown
+    partition_codes: Dict[str, np.ndarray]  # int32 codes, -1 = null
+    partition_dicts: Dict[str, List[str]]  # code -> raw partition string
+    stats_min: Dict[str, np.ndarray]  # float64, NaN = missing
+    stats_max: Dict[str, np.ndarray]
+    stats_null_count: Dict[str, np.ndarray]  # int64, -1 = missing
+
+    @property
+    def num_files(self) -> int:
+        return len(self.paths)
+
+    def device_env(self):
+        """Bind columns as :class:`delta_tpu.expr.jaxeval.DeviceColumn`s using
+        the flat names the skipping rewrite emits (``min.c`` / ``max.c`` /
+        ``nullCount.c`` / ``numRecords`` / partition columns as codes)."""
+        from delta_tpu.expr.jaxeval import DeviceColumn
+
+        env = {"numRecords": DeviceColumn.of(self.num_records, self.num_records >= 0)}
+        env["size"] = DeviceColumn.of(self.size)
+        for c, codes in self.partition_codes.items():
+            env[c] = DeviceColumn.of(codes, codes >= 0)
+        for c, mn in self.stats_min.items():
+            env[f"min.{c}"] = DeviceColumn.of(mn, ~np.isnan(mn))
+        for c, mx in self.stats_max.items():
+            env[f"max.{c}"] = DeviceColumn.of(mx, ~np.isnan(mx))
+        for c, nc in self.stats_null_count.items():
+            env[f"nullCount.{c}"] = DeviceColumn.of(nc, nc >= 0)
+        return env
+
+
+def files_to_arrays(
+    files: Sequence[AddFile],
+    metadata: Metadata,
+    stats_columns: Optional[Sequence[str]] = None,
+) -> FileStateArrays:
+    """Columnarize AddFiles. ``stats_columns`` defaults to every numeric leaf
+    of the data schema (the first ``dataSkippingNumIndexedCols`` columns —
+    `DeltaConfig.scala:383` semantics are applied by the caller)."""
+    schema: StructType = metadata.schema
+    part_cols = list(metadata.partition_columns)
+    if stats_columns is None:
+        stats_columns = [
+            f.name
+            for f in schema.fields
+            if f.name not in part_cols and isinstance(f.data_type, _NUMERIC)
+        ]
+    col_types: Dict[str, DataType] = {f.name: f.data_type for f in schema.fields}
+
+    n = len(files)
+    paths = [f.path for f in files]
+    size = np.fromiter((f.size or 0 for f in files), np.int64, n)
+    mtime = np.fromiter((f.modification_time or 0 for f in files), np.int64, n)
+
+    part_codes: Dict[str, np.ndarray] = {}
+    part_dicts: Dict[str, List[str]] = {}
+    for c in part_cols:
+        codes = np.empty(n, np.int32)
+        mapping: Dict[str, int] = {}
+        dictionary: List[str] = []
+        for i, f in enumerate(files):
+            v = (f.partition_values or {}).get(c)
+            if v is None:
+                codes[i] = -1
+                continue
+            code = mapping.get(v)
+            if code is None:
+                code = mapping[v] = len(dictionary)
+                dictionary.append(v)
+            codes[i] = code
+        part_codes[c] = codes
+        part_dicts[c] = dictionary
+
+    num_records = np.full(n, -1, np.int64)
+    smin = {c: np.full(n, np.nan) for c in stats_columns}
+    smax = {c: np.full(n, np.nan) for c in stats_columns}
+    snull = {c: np.full(n, -1, np.int64) for c in stats_columns}
+    for i, f in enumerate(files):
+        st = f.stats_dict()
+        if not st:
+            continue
+        nr = st.get("numRecords")
+        if nr is not None:
+            num_records[i] = int(nr)
+        mins = st.get("minValues") or {}
+        maxs = st.get("maxValues") or {}
+        nulls = st.get("nullCount") or {}
+        for c in stats_columns:
+            dt = col_types.get(c, DoubleType())
+            v = _stat_to_lane(mins.get(c), dt)
+            if v is not None:
+                smin[c][i] = v
+            v = _stat_to_lane(maxs.get(c), dt)
+            if v is not None:
+                smax[c][i] = v
+            if nulls.get(c) is not None:
+                snull[c][i] = int(nulls[c])
+
+    return FileStateArrays(
+        paths=paths,
+        size=size,
+        modification_time=mtime,
+        num_records=num_records,
+        partition_codes=part_codes,
+        partition_dicts=part_dicts,
+        stats_min=smin,
+        stats_max=smax,
+        stats_null_count=snull,
+    )
+
+
+def stats_table(files: Sequence[AddFile], metadata: Metadata,
+                stats_columns: Optional[Sequence[str]] = None) -> pa.Table:
+    """Host (Arrow) view of per-file stats for the vectorized skipping path —
+    includes string columns the device path can't carry."""
+    schema: StructType = metadata.schema
+    part_cols = set(metadata.partition_columns)
+    if stats_columns is None:
+        stats_columns = [f.name for f in schema.fields if f.name not in part_cols]
+    rows: List[Dict[str, Any]] = []
+    for f in files:
+        st = f.stats_dict() or {}
+        row: Dict[str, Any] = {"numRecords": st.get("numRecords")}
+        mins = st.get("minValues") or {}
+        maxs = st.get("maxValues") or {}
+        nulls = st.get("nullCount") or {}
+        for c in stats_columns:
+            row[f"min.{c}"] = mins.get(c)
+            row[f"max.{c}"] = maxs.get(c)
+            row[f"nullCount.{c}"] = nulls.get(c)
+        rows.append(row)
+    return pa.Table.from_pylist(rows) if rows else pa.table({"numRecords": pa.nulls(0, pa.int64())})
+
+
+# -- raw action-stream export for the replay kernel -----------------------
+
+
+@dataclass
+class ReplayArrays:
+    """A log segment's Add/Remove stream as device columns, in commit order.
+
+    ``seq`` is the global action order (commit version major, position within
+    the commit minor) — the sort key that makes last-writer-wins a segmented
+    max (`actions/InMemoryLogReplay.scala:43-65` semantics).
+    """
+
+    paths: List[str]  # dictionary: path_id -> path
+    path_id: np.ndarray  # int32, one per action row
+    seq: np.ndarray  # int64
+    is_add: np.ndarray  # bool
+    size: np.ndarray  # int64 (0 for removes without size)
+    deletion_timestamp: np.ndarray  # int64, only for removes (0 otherwise)
+    row_action: List[Action] = field(default_factory=list)  # aligned originals
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.path_id)
+
+
+def actions_to_arrays(versioned_actions: Sequence[Tuple[int, Sequence[Action]]]) -> ReplayArrays:
+    """Flatten ``[(version, actions), ...]`` into :class:`ReplayArrays`,
+    keeping only file actions (Metadata/Protocol/txns replay on host)."""
+    mapping: Dict[str, int] = {}
+    dictionary: List[str] = []
+    path_id: List[int] = []
+    seq: List[int] = []
+    is_add: List[bool] = []
+    size: List[int] = []
+    del_ts: List[int] = []
+    originals: List[Action] = []
+    for version, actions in versioned_actions:
+        for pos, a in enumerate(actions):
+            if isinstance(a, AddFile):
+                add = True
+                sz = a.size or 0
+                dts = 0
+            elif isinstance(a, RemoveFile):
+                add = False
+                sz = a.size or 0
+                dts = a.delete_timestamp
+            else:
+                continue
+            code = mapping.get(a.path)
+            if code is None:
+                code = mapping[a.path] = len(dictionary)
+                dictionary.append(a.path)
+            path_id.append(code)
+            # position fits in 20 bits per commit (1M actions); version in 43
+            seq.append((version << 20) | min(pos, (1 << 20) - 1))
+            is_add.append(add)
+            size.append(sz)
+            del_ts.append(dts)
+            originals.append(a)
+    return ReplayArrays(
+        paths=dictionary,
+        path_id=np.asarray(path_id, np.int32),
+        seq=np.asarray(seq, np.int64),
+        is_add=np.asarray(is_add, bool),
+        size=np.asarray(size, np.int64),
+        deletion_timestamp=np.asarray(del_ts, np.int64),
+        row_action=originals,
+    )

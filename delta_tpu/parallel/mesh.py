@@ -1,0 +1,37 @@
+"""Device mesh helpers — the framework's parallel substrate.
+
+The reference's distribution substrate is Spark's driver/executor fan-out
+(SURVEY §2.8); ours is a `jax.sharding.Mesh`. Table-state kernels shard over a
+1-D ``"shards"`` axis (the analogue of the reference's 50-way state
+repartition, `Snapshot.scala:75-78`); collectives ride ICI within a slice and
+DCN across hosts — all inserted by XLA from sharding annotations.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["state_mesh", "shard_count", "pad_to_multiple", "P", "NamedSharding"]
+
+STATE_AXIS = "shards"
+
+
+def state_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over ``n_devices`` (default: all local devices) with the
+    table-state sharding axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (STATE_AXIS,))
+
+
+def shard_count(mesh: Mesh) -> int:
+    return mesh.shape[STATE_AXIS]
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n`` (and >= m)."""
+    return max(((n + m - 1) // m) * m, m)

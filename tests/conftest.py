@@ -7,10 +7,17 @@ parallelism real. Must set flags before the first jax import.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the harness boots an `axon` TPU plugin from sitecustomize (one
+# real chip via a tunnel, ~30s per compile) that ignores the JAX_PLATFORMS
+# env var — only the jax_platforms *config* reliably overrides it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

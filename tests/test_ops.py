@@ -1,0 +1,259 @@
+"""Device ops: sharded replay kernel + data-skipping pruning.
+
+The host `LogReplay` is the spec (PROTOCOL.md "Action Reconciliation");
+the device kernel must compute identical alive/tombstone sets on random
+action streams, single-device and sharded over the virtual 8-CPU mesh.
+"""
+import json
+import random
+
+import numpy as np
+import pytest
+
+from delta_tpu.log.replay import LogReplay
+from delta_tpu.ops import pruning, replay_kernel, state_export
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.parallel.mesh import state_mesh
+from delta_tpu.protocol.actions import AddFile, Metadata, RemoveFile
+from delta_tpu.schema.types import (
+    DoubleType,
+    IntegerType,
+    LongType,
+    StringType,
+    StructType,
+)
+
+
+def _random_stream(seed, n_versions=40, n_paths=25):
+    rng = random.Random(seed)
+    versioned = []
+    for v in range(n_versions):
+        actions = []
+        for _ in range(rng.randint(1, 6)):
+            p = f"part-{rng.randrange(n_paths):05d}.parquet"
+            if rng.random() < 0.7:
+                actions.append(
+                    AddFile(path=p, partition_values={}, size=rng.randrange(1, 1000),
+                            modification_time=v, data_change=True)
+                )
+            else:
+                actions.append(
+                    RemoveFile(path=p, deletion_timestamp=v * 1000, data_change=True)
+                )
+        versioned.append((v, actions))
+    return versioned
+
+
+def _host_state(versioned, min_retention=0):
+    replay = LogReplay(min_file_retention_timestamp=min_retention)
+    for v, actions in versioned:
+        replay.append(v, actions)
+    alive = set(replay.active_files.keys())
+    tombs = {r.path for r in replay.get_tombstones()}
+    return alive, tombs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_replay_kernel_matches_host(seed):
+    versioned = _random_stream(seed)
+    arrays = state_export.actions_to_arrays(versioned)
+    result = replay_kernel.replay_alive_mask(arrays, min_retention_ts=0)
+    alive_paths = {
+        arrays.paths[arrays.path_id[i]]
+        for i in range(arrays.num_rows)
+        if bool(result.alive[i])
+    }
+    tomb_paths = {
+        arrays.paths[arrays.path_id[i]]
+        for i in range(arrays.num_rows)
+        if bool(result.tombstone[i])
+    }
+    host_alive, host_tombs = _host_state(versioned)
+    assert alive_paths == host_alive
+    assert tomb_paths == host_tombs
+    assert int(result.stats.num_files) == len(host_alive)
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_replay_sharded_matches_host(seed):
+    versioned = _random_stream(seed, n_versions=60, n_paths=50)
+    arrays = state_export.actions_to_arrays(versioned)
+    mesh = state_mesh()
+    result = replay_kernel.replay_sharded(arrays, mesh, min_retention_ts=0)
+    alive_paths = {
+        arrays.paths[arrays.path_id[i]]
+        for i in range(arrays.num_rows)
+        if bool(result.alive[i])
+    }
+    host_alive, _ = _host_state(versioned)
+    assert alive_paths == host_alive
+    assert int(result.stats.num_files) == len(host_alive)
+    replay = LogReplay()
+    for v, actions in versioned:
+        replay.append(v, actions)
+    assert int(result.stats.total_size) == sum(
+        f.size for f in replay.active_files.values()
+    )
+
+
+def test_replay_tombstone_retention():
+    versioned = [
+        (0, [AddFile(path="a", partition_values={}, size=1, modification_time=0, data_change=True)]),
+        (1, [RemoveFile(path="a", deletion_timestamp=500, data_change=True)]),
+        (2, [AddFile(path="b", partition_values={}, size=2, modification_time=0, data_change=True)]),
+    ]
+    arrays = state_export.actions_to_arrays(versioned)
+    kept = replay_kernel.replay_alive_mask(arrays, min_retention_ts=100)
+    assert int(kept.stats.num_tombstones) == 1
+    expired = replay_kernel.replay_alive_mask(arrays, min_retention_ts=1000)
+    assert int(expired.stats.num_tombstones) == 0
+
+
+# -- pruning ----------------------------------------------------------------
+
+SCHEMA = (
+    StructType()
+    .add("id", LongType())
+    .add("price", DoubleType())
+    .add("name", StringType())
+    .add("part", StringType())
+)
+
+
+def _meta():
+    return Metadata(schema_string=SCHEMA.to_json(), partition_columns=["part"])
+
+
+def _file(path, part, id_min, id_max, price_min, price_max, nulls_name=0, num=100):
+    stats = {
+        "numRecords": num,
+        "minValues": {"id": id_min, "price": price_min, "name": "a"},
+        "maxValues": {"id": id_max, "price": price_max, "name": "z"},
+        "nullCount": {"id": 0, "price": 0, "name": nulls_name},
+    }
+    return AddFile(
+        path=path,
+        partition_values={"part": part},
+        size=1000,
+        modification_time=0,
+        data_change=True,
+        stats=json.dumps(stats),
+    )
+
+
+FILES = [
+    _file("f1", "us", 0, 99, 1.0, 9.9),
+    _file("f2", "us", 100, 199, 10.0, 19.9),
+    _file("f3", "eu", 200, 299, 20.0, 29.9, nulls_name=100),
+    _file("f4", "eu", 300, 399, 30.0, 39.9),
+]
+
+
+class _FakeSnapshot:
+    version = 7
+    all_files = FILES
+    metadata = _meta()
+
+
+def _scan(sql):
+    return pruning.files_for_scan(_FakeSnapshot(), [parse_predicate(sql)])
+
+
+def test_partition_pruning():
+    scan = _scan("part = 'us'")
+    assert [f.path for f in scan.files] == ["f1", "f2"]
+    assert scan.partition.files == 2
+
+
+def test_stats_eq_pruning():
+    assert [f.path for f in _scan("id = 150").files] == ["f2"]
+
+
+def test_stats_range_pruning():
+    assert [f.path for f in _scan("price >= 25.0").files] == ["f3", "f4"]
+    assert [f.path for f in _scan("id < 100").files] == ["f1"]
+
+
+def test_stats_combined_partition_and_data():
+    scan = _scan("part = 'eu' AND id <= 250")
+    assert [f.path for f in scan.files] == ["f3"]
+
+
+def test_stats_in_pruning():
+    assert [f.path for f in _scan("id IN (5, 305)").files] == ["f1", "f4"]
+
+
+def test_stats_null_count_pruning():
+    assert [f.path for f in _scan("name IS NULL").files] == ["f3"]
+    # f3 is all-null for name -> IS NOT NULL prunes it
+    assert [f.path for f in _scan("name IS NOT NULL").files] == ["f1", "f2", "f4"]
+
+
+def test_missing_stats_keeps_file():
+    no_stats = AddFile(path="f5", partition_values={"part": "eu"}, size=10,
+                       modification_time=0, data_change=True)
+
+    class S:
+        version = 1
+        all_files = FILES + [no_stats]
+        metadata = _meta()
+
+    scan = pruning.files_for_scan(S(), [parse_predicate("id = 150")])
+    assert [f.path for f in scan.files] == ["f2", "f5"]
+
+
+def test_unsupported_predicate_keeps_all():
+    scan = _scan("name LIKE '%x%'")
+    assert len(scan.files) == 4
+
+
+def test_string_stats_pruned_on_host():
+    # string min/max can't ship to device; host Arrow path must still prune
+    scan = _scan("name > 'zz'")
+    assert scan.files == []
+
+
+def test_startswith_pruning_astral_chars():
+    # regression: prefix upper bound must cover code points above U+FFFF
+    f = _file("fx", "us", 0, 9, 1.0, 2.0)
+    st = json.loads(f.stats)
+    st["minValues"]["name"] = st["maxValues"]["name"] = "ap\U0001F600"
+    f = AddFile(path="fx", partition_values={"part": "us"}, size=1000,
+                modification_time=0, data_change=True, stats=json.dumps(st))
+
+    class S:
+        version = 1
+        all_files = [f]
+        metadata = _meta()
+
+    from delta_tpu.expr import ir
+    scan = pruning.files_for_scan(
+        S(), [ir.StartsWith(ir.Column("name"), ir.Literal("ap"))]
+    )
+    assert [x.path for x in scan.files] == ["fx"]
+    scan2 = pruning.files_for_scan(
+        S(), [ir.StartsWith(ir.Column("name"), ir.Literal("zz"))]
+    )
+    assert scan2.files == []
+
+
+def test_int64_literal_falls_back_to_host():
+    # regression: id > 2**31 must not crash scan planning
+    scan = _scan("id > 2147483648")
+    assert scan.files == []
+    scan2 = _scan("id >= 2147483647")
+    assert scan2.files == []
+
+
+def test_null_partition_value_pruned():
+    # a NULL partition verdict is constant for the file: prune strictly
+    f = AddFile(path="fnull", partition_values={"part": None}, size=1,
+                modification_time=0, data_change=True)
+
+    class S:
+        version = 1
+        all_files = FILES + [f]
+        metadata = _meta()
+
+    scan = pruning.files_for_scan(S(), [parse_predicate("part = 'us'")])
+    assert [x.path for x in scan.files] == ["f1", "f2"]
