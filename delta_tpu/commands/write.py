@@ -1,0 +1,204 @@
+"""Batch write command: append / overwrite / replaceWhere.
+
+Equivalent of `commands/WriteIntoDelta.scala:46-138` plus the implicit
+metadata logic of `schema/ImplicitMetadataOperation.scala:30-62`: first write
+creates the table (schema inferred from the Arrow batch), `mergeSchema`
+evolves it, `overwriteSchema` replaces it (overwrite mode only);
+`replaceWhere` turns overwrite into a predicate-scoped atomic replacement
+after validating every written row matches the predicate; `rearrangeOnly`
+flips `dataChange=False` on all emitted actions (`:129-131`).
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.exec import write as write_exec
+from delta_tpu.expr import ir
+from delta_tpu.expr import partition as partition_expr
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.protocol.actions import Action, AddFile, Metadata
+from delta_tpu.schema import schema_utils
+from delta_tpu.schema.arrow_interop import schema_from_arrow
+from delta_tpu.schema.types import StructType
+from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalArgumentError
+
+__all__ = ["WriteIntoDelta", "update_metadata_on_write", "coerce_to_table"]
+
+MODES = ("append", "overwrite", "error", "errorifexists", "ignore")
+
+
+def coerce_to_table(data: Any) -> pa.Table:
+    """Accept pa.Table / RecordBatch / dict-of-lists / list-of-dicts."""
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, pa.RecordBatch):
+        return pa.Table.from_batches([data])
+    if isinstance(data, dict):
+        return pa.table(data)
+    if isinstance(data, list):
+        return pa.Table.from_pylist(data)
+    try:  # pandas, polars, anything with an Arrow bridge
+        return pa.table(data)
+    except Exception:
+        raise DeltaIllegalArgumentError(
+            f"Cannot convert {type(data).__name__} to an Arrow table"
+        )
+
+
+def update_metadata_on_write(
+    txn,
+    data_schema: StructType,
+    partition_columns: Sequence[str],
+    configuration: Optional[Dict[str, str]] = None,
+    is_overwrite: bool = False,
+    merge_schema: bool = False,
+    overwrite_schema: bool = False,
+) -> None:
+    """`ImplicitMetadataOperation.updateMetadata` semantics."""
+    table_exists = txn.read_version >= 0 and txn.metadata.schema_string is not None
+    if overwrite_schema and not is_overwrite:
+        raise DeltaAnalysisError("overwriteSchema requires mode('overwrite')")
+    if not table_exists:
+        schema_utils.check_partition_columns(partition_columns, data_schema)
+        txn.update_metadata(
+            Metadata(
+                schema_string=data_schema.to_json(),
+                partition_columns=list(partition_columns),
+                configuration=dict(configuration or {}),
+            )
+        )
+        return
+    current = txn.metadata
+    if partition_columns and [c.lower() for c in partition_columns] != [
+        c.lower() for c in current.partition_columns
+    ]:
+        raise DeltaAnalysisError(
+            f"Partition columns {list(partition_columns)} don't match the table's "
+            f"{current.partition_columns}"
+        )
+    if overwrite_schema:
+        new_meta = replace(
+            current,
+            schema_string=data_schema.to_json(),
+            partition_columns=list(partition_columns or current.partition_columns),
+        )
+        txn.update_metadata(new_meta)
+        return
+    if merge_schema:
+        merged = schema_utils.merge_schemas(current.schema, data_schema)
+        if merged.to_json() != current.schema.to_json():
+            txn.update_metadata(replace(current, schema_string=merged.to_json()))
+        return
+    # plain enforcement: the batch must fit the table schema
+    schema_utils.enforce_write_compatibility(current.schema, data_schema)
+
+
+class WriteIntoDelta:
+    def __init__(
+        self,
+        delta_log,
+        mode: str,
+        data: Any,
+        partition_columns: Sequence[str] = (),
+        replace_where: Optional[Union[str, ir.Expression]] = None,
+        merge_schema: bool = False,
+        overwrite_schema: bool = False,
+        rearrange_only: bool = False,
+        configuration: Optional[Dict[str, str]] = None,
+        user_metadata: Optional[str] = None,
+    ):
+        mode = mode.lower()
+        if mode not in MODES:
+            raise DeltaIllegalArgumentError(f"Unknown save mode {mode!r}")
+        if replace_where is not None and mode != "overwrite":
+            raise DeltaAnalysisError("replaceWhere is only supported with mode('overwrite')")
+        self.delta_log = delta_log
+        self.mode = mode
+        self.table = coerce_to_table(data)
+        self.partition_columns = list(partition_columns)
+        self.replace_where = (
+            parse_predicate(replace_where) if isinstance(replace_where, str) else replace_where
+        )
+        self.merge_schema = merge_schema
+        self.overwrite_schema = overwrite_schema
+        self.rearrange_only = rearrange_only
+        self.configuration = configuration
+        self.user_metadata = user_metadata
+
+    def run(self) -> int:
+        log = self.delta_log
+        if log.table_exists:
+            if self.mode == "ignore":
+                return log.snapshot.version
+            if self.mode in ("error", "errorifexists"):
+                raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
+
+        def body(txn):
+            actions = self.write(txn)
+            op = ops.Write(
+                mode=self.mode,
+                partition_by=self.partition_columns or None,
+                predicate=self.replace_where.sql() if self.replace_where else None,
+            )
+            return txn.commit(actions, op)
+
+        return log.with_new_transaction(body)
+
+    def write(self, txn) -> List[Action]:
+        data_schema = schema_from_arrow(self.table.schema)
+        is_overwrite = self.mode == "overwrite"
+        update_metadata_on_write(
+            txn,
+            data_schema,
+            self.partition_columns or txn.metadata.partition_columns,
+            configuration=self.configuration,
+            is_overwrite=is_overwrite,
+            merge_schema=self.merge_schema,
+            overwrite_schema=self.overwrite_schema,
+        )
+        metadata = txn.metadata
+
+        adds = write_exec.write_files(
+            self.delta_log.data_path,
+            self.table,
+            metadata,
+            data_change=not self.rearrange_only,
+        )
+
+        removes: List[Action] = []
+        if is_overwrite:
+            if self.replace_where is None:
+                removes = [f.remove(data_change=not self.rearrange_only)
+                           for f in txn.filter_files()]
+            else:
+                removes = self._replace_where_removes(txn, adds)
+        return list(adds) + removes
+
+    def _replace_where_removes(self, txn, written: List[AddFile]) -> List[Action]:
+        """Validate written files land inside the predicate, then remove the
+        matching files (`WriteIntoDelta.scala:112-125`). Like the reference,
+        only partition predicates are supported — removing a file matched by
+        a *data* predicate would also delete its non-matching rows."""
+        pred = self.replace_where
+        metadata = txn.metadata
+        part_schema = metadata.partition_schema
+        pcols = metadata.partition_columns
+        conjuncts = ir.split_conjuncts(pred)
+        if not all(partition_expr.is_partition_predicate(c, pcols) for c in conjuncts):
+            raise DeltaAnalysisError(
+                f"replaceWhere {pred.sql()!r} must reference only partition columns "
+                f"{pcols}"
+            )
+        for add in written:
+            if not partition_expr.matches(pred, add, part_schema):
+                raise DeltaAnalysisError(
+                    f"Written data does not match replaceWhere {pred.sql()!r}: "
+                    f"partition {add.partition_values}"
+                )
+        matched = txn.filter_files([pred])
+        data_change = not self.rearrange_only
+        return [f.remove(data_change=data_change) for f in matched]

@@ -1,0 +1,100 @@
+"""Parquet read/write executor (host data plane, Arrow C++ underneath).
+
+The role Spark's `ParquetFileFormat` + `FileFormatWriter` play in the
+reference (`files/TransactionalWrite.scala:182-192`, `DeltaFileFormat.scala`)
+— encode/decode Parquet, collect per-file column stats — lands on Arrow's
+native Parquet module here. Stats collection follows the protocol's
+per-column ``minValues``/``maxValues``/``nullCount`` + ``numRecords`` schema
+(`PROTOCOL.md:441-480`), truncated to the first
+``dataSkippingNumIndexedCols`` leaf columns (`DeltaConfig.scala:383`).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+__all__ = ["write_parquet_file", "read_parquet_files", "collect_stats", "stats_json"]
+
+
+def _stat_value(scalar: pa.Scalar) -> Any:
+    v = scalar.as_py()
+    if isinstance(v, _dt.datetime):
+        return v.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+    if isinstance(v, _dt.date):
+        return v.isoformat()
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return None
+    if isinstance(v, bytes):
+        return None  # binary stats not representable in JSON stats
+    return v
+
+
+def collect_stats(table: pa.Table, num_indexed_cols: int = 32) -> Dict[str, Any]:
+    """Per-file stats over the first ``num_indexed_cols`` leaf columns."""
+    mins: Dict[str, Any] = {}
+    maxs: Dict[str, Any] = {}
+    nulls: Dict[str, Any] = {}
+    for name in table.column_names[: num_indexed_cols if num_indexed_cols >= 0 else None]:
+        col = table.column(name)
+        nulls[name] = col.null_count
+        t = col.type
+        skippable = (
+            pa.types.is_integer(t)
+            or pa.types.is_floating(t)
+            or pa.types.is_string(t)
+            or pa.types.is_date(t)
+            or pa.types.is_timestamp(t)
+            or pa.types.is_boolean(t)
+            or pa.types.is_decimal(t)
+        )
+        if not skippable or col.null_count == len(col):
+            continue
+        try:
+            mn = _stat_value(pc.min(col))
+            mx = _stat_value(pc.max(col))
+        except pa.ArrowNotImplementedError:
+            continue
+        if mn is not None:
+            mins[name] = mn
+        if mx is not None:
+            maxs[name] = mx
+    return {
+        "numRecords": table.num_rows,
+        "minValues": mins,
+        "maxValues": maxs,
+        "nullCount": nulls,
+    }
+
+
+def stats_json(table: pa.Table, num_indexed_cols: int = 32) -> str:
+    return json.dumps(collect_stats(table, num_indexed_cols))
+
+
+def write_parquet_file(
+    table: pa.Table, abs_path: str, compression: str = "snappy"
+) -> Tuple[int, int]:
+    """Write one Parquet file; returns (size_bytes, mtime_ms)."""
+    os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+    pq.write_table(table, abs_path, compression=compression)
+    st = os.stat(abs_path)
+    return st.st_size, int(st.st_mtime * 1000)
+
+
+def read_parquet_files(
+    abs_paths: Sequence[str],
+    columns: Optional[Sequence[str]] = None,
+    schema: Optional[pa.Schema] = None,
+) -> List[pa.Table]:
+    """Read data files; one table per file (callers attach partition values
+    before concatenation)."""
+    out = []
+    for p in abs_paths:
+        out.append(pq.read_table(p, columns=list(columns) if columns else None))
+    return out

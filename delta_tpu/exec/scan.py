@@ -1,0 +1,111 @@
+"""Scan executor: snapshot + predicate → Arrow table.
+
+The read side of the engine, replacing Spark's `FileSourceScanExec` over the
+`TahoeFileIndex` (`files/TahoeFileIndex.scala:58-81`, SURVEY §3.2): prune the
+file list on device (`ops/pruning.files_for_scan` — partition + min/max
+skipping), decode the surviving Parquet with Arrow, materialize partition
+columns from `partitionValues` (data files don't store them), and apply the
+residual predicate with the vectorized evaluator.
+"""
+from __future__ import annotations
+
+import os
+import urllib.parse
+from typing import List, Optional, Sequence, Union
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.exec import parquet as pq_exec
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.expr.partition import typed_partition_row
+from delta_tpu.expr.vectorized import arrow_type_for, filter_table
+from delta_tpu.ops import pruning
+from delta_tpu.protocol.actions import AddFile
+from delta_tpu.schema.types import StructType
+
+__all__ = ["scan_files", "read_files_as_table", "scan_to_table"]
+
+
+def _abs_data_path(data_path: str, file_path: str) -> str:
+    if "://" in file_path or os.path.isabs(file_path):
+        return urllib.parse.unquote(file_path)
+    return os.path.join(data_path, urllib.parse.unquote(file_path).replace("/", os.sep))
+
+
+def read_files_as_table(
+    data_path: str,
+    files: Sequence[AddFile],
+    metadata,
+    columns: Optional[Sequence[str]] = None,
+) -> pa.Table:
+    """Decode AddFiles to one Arrow table, materializing partition columns."""
+    schema: StructType = metadata.schema
+    part_cols = list(metadata.partition_columns)
+    part_schema = metadata.partition_schema
+    out_names = columns if columns is not None else [f.name for f in schema.fields]
+    data_cols = [c for c in out_names if c not in part_cols]
+
+    arrow_fields = [
+        pa.field(f.name, arrow_type_for(f.data_type), f.nullable)
+        for f in schema.fields
+        if f.name in out_names
+    ]
+    empty = pa.schema(arrow_fields).empty_table()
+    if not files:
+        return empty
+
+    pieces: List[pa.Table] = []
+    for add in files:
+        abs_path = _abs_data_path(data_path, add.path)
+        # project to the columns this file actually has (files written before
+        # a schema evolution lack the newer columns — read fills them w/ null)
+        import pyarrow.parquet as pq
+
+        present = set(pq.ParquetFile(abs_path).schema_arrow.names)
+        file_cols = [c for c in data_cols if c in present]
+        t = pq_exec.read_parquet_files([abs_path], columns=file_cols or None)[0]
+        for f in schema.fields:
+            if f.name in data_cols and f.name not in t.column_names:
+                at = arrow_type_for(f.data_type)
+                t = t.append_column(pa.field(f.name, at, True), pa.nulls(t.num_rows, at))
+        if part_cols:
+            typed = typed_partition_row(add, part_schema)
+            for c in part_cols:
+                if c not in out_names:
+                    continue
+                f = part_schema[c]
+                at = arrow_type_for(f.data_type)
+                v = typed.get(c)
+                arr = (
+                    pa.nulls(t.num_rows, at)
+                    if v is None
+                    else pa.array([v] * t.num_rows, type=at)
+                )
+                t = t.append_column(pa.field(c, at, f.nullable), arr)
+        # column order = requested order
+        t = t.select([c for c in out_names if c in t.column_names])
+        pieces.append(t)
+    return pa.concat_tables(pieces, promote_options="permissive") if pieces else empty
+
+
+def scan_files(snapshot, filters: Sequence[Union[str, ir.Expression]] = ()) -> pruning.DeltaScan:
+    exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
+    return pruning.files_for_scan(snapshot, exprs)
+
+
+def scan_to_table(
+    snapshot,
+    filters: Sequence[Union[str, ir.Expression]] = (),
+    columns: Optional[Sequence[str]] = None,
+) -> pa.Table:
+    """Full read path: prune → decode → residual filter."""
+    exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
+    scan = pruning.files_for_scan(snapshot, exprs)
+    data_path = snapshot.delta_log.data_path
+    table = read_files_as_table(data_path, scan.files, snapshot.metadata, columns)
+    residual = scan.partition_filters + scan.data_filters
+    if residual and table.num_rows:
+        table = filter_table(table, ir.and_all(residual))
+    return table

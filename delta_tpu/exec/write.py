@@ -1,0 +1,179 @@
+"""Transactional write path: Arrow batch → partitioned Parquet → AddFiles.
+
+Equivalent of `files/TransactionalWrite.scala:43-207` +
+`files/DelayedCommitProtocol.scala:41-164`: normalize the batch to the table
+schema, enforce constraints (vectorized, `schema/constraints.py`), split by
+partition values, write `part-<n>-<uuid>.c000.snappy.parquet` files directly
+into partition directories (no rename — the commit *is* the transaction log
+entry), and return `AddFile` actions carrying protocol-format stats.
+
+Like the reference's committer, files become visible only via the commit;
+orphaned files from failed writes are invisible to readers and reaped by
+VACUUM.
+"""
+from __future__ import annotations
+
+import os
+import urllib.parse
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.exec import parquet as pq_exec
+from delta_tpu.expr.vectorized import arrow_type_for
+from delta_tpu.protocol.actions import AddFile, Metadata
+from delta_tpu.schema import constraints as constraints_mod
+from delta_tpu.schema.types import StructType
+from delta_tpu.utils.config import DeltaConfigs
+from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+
+__all__ = ["normalize_data", "write_files", "escape_partition_value", "partition_path"]
+
+# Hive-style partition-path escaping (util/PartitionUtils.scala vendored copy
+# of Spark's ExternalCatalogUtils): these characters are %-encoded in dir names.
+_ESCAPE = set('\\"#%\'*/:=?\x7f[]^ \t\n\x0b\x0c\r{}')
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def escape_partition_value(v: Optional[str]) -> str:
+    if v is None or v == "":
+        return HIVE_DEFAULT_PARTITION
+    return "".join(f"%{ord(c):02X}" if c in _ESCAPE or ord(c) < 0x20 else c for c in v)
+
+
+def unescape_partition_value(s: str) -> Optional[str]:
+    if s == HIVE_DEFAULT_PARTITION:
+        return None
+    return urllib.parse.unquote(s)
+
+
+def partition_path(partition_values: Dict[str, Optional[str]], partition_columns: Sequence[str]) -> str:
+    return "/".join(
+        f"{c}={escape_partition_value(partition_values.get(c))}" for c in partition_columns
+    )
+
+
+def _resolve(table: pa.Table, name: str) -> Optional[str]:
+    if name in table.column_names:
+        return name
+    low = name.lower()
+    for c in table.column_names:
+        if c.lower() == low:
+            return c
+    return None
+
+
+def normalize_data(table: pa.Table, schema: StructType) -> pa.Table:
+    """Reorder/case-normalize/cast the batch to the table schema
+    (`TransactionalWrite.scala:79-115` normalizeData)."""
+    cols = []
+    fields = []
+    for f in schema.fields:
+        src = _resolve(table, f.name)
+        target_type = arrow_type_for(f.data_type)
+        if src is None:
+            # missing column → nulls (schema enforcement happens upstream)
+            cols.append(pa.nulls(table.num_rows, target_type))
+        else:
+            col = table.column(src)
+            if col.type != target_type:
+                try:
+                    col = pc.cast(col, target_type)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as e:
+                    raise SchemaMismatchError(
+                        f"Cannot cast column {f.name} from {col.type} to {target_type}: {e}"
+                    )
+            cols.append(col)
+        fields.append(pa.field(f.name, target_type, f.nullable))
+    extra = [
+        c for c in table.column_names
+        if all(c.lower() != f.name.lower() for f in schema.fields)
+    ]
+    if extra:
+        raise SchemaMismatchError(
+            f"Data columns {extra} not present in table schema "
+            f"{[f.name for f in schema.fields]} (enable mergeSchema to add them)"
+        )
+    return pa.table(cols, schema=pa.schema(fields))
+
+
+def _partition_value_str(scalar: pa.Scalar) -> Optional[str]:
+    v = scalar.as_py()
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def write_files(
+    data_path: str,
+    table: pa.Table,
+    metadata: Metadata,
+    data_change: bool = True,
+    target_file_rows: Optional[int] = None,
+    constraints: Optional[List[constraints_mod.Constraint]] = None,
+) -> List[AddFile]:
+    """Write a normalized batch as partitioned Parquet; return AddFiles."""
+    schema: StructType = metadata.schema
+    part_cols = list(metadata.partition_columns)
+    table = normalize_data(table, schema)
+    if constraints is None:
+        constraints = constraints_mod.from_metadata(metadata)
+    constraints_mod.enforce(constraints, table)
+    num_indexed = DeltaConfigs.DATA_SKIPPING_NUM_INDEXED_COLS.from_metadata(metadata)
+
+    data_cols = [f.name for f in schema.fields if f.name not in part_cols]
+
+    groups: List[Tuple[Dict[str, Optional[str]], pa.Table]] = []
+    if part_cols:
+        # group rows by partition tuple (arrow group-split, stable order)
+        combined = table.group_by(part_cols, use_threads=False).aggregate([])
+        for i in range(combined.num_rows):
+            pv = {
+                c: _partition_value_str(combined.column(c)[i]) for c in part_cols
+            }
+            mask = None
+            for c in part_cols:
+                col = table.column(c)
+                v = combined.column(c)[i]
+                m = pc.is_null(col) if not v.is_valid else pc.equal(col, v)
+                m = pc.fill_null(m, False)
+                mask = m if mask is None else pc.and_(mask, m)
+            groups.append((pv, table.filter(mask)))
+    else:
+        groups.append(({}, table))
+
+    adds: List[AddFile] = []
+    for pv, part_table in groups:
+        if part_table.num_rows == 0:
+            continue
+        chunks: List[pa.Table] = []
+        if target_file_rows and part_table.num_rows > target_file_rows:
+            for start in range(0, part_table.num_rows, target_file_rows):
+                chunks.append(part_table.slice(start, target_file_rows))
+        else:
+            chunks.append(part_table)
+        prefix = partition_path(pv, part_cols)
+        for idx, chunk in enumerate(chunks):
+            file_data = chunk.select(data_cols) if part_cols else chunk
+            name = f"part-{idx:05d}-{uuid.uuid4()}.c000.snappy.parquet"
+            rel = f"{prefix}/{name}" if prefix else name
+            abs_path = os.path.join(data_path, rel.replace("/", os.sep))
+            size, mtime = pq_exec.write_parquet_file(file_data, abs_path)
+            adds.append(
+                AddFile(
+                    # AddFile.path is URI-encoded per the protocol (the hive-
+                    # escaped dir's '%' becomes '%25'); readers unquote once.
+                    # safe set = URI path chars java Path.toUri leaves bare.
+                    path=urllib.parse.quote(rel, safe="/:@!$&'()*+,;=-._~"),
+                    partition_values=pv,
+                    size=size,
+                    modification_time=mtime,
+                    data_change=data_change,
+                    stats=pq_exec.stats_json(file_data, num_indexed),
+                )
+            )
+    return adds

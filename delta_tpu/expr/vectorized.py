@@ -36,7 +36,7 @@ from delta_tpu.schema.types import (
 )
 from delta_tpu.utils.errors import DeltaAnalysisError
 
-__all__ = ["evaluate", "filter_table", "project", "arrow_type_for"]
+__all__ = ["evaluate", "filter_table", "boolean_mask", "project", "arrow_type_for"]
 
 
 def arrow_type_for(dt: DataType) -> pa.DataType:
@@ -96,9 +96,10 @@ def _as_array(v: Any, n: int) -> pa.ChunkedArray:
     return pa.chunked_array([pa.array([v] * n)])
 
 
-def _row_fallback(expr: ir.Expression, table: pa.Table) -> pa.ChunkedArray:
+def _row_fallback(expr: ir.Expression, table: pa.Table, rows=None) -> pa.ChunkedArray:
     """Exact-semantics fallback: row-at-a-time eval over python dicts."""
-    rows = table.to_pylist()
+    if rows is None:
+        rows = table.to_pylist()
     return pa.chunked_array([pa.array([expr.eval(r) for r in rows])]) if rows else pa.chunked_array(
         [pa.nulls(0)]
     )
@@ -120,15 +121,22 @@ class _Vectorizer:
     def __init__(self, table: pa.Table):
         self.table = table
         self.n = table.num_rows
+        self._rows = None  # lazy to_pylist() cache for the fallback path
+
+    def _fallback(self, e: ir.Expression):
+        if self._rows is None:
+            self._rows = self.table.to_pylist()
+        return _row_fallback(e, self.table, self._rows)
 
     def visit(self, e: ir.Expression):
         m = getattr(self, "_v_" + type(e).__name__, None)
         if m is None:
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
         try:
             return m(e)
-        except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
-            return _row_fallback(e, self.table)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError,
+                UnicodeEncodeError):
+            return self._fallback(e)
 
     # -- leaves -----------------------------------------------------------
     def _v_Column(self, e: ir.Column):
@@ -192,7 +200,7 @@ class _Vectorizer:
         v = _as_array(self.visit(e.value), self.n)
         opts = [o.value for o in e.options if isinstance(o, ir.Literal)]
         if len(opts) != len(e.options):
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
         has_null_opt = any(o is None for o in opts)
         vals = [o for o in opts if o is not None]
         found = pc.is_in(v, value_set=pa.array(vals, type=v.type) if vals else pa.nulls(0, v.type))
@@ -236,17 +244,17 @@ class _Vectorizer:
         try:
             return pc.cast(child, target, safe=False)
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
 
     # -- strings ----------------------------------------------------------
     def _v_Like(self, e: ir.Like):
         if not isinstance(e.right, ir.Literal):
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
         return pc.match_like(self.visit(e.left), e.right.value)
 
     def _v_StartsWith(self, e: ir.StartsWith):
         if not isinstance(e.right, ir.Literal):
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
         return pc.starts_with(self.visit(e.left), pattern=e.right.value)
 
     def _v_Coalesce(self, e: ir.Coalesce):
@@ -278,7 +286,7 @@ class _Vectorizer:
     def _v_Func(self, e: ir.Func):
         fn = self._ARROW_FUNCS.get(e.name)
         if fn is None:
-            return _row_fallback(e, self.table)
+            return self._fallback(e)
         args = [self.visit(a) for a in e.children]
         return fn(*args)
 
@@ -293,8 +301,7 @@ def filter_table(table: pa.Table, expr: Optional[ir.Expression]) -> pa.Table:
     """Keep rows where ``expr`` is exactly TRUE (NULL drops, like SQL WHERE)."""
     if expr is None or table.num_rows == 0:
         return table
-    mask = pc.fill_null(pc.cast(evaluate(expr, table), pa.bool_()), False)
-    return table.filter(mask)
+    return table.filter(boolean_mask(expr, table))
 
 
 def boolean_mask(expr: ir.Expression, table: pa.Table):

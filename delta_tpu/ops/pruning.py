@@ -66,20 +66,44 @@ def _nulls(c: str) -> ir.Expression:
 _UNKNOWN = ir.Literal(None)
 
 
-def skipping_predicate(e: ir.Expression) -> ir.Expression:
+def skipping_predicate(
+    e: ir.Expression, partition_cols: frozenset = frozenset()
+) -> ir.Expression:
     """Rewrite a data predicate into a can-match predicate over stats columns.
-    Returns ``Literal(None)`` (= keep) for unsupported shapes."""
+    Returns ``Literal(None)`` (= keep) for unsupported shapes. Partition
+    columns have no stats lanes — references to them rewrite to UNKNOWN
+    (they only reach here inside mixed OR branches; pure partition conjuncts
+    are routed to partition pruning upstream)."""
+
+    def _is_part(col: ir.Expression) -> bool:
+        return isinstance(col, ir.Column) and col.name.lower() in partition_cols
+
     t = type(e)
     if t is ir.And:
-        return ir.And(skipping_predicate(e.left), skipping_predicate(e.right))
+        return ir.And(
+            skipping_predicate(e.left, partition_cols),
+            skipping_predicate(e.right, partition_cols),
+        )
     if t is ir.Or:
-        return ir.Or(skipping_predicate(e.left), skipping_predicate(e.right))
+        return ir.Or(
+            skipping_predicate(e.left, partition_cols),
+            skipping_predicate(e.right, partition_cols),
+        )
     if t is ir.Not:
         c = e.child
         if isinstance(c, ir.IsNull):
-            return skipping_predicate(ir.IsNotNull(c.child))
+            return skipping_predicate(ir.IsNotNull(c.child), partition_cols)
         if isinstance(c, ir.IsNotNull):
-            return skipping_predicate(ir.IsNull(c.child))
+            return skipping_predicate(ir.IsNull(c.child), partition_cols)
+        if all(col.lower() in partition_cols for col in ir.references(c)):
+            return e  # exact per-file partition verdict, negation included
+        return _UNKNOWN
+    if any(_is_part(c) for c in getattr(e, "children", ())):
+        # a partition column's value is constant per file: keep the predicate
+        # as-is and evaluate it exactly against the bound partition value —
+        # unless it also references data columns (no lane to bind)
+        if all(col.lower() in partition_cols for col in ir.references(e)):
+            return e
         return _UNKNOWN
     # normalize <col> <op> <lit>
     cmp_map = {ir.Eq: ir.Eq, ir.Lt: ir.Lt, ir.Le: ir.Le, ir.Gt: ir.Gt, ir.Ge: ir.Ge}
@@ -138,7 +162,10 @@ def _prefix_upper_bound(p: str) -> Optional[str]:
     while chars:
         cp = ord(chars[-1])
         if cp < 0x10FFFF:
-            chars[-1] = chr(cp + 1)
+            nxt = cp + 1
+            if 0xD800 <= nxt <= 0xDFFF:  # skip the surrogate block
+                nxt = 0xE000
+            chars[-1] = chr(nxt)
             return "".join(chars)
         chars.pop()
     return None
@@ -194,7 +221,8 @@ def prune_files(
     """Apply min/max skipping; returns the files that may contain matches."""
     if not files or not data_filters:
         return list(files)
-    pred = skipping_predicate(ir.and_all(list(data_filters)))
+    pcols = frozenset(c.lower() for c in metadata.partition_columns)
+    pred = skipping_predicate(ir.and_all(list(data_filters)), pcols)
     keep: Optional[np.ndarray] = None
     if prefer_device:
         arrays = state_export.files_to_arrays(files, metadata)

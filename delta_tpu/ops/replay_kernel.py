@@ -20,7 +20,7 @@ tombstones*, not to which add survives — handled by a mask on remove rows.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -29,7 +29,7 @@ from jax.sharding import Mesh
 from jax import shard_map
 
 from delta_tpu.ops.state_export import ReplayArrays
-from delta_tpu.parallel.mesh import P, STATE_AXIS, pad_to_multiple, shard_count
+from delta_tpu.parallel.mesh import P, STATE_AXIS, shard_count
 
 __all__ = ["ReplayResult", "replay_alive_mask", "replay_sharded", "ReplayStats"]
 

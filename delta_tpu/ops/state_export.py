@@ -39,8 +39,14 @@ _NUMERIC = (ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType,
 
 
 def _stat_to_lane(v: Any, dt: DataType) -> Optional[float]:
-    """Normalize a JSON stats value to a comparable float64 lane value."""
+    """Normalize a JSON stats value to a comparable float64 lane value.
+
+    Integers beyond 2^53 don't fit a float64 lane exactly — treating them as
+    missing keeps pruning conservative (NULL keeps the file) instead of
+    silently pruning on a rounded bound."""
     if v is None:
+        return None
+    if isinstance(v, int) and abs(v) > 2**53:
         return None
     try:
         if isinstance(dt, DateType) and isinstance(v, str):
@@ -184,8 +190,11 @@ def stats_table(files: Sequence[AddFile], metadata: Metadata,
                 stats_columns: Optional[Sequence[str]] = None) -> pa.Table:
     """Host (Arrow) view of per-file stats for the vectorized skipping path —
     includes string columns the device path can't carry."""
+    from delta_tpu.expr.partition import typed_partition_row
+
     schema: StructType = metadata.schema
     part_cols = set(metadata.partition_columns)
+    part_schema = metadata.partition_schema
     if stats_columns is None:
         stats_columns = [f.name for f in schema.fields if f.name not in part_cols]
     rows: List[Dict[str, Any]] = []
@@ -199,6 +208,9 @@ def stats_table(files: Sequence[AddFile], metadata: Metadata,
             row[f"min.{c}"] = mins.get(c)
             row[f"max.{c}"] = maxs.get(c)
             row[f"nullCount.{c}"] = nulls.get(c)
+        # typed partition values: constant per file, bound so mixed
+        # partition/data predicates evaluate the partition leg exactly
+        row.update(typed_partition_row(f, part_schema))
         rows.append(row)
     return pa.Table.from_pylist(rows) if rows else pa.table({"numRecords": pa.nulls(0, pa.int64())})
 

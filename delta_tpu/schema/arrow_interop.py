@@ -1,0 +1,80 @@
+"""Arrow ⇄ Delta schema conversion.
+
+The reference converts between Spark `StructType` and Parquet schemas inside
+Spark; here the engine's interchange format is Arrow, so schema inference for
+new tables (`schema/ImplicitMetadataOperation.scala:30-62`) starts from a
+`pyarrow.Schema`.
+"""
+from __future__ import annotations
+
+import pyarrow as pa
+
+from delta_tpu.schema.types import (
+    ArrayType,
+    BinaryType,
+    BooleanType,
+    ByteType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    MapType,
+    ShortType,
+    StringType,
+    StructField,
+    StructType,
+    TimestampType,
+)
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["delta_type_from_arrow", "schema_from_arrow"]
+
+
+def delta_type_from_arrow(t: pa.DataType) -> DataType:
+    if pa.types.is_boolean(t):
+        return BooleanType()
+    if pa.types.is_int8(t):
+        return ByteType()
+    if pa.types.is_int16(t):
+        return ShortType()
+    if pa.types.is_int32(t) or pa.types.is_uint8(t) or pa.types.is_uint16(t):
+        return IntegerType()
+    if pa.types.is_int64(t) or pa.types.is_uint32(t) or pa.types.is_uint64(t):
+        return LongType()
+    if pa.types.is_float32(t) or pa.types.is_float16(t):
+        return FloatType()
+    if pa.types.is_float64(t):
+        return DoubleType()
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return StringType()
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return BinaryType()
+    if pa.types.is_date(t):
+        return DateType()
+    if pa.types.is_timestamp(t):
+        return TimestampType()
+    if pa.types.is_decimal(t):
+        return DecimalType(t.precision, t.scale)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return ArrayType(delta_type_from_arrow(t.value_type))
+    if pa.types.is_map(t):
+        return MapType(delta_type_from_arrow(t.key_type), delta_type_from_arrow(t.item_type))
+    if pa.types.is_struct(t):
+        return StructType(
+            [
+                StructField(t.field(i).name, delta_type_from_arrow(t.field(i).type), t.field(i).nullable)
+                for i in range(t.num_fields)
+            ]
+        )
+    if pa.types.is_null(t):
+        return StringType()  # all-null columns default to string, like Spark
+    raise DeltaAnalysisError(f"Unsupported Arrow type for Delta schema: {t}")
+
+
+def schema_from_arrow(schema: pa.Schema) -> StructType:
+    return StructType(
+        [StructField(f.name, delta_type_from_arrow(f.type), f.nullable) for f in schema]
+    )

@@ -257,3 +257,37 @@ def test_null_partition_value_pruned():
 
     scan = pruning.files_for_scan(S(), [parse_predicate("part = 'us'")])
     assert [x.path for x in scan.files] == ["f1", "f2"]
+
+
+def test_mixed_partition_data_or_predicate():
+    # regression: partition col inside an OR with a data col must not crash
+    scan = _scan("part = 'us' OR id > 350")
+    assert [f.path for f in scan.files] == ["f1", "f2", "f4"]
+
+
+def test_int64_stats_beyond_float53_kept():
+    # regression: int stats beyond 2^53 must not be pruned on rounded bounds
+    big = 2**53
+    f = _file("fbig", "us", 0, 0, 1.0, 2.0)
+    st = json.loads(f.stats)
+    st["minValues"]["id"] = big
+    st["maxValues"]["id"] = big + 1
+    f = AddFile(path="fbig", partition_values={"part": "us"}, size=1,
+                modification_time=0, data_change=True, stats=json.dumps(st))
+
+    class S:
+        version = 1
+        all_files = [f]
+        metadata = _meta()
+
+    scan = pruning.files_for_scan(S(), [parse_predicate(f"id > {big}")])
+    assert [x.path for x in scan.files] == ["fbig"]
+
+
+def test_prefix_upper_bound_surrogates():
+    from delta_tpu.ops.pruning import _prefix_upper_bound
+
+    assert _prefix_upper_bound("퟿") == ""
+    assert _prefix_upper_bound("a") == "b"
+    assert _prefix_upper_bound("a\U0010FFFF") == "b"
+    assert _prefix_upper_bound("\U0010FFFF") is None

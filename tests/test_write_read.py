@@ -1,0 +1,239 @@
+"""End-to-end slice: create → append → scan → overwrite → time travel.
+
+The behavioral spec is the reference's write/read call stacks (SURVEY §3.1,
+§3.2) and `examples/python/quickstart.py` up to the DML steps.
+"""
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.exec.scan import scan_files, scan_to_table
+from delta_tpu.schema.constraints import CONSTRAINT_PROP_PREFIX
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    InvariantViolationError,
+    SchemaMismatchError,
+)
+
+
+def write(log, data, mode="append", **kw):
+    return WriteIntoDelta(log, mode, data, **kw).run()
+
+
+def read_ids(log, filters=()):
+    t = scan_to_table(log.update(), filters)
+    return sorted(t.column("id").to_pylist())
+
+
+def test_quickstart_create_read_overwrite(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": list(range(5))})
+    assert read_ids(log) == [0, 1, 2, 3, 4]
+    # overwrite with 5..10
+    write(log, {"id": list(range(5, 10))}, mode="overwrite")
+    assert read_ids(log) == [5, 6, 7, 8, 9]
+    # time travel back to v0
+    v0 = log.get_snapshot_at(0)
+    assert sorted(scan_to_table(v0).column("id").to_pylist()) == [0, 1, 2, 3, 4]
+
+
+def test_append_accumulates(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2]})
+    write(log, {"id": [3]})
+    assert read_ids(log) == [1, 2, 3]
+    assert log.snapshot.version == 1
+
+
+def test_error_mode_and_ignore(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    with pytest.raises(DeltaAnalysisError):
+        write(log, {"id": [2]}, mode="error")
+    write(log, {"id": [2]}, mode="ignore")  # no-op
+    assert read_ids(log) == [1]
+
+
+def test_partitioned_write_layout_and_pruning(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    data = {
+        "id": [1, 2, 3, 4],
+        "country": ["us", "us", "fr", "fr"],
+    }
+    write(log, data, partition_columns=["country"])
+    snap = log.update()
+    files = snap.all_files
+    assert len(files) == 2
+    assert all(f.path.startswith("country=") for f in files)
+    # physical file must NOT contain the partition column
+    raw = pq.read_table(os.path.join(tmp_table, files[0].path))
+    assert "country" not in raw.column_names
+    # partition pruning reads one file
+    scan = scan_files(snap, ["country = 'us'"])
+    assert len(scan.files) == 1
+    t = scan_to_table(snap, ["country = 'us'"])
+    assert sorted(t.column("id").to_pylist()) == [1, 2]
+    assert set(t.column("country").to_pylist()) == {"us"}
+
+
+def test_stats_skipping_on_read(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2, 3]})
+    write(log, {"id": [100, 200, 300]})
+    snap = log.update()
+    scan = scan_files(snap, ["id > 50"])
+    assert scan.total.files == 2
+    assert scan.scanned.files == 1  # min/max skipping pruned the first file
+    assert sorted(scan_to_table(snap, ["id > 50"]).column("id").to_pylist()) == [100, 200, 300]
+
+
+def test_stats_written_per_file(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [5, 1, 9], "name": ["c", "a", "b"]})
+    f = log.update().all_files[0]
+    st = json.loads(f.stats)
+    assert st["numRecords"] == 3
+    assert st["minValues"] == {"id": 1, "name": "a"}
+    assert st["maxValues"] == {"id": 9, "name": "c"}
+    assert st["nullCount"] == {"id": 0, "name": 0}
+
+
+def test_schema_enforcement_rejects_extra_column(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        write(log, {"id": [2], "extra": ["x"]})
+
+
+def test_merge_schema_evolution(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    write(log, {"id": [2], "extra": ["x"]}, merge_schema=True)
+    snap = log.update()
+    assert [f.name for f in snap.metadata.schema.fields] == ["id", "extra"]
+    t = scan_to_table(snap)
+    by_id = dict(zip(t.column("id").to_pylist(), t.column("extra").to_pylist()))
+    assert by_id == {1: None, 2: "x"}
+
+
+def test_overwrite_schema(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    with pytest.raises(DeltaAnalysisError):
+        write(log, {"other": [1.5]}, overwrite_schema=True)  # append mode
+    write(log, {"other": [1.5]}, mode="overwrite", overwrite_schema=True)
+    snap = log.update()
+    assert [f.name for f in snap.metadata.schema.fields] == ["other"]
+
+
+def test_replace_where(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(
+        log,
+        {"id": [1, 2, 3, 4], "country": ["us", "us", "fr", "fr"]},
+        partition_columns=["country"],
+    )
+    write(
+        log,
+        {"id": [20, 21], "country": ["us", "us"]},
+        mode="overwrite",
+        replace_where="country = 'us'",
+    )
+    assert read_ids(log) == [3, 4, 20, 21]
+    # writing a row outside the predicate fails
+    with pytest.raises(DeltaAnalysisError):
+        write(
+            log,
+            {"id": [9], "country": ["de"]},
+            mode="overwrite",
+            replace_where="country = 'us'",
+        )
+    # data-column predicate is rejected (partition-only, like the reference)
+    with pytest.raises(DeltaAnalysisError):
+        write(
+            log,
+            {"id": [9], "country": ["us"]},
+            mode="overwrite",
+            replace_where="id > 0",
+        )
+
+
+def test_rearrange_only_sets_datachange_false(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2]})
+    write(log, {"id": [1, 2]}, mode="overwrite", rearrange_only=True)
+    changes = list(log.get_changes(1))
+    _, actions = changes[0]
+    from delta_tpu.protocol.actions import AddFile, RemoveFile
+
+    for a in actions:
+        if isinstance(a, (AddFile, RemoveFile)):
+            assert a.data_change is False
+
+
+def test_check_constraint_enforced_on_write(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(
+        log,
+        {"id": [1, 2]},
+        configuration={CONSTRAINT_PROP_PREFIX + "idpositive": "id > 0"},
+    )
+    with pytest.raises(InvariantViolationError):
+        write(log, {"id": [-5]})
+    assert read_ids(log) == [1, 2]
+
+
+def test_null_partition_value_roundtrip(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(
+        log,
+        {"id": [1, 2], "p": ["a", None]},
+        partition_columns=["p"],
+    )
+    snap = log.update()
+    paths = sorted(f.path for f in snap.all_files)
+    assert any("__HIVE_DEFAULT_PARTITION__" in p for p in paths)
+    t = scan_to_table(snap)
+    assert sorted(t.column("id").to_pylist()) == [1, 2]
+    got = dict(zip(t.column("id").to_pylist(), t.column("p").to_pylist()))
+    assert got == {1: "a", 2: None}
+
+
+def test_special_chars_in_partition_values(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(
+        log,
+        {"id": [1], "p": ["a/b c=d"]},
+        partition_columns=["p"],
+    )
+    snap = log.update()
+    t = scan_to_table(snap, ["p = 'a/b c=d'"])
+    assert t.column("id").to_pylist() == [1]
+
+
+def test_checkpoint_after_writes_and_reload(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(12):  # crosses the checkpoint interval (10)
+        write(log, {"id": [i]})
+    assert os.path.exists(os.path.join(tmp_table, "_delta_log", "_last_checkpoint"))
+    DeltaLog.clear_cache()
+    log2 = DeltaLog.for_table(tmp_table)
+    assert read_ids(log2) == list(range(12))
+
+
+def test_large_batch_splits_files(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    from delta_tpu.exec.write import write_files
+    from delta_tpu.protocol.actions import Metadata
+    from delta_tpu.schema.arrow_interop import schema_from_arrow
+
+    t = pa.table({"id": list(range(100))})
+    meta = Metadata(schema_string=schema_from_arrow(t.schema).to_json(), partition_columns=[])
+    adds = write_files(tmp_table, t, meta, target_file_rows=30)
+    assert len(adds) == 4
+    assert sum(json.loads(a.stats)["numRecords"] for a in adds) == 100
