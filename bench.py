@@ -1,0 +1,95 @@
+"""Benchmark: snapshot state reconstruction (checkpoint replay) on device.
+
+BASELINE.json config 5: "DeltaLog checkpoint + 10k-version snapshot
+stateReconstruction replay". The reference replays the action log as a
+50-partition Spark job with per-partition hash maps (`Snapshot.scala:88-111`,
+`actions/InMemoryLogReplay.scala:43-65`); here the same reconciliation is one
+device sort + segmented reduce. ``vs_baseline`` is the speedup over the
+host-side pure-Python replay (the same algorithm the reference's executors
+run per partition, minus JVM overheads) on this machine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_stream(n_versions=10_000, actions_per_commit=20, n_paths=50_000):
+    """Synthetic 10k-version log: adds/removes over a bounded path universe."""
+    rng = np.random.RandomState(7)
+    path_id = rng.randint(0, n_paths, size=n_versions * actions_per_commit).astype(np.int32)
+    version = np.repeat(np.arange(n_versions, dtype=np.int64), actions_per_commit)
+    pos = np.tile(np.arange(actions_per_commit, dtype=np.int64), n_versions)
+    seq = (version << 20) | pos
+    is_add = rng.rand(len(path_id)) < 0.85
+    size = rng.randint(1, 1 << 24, size=len(path_id)).astype(np.int64)
+    del_ts = np.where(is_add, 0, version * 1000).astype(np.int64)
+    return path_id, seq, is_add, size, del_ts
+
+
+def host_replay_ms(path_id, seq, is_add, size):
+    """The reference algorithm: sequential hash-map replay (one partition)."""
+    t0 = time.perf_counter()
+    active = {}
+    for i in range(len(path_id)):
+        p = path_id[i]
+        if is_add[i]:
+            active[p] = size[i]
+        else:
+            active.pop(p, None)
+    elapsed = (time.perf_counter() - t0) * 1000
+    return elapsed, len(active)
+
+
+def device_replay_ms(path_id, seq, is_add, size, del_ts):
+    import jax
+
+    from delta_tpu.ops import replay_kernel
+    from delta_tpu.ops.state_export import ReplayArrays
+
+    arrays = ReplayArrays(
+        paths=[],  # dictionary not needed for the kernel
+        path_id=path_id,
+        seq=seq,
+        is_add=is_add,
+        size=size,
+        deletion_timestamp=del_ts,
+    )
+    # warm-up: compile
+    r = replay_kernel.replay_alive_mask(arrays)
+    jax.block_until_ready(r.alive)
+    runs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        r = replay_kernel.replay_alive_mask(arrays)
+        jax.block_until_ready(r.alive)
+        runs.append((time.perf_counter() - t0) * 1000)
+    return min(runs), int(r.stats.num_files)
+
+
+def main():
+    path_id, seq, is_add, size, del_ts = build_stream()
+    host_ms, host_n = host_replay_ms(path_id, seq, is_add, size)
+    dev_ms, dev_n = device_replay_ms(path_id, seq, is_add, size, del_ts)
+    if host_n != dev_n:
+        print(
+            f"MISMATCH host={host_n} device={dev_n}", file=sys.stderr
+        )
+        sys.exit(1)
+    print(
+        json.dumps(
+            {
+                "metric": "checkpoint_replay_10k_versions_200k_actions",
+                "value": round(dev_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(host_ms / dev_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -56,16 +56,23 @@ def read_files_as_table(
     if not files:
         return empty
 
+    import pyarrow.parquet as pq
+
     pieces: List[pa.Table] = []
     for add in files:
         abs_path = _abs_data_path(data_path, add.path)
+        pf = pq.ParquetFile(abs_path)
         # project to the columns this file actually has (files written before
         # a schema evolution lack the newer columns — read fills them w/ null)
-        import pyarrow.parquet as pq
-
-        present = set(pq.ParquetFile(abs_path).schema_arrow.names)
+        present = set(pf.schema_arrow.names)
         file_cols = [c for c in data_cols if c in present]
-        t = pq_exec.read_parquet_files([abs_path], columns=file_cols or None)[0]
+        if file_cols:
+            t = pf.read(columns=file_cols)
+        else:
+            # no stored columns requested (partition-only projection, or all
+            # requested columns post-date this file): carry just the row
+            # count — the dummy column is dropped by the final select
+            t = pa.table({"__dummy": pa.nulls(pf.metadata.num_rows)})
         for f in schema.fields:
             if f.name in data_cols and f.name not in t.column_names:
                 at = arrow_type_for(f.data_type)
@@ -100,12 +107,23 @@ def scan_to_table(
     filters: Sequence[Union[str, ir.Expression]] = (),
     columns: Optional[Sequence[str]] = None,
 ) -> pa.Table:
-    """Full read path: prune → decode → residual filter."""
+    """Full read path: prune → decode (projection ∪ filter columns) →
+    residual filter → project."""
     exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
     scan = pruning.files_for_scan(snapshot, exprs)
     data_path = snapshot.delta_log.data_path
-    table = read_files_as_table(data_path, scan.files, snapshot.metadata, columns)
     residual = scan.partition_filters + scan.data_filters
+    read_cols = columns
+    if columns is not None and residual:
+        # read filter-referenced columns too; project back after filtering
+        needed = set(columns)
+        for e in residual:
+            needed.update(ir.references(e))
+        read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
+                     if c in needed]
+    table = read_files_as_table(data_path, scan.files, snapshot.metadata, read_cols)
     if residual and table.num_rows:
         table = filter_table(table, ir.and_all(residual))
+    if columns is not None and read_cols != list(columns):
+        table = table.select([c for c in columns if c in table.column_names])
     return table

@@ -139,7 +139,12 @@ def write_files(
             for c in part_cols:
                 col = table.column(c)
                 v = combined.column(c)[i]
-                m = pc.is_null(col) if not v.is_valid else pc.equal(col, v)
+                if not v.is_valid:
+                    m = pc.is_null(col)
+                elif pa.types.is_floating(v.type) and v.as_py() != v.as_py():
+                    m = pc.is_nan(col)  # NaN group: NaN != NaN under pc.equal
+                else:
+                    m = pc.equal(col, v)
                 m = pc.fill_null(m, False)
                 mask = m if mask is None else pc.and_(mask, m)
             groups.append((pv, table.filter(mask)))

@@ -95,8 +95,12 @@ class FileStateArrays:
 
         env = {"numRecords": DeviceColumn.of(self.num_records, self.num_records >= 0)}
         env["size"] = DeviceColumn.of(self.size)
+        # partition codes are intentionally NOT bound under the column name:
+        # a predicate literal compares against the VALUE, not the dictionary
+        # code — binding codes here made `year = 2021` prune wrongly. Kernels
+        # that want code-space comparison bind `partition_code.<c>` explicitly.
         for c, codes in self.partition_codes.items():
-            env[c] = DeviceColumn.of(codes, codes >= 0)
+            env[f"partition_code.{c}"] = DeviceColumn.of(codes, codes >= 0)
         for c, mn in self.stats_min.items():
             env[f"min.{c}"] = DeviceColumn.of(mn, ~np.isnan(mn))
         for c, mx in self.stats_max.items():

@@ -237,3 +237,35 @@ def test_large_batch_splits_files(tmp_table):
     adds = write_files(tmp_table, t, meta, target_file_rows=30)
     assert len(adds) == 4
     assert sum(json.loads(a.stats)["numRecords"] for a in adds) == 100
+
+
+def test_projection_with_filter_on_unprojected_column(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "country": ["us", "fr"]})
+    t = scan_to_table(log.update(), ["country = 'us'"], columns=["id"])
+    assert t.column_names == ["id"]
+    assert t.column("id").to_pylist() == [1]
+
+
+def test_partition_only_projection(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "c": ["a", "b"]}, partition_columns=["c"])
+    t = scan_to_table(log.update(), columns=["c"])
+    assert sorted(t.column("c").to_pylist()) == ["a", "b"]
+
+
+def test_nan_partition_value_not_lost(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2, 3], "p": [1.5, float("nan"), None]},
+          partition_columns=["p"])
+    t = scan_to_table(log.update())
+    assert sorted(t.column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_numeric_partition_with_data_predicate(tmp_table):
+    # regression: device path must not compare partition dictionary codes
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2, 3, 4], "year": [2020, 2020, 2021, 2021]},
+          partition_columns=["year"])
+    t = scan_to_table(log.update(), ["year = 2021 OR id > 100"])
+    assert sorted(t.column("id").to_pylist()) == [3, 4]
