@@ -1,0 +1,104 @@
+"""DELETE command — predicate-scoped file removal/rewrite.
+
+Mirrors the 3-case structure of `commands/DeleteCommand.scala:92-181`:
+(1) no predicate → remove every file (no data read);
+(2) partition-only predicate → remove pruned files metadata-only;
+(3) data predicate → find touched files by a vectorized scan, rewrite each
+    keeping only non-matching rows (the reference rewrites with the negated
+    predicate via Spark jobs, `:158-171`).
+Emits the reference's operation metrics (numRemovedFiles/numAddedFiles/
+numDeletedRows/scanTimeMs/rewriteTimeMs, `DeleteCommand.scala:56-63`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import pyarrow.compute as pc
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.commands.dml_common import Timer, candidate_files, read_candidates
+from delta_tpu.exec import write as write_exec
+from delta_tpu.expr import ir
+from delta_tpu.expr import partition as partition_expr
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.protocol.actions import Action
+
+__all__ = ["DeleteCommand"]
+
+
+class DeleteCommand:
+    def __init__(self, delta_log, condition: Optional[Union[str, ir.Expression]] = None):
+        self.delta_log = delta_log
+        self.condition = (
+            parse_predicate(condition) if isinstance(condition, str) else condition
+        )
+        self.metrics: Dict[str, int] = {}
+
+    def run(self) -> int:
+        return self.delta_log.with_new_transaction(self._body)
+
+    def _body(self, txn) -> int:
+        timer = Timer()
+        actions = self._perform_delete(txn, timer)
+        op = ops.Delete(
+            predicate=[self.condition.sql()] if self.condition is not None else []
+        )
+        txn.report_metrics(**self.metrics)
+        return txn.commit(actions, op)
+
+    def _perform_delete(self, txn, timer: Timer) -> List[Action]:
+        metadata = txn.metadata
+        pcols = metadata.partition_columns
+
+        if self.condition is None:
+            # case 1: whole-table delete — no data read
+            removes = [f.remove() for f in txn.filter_files()]
+            txn.read_whole_table()
+            self.metrics.update(
+                numRemovedFiles=len(removes), numAddedFiles=0,
+                numDeletedRows=-1, scanTimeMs=timer.lap_ms(), rewriteTimeMs=0,
+            )
+            return list(removes)
+
+        conjuncts = ir.split_conjuncts(self.condition)
+        if all(partition_expr.is_partition_predicate(c, pcols) for c in conjuncts):
+            # case 2: metadata-only — prune and remove, never read data
+            # (filter_files already evaluates the partition predicate exactly)
+            to_remove = txn.filter_files([self.condition])
+            self.metrics.update(
+                numRemovedFiles=len(to_remove), numAddedFiles=0,
+                numDeletedRows=-1, scanTimeMs=timer.lap_ms(), rewriteTimeMs=0,
+            )
+            return [f.remove() for f in to_remove]
+
+        # case 3: scan + rewrite
+        candidates = candidate_files(txn, self.condition)
+        touched = read_candidates(
+            self.delta_log.data_path, candidates, metadata, self.condition
+        )
+        scan_ms = timer.lap_ms()
+
+        removes: List[Action] = []
+        adds: List[Action] = []
+        deleted_rows = 0
+        for tf in touched:
+            matches = pc.sum(tf.mask).as_py() or 0
+            if not matches:
+                continue  # file untouched
+            deleted_rows += matches
+            removes.append(tf.add.remove())
+            if matches < tf.table.num_rows:
+                survivors = tf.table.filter(pc.invert(tf.mask))
+                adds.extend(
+                    write_exec.write_files(
+                        self.delta_log.data_path, survivors, metadata, data_change=True
+                    )
+                )
+        self.metrics.update(
+            numRemovedFiles=len(removes),
+            numAddedFiles=len(adds),
+            numDeletedRows=deleted_rows,
+            scanTimeMs=scan_ms,
+            rewriteTimeMs=timer.lap_ms(),
+        )
+        return removes + adds

@@ -1,0 +1,70 @@
+"""Shared DML machinery: candidate selection and file rewrites.
+
+The reference's `commands/DeltaCommand.scala:48-219` equivalent — resolve the
+files a predicate may touch (partition pruning + stats skipping), read them,
+and rewrite survivors — but columnar: per-file row masks come from one
+vectorized predicate evaluation instead of `input_file_name()` joins.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from delta_tpu.exec.scan import read_files_as_table
+from delta_tpu.expr import ir
+from delta_tpu.expr.vectorized import boolean_mask
+from delta_tpu.ops import pruning
+from delta_tpu.protocol.actions import AddFile
+
+__all__ = ["TouchedFile", "candidate_files", "read_candidates", "Timer"]
+
+
+class Timer:
+    """Phase timer for operation metrics (scanTimeMs / rewriteTimeMs)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def lap_ms(self) -> int:
+        now = time.perf_counter()
+        ms = int((now - self.t0) * 1000)
+        self.t0 = now
+        return ms
+
+
+@dataclass
+class TouchedFile:
+    add: AddFile
+    table: pa.Table  # full rows of the file (with partition columns)
+    mask: pa.ChunkedArray  # True = row matches the predicate
+
+
+def candidate_files(txn, predicate: Optional[ir.Expression]) -> List[AddFile]:
+    """Files the predicate may touch; registers the read set on the txn."""
+    if predicate is None:
+        return txn.filter_files()
+    matched = txn.filter_files([predicate])
+    scan = pruning.files_for_scan(txn.snapshot, [predicate])
+    kept_paths = {f.path for f in scan.files}
+    return [f for f in matched if f.path in kept_paths]
+
+
+def read_candidates(
+    data_path: str,
+    files: Sequence[AddFile],
+    metadata,
+    predicate: Optional[ir.Expression],
+) -> List[TouchedFile]:
+    """Read each candidate and compute its per-row match mask."""
+    out: List[TouchedFile] = []
+    for add in files:
+        t = read_files_as_table(data_path, [add], metadata)
+        if predicate is None:
+            mask = pa.chunked_array([pa.array([True] * t.num_rows)])
+        else:
+            mask = boolean_mask(predicate, t)
+        out.append(TouchedFile(add=add, table=t, mask=mask))
+    return out

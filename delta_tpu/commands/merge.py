@@ -1,0 +1,534 @@
+"""MERGE INTO — columnar three-phase upsert.
+
+The reference (`commands/MergeIntoCommand.scala:201-771`) runs MERGE as:
+(1) findTouchedFiles — inner join source×target to locate files with matches
+    plus multi-match detection (`:310-389`);
+(2) writeAllChanges — re-read only touched files, outer join, then a
+    row-at-a-time clause interpreter (`JoinedRowProcessor :681-753`);
+(3) commit removes ++ adds.
+
+This engine keeps the phase structure but replaces the row interpreter with
+columnar blocks: matched pairs / unmatched target rows / unmatched source
+rows are materialized separately (equi-join via Arrow's hash join — the C++
+kernel; device hash-join kernel for numeric keys lives in ops/join_kernel),
+and every clause becomes a vectorized mask + projection over its block.
+Multi-clause ordering, clause conditions, multi-match errors, the insert-only
+fast path (`:397-450`) and `MergeStats` (`:79-174`) follow the reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.commands.dml_common import Timer, candidate_files
+from delta_tpu.exec import write as write_exec
+from delta_tpu.exec.scan import read_files_as_table
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression, parse_predicate
+from delta_tpu.expr.vectorized import boolean_mask, evaluate
+from delta_tpu.protocol.actions import Action, AddFile
+from delta_tpu.utils.errors import DeltaAnalysisError, DeltaUnsupportedOperationError
+
+__all__ = ["MergeIntoCommand", "MergeClause"]
+
+def _common_key_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
+    """Widest-wins join-key promotion: never cast a key down (a narrowing
+    cast with safe=False wraps values and fabricates matches)."""
+    if pa.types.is_floating(a) or pa.types.is_floating(b):
+        return pa.float64()
+    if pa.types.is_integer(a) and pa.types.is_integer(b):
+        return a if a.bit_width >= b.bit_width else b
+    if pa.types.is_string(a) or pa.types.is_string(b):
+        return pa.string()
+    return a
+
+
+_SRC = "__s__"  # prefix for source columns in the combined pair table
+_TID = "__t_row__"
+_SID = "__s_row__"
+_FID = "__t_file__"
+
+
+@dataclass
+class MergeClause:
+    """One WHEN clause (`catalyst/plans/logical/deltaMerge.scala:161-221`)."""
+
+    kind: str  # "update" | "delete" | "insert"
+    condition: Optional[ir.Expression] = None
+    # None = updateAll/insertAll (star); else target column -> expression
+    assignments: Optional[Dict[str, ir.Expression]] = None
+
+    @property
+    def is_star(self) -> bool:
+        return self.assignments is None and self.kind in ("update", "insert")
+
+
+def _parse_opt(e: Optional[Union[str, ir.Expression]], pred=True):
+    if e is None or isinstance(e, ir.Expression):
+        return e
+    return parse_predicate(e) if pred else parse_expression(e)
+
+
+class MergeIntoCommand:
+    def __init__(
+        self,
+        delta_log,
+        source: Any,
+        condition: Union[str, ir.Expression],
+        matched_clauses: Sequence[MergeClause] = (),
+        not_matched_clauses: Sequence[MergeClause] = (),
+        source_alias: Optional[str] = None,
+        target_alias: Optional[str] = None,
+    ):
+        from delta_tpu.commands.write import coerce_to_table
+
+        self.delta_log = delta_log
+        self.source = coerce_to_table(source)
+        self.condition = _parse_opt(condition)
+
+        def _norm(c: MergeClause) -> MergeClause:
+            return MergeClause(
+                kind=c.kind,
+                condition=_parse_opt(c.condition),
+                assignments=None if c.assignments is None else {
+                    col: (parse_expression(e) if isinstance(e, str) else e)
+                    for col, e in c.assignments.items()
+                },
+            )
+
+        self.matched_clauses = [_norm(c) for c in matched_clauses]
+        self.not_matched_clauses = [_norm(c) for c in not_matched_clauses]
+        self.source_alias = source_alias
+        self.target_alias = target_alias
+        self.metrics: Dict[str, int] = {}
+        self._validate_clauses()
+
+    def _validate_clauses(self) -> None:
+        for c in self.matched_clauses:
+            if c.kind not in ("update", "delete"):
+                raise DeltaAnalysisError(f"Invalid matched clause: {c.kind}")
+        for c in self.not_matched_clauses:
+            if c.kind != "insert":
+                raise DeltaAnalysisError(f"Invalid not-matched clause: {c.kind}")
+        # only the last clause of each group may lack a condition
+        for group in (self.matched_clauses, self.not_matched_clauses):
+            for c in group[:-1]:
+                if c.condition is None:
+                    raise DeltaAnalysisError(
+                        "When there are more than one MATCHED/NOT MATCHED clauses, "
+                        "only the last can omit its condition"
+                    )
+
+    # -- name resolution --------------------------------------------------
+
+    def _resolve(self, e: ir.Expression, target_cols: Sequence[str],
+                 source_cols: Sequence[str]) -> ir.Expression:
+        """Rewrite alias-qualified/unqualified refs onto the combined pair
+        table: target columns keep their names, source columns get _SRC."""
+        t_low = {c.lower(): c for c in target_cols}
+        s_low = {c.lower(): c for c in source_cols}
+        t_alias = (self.target_alias or "").lower()
+        s_alias = (self.source_alias or "").lower()
+
+        def rewrite(node: ir.Expression) -> Optional[ir.Expression]:
+            if not isinstance(node, ir.Column):
+                return None
+            name = node.name
+            low = name.lower()
+            if "." in low and low not in t_low and low not in s_low:
+                qual, _, col = low.partition(".")
+                if qual == s_alias and col in s_low:
+                    return ir.Column(_SRC + s_low[col])
+                if qual == t_alias and col in t_low:
+                    return ir.Column(t_low[col])
+                # an unknown qualifier must NOT fall back to bare resolution:
+                # 't.id = s.id' without aliases would resolve both sides to
+                # the target and turn the condition into a tautology
+                raise DeltaAnalysisError(
+                    f"Cannot resolve {name!r} in MERGE: qualifier {qual!r} matches "
+                    f"neither target alias {self.target_alias!r} nor source alias "
+                    f"{self.source_alias!r}"
+                )
+            if low in t_low:
+                return ir.Column(t_low[low])
+            if low in s_low:
+                return ir.Column(_SRC + s_low[low])
+            raise DeltaAnalysisError(
+                f"Cannot resolve {name!r} in MERGE (target={list(target_cols)}, "
+                f"source={list(source_cols)})"
+            )
+
+        return e.transform(rewrite)
+
+    def _split_equi_keys(
+        self, cond: ir.Expression
+    ) -> Tuple[List[Tuple[ir.Expression, ir.Expression]], List[ir.Expression]]:
+        """Split the (resolved) join condition into target=source equi pairs
+        + residual conjuncts."""
+        pairs: List[Tuple[ir.Expression, ir.Expression]] = []
+        residual: List[ir.Expression] = []
+        for c in ir.split_conjuncts(cond):
+            if isinstance(c, ir.Eq):
+                sides = [c.left, c.right]
+                refs = [set(ir.references(s)) for s in sides]
+                t_side = s_side = None
+                for side, r in zip(sides, refs):
+                    if r and all(x.startswith(_SRC) for x in r):
+                        s_side = side
+                    elif r and not any(x.startswith(_SRC) for x in r):
+                        t_side = side
+                if t_side is not None and s_side is not None:
+                    pairs.append((t_side, s_side))
+                    continue
+            residual.append(c)
+        return pairs, residual
+
+    # -- main -------------------------------------------------------------
+
+    def run(self) -> int:
+        return self.delta_log.with_new_transaction(self._body)
+
+    def _body(self, txn) -> int:
+        timer = Timer()
+        metadata = txn.metadata
+        target_cols = [f.name for f in metadata.schema.fields]
+        source_cols = list(self.source.column_names)
+        cond = self._resolve(self.condition, target_cols, source_cols)
+        equi, residual = self._split_equi_keys(cond)
+
+        # source with prefixed names + row ids
+        src = self.source.rename_columns([_SRC + c for c in source_cols])
+        src = src.append_column(_SID, pa.array(range(src.num_rows), pa.int64()))
+
+        # phase 1: candidates by target-only conjuncts, then the join
+        target_only = [
+            c for c in ir.split_conjuncts(cond)
+            if not any(r.startswith(_SRC) for r in ir.references(c))
+        ]
+        candidates = candidate_files(txn, ir.and_all(target_only) if target_only else None)
+        insert_only = not self.matched_clauses
+        matched_pairs, tgt_tables = self._join(
+            txn, candidates, src, equi, residual, metadata
+        )
+        scan_ms = timer.lap_ms()
+
+        if not insert_only:
+            # insert-only merges can't modify target rows, so duplicate
+            # matches are harmless (reference fast path, `:397-450`)
+            self._check_multi_match(matched_pairs)
+
+        touched_ids = set()
+        if matched_pairs.num_rows:
+            touched_ids = set(pc.unique(matched_pairs.column(_FID)).to_pylist())
+
+        removes: List[Action] = []
+        out_blocks: List[pa.Table] = []
+        n_copied = n_updated = n_deleted = 0
+
+        if not insert_only:
+            for fid in sorted(touched_ids):
+                removes.append(candidates[fid].remove())
+            # matched block → per-clause masks
+            upd, n_updated, n_deleted, n_pair_copied = self._apply_matched(
+                matched_pairs, target_cols
+            )
+            n_copied += n_pair_copied
+            if upd is not None:
+                out_blocks.append(upd)
+            # unmatched target rows inside touched files → copy
+            for fid in sorted(touched_ids):
+                t = tgt_tables[fid]
+                matched_rows = matched_pairs.filter(
+                    pc.equal(matched_pairs.column(_FID), fid)
+                ).column(_TID)
+                keep = pc.invert(
+                    pc.is_in(t.column(_TID), value_set=pc.unique(matched_rows))
+                )
+                copied = t.filter(keep).select(target_cols)
+                n_copied += copied.num_rows
+                if copied.num_rows:
+                    out_blocks.append(copied)
+
+        # not-matched source rows → insert clauses
+        inserts, n_inserted = self._apply_not_matched(
+            matched_pairs, src, target_cols, source_cols, metadata
+        )
+        if inserts is not None and inserts.num_rows:
+            out_blocks.append(inserts)
+
+        adds: List[Action] = []
+        if out_blocks:
+            out = pa.concat_tables(out_blocks, promote_options="permissive")
+            if out.num_rows:
+                adds = list(
+                    write_exec.write_files(
+                        self.delta_log.data_path, out, metadata, data_change=True
+                    )
+                )
+        rewrite_ms = timer.lap_ms()
+
+        self.metrics.update(
+            numSourceRows=self.source.num_rows,
+            numTargetRowsCopied=n_copied,
+            numTargetRowsUpdated=n_updated,
+            numTargetRowsDeleted=n_deleted,
+            numTargetRowsInserted=n_inserted,
+            numTargetFilesRemoved=len(removes),
+            numTargetFilesAdded=len(adds),
+            scanTimeMs=scan_ms,
+            rewriteTimeMs=rewrite_ms,
+        )
+        txn.report_metrics(**self.metrics)
+        def _clause_info(c: MergeClause) -> Dict[str, Any]:
+            info: Dict[str, Any] = {"actionType": c.kind}
+            if c.condition is not None:
+                info["predicate"] = c.condition.sql()
+            return info
+
+        op = ops.Merge(
+            predicate=self.condition.sql(),
+            updates=[_clause_info(c) for c in self.matched_clauses if c.kind == "update"],
+            deletes=[_clause_info(c) for c in self.matched_clauses if c.kind == "delete"],
+            inserts=[_clause_info(c) for c in self.not_matched_clauses],
+        )
+        return txn.commit(removes + adds, op)
+
+    # -- join -------------------------------------------------------------
+
+    def _join(self, txn, candidates: List[AddFile], src: pa.Table, equi, residual,
+              metadata) -> Tuple[pa.Table, Dict[int, pa.Table]]:
+        """Inner-join source×candidate-target. Returns (pair table with
+        target cols bare + source cols prefixed + ids, per-file target
+        tables with row ids)."""
+        target_cols = [f.name for f in metadata.schema.fields]
+        tgt_tables: Dict[int, pa.Table] = {}
+        pieces: List[pa.Table] = []
+        row_base = 0
+        for fid, add in enumerate(candidates):
+            t = read_files_as_table(self.delta_log.data_path, [add], metadata)
+            t = t.append_column(
+                _TID, pa.array(range(row_base, row_base + t.num_rows), pa.int64())
+            )
+            t = t.append_column(_FID, pa.array([fid] * t.num_rows, pa.int64()))
+            row_base += t.num_rows
+            tgt_tables[fid] = t
+            pieces.append(t)
+        if not pieces:
+            empty = pa.schema(
+                [pa.field(_TID, pa.int64()), pa.field(_FID, pa.int64())]
+            ).empty_table()
+            target = empty
+        else:
+            target = pa.concat_tables(pieces, promote_options="permissive")
+
+        if target.num_rows == 0 or src.num_rows == 0:
+            # empty pair table with full combined schema
+            combined = pa.concat_tables(
+                [
+                    target.slice(0, 0),
+                ],
+                promote_options="permissive",
+            )
+            for name in src.column_names:
+                combined = combined.append_column(
+                    name, pa.nulls(0, src.column(name).type)
+                )
+            return combined, tgt_tables
+
+        if equi:
+            tkeys, skeys = [], []
+            t_aug, s_aug = target, src
+            for i, (t_e, s_e) in enumerate(equi):
+                k = f"__k{i}__"
+                t_vals = evaluate(t_e, target)
+                s_vals = evaluate(s_e, src)
+                if t_vals.type != s_vals.type:
+                    common = _common_key_type(t_vals.type, s_vals.type)
+                    t_vals = pc.cast(t_vals, common, safe=False)
+                    s_vals = pc.cast(s_vals, common, safe=False)
+                t_aug = t_aug.append_column(k, t_vals)
+                s_aug = s_aug.append_column(k, s_vals)
+                tkeys.append(k)
+                skeys.append(k)
+            joined = t_aug.join(
+                s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
+                use_threads=False,
+            )
+            joined = joined.drop_columns(tkeys)
+        else:
+            # general condition: cartesian pairing (small sources only)
+            if target.num_rows * src.num_rows > 50_000_000:
+                raise DeltaUnsupportedOperationError(
+                    "Non-equi MERGE condition over large inputs"
+                )
+            t_idx = pa.array(
+                [i for i in range(target.num_rows) for _ in range(src.num_rows)],
+                pa.int64(),
+            )
+            s_idx = pa.array(
+                list(range(src.num_rows)) * target.num_rows, pa.int64()
+            )
+            joined = target.take(t_idx)
+            s_taken = src.take(s_idx)
+            for name in s_taken.column_names:
+                joined = joined.append_column(name, s_taken.column(name))
+        if residual:
+            joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
+        return joined, tgt_tables
+
+    def _check_multi_match(self, pairs: pa.Table) -> None:
+        """Error when a target row matches multiple source rows, unless the
+        merge is a single unconditional DELETE (`:351-365`)."""
+        if pairs.num_rows == 0:
+            return
+        single_delete = (
+            len(self.matched_clauses) == 1
+            and self.matched_clauses[0].kind == "delete"
+            and self.matched_clauses[0].condition is None
+        )
+        if single_delete:
+            return
+        counts = pairs.group_by(_TID).aggregate([(_TID, "count")])
+        if pc.max(counts.column(f"{_TID}_count")).as_py() > 1:
+            raise DeltaUnsupportedOperationError(
+                "Cannot perform Merge as multiple source rows matched and attempted "
+                "to modify the same target row in the Delta table in possibly "
+                "conflicting ways."
+            )
+
+    # -- clause application ------------------------------------------------
+
+    def _apply_matched(self, pairs: pa.Table, target_cols: List[str]):
+        """Matched block: rows claimed by update clauses are projected, by
+        delete clauses dropped, unclaimed pairs copy the target row."""
+        if pairs.num_rows == 0 or not self.matched_clauses:
+            return None, 0, 0, 0
+        n = pairs.num_rows
+        unclaimed = pa.chunked_array([pa.array([True] * n)])
+        out_parts: List[pa.Table] = []
+        n_updated = n_deleted = 0
+        for clause in self.matched_clauses:
+            if clause.condition is None:
+                fire = unclaimed
+            else:
+                cond = self._resolve_in_pairs(clause.condition, pairs)
+                fire = pc.and_(unclaimed, boolean_mask(cond, pairs))
+            count = pc.sum(fire).as_py() or 0
+            if count:
+                block = pairs.filter(fire)
+                if clause.kind == "update":
+                    out_parts.append(self._project_update(block, clause, target_cols))
+                    n_updated += count
+                else:
+                    n_deleted += count
+            unclaimed = pc.and_(unclaimed, pc.invert(fire))
+        # unclaimed matched pairs: copy target row unchanged
+        rest = pairs.filter(unclaimed)
+        if rest.num_rows:
+            out_parts.append(rest.select(target_cols))
+        out = (
+            pa.concat_tables(out_parts, promote_options="permissive")
+            if out_parts
+            else None
+        )
+        return out, n_updated, n_deleted, rest.num_rows
+
+    def _resolve_in_pairs(self, e: ir.Expression, pairs: pa.Table) -> ir.Expression:
+        src_cols = [c[len(_SRC):] for c in pairs.column_names if c.startswith(_SRC)]
+        tgt_cols = [
+            c for c in pairs.column_names
+            if not c.startswith("__") and not c.startswith(_SRC)
+        ]
+        return self._resolve(e, tgt_cols, src_cols)
+
+    def _project_update(self, block: pa.Table, clause: MergeClause,
+                        target_cols: List[str]) -> pa.Table:
+        src_cols = [c[len(_SRC):] for c in block.column_names if c.startswith(_SRC)]
+        if clause.is_star:
+            # updateAll: SET t.c = s.c for every target column present in source
+            assignments = {
+                c: ir.Column(_SRC + next(s for s in src_cols if s.lower() == c.lower()))
+                for c in target_cols
+                if any(s.lower() == c.lower() for s in src_cols)
+            }
+        else:
+            assignments = {}
+            for col, e in clause.assignments.items():
+                name = col.split(".")[-1]  # strip target alias qualifier
+                assignments[name] = self._resolve_in_pairs(e, block)
+        cols = []
+        for c in target_cols:
+            e = None
+            for k, v in assignments.items():
+                if k.lower() == c.lower():
+                    e = v
+                    break
+            if e is None:
+                cols.append(block.column(c))
+            else:
+                new = evaluate(e, block)
+                cols.append(pc.cast(new, block.column(c).type, safe=False))
+        return pa.table(cols, names=target_cols)
+
+    def _apply_not_matched(self, pairs: pa.Table, src: pa.Table,
+                           target_cols: List[str], source_cols: List[str], metadata):
+        if not self.not_matched_clauses:
+            return None, 0
+        if pairs.num_rows:
+            matched_sids = pc.unique(pairs.column(_SID))
+            unmatched = src.filter(
+                pc.invert(pc.is_in(src.column(_SID), value_set=matched_sids))
+            )
+        else:
+            unmatched = src
+        if unmatched.num_rows == 0:
+            return None, 0
+        n = unmatched.num_rows
+        unclaimed = pa.chunked_array([pa.array([True] * n)])
+        parts: List[pa.Table] = []
+        n_inserted = 0
+        from delta_tpu.expr.vectorized import arrow_type_for
+
+        for clause in self.not_matched_clauses:
+            if clause.condition is None:
+                fire = unclaimed
+            else:
+                cond = self._resolve(clause.condition, [], source_cols)
+                fire = pc.and_(unclaimed, boolean_mask(cond, unmatched))
+            count = pc.sum(fire).as_py() or 0
+            if count:
+                block = unmatched.filter(fire)
+                if clause.is_star:
+                    assignments = {
+                        c: ir.Column(_SRC + next(
+                            s for s in source_cols if s.lower() == c.lower()
+                        ))
+                        for c in target_cols
+                        if any(s.lower() == c.lower() for s in source_cols)
+                    }
+                else:
+                    assignments = {
+                        col.split(".")[-1]: self._resolve(e, [], source_cols)
+                        for col, e in clause.assignments.items()
+                    }
+                cols = []
+                for f in metadata.schema.fields:
+                    e = None
+                    for k, v in assignments.items():
+                        if k.lower() == f.name.lower():
+                            e = v
+                            break
+                    at = arrow_type_for(f.data_type)
+                    if e is None:
+                        cols.append(pa.nulls(block.num_rows, at))
+                    else:
+                        cols.append(pc.cast(evaluate(e, block), at, safe=False))
+                parts.append(pa.table(cols, names=target_cols))
+                n_inserted += count
+            unclaimed = pc.and_(unclaimed, pc.invert(fire))
+        out = pa.concat_tables(parts, promote_options="permissive") if parts else None
+        return out, n_inserted

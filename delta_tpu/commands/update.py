@@ -1,0 +1,112 @@
+"""UPDATE command — conditional column rewrite.
+
+Mirrors `commands/UpdateCommand.scala:45-269`: find candidate files by
+predicate scan, rewrite each touched file projecting
+``CASE WHEN cond THEN new_expr ELSE old END`` per updated column
+(`buildUpdatedColumns :232`), commit remove+add. The projection is one
+vectorized pass per column (Arrow kernels) instead of per-row codegen.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.commands.dml_common import Timer, candidate_files, read_candidates
+from delta_tpu.exec import write as write_exec
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression, parse_predicate
+from delta_tpu.expr.vectorized import evaluate
+from delta_tpu.protocol.actions import Action
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["UpdateCommand"]
+
+
+class UpdateCommand:
+    def __init__(
+        self,
+        delta_log,
+        set_exprs: Dict[str, Union[str, ir.Expression]],
+        condition: Optional[Union[str, ir.Expression]] = None,
+    ):
+        if not set_exprs:
+            raise DeltaAnalysisError("UPDATE requires at least one SET assignment")
+        self.delta_log = delta_log
+        self.set_exprs = {
+            col: parse_expression(e) if isinstance(e, str) else e
+            for col, e in set_exprs.items()
+        }
+        self.condition = (
+            parse_predicate(condition) if isinstance(condition, str) else condition
+        )
+        self.metrics: Dict[str, int] = {}
+
+    def run(self) -> int:
+        return self.delta_log.with_new_transaction(self._body)
+
+    def _body(self, txn) -> int:
+        metadata = txn.metadata
+        schema_cols = {f.name.lower(): f.name for f in metadata.schema.fields}
+        # updating a partition column is allowed: write_files is partition-
+        # aware, so rewritten rows land in their new partition directories
+        for col in self.set_exprs:
+            if col.lower() not in schema_cols:
+                raise DeltaAnalysisError(f"Column {col!r} not found in table schema")
+
+        timer = Timer()
+        candidates = candidate_files(txn, self.condition)
+        touched = read_candidates(
+            self.delta_log.data_path, candidates, metadata, self.condition
+        )
+        scan_ms = timer.lap_ms()
+
+        removes: List[Action] = []
+        adds: List[Action] = []
+        updated_rows = 0
+        for tf in touched:
+            n_match = pc.sum(tf.mask).as_py() or 0
+            if not n_match:
+                continue
+            updated_rows += n_match
+            removes.append(tf.add.remove())
+            rewritten = self._apply_updates(tf.table, tf.mask, metadata)
+            adds.extend(
+                write_exec.write_files(
+                    self.delta_log.data_path, rewritten, metadata, data_change=True
+                )
+            )
+        self.metrics.update(
+            numRemovedFiles=len(removes),
+            numAddedFiles=len(adds),
+            numUpdatedRows=updated_rows,
+            scanTimeMs=scan_ms,
+            rewriteTimeMs=timer.lap_ms(),
+        )
+        txn.report_metrics(**self.metrics)
+        op = ops.Update(predicate=self.condition.sql() if self.condition else None)
+        return txn.commit(removes + adds, op)
+
+    def _apply_updates(self, table: pa.Table, mask, metadata) -> pa.Table:
+        cols = []
+        names = []
+        lower_set = {c.lower(): e for c, e in self.set_exprs.items()}
+        for name in table.column_names:
+            expr = lower_set.get(name.lower())
+            old = table.column(name)
+            if expr is None:
+                cols.append(old)
+            else:
+                new = evaluate(expr, table)
+                try:
+                    new = pc.cast(new, old.type, safe=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    raise DeltaAnalysisError(
+                        f"UPDATE expression for {name} has incompatible type "
+                        f"{new.type} (column is {old.type})"
+                    )
+                cols.append(pc.if_else(mask, new, old))
+            names.append(name)
+        return pa.table(cols, names=names)
