@@ -43,10 +43,16 @@ class TouchedFile:
 
 
 def candidate_files(txn, predicate: Optional[ir.Expression]) -> List[AddFile]:
-    """Files the predicate may touch; registers the read set on the txn."""
+    """Files the predicate may touch; registers the read set on the txn.
+
+    Conjuncts are split so a mixed predicate (``part='a' AND data>5``)
+    records the partition leg as the transaction's read predicate — keeping
+    the OCC read set partition-scoped instead of whole-table — while stats
+    skipping still applies the data leg."""
     if predicate is None:
         return txn.filter_files()
-    matched = txn.filter_files([predicate])
+    conjuncts = ir.split_conjuncts(predicate)
+    matched = txn.filter_files(conjuncts)
     scan = pruning.files_for_scan(txn.snapshot, [predicate])
     kept_paths = {f.path for f in scan.files}
     return [f for f in matched if f.path in kept_paths]

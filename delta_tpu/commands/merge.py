@@ -35,16 +35,44 @@ from delta_tpu.utils.errors import DeltaAnalysisError, DeltaUnsupportedOperation
 
 __all__ = ["MergeIntoCommand", "MergeClause"]
 
-def _common_key_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
-    """Widest-wins join-key promotion: never cast a key down (a narrowing
-    cast with safe=False wraps values and fabricates matches)."""
-    if pa.types.is_floating(a) or pa.types.is_floating(b):
-        return pa.float64()
+def _coerce_join_keys(t_vals, s_vals):
+    """Lossless join-key coercion: never run a narrowing or precision-losing
+    cast (wrapped/rounded keys fabricate matches).
+
+    int vs int → wider int; float vs float → float64; int vs float → keep
+    int64 and map the float side through an integrality check (non-integral
+    or out-of-range floats become NULL, and NULL keys never join)."""
+    a, b = t_vals.type, s_vals.type
+    if a == b:
+        return t_vals, s_vals
     if pa.types.is_integer(a) and pa.types.is_integer(b):
-        return a if a.bit_width >= b.bit_width else b
+        common = a if a.bit_width >= b.bit_width else b
+        return pc.cast(t_vals, common), pc.cast(s_vals, common)
+    if pa.types.is_floating(a) and pa.types.is_floating(b):
+        return pc.cast(t_vals, pa.float64()), pc.cast(s_vals, pa.float64())
+
+    def float_to_int64(vals):
+        f = pc.cast(vals, pa.float64())
+        # any integral float64 in ±2^62 casts to int64 exactly (it IS a
+        # representable integer); non-integral / out-of-range can't equal
+        # any int64 key, so they become NULL (null keys never join)
+        integral = pc.and_(
+            pc.equal(pc.floor(f), f),
+            pc.and_(pc.greater_equal(f, pa.scalar(-(2.0**62))),
+                    pc.less_equal(f, pa.scalar(2.0**62))),
+        )
+        return pc.cast(
+            pc.if_else(pc.fill_null(integral, False), f, pa.scalar(None, pa.float64())),
+            pa.int64(),
+        )
+
+    if pa.types.is_integer(a) and pa.types.is_floating(b):
+        return pc.cast(t_vals, pa.int64()), float_to_int64(s_vals)
+    if pa.types.is_floating(a) and pa.types.is_integer(b):
+        return float_to_int64(t_vals), pc.cast(s_vals, pa.int64())
     if pa.types.is_string(a) or pa.types.is_string(b):
-        return pa.string()
-    return a
+        return pc.cast(t_vals, pa.string()), pc.cast(s_vals, pa.string())
+    return t_vals, s_vals
 
 
 _SRC = "__s__"  # prefix for source columns in the combined pair table
@@ -346,10 +374,7 @@ class MergeIntoCommand:
                 k = f"__k{i}__"
                 t_vals = evaluate(t_e, target)
                 s_vals = evaluate(s_e, src)
-                if t_vals.type != s_vals.type:
-                    common = _common_key_type(t_vals.type, s_vals.type)
-                    t_vals = pc.cast(t_vals, common, safe=False)
-                    s_vals = pc.cast(s_vals, common, safe=False)
+                t_vals, s_vals = _coerce_join_keys(t_vals, s_vals)
                 t_aug = t_aug.append_column(k, t_vals)
                 s_aug = s_aug.append_column(k, s_vals)
                 tkeys.append(k)

@@ -88,7 +88,11 @@ class OptimizeCommand:
 
         removes: List[Action] = []
         adds: List[Action] = []
-        for key, files in sorted(by_partition.items()):
+        # None-safe ordering: null partition values sort first
+        for key, files in sorted(
+            by_partition.items(),
+            key=lambda kv: [(c, v is not None, v or "") for c, v in kv[0]],
+        ):
             if self.z_order_by:
                 group = files  # Z-order rewrites every selected file
             else:
@@ -138,9 +142,11 @@ def np_col(table: pa.Table, name: str):
         if c.lower() == name.lower():
             col = table.column(c)
             break
+    if col.null_count == len(col):
+        # all-null: every rank is equal, contribute a constant dimension
+        import numpy as np
+
+        return np.zeros(len(col), np.int64)
     if col.null_count:
-        floor = pc.min(col)
-        if not floor.is_valid:  # all-null column
-            floor = pa.scalar(0)
-        col = pc.fill_null(col, floor)
+        col = pc.fill_null(col, pc.min(col))
     return col.to_numpy(zero_copy_only=False)

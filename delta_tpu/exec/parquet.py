@@ -23,9 +23,13 @@ import pyarrow.parquet as pq
 __all__ = ["write_parquet_file", "read_parquet_files", "collect_stats", "stats_json"]
 
 
-def _stat_value(scalar: pa.Scalar) -> Any:
+def _stat_value(scalar: pa.Scalar, round_up: bool = False) -> Any:
     v = scalar.as_py()
     if isinstance(v, _dt.datetime):
+        if round_up and v.microsecond % 1000:
+            # maxValues truncated to ms must round UP or data skipping would
+            # prune files containing sub-millisecond maxima
+            v = v + _dt.timedelta(microseconds=1000 - v.microsecond % 1000)
         return v.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
     if isinstance(v, _dt.date):
         return v.isoformat()
@@ -58,7 +62,7 @@ def collect_stats(table: pa.Table, num_indexed_cols: int = 32) -> Dict[str, Any]
             continue
         try:
             mn = _stat_value(pc.min(col))
-            mx = _stat_value(pc.max(col))
+            mx = _stat_value(pc.max(col), round_up=True)
         except pa.ArrowNotImplementedError:
             continue
         if mn is not None:

@@ -99,6 +99,44 @@ def normalize_data(table: pa.Table, schema: StructType) -> pa.Table:
     return pa.table(cols, schema=pa.schema(fields))
 
 
+def _split_by_partition(
+    table: pa.Table, part_cols: Sequence[str]
+) -> List[Tuple[Dict[str, Optional[str]], pa.Table]]:
+    """One sort + linear run-boundary scan instead of one full-table mask per
+    partition value (O(n log n) vs O(groups × rows))."""
+    import numpy as np
+
+    t = table.sort_by([(c, "ascending") for c in part_cols])
+    n = t.num_rows
+    if n == 0:
+        return []
+    change = np.zeros(n, bool)
+    change[0] = True
+    for c in part_cols:
+        col = pa.chunked_array(t.column(c)).combine_chunks()
+        prev, cur = col.slice(0, n - 1), col.slice(1)
+        neq = pc.fill_null(pc.not_equal(cur, prev), False)
+        # null↔value transitions are boundaries; null↔null is not
+        null_b = pc.xor(pc.is_null(cur), pc.is_null(prev))
+        m = pc.or_(neq, null_b)
+        if pa.types.is_floating(col.type):
+            # NaN != NaN would split every NaN row into its own group
+            both_nan = pc.and_(
+                pc.fill_null(pc.is_nan(cur), False),
+                pc.fill_null(pc.is_nan(prev), False),
+            )
+            m = pc.and_(m, pc.invert(both_nan))
+        change[1:] |= np.asarray(m)
+    starts = np.flatnonzero(change)
+    bounds = np.append(starts, n)
+    out: List[Tuple[Dict[str, Optional[str]], pa.Table]] = []
+    for i, s in enumerate(starts):
+        chunk = t.slice(int(s), int(bounds[i + 1] - s))
+        pv = {c: _partition_value_str(chunk.column(c)[0]) for c in part_cols}
+        out.append((pv, chunk))
+    return out
+
+
 def _partition_value_str(scalar: pa.Scalar) -> Optional[str]:
     v = scalar.as_py()
     if v is None:
@@ -129,25 +167,7 @@ def write_files(
 
     groups: List[Tuple[Dict[str, Optional[str]], pa.Table]] = []
     if part_cols:
-        # group rows by partition tuple (arrow group-split, stable order)
-        combined = table.group_by(part_cols, use_threads=False).aggregate([])
-        for i in range(combined.num_rows):
-            pv = {
-                c: _partition_value_str(combined.column(c)[i]) for c in part_cols
-            }
-            mask = None
-            for c in part_cols:
-                col = table.column(c)
-                v = combined.column(c)[i]
-                if not v.is_valid:
-                    m = pc.is_null(col)
-                elif pa.types.is_floating(v.type) and v.as_py() != v.as_py():
-                    m = pc.is_nan(col)  # NaN group: NaN != NaN under pc.equal
-                else:
-                    m = pc.equal(col, v)
-                m = pc.fill_null(m, False)
-                mask = m if mask is None else pc.and_(mask, m)
-            groups.append((pv, table.filter(mask)))
+        groups = _split_by_partition(table, part_cols)
     else:
         groups.append(({}, table))
 

@@ -1,0 +1,58 @@
+"""Streaming source offset — versioned JSON, table-identity checked.
+
+Mirrors `sources/DeltaSourceOffset.scala` (sourceVersion=1): an offset is
+``(reservoirVersion, index, isStartingVersion)`` where ``index`` points INTO
+a commit's file list (admission control can split one commit across
+micro-batches) and ``isStartingVersion`` marks offsets still streaming the
+initial snapshot rather than the log tail.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from delta_tpu.utils.errors import DeltaIllegalStateError
+
+__all__ = ["DeltaSourceOffset", "VERSION"]
+
+VERSION = 1
+
+
+@dataclass(frozen=True, order=True)
+class DeltaSourceOffset:
+    reservoir_version: int
+    index: int
+    is_starting_version: bool
+    reservoir_id: str = ""
+
+    def json(self) -> str:
+        return json.dumps(
+            {
+                "sourceVersion": VERSION,
+                "reservoirId": self.reservoir_id,
+                "reservoirVersion": self.reservoir_version,
+                "index": self.index,
+                "isStartingVersion": self.is_starting_version,
+            },
+            separators=(",", ":"),
+        )
+
+    @staticmethod
+    def from_json(s: str, expected_table_id: str = "") -> "DeltaSourceOffset":
+        d: Dict[str, Any] = json.loads(s)
+        sv = d.get("sourceVersion")
+        if sv is None or sv > VERSION:
+            raise DeltaIllegalStateError(f"Unsupported Delta source offset version: {sv}")
+        rid = d.get("reservoirId", "")
+        if expected_table_id and rid and rid != expected_table_id:
+            raise DeltaIllegalStateError(
+                f"Offset belongs to table {rid}, not {expected_table_id} — "
+                "delete the streaming checkpoint if the table was recreated"
+            )
+        return DeltaSourceOffset(
+            reservoir_version=int(d["reservoirVersion"]),
+            index=int(d["index"]),
+            is_starting_version=bool(d.get("isStartingVersion", False)),
+            reservoir_id=rid,
+        )

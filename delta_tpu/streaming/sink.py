@@ -1,0 +1,83 @@
+"""Streaming sink — exactly-once micro-batch writes.
+
+Mirrors `sources/DeltaSink.scala:37-113`: each `addBatch(batchId, data)`
+commits inside one transaction carrying `SetTransaction(queryId, batchId)`;
+a replayed batch (engine retry / query restart) is detected by
+``txn.txn_version(queryId) >= batchId`` and skipped (`:87-91,100`). Complete
+output mode removes all existing files first (`:93-98`).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.commands.write import coerce_to_table, update_metadata_on_write
+from delta_tpu.exec import write as write_exec
+from delta_tpu.protocol.actions import Action, SetTransaction
+from delta_tpu.schema.arrow_interop import schema_from_arrow
+from delta_tpu.utils.errors import DeltaIllegalArgumentError
+
+__all__ = ["DeltaSink"]
+
+
+class DeltaSink:
+    def __init__(
+        self,
+        delta_log,
+        query_id: str,
+        output_mode: str = "append",
+        partition_columns: Sequence[str] = (),
+        merge_schema: bool = False,
+    ):
+        if output_mode not in ("append", "complete"):
+            raise DeltaIllegalArgumentError(
+                f"Data source delta does not support {output_mode} output mode"
+            )
+        self.delta_log = delta_log
+        self.query_id = query_id
+        self.output_mode = output_mode
+        self.partition_columns = list(partition_columns)
+        self.merge_schema = merge_schema
+
+    def add_batch(self, batch_id: int, data: Any) -> bool:
+        """Write one micro-batch; returns False when the batch was already
+        committed (idempotent skip)."""
+        table = coerce_to_table(data)
+
+        def body(txn) -> bool:
+            if txn.txn_version(self.query_id) >= batch_id:
+                return False  # already committed by a previous attempt
+            update_metadata_on_write(
+                txn,
+                schema_from_arrow(table.schema),
+                self.partition_columns or txn.metadata.partition_columns,
+                is_overwrite=self.output_mode == "complete",
+                merge_schema=self.merge_schema,
+                overwrite_schema=False,
+            )
+            metadata = txn.metadata
+            actions: List[Action] = [
+                SetTransaction(
+                    app_id=self.query_id,
+                    version=batch_id,
+                    last_updated=int(time.time() * 1000),
+                )
+            ]
+            if self.output_mode == "complete":
+                txn.read_whole_table()
+                actions.extend(f.remove() for f in txn.filter_files())
+            actions.extend(
+                write_exec.write_files(
+                    self.delta_log.data_path, table, metadata, data_change=True
+                )
+            )
+            op = ops.StreamingUpdate(
+                output_mode=self.output_mode,
+                query_id=self.query_id,
+                epoch_id=batch_id,
+            )
+            txn.commit(actions, op)
+            return True
+
+        return self.delta_log.with_new_transaction(body)

@@ -1,0 +1,248 @@
+"""Streaming source: initial snapshot + log tailing with admission control.
+
+Mirrors `sources/DeltaSource.scala:57-539`:
+
+* the first read serves the *initial snapshot* as indexed batches
+  (`DeltaSourceSnapshot`, files sorted by (modificationTime, path));
+* afterwards the source tails the log via `DeltaLog.getChanges`
+  (`getFileChanges :183-209`);
+* admission control caps a micro-batch by `maxFilesPerTrigger` (default
+  1000) and/or `maxBytesPerTrigger` (`AdmissionLimits`);
+* hygiene: a commit that removes or rewrites data upstream fails the stream
+  unless `ignoreDeletes` (delete-only commits) or `ignoreChanges` (rewrites;
+  re-emits updated files) is set (`verifyStreamHygieneAndFilterAddFiles
+  :312-355`); metadata (schema) changes always fail the stream;
+* `startingVersion` / `startingTimestamp` skip the initial snapshot.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from delta_tpu.protocol.actions import Action, AddFile, Metadata, Protocol, RemoveFile
+from delta_tpu.streaming.offset import DeltaSourceOffset
+from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalStateError
+
+__all__ = ["IndexedFile", "AdmissionLimits", "DeltaSource"]
+
+BASE_INDEX = -1  # offset index meaning "before any file of this version"
+
+
+@dataclass(frozen=True)
+class IndexedFile:
+    """(version, index, add) — one admissible unit (`DeltaSource.scala:57-74`)."""
+
+    version: int
+    index: int
+    add: Optional[AddFile]  # None for version sentinels
+    is_last: bool = False
+
+
+class AdmissionLimits:
+    """Per-trigger caps (`DeltaSource.scala` AdmissionLimits)."""
+
+    def __init__(self, max_files: Optional[int] = 1000, max_bytes: Optional[int] = None):
+        self.files_left = max_files if max_files is not None else float("inf")
+        self.bytes_left = max_bytes if max_bytes is not None else float("inf")
+        self._admitted_any = False
+
+    def admit(self, add: Optional[AddFile]) -> bool:
+        if add is None:
+            return True
+        size = add.size or 0
+        # always admit at least one file so the stream can't stall
+        ok = (self.files_left >= 1 and self.bytes_left >= size) or not self._admitted_any
+        if ok:
+            self.files_left -= 1
+            self.bytes_left -= size
+            self._admitted_any = True
+        return ok
+
+
+class DeltaSource:
+    def __init__(
+        self,
+        delta_log,
+        max_files_per_trigger: Optional[int] = 1000,
+        max_bytes_per_trigger: Optional[int] = None,
+        ignore_deletes: bool = False,
+        ignore_changes: bool = False,
+        fail_on_data_loss: bool = True,
+        exclude_regex: Optional[str] = None,
+        starting_version: Optional[int] = None,
+        starting_timestamp: Optional[str] = None,
+    ):
+        self.delta_log = delta_log
+        self.max_files = max_files_per_trigger
+        self.max_bytes = max_bytes_per_trigger
+        self.ignore_deletes = ignore_deletes
+        self.ignore_changes = ignore_changes
+        self.fail_on_data_loss = fail_on_data_loss
+        self.exclude = re.compile(exclude_regex) if exclude_regex else None
+        if starting_version is not None and starting_timestamp is not None:
+            raise DeltaAnalysisError(
+                "Cannot set both startingVersion and startingTimestamp"
+            )
+        self.starting_version = starting_version
+        self.starting_timestamp = starting_timestamp
+        snap = delta_log.update()
+        self.table_id = snap.metadata.id or ""
+        self._initial_schema = snap.metadata.schema_string
+
+    # -- file enumeration -------------------------------------------------
+
+    def _resolve_starting_version(self) -> Optional[int]:
+        if self.starting_version is not None:
+            if self.starting_version == "latest":
+                return self.delta_log.update().version + 1
+            return int(self.starting_version)
+        if self.starting_timestamp is not None:
+            ts = self.starting_timestamp
+            if isinstance(ts, str):
+                import datetime as _dt
+
+                ts = int(
+                    _dt.datetime.fromisoformat(ts.replace(" ", "T"))
+                    .replace(tzinfo=_dt.timezone.utc)
+                    .timestamp() * 1000
+                )
+            return self.delta_log.history.get_active_commit_at_time(
+                ts, can_return_last_commit=True, can_return_earliest_commit=True
+            ).version
+        return None
+
+    def _initial_snapshot_files(self, version: int) -> List[IndexedFile]:
+        """Initial table state as a deterministic indexed sequence
+        (`files/DeltaSourceSnapshot.scala`)."""
+        if version < 0:
+            return []
+        snap = self.delta_log.get_snapshot_at(version)
+        files = sorted(
+            snap.all_files, key=lambda f: (f.modification_time or 0, f.path)
+        )
+        out = [
+            IndexedFile(version, i, f)
+            for i, f in enumerate(files)
+            if self.exclude is None or not self.exclude.search(f.path)
+        ]
+        if out:
+            out[-1] = IndexedFile(
+                out[-1].version, out[-1].index, out[-1].add, is_last=True
+            )
+        return out
+
+    def _verify_hygiene(self, version: int, actions: Sequence[Action]) -> None:
+        """`verifyStreamHygieneAndFilterAddFiles` (`DeltaSource.scala:312-355`)."""
+        seen_file_action = False
+        removes = []
+        adds_with_change = []
+        for a in actions:
+            if isinstance(a, Metadata):
+                if a.schema_string != self._initial_schema:
+                    raise DeltaIllegalStateError(
+                        f"Detected schema change at version {version}; streaming "
+                        "sources don't support schema changes — restart the query"
+                    )
+            elif isinstance(a, Protocol):
+                self.delta_log.assert_protocol_read(a)
+            elif isinstance(a, RemoveFile) and a.data_change:
+                removes.append(a)
+            elif isinstance(a, AddFile) and a.data_change:
+                adds_with_change.append(a)
+        if removes and adds_with_change and not self.ignore_changes:
+            raise DeltaIllegalStateError(
+                f"Detected a data update at version {version} (e.g. "
+                f"{removes[0].path}). This is currently not supported — set "
+                "ignoreChanges to re-emit updated files, or restart from a "
+                "fresh checkpoint"
+            )
+        if removes and not adds_with_change and not (
+            self.ignore_deletes or self.ignore_changes
+        ):
+            raise DeltaIllegalStateError(
+                f"Detected deleted data (e.g. {removes[0].path}) at version "
+                f"{version}. This is currently not supported — set ignoreDeletes "
+                "or use a snapshot-only read"
+            )
+
+    def _changes_from(self, version: int, start_index: int) -> Iterator[IndexedFile]:
+        for v, actions in self.delta_log.get_changes(
+            version, fail_on_data_loss=self.fail_on_data_loss
+        ):
+            self._verify_hygiene(v, actions)
+            idx = 0
+            adds = [
+                a for a in actions
+                if isinstance(a, AddFile) and a.data_change
+                and (self.exclude is None or not self.exclude.search(a.path))
+            ]
+            for a in adds:
+                f = IndexedFile(v, idx, a, is_last=(idx == len(adds) - 1))
+                idx += 1
+                if v == version and f.index <= start_index:
+                    continue  # already consumed
+                yield f
+            if not adds:
+                # version sentinel so the offset can advance past data-less
+                # commits
+                yield IndexedFile(v, BASE_INDEX, None, is_last=True)
+
+    # -- offsets ----------------------------------------------------------
+
+    def initial_offset(self) -> DeltaSourceOffset:
+        sv = self._resolve_starting_version()
+        if sv is not None:
+            return DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
+        version = self.delta_log.update().version
+        return DeltaSourceOffset(version, BASE_INDEX, True, self.table_id)
+
+    def latest_offset(self, start: DeltaSourceOffset) -> Optional[DeltaSourceOffset]:
+        """End offset for the next micro-batch under the admission limits;
+        None when no new data."""
+        limits = AdmissionLimits(self.max_files, self.max_bytes)
+        last: Optional[IndexedFile] = None
+        for f in self._pending(start):
+            if not limits.admit(f.add):
+                break
+            last = f
+        if last is None:
+            return None
+        is_starting = start.is_starting_version and last.version == start.reservoir_version
+        return DeltaSourceOffset(last.version, last.index, is_starting, self.table_id)
+
+    def _pending(self, start: DeltaSourceOffset) -> Iterator[IndexedFile]:
+        if start.is_starting_version:
+            for f in self._initial_snapshot_files(start.reservoir_version):
+                if f.index > start.index:
+                    yield f
+            yield from self._changes_from(start.reservoir_version + 1, BASE_INDEX)
+        else:
+            yield from self._changes_from(start.reservoir_version, start.index)
+
+    def get_batch(
+        self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset
+    ) -> pa.Table:
+        """Files in (start, end] decoded to one Arrow table."""
+        from delta_tpu.exec.scan import read_files_as_table
+
+        if start is None:
+            start = self.initial_offset()
+            # initial_offset is exclusive of nothing when starting from a
+            # snapshot: re-anchor to serve the snapshot itself
+            start = DeltaSourceOffset(
+                start.reservoir_version, BASE_INDEX, start.is_starting_version,
+                self.table_id,
+            )
+        files: List[AddFile] = []
+        for f in self._pending(start):
+            if (f.version, f.index) > (end.reservoir_version, end.index):
+                break
+            if f.add is not None:
+                files.append(f.add)
+        snap = self.delta_log.update()
+        return read_files_as_table(
+            self.delta_log.data_path, files, snap.metadata
+        )

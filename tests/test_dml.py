@@ -490,3 +490,40 @@ def test_zorder_with_nulls(tmp_table):
     OptimizeCommand(log, z_order_by=["x", "y"], target_rows=2).run()
     t = scan_to_table(log.update())
     assert t.num_rows == 4
+
+
+def test_optimize_null_partition_values(tmp_table):
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1], "p": [None]}, partition_columns=["p"])
+    write(log, {"id": [2], "p": [None]})
+    write(log, {"id": [3], "p": ["x"]})
+    write(log, {"id": [4], "p": ["x"]})
+    OptimizeCommand(log).run()
+    snap = log.update()
+    assert len(snap.all_files) == 2
+    assert ids(log) == [1, 2, 3, 4]
+
+
+def test_zorder_all_null_column(tmp_table):
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "s": pa.array([None, None], pa.string())})
+    OptimizeCommand(log, z_order_by=["s", "id"], target_rows=1).run()
+    assert ids(log) == [1, 2]
+
+
+def test_merge_int64_float_keys_no_collapse(tmp_table):
+    big = 2**53
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [big, big + 1]})
+    src = pa.table({"id": pa.array([float(big)], pa.float64())})
+    _merge(
+        log, src, "t.id = s.id",
+        matched=[MergeClause("delete")],
+        source_alias="s", target_alias="t",
+    )
+    # only the exactly-equal key may match; big+1 must survive
+    assert ids(log) == [big + 1]

@@ -1,0 +1,218 @@
+"""Streaming source/sink suites.
+
+Behavioral spec: `DeltaSourceSuite` / `DeltaSinkSuite` (SURVEY §4) — initial
+snapshot serving, log tailing, admission control, hygiene checks, offset
+restart, sink exactly-once.
+"""
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.delete import DeleteCommand
+from delta_tpu.commands.update import UpdateCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.exec.scan import scan_to_table
+from delta_tpu.streaming.offset import DeltaSourceOffset
+from delta_tpu.streaming.query import StreamingQuery
+from delta_tpu.streaming.sink import DeltaSink
+from delta_tpu.streaming.source import DeltaSource
+from delta_tpu.utils.errors import DeltaIllegalStateError
+
+
+def write(log, data, mode="append", **kw):
+    return WriteIntoDelta(log, mode, data, **kw).run()
+
+
+def drain(source, start=None):
+    """Pull every pending batch; returns list of id-lists per batch."""
+    out = []
+    cur = start
+    while True:
+        anchor = cur
+        if anchor is None:
+            anchor = source.initial_offset()
+            anchor = DeltaSourceOffset(
+                anchor.reservoir_version, -1, anchor.is_starting_version,
+                anchor.reservoir_id,
+            )
+        end = source.latest_offset(anchor)
+        if end is None:
+            return out, cur
+        t = source.get_batch(cur, end)
+        out.append(sorted(t.column("id").to_pylist()))
+        cur = end
+
+
+def test_source_initial_snapshot_then_tail(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2]})
+    write(log, {"id": [3]})
+    source = DeltaSource(log)
+    batches, cur = drain(source)
+    assert batches == [[1, 2, 3]]  # initial snapshot in one batch
+    # now tail new commits
+    write(log, {"id": [4, 5]})
+    batches, cur = drain(source, cur)
+    assert batches == [[4, 5]]
+    # nothing new -> no batch
+    batches, _ = drain(source, cur)
+    assert batches == []
+
+
+def test_source_max_files_per_trigger(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(4):
+        write(log, {"id": [i]})
+    source = DeltaSource(log, max_files_per_trigger=2)
+    batches, _ = drain(source)
+    assert batches == [[0, 1], [2, 3]]
+
+
+def test_source_max_bytes_always_admits_one(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(3):
+        write(log, {"id": [i]})
+    source = DeltaSource(log, max_files_per_trigger=None, max_bytes_per_trigger=1)
+    batches, _ = drain(source)
+    # 1 byte cap still admits one file per trigger (no stall)
+    assert batches == [[0], [1], [2]]
+
+
+def test_source_starting_version_skips_snapshot(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    write(log, {"id": [2]})
+    write(log, {"id": [3]})
+    source = DeltaSource(log, starting_version=1)
+    batches, _ = drain(source)
+    assert batches == [[2, 3]]
+
+
+def test_source_delete_fails_stream(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2]})
+    source = DeltaSource(log)
+    _, cur = drain(source)
+    DeleteCommand(log, None).run()
+    with pytest.raises(DeltaIllegalStateError):
+        drain(source, cur)
+
+
+def test_source_ignore_deletes(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2]})
+    write(log, {"id": [3]})
+    source = DeltaSource(log, ignore_deletes=True)
+    _, cur = drain(source)
+    DeleteCommand(log, None).run()
+    write(log, {"id": [9]})
+    batches, _ = drain(source, cur)
+    assert batches == [[9]]
+
+
+def test_source_update_requires_ignore_changes(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "v": [1, 1]})
+    source = DeltaSource(log)
+    _, cur = drain(source)
+    UpdateCommand(log, {"v": "2"}, condition="id = 1").run()
+    with pytest.raises(DeltaIllegalStateError):
+        drain(source, cur)
+    # with ignoreChanges the rewritten file is re-emitted
+    source2 = DeltaSource(log, ignore_changes=True)
+    _, cur2 = drain(source2)
+    UpdateCommand(log, {"v": "3"}, condition="id = 1").run()
+    batches, _ = drain(source2, cur2)
+    assert batches == [[1, 2]]  # whole rewritten file re-emitted
+
+
+def test_source_schema_change_fails(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    source = DeltaSource(log)
+    _, cur = drain(source)
+    write(log, {"id": [2], "extra": ["x"]}, merge_schema=True)
+    with pytest.raises(DeltaIllegalStateError):
+        drain(source, cur)
+
+
+def test_offset_json_roundtrip_and_table_id_check():
+    off = DeltaSourceOffset(7, 3, True, "tbl-1")
+    back = DeltaSourceOffset.from_json(off.json(), "tbl-1")
+    assert back == off
+    with pytest.raises(DeltaIllegalStateError):
+        DeltaSourceOffset.from_json(off.json(), "other-table")
+
+
+def test_sink_exactly_once(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    sink = DeltaSink(log, query_id="q1")
+    assert sink.add_batch(0, {"id": [1]}) is True
+    assert sink.add_batch(0, {"id": [1]}) is False  # replay skipped
+    assert sink.add_batch(1, {"id": [2]}) is True
+    assert sorted(scan_to_table(log.update()).column("id").to_pylist()) == [1, 2]
+
+
+def test_sink_complete_mode(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    sink = DeltaSink(log, query_id="q1", output_mode="complete")
+    sink.add_batch(0, {"id": [1, 2]})
+    sink.add_batch(1, {"id": [9]})
+    assert scan_to_table(log.update()).column("id").to_pylist() == [9]
+
+
+def test_query_end_to_end_and_restart(tmp_table, tmp_path):
+    src_log = DeltaLog.for_table(tmp_table)
+    dst_path = str(tmp_path / "dst")
+    ckpt = str(tmp_path / "ckpt")
+    write(src_log, {"id": [1, 2]})
+
+    def run_query():
+        dst_log = DeltaLog.for_table(dst_path)
+        source = DeltaSource(src_log, max_files_per_trigger=1)
+        q = StreamingQuery(source, DeltaSink(dst_log, query_id="qx"), ckpt)
+        return q.process_all_available()
+
+    assert run_query() == 1
+    assert sorted(
+        scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
+    ) == [1, 2]
+    # new upstream commits; a fresh query object resumes from the checkpoint
+    write(src_log, {"id": [3]})
+    write(src_log, {"id": [4]})
+    assert run_query() == 2  # one file per trigger
+    assert sorted(
+        scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
+    ) == [1, 2, 3, 4]
+    # drained: no more batches, no duplicates
+    assert run_query() == 0
+    assert sorted(
+        scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
+    ) == [1, 2, 3, 4]
+
+
+def test_query_recovers_unfinished_batch(tmp_table, tmp_path):
+    import os
+
+    src_log = DeltaLog.for_table(tmp_table)
+    dst_path = str(tmp_path / "dst")
+    ckpt = str(tmp_path / "ckpt")
+    write(src_log, {"id": [1]})
+
+    source = DeltaSource(src_log)
+    dst_log = DeltaLog.for_table(dst_path)
+    q = StreamingQuery(source, DeltaSink(dst_log, query_id="qy"), ckpt)
+    q.process_all_available()
+    # simulate crash after writing the offset but before running batch 1
+    write(src_log, {"id": [2]})
+    end = source.latest_offset(q._read_offset(0))
+    q._write_offset(1, end)
+    # restart: the planned batch must run exactly once
+    q2 = StreamingQuery(
+        DeltaSource(src_log), DeltaSink(dst_log, query_id="qy"), ckpt
+    )
+    ran = q2.process_all_available()
+    assert ran == 1
+    assert sorted(
+        scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
+    ) == [1, 2]

@@ -269,3 +269,26 @@ def test_numeric_partition_with_data_predicate(tmp_table):
           partition_columns=["year"])
     t = scan_to_table(log.update(), ["year = 2021 OR id > 100"])
     assert sorted(t.column("id").to_pylist()) == [3, 4]
+
+
+def test_timestamp_max_stats_round_up(tmp_table):
+    import datetime as dt
+
+    log = DeltaLog.for_table(tmp_table)
+    ts = dt.datetime(2026, 1, 1, 12, 0, 0, 999)  # sub-millisecond max
+    write(log, {"ts": pa.array([ts], pa.timestamp("us"))})
+    f = log.update().all_files[0]
+    st = json.loads(f.stats)
+    # max must round UP to the next ms, min floors
+    assert st["maxValues"]["ts"] == "2026-01-01T12:00:00.001Z"
+    assert st["minValues"]["ts"] == "2026-01-01T12:00:00.000Z"
+
+
+def test_many_partitions_write(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    n = 500
+    write(log, {"id": list(range(n)), "p": [str(i % 50) for i in range(n)]},
+          partition_columns=["p"])
+    snap = log.update()
+    assert len(snap.all_files) == 50
+    assert read_ids(log) == list(range(n))
