@@ -1,0 +1,50 @@
+"""DESCRIBE DETAIL / DESCRIBE HISTORY.
+
+Mirrors `commands/DescribeDeltaDetailsCommand.scala` (one detail row with
+format/id/location/times/partitioning/counts/properties/protocol) and
+`commands/DescribeDeltaHistoryCommand.scala` (CommitInfo rows, newest
+first, via the history manager).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["describe_detail", "describe_history"]
+
+
+def describe_detail(delta_log) -> Dict[str, Any]:
+    snapshot = delta_log.update()
+    meta = snapshot.metadata
+    created = meta.created_time
+    return {
+        "format": "delta",
+        "id": meta.id,
+        "name": meta.name,
+        "description": meta.description,
+        "location": delta_log.data_path,
+        "createdAt": _ts(created),
+        "lastModified": _ts(snapshot.timestamp),
+        "partitionColumns": list(meta.partition_columns),
+        "numFiles": snapshot.num_of_files,
+        "sizeInBytes": snapshot.size_in_bytes,
+        "properties": dict(meta.configuration or {}),
+        "minReaderVersion": snapshot.protocol.min_reader_version,
+        "minWriterVersion": snapshot.protocol.min_writer_version,
+    }
+
+
+def describe_history(delta_log, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    commits = delta_log.history.get_history(limit)
+    out = []
+    for ci in commits:
+        d = ci.to_dict()
+        out.append(d)
+    return out
+
+
+def _ts(ms: Optional[int]):
+    if ms is None:
+        return None
+    return _dt.datetime.fromtimestamp(ms / 1000, _dt.timezone.utc)

@@ -53,13 +53,13 @@ def _coerce_join_keys(t_vals, s_vals):
 
     def float_to_int64(vals):
         f = pc.cast(vals, pa.float64())
-        # any integral float64 in ±2^62 casts to int64 exactly (it IS a
-        # representable integer); non-integral / out-of-range can't equal
-        # any int64 key, so they become NULL (null keys never join)
+        # any integral float64 in [-2^63, 2^63) casts to int64 exactly (it
+        # IS a representable integer); non-integral / out-of-range can't
+        # equal any int64 key, so they become NULL (null keys never join)
         integral = pc.and_(
             pc.equal(pc.floor(f), f),
-            pc.and_(pc.greater_equal(f, pa.scalar(-(2.0**62))),
-                    pc.less_equal(f, pa.scalar(2.0**62))),
+            pc.and_(pc.greater_equal(f, pa.scalar(-(2.0**63))),
+                    pc.less(f, pa.scalar(2.0**63))),
         )
         return pc.cast(
             pc.if_else(pc.fill_null(integral, False), f, pa.scalar(None, pa.float64())),

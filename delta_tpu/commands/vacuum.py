@@ -1,0 +1,176 @@
+"""VACUUM — garbage-collect files no snapshot references.
+
+Mirrors `commands/VacuumCommand.scala:49-347`: build the valid-file set from
+the current state (live files + un-expired tombstones, relativized), list the
+table directory recursively in parallel, and delete unreferenced files whose
+modification time is older than the retention horizon. Retention below the
+tombstone retention (default 168h) is refused unless the safety check is
+disabled (`:54-77`) — deleting younger files breaks readers of older
+snapshots and concurrent writers. Hidden files/dirs (`_`/`.`-prefixed) are
+skipped except partition directories (`=` in the name) and CDC dirs.
+"""
+from __future__ import annotations
+
+import os
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.utils.config import DeltaConfigs
+from delta_tpu.utils.errors import DeltaIllegalArgumentError
+
+__all__ = ["VacuumCommand", "VacuumResult"]
+
+MS_PER_HOUR = 3600 * 1000
+
+
+@dataclass
+class VacuumResult:
+    path: str
+    files_deleted: int
+    dirs_deleted: int
+    dry_run: bool
+    retention_ms: int
+    deleted_paths: List[str] = field(default_factory=list)
+
+
+def _is_hidden(name: str) -> bool:
+    return (name.startswith("_") or name.startswith(".")) and "=" not in name and not (
+        name.startswith("_change_data") or name.startswith("_cdc")
+    )
+
+
+class VacuumCommand:
+    def __init__(
+        self,
+        delta_log,
+        retention_hours: Optional[float] = None,
+        dry_run: bool = False,
+        retention_check_enabled: bool = True,
+        parallelism: int = 8,
+    ):
+        self.delta_log = delta_log
+        self.retention_hours = retention_hours
+        self.dry_run = dry_run
+        self.retention_check_enabled = retention_check_enabled
+        self.parallelism = parallelism
+
+    def run(self) -> VacuumResult:
+        log = self.delta_log
+        snapshot = log.update()
+        metadata = snapshot.metadata
+        tombstone_retention_ms = DeltaConfigs.TOMBSTONE_RETENTION.from_metadata(metadata)
+        if self.retention_hours is None:
+            retention_ms = tombstone_retention_ms
+        else:
+            retention_ms = int(self.retention_hours * MS_PER_HOUR)
+        if self.retention_check_enabled and retention_ms < tombstone_retention_ms:
+            raise DeltaIllegalArgumentError(
+                f"Are you sure you would like to vacuum files with such a low "
+                f"retention period ({self.retention_hours}h)? The table's "
+                f"deletedFileRetentionDuration is "
+                f"{tombstone_retention_ms // MS_PER_HOUR}h. Disable the retention "
+                "duration check to proceed."
+            )
+        cutoff = log.clock() - retention_ms
+
+        # valid set: live files + tombstones younger than THIS vacuum's
+        # horizon (snapshot.tombstones caches against an older clock reading)
+        valid: Set[str] = set()
+        for f in snapshot.all_files:
+            valid.add(urllib.parse.unquote(f.path))
+        for r in snapshot.tombstones_newer_than(cutoff):
+            valid.add(urllib.parse.unquote(r.path))
+
+        data_path = log.data_path
+        all_files: List[str] = []
+        all_dirs: List[str] = []
+
+        def walk(rel_dir: str) -> None:
+            abs_dir = os.path.join(data_path, rel_dir) if rel_dir else data_path
+            try:
+                entries = sorted(os.scandir(abs_dir), key=lambda e: e.name)
+            except FileNotFoundError:
+                return
+            subdirs = []
+            for e in entries:
+                rel = f"{rel_dir}/{e.name}" if rel_dir else e.name
+                if e.is_dir(follow_symlinks=False):
+                    if not _is_hidden(e.name):
+                        subdirs.append(rel)
+                        all_dirs.append(rel)
+                else:
+                    if not _is_hidden(e.name):
+                        all_files.append(rel)
+            for s in subdirs:
+                walk(s)
+
+        # parallel top-level fan-out (the reference lists with a Spark job)
+        top = []
+        try:
+            for e in sorted(os.scandir(data_path), key=lambda x: x.name):
+                if e.is_dir(follow_symlinks=False):
+                    if not _is_hidden(e.name):
+                        top.append(e.name)
+                        all_dirs.append(e.name)
+                elif not _is_hidden(e.name):
+                    all_files.append(e.name)
+        except FileNotFoundError:
+            pass
+        if top:
+            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                list(pool.map(walk, top))
+
+        to_delete: List[str] = []
+        for rel in all_files:
+            if rel in valid:
+                continue
+            abs_p = os.path.join(data_path, rel)
+            try:
+                mtime_ms = int(os.stat(abs_p).st_mtime * 1000)
+            except FileNotFoundError:
+                continue
+            if mtime_ms < cutoff:
+                to_delete.append(rel)
+
+        if self.dry_run:
+            return VacuumResult(
+                path=data_path,
+                files_deleted=len(to_delete),
+                dirs_deleted=0,
+                dry_run=True,
+                retention_ms=retention_ms,
+                deleted_paths=sorted(to_delete),
+            )
+
+        def rm(rel: str) -> None:
+            try:
+                os.remove(os.path.join(data_path, rel))
+            except FileNotFoundError:
+                pass
+
+        if to_delete:
+            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                list(pool.map(rm, to_delete))
+
+        # drop now-empty partition dirs (deepest first)
+        dirs_deleted = 0
+        for rel in sorted(all_dirs, key=lambda d: -d.count("/")):
+            abs_d = os.path.join(data_path, rel)
+            try:
+                if not os.listdir(abs_d):
+                    os.rmdir(abs_d)
+                    dirs_deleted += 1
+            except OSError:
+                pass
+
+        return VacuumResult(
+            path=data_path,
+            files_deleted=len(to_delete),
+            dirs_deleted=dirs_deleted,
+            dry_run=False,
+            retention_ms=retention_ms,
+            deleted_paths=sorted(to_delete),
+        )

@@ -1,0 +1,103 @@
+"""GENERATE symlink_format_manifest — Presto/Athena compatibility manifests.
+
+Mirrors `hooks/GenerateSymlinkManifest.scala:41-374`: writes
+``_symlink_format_manifest/[<partition-path>/]manifest`` files, each listing
+the absolute URIs of the table's current data files for that partition.
+Two modes:
+* **full** (`:165`) — regenerate every partition's manifest, drop manifests
+  of vanished partitions (the GENERATE command);
+* **incremental** (`:80`) — post-commit hook (enabled by table property
+  ``delta.compatibility.symlinkFormatManifest.enabled``) that rewrites only
+  partitions touched by the commit.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.parse
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from delta_tpu.exec.write import partition_path
+from delta_tpu.protocol.actions import Action, AddFile, RemoveFile
+from delta_tpu.utils.config import DeltaConfigs
+
+__all__ = ["MANIFEST_DIR", "generate_full_manifest", "SymlinkManifestHook"]
+
+MANIFEST_DIR = "_symlink_format_manifest"
+
+
+def _partition_dir(pv: Dict[str, Optional[str]], part_cols) -> str:
+    return partition_path(pv or {}, part_cols)
+
+
+def _write_manifest(data_path: str, rel_dir: str, files: Iterable[AddFile]) -> None:
+    out_dir = os.path.join(data_path, MANIFEST_DIR, rel_dir.replace("/", os.sep))
+    os.makedirs(out_dir, exist_ok=True)
+    lines = []
+    for f in sorted(files, key=lambda a: a.path):
+        abs_p = os.path.join(data_path, urllib.parse.unquote(f.path).replace("/", os.sep))
+        lines.append("file:" + urllib.parse.quote(os.path.abspath(abs_p)))
+    with open(os.path.join(out_dir, "manifest"), "w") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+
+
+def generate_full_manifest(delta_log) -> int:
+    """Regenerate all manifests; returns the number written (`:165`)."""
+    snapshot = delta_log.update()
+    part_cols = snapshot.metadata.partition_columns
+    by_dir: Dict[str, List[AddFile]] = defaultdict(list)
+    for f in snapshot.all_files:
+        by_dir[_partition_dir(f.partition_values, part_cols)].append(f)
+
+    manifest_root = os.path.join(delta_log.data_path, MANIFEST_DIR)
+    if os.path.isdir(manifest_root):
+        shutil.rmtree(manifest_root)
+    for rel_dir, files in by_dir.items():
+        _write_manifest(delta_log.data_path, rel_dir, files)
+    return len(by_dir)
+
+
+class SymlinkManifestHook:
+    """Post-commit hook: incremental manifest update (`:80`).
+    Registered automatically by the transaction when the table property
+    ``delta.compatibility.symlinkFormatManifest.enabled`` is set."""
+
+    name = "Generate Symlink Format Manifest"
+
+    def __eq__(self, other) -> bool:  # dedupe in the hook registry
+        return type(other) is type(self)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+    def run(self, txn, committed_version: int, snapshot) -> None:
+        metadata = txn.metadata
+        if not DeltaConfigs.SYMLINK_FORMAT_MANIFEST_ENABLED.from_metadata(metadata):
+            return
+        part_cols = metadata.partition_columns
+        committed_actions: List[Action] = []
+        for v, actions in txn.delta_log.get_changes(committed_version):
+            if v == committed_version:
+                committed_actions = actions
+            break
+        touched: Set[str] = set()
+        for a in committed_actions:
+            if isinstance(a, (AddFile, RemoveFile)):
+                touched.add(_partition_dir(a.partition_values or {}, part_cols))
+        if not touched:
+            return
+        by_dir: Dict[str, List[AddFile]] = defaultdict(list)
+        for f in snapshot.all_files:
+            by_dir[_partition_dir(f.partition_values, part_cols)].append(f)
+        for rel_dir in touched:
+            files = by_dir.get(rel_dir)
+            if files:
+                _write_manifest(txn.delta_log.data_path, rel_dir, files)
+            else:
+                # partition vanished: remove its manifest dir
+                gone = os.path.join(
+                    txn.delta_log.data_path, MANIFEST_DIR, rel_dir.replace("/", os.sep)
+                )
+                if os.path.isdir(gone):
+                    shutil.rmtree(gone, ignore_errors=True)

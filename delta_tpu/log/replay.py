@@ -79,12 +79,13 @@ class LogReplay:
 
     # -- outputs ---------------------------------------------------------
 
-    def get_tombstones(self) -> List[RemoveFile]:
-        """Un-expired tombstones (InMemoryLogReplay.scala:66-69)."""
+    def get_tombstones(self, cutoff_ms: Optional[int] = None) -> List[RemoveFile]:
+        """Un-expired tombstones (InMemoryLogReplay.scala:66-69). Callers with
+        their own retention horizon (VACUUM) pass ``cutoff_ms``."""
+        if cutoff_ms is None:
+            cutoff_ms = self.min_file_retention_timestamp
         return [
-            r
-            for r in self._tombstones.values()
-            if r.delete_timestamp > self.min_file_retention_timestamp
+            r for r in self._tombstones.values() if r.delete_timestamp > cutoff_ms
         ]
 
     def checkpoint_actions(self) -> List[Action]:

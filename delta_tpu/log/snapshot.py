@@ -169,6 +169,11 @@ class Snapshot:
         cutoff = self.min_file_retention_timestamp()
         return [r for r in self._replay.get_tombstones() if r.delete_timestamp > cutoff]
 
+    def tombstones_newer_than(self, cutoff_ms: int) -> List[RemoveFile]:
+        """Un-expired tombstones against a caller-supplied horizon — VACUUM
+        must apply its own retention, not the snapshot's clock-cached one."""
+        return self._replay.get_tombstones(cutoff_ms)
+
     @property
     def num_of_files(self) -> int:
         return len(self.all_files)

@@ -92,12 +92,6 @@ class StreamingQuery:
 
         while True:
             anchor = prev_end if prev_end is not None else self.source.initial_offset()
-            if prev_end is None:
-                # serve the initial snapshot itself: anchor exclusive-before it
-                anchor = DeltaSourceOffset(
-                    anchor.reservoir_version, -1, anchor.is_starting_version,
-                    anchor.reservoir_id,
-                )
             end = self.source.latest_offset(anchor)
             if end is None:
                 return ran

@@ -29,6 +29,9 @@ from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalStateError
 __all__ = ["IndexedFile", "AdmissionLimits", "DeltaSource"]
 
 BASE_INDEX = -1  # offset index meaning "before any file of this version"
+# index marking "this version fully consumed" — used when transitioning from
+# the initial snapshot to the log tail without re-emitting version V's adds
+VERSION_DONE_INDEX = 1 << 30
 
 
 @dataclass(frozen=True)
@@ -185,9 +188,10 @@ class DeltaSource:
                 if v == version and f.index <= start_index:
                     continue  # already consumed
                 yield f
-            if not adds:
+            if not adds and v > version:
                 # version sentinel so the offset can advance past data-less
-                # commits
+                # commits; v == version is already consumed (re-yielding it
+                # would make latest_offset spin forever after e.g. OPTIMIZE)
                 yield IndexedFile(v, BASE_INDEX, None, is_last=True)
 
     # -- offsets ----------------------------------------------------------
@@ -201,14 +205,29 @@ class DeltaSource:
 
     def latest_offset(self, start: DeltaSourceOffset) -> Optional[DeltaSourceOffset]:
         """End offset for the next micro-batch under the admission limits;
-        None when no new data."""
+        None when no new data.
+
+        A batch never crosses the initial-snapshot boundary: while the start
+        offset is still `isStartingVersion`, only snapshot files are
+        admitted, so a crash-recovered `get_batch(None, end)` can always
+        re-anchor deterministically at the snapshot version. Draining the
+        snapshot emits one empty transition batch that flips the offset into
+        tail mode."""
         limits = AdmissionLimits(self.max_files, self.max_bytes)
         last: Optional[IndexedFile] = None
+        tail_has_data = False
         for f in self._pending(start):
+            if start.is_starting_version and f.version != start.reservoir_version:
+                tail_has_data = True
+                break
             if not limits.admit(f.add):
                 break
             last = f
         if last is None:
+            if start.is_starting_version and tail_has_data:
+                return DeltaSourceOffset(
+                    start.reservoir_version, VERSION_DONE_INDEX, False, self.table_id
+                )
             return None
         is_starting = start.is_starting_version and last.version == start.reservoir_version
         return DeltaSourceOffset(last.version, last.index, is_starting, self.table_id)
@@ -225,17 +244,25 @@ class DeltaSource:
     def get_batch(
         self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset
     ) -> pa.Table:
-        """Files in (start, end] decoded to one Arrow table."""
+        """Files in (start, end] decoded to one Arrow table.
+
+        ``start=None`` (batch 0, possibly crash-recovered) anchors on the
+        *planned end offset*, never on the table's current version — a
+        recovered batch must serve exactly what was planned even if the
+        table moved on."""
         from delta_tpu.exec.scan import read_files_as_table
 
         if start is None:
-            start = self.initial_offset()
-            # initial_offset is exclusive of nothing when starting from a
-            # snapshot: re-anchor to serve the snapshot itself
-            start = DeltaSourceOffset(
-                start.reservoir_version, BASE_INDEX, start.is_starting_version,
-                self.table_id,
-            )
+            if end.is_starting_version:
+                start = DeltaSourceOffset(
+                    end.reservoir_version, BASE_INDEX, True, self.table_id
+                )
+            else:
+                sv = self._resolve_starting_version()
+                if sv is not None:
+                    start = DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
+                else:
+                    return self.get_batch(end, end)  # transition batch: empty
         files: List[AddFile] = []
         for f in self._pending(start):
             if (f.version, f.index) > (end.reservoir_version, end.index):

@@ -202,6 +202,11 @@ class OptimisticTransaction:
         with record_operation("delta.commit", path=self.delta_log.data_path):
             actions = self._prepare_commit(list(actions))
 
+            if DeltaConfigs.SYMLINK_FORMAT_MANIFEST_ENABLED.from_metadata(self.metadata):
+                from delta_tpu.hooks.symlink_manifest import SymlinkManifestHook
+
+                self.register_post_commit_hook(SymlinkManifestHook())
+
             # Isolation pick (scala:432-440): data-changing commits use
             # WriteSerializable; rearrange-only commits can use SnapshotIsolation.
             no_data_changed = all(

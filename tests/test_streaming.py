@@ -24,22 +24,17 @@ def write(log, data, mode="append", **kw):
 
 
 def drain(source, start=None):
-    """Pull every pending batch; returns list of id-lists per batch."""
+    """Pull every pending batch; returns list of non-empty id-lists."""
     out = []
     cur = start
     while True:
-        anchor = cur
-        if anchor is None:
-            anchor = source.initial_offset()
-            anchor = DeltaSourceOffset(
-                anchor.reservoir_version, -1, anchor.is_starting_version,
-                anchor.reservoir_id,
-            )
+        anchor = cur if cur is not None else source.initial_offset()
         end = source.latest_offset(anchor)
         if end is None:
             return out, cur
         t = source.get_batch(cur, end)
-        out.append(sorted(t.column("id").to_pylist()))
+        if t.num_rows:
+            out.append(sorted(t.column("id").to_pylist()))
         cur = end
 
 
@@ -180,7 +175,8 @@ def test_query_end_to_end_and_restart(tmp_table, tmp_path):
     # new upstream commits; a fresh query object resumes from the checkpoint
     write(src_log, {"id": [3]})
     write(src_log, {"id": [4]})
-    assert run_query() == 2  # one file per trigger
+    # one empty snapshot→tail transition batch + one file per trigger
+    assert run_query() == 3
     assert sorted(
         scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
     ) == [1, 2, 3, 4]
@@ -212,7 +208,49 @@ def test_query_recovers_unfinished_batch(tmp_table, tmp_path):
         DeltaSource(src_log), DeltaSink(dst_log, query_id="qy"), ckpt
     )
     ran = q2.process_all_available()
-    assert ran == 1
+    assert ran == 2  # recovered transition batch + the data batch
     assert sorted(
         scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
     ) == [1, 2]
+
+
+# -- review regressions -----------------------------------------------------
+
+
+def test_source_rearrange_only_commit_does_not_spin(tmp_table):
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(3):
+        write(log, {"id": [i]})
+    source = DeltaSource(log, ignore_changes=True)
+    _, cur = drain(source)
+    OptimizeCommand(log).run()  # dataChange=False commit
+    # the offset advances past the data-less commit exactly once, then stops
+    end = source.latest_offset(cur)
+    if end is not None:
+        assert source.latest_offset(end) is None
+        assert source.get_batch(cur, end).num_rows == 0
+
+
+def test_query_recovery_of_initial_snapshot_batch(tmp_table, tmp_path):
+    src_log = DeltaLog.for_table(tmp_table)
+    dst_path = str(tmp_path / "dst")
+    ckpt = str(tmp_path / "ckpt")
+    write(src_log, {"id": [1, 2]})
+
+    # plan batch 0 (initial snapshot) but crash before running it
+    source = DeltaSource(src_log)
+    q = StreamingQuery(source, DeltaSink(DeltaLog.for_table(dst_path), query_id="qz"), ckpt)
+    end0 = source.latest_offset(source.initial_offset())
+    q._write_offset(0, end0)
+    # upstream moves on before the restart
+    write(src_log, {"id": [3]})
+    q2 = StreamingQuery(
+        DeltaSource(src_log), DeltaSink(DeltaLog.for_table(dst_path), query_id="qz"), ckpt
+    )
+    q2.process_all_available()
+    got = sorted(
+        scan_to_table(DeltaLog.for_table(dst_path).update()).column("id").to_pylist()
+    )
+    assert got == [1, 2, 3]  # snapshot rows must NOT be lost

@@ -1,0 +1,224 @@
+"""Utility commands: VACUUM, CONVERT TO DELTA, DESCRIBE, GENERATE.
+
+Behavioral spec: `DeltaVacuumSuite` (manual clock + CheckFiles DSL),
+`ConvertToDeltaSuiteBase`, `DescribeDelta*Suite`,
+`DeltaGenerateSymlinkManifestSuite` (SURVEY §4).
+"""
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.convert import ConvertToDeltaCommand
+from delta_tpu.commands.delete import DeleteCommand
+from delta_tpu.commands.describe import describe_detail, describe_history
+from delta_tpu.commands.vacuum import VacuumCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.exec.scan import scan_to_table
+from delta_tpu.hooks.symlink_manifest import MANIFEST_DIR, generate_full_manifest
+from delta_tpu.schema.types import StringType, StructField, StructType
+from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalArgumentError
+
+
+def write(log, data, mode="append", **kw):
+    return WriteIntoDelta(log, mode, data, **kw).run()
+
+
+class ManualClock:
+    """Starts at real now (data file mtimes are real) and advances manually —
+    the reference's ManualClock+set-mtime trick, inverted."""
+
+    def __init__(self, now_ms=None):
+        import time
+
+        self.now = now_ms if now_ms is not None else int(time.time() * 1000)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, ms):
+        self.now += ms
+
+
+HOUR = 3600 * 1000
+
+
+# -- VACUUM -----------------------------------------------------------------
+
+
+def test_vacuum_removes_unreferenced_after_retention(tmp_table):
+    clock = ManualClock()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    write(log, {"id": [1, 2, 3]})
+    removed_path = log.update().all_files[0].path
+    DeleteCommand(log, None).run()
+    write(log, {"id": [9]})
+
+    # too young: nothing deleted
+    res = VacuumCommand(log, retention_hours=200).run()
+    assert res.files_deleted == 0
+    assert os.path.exists(os.path.join(tmp_table, removed_path))
+
+    clock.advance(201 * HOUR)
+    # dry run reports but doesn't delete
+    res = VacuumCommand(log, retention_hours=200, dry_run=True).run()
+    assert res.files_deleted == 1
+    assert os.path.exists(os.path.join(tmp_table, removed_path))
+    res = VacuumCommand(log, retention_hours=200).run()
+    assert res.files_deleted == 1
+    assert not os.path.exists(os.path.join(tmp_table, removed_path))
+    # live data survives
+    assert scan_to_table(log.update()).column("id").to_pylist() == [9]
+
+
+def test_vacuum_retention_check(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    with pytest.raises(DeltaIllegalArgumentError):
+        VacuumCommand(log, retention_hours=0).run()
+    # disabled check allows it
+    VacuumCommand(log, retention_hours=0, retention_check_enabled=False).run()
+
+
+def test_vacuum_untracked_files_and_empty_dirs(tmp_table):
+    clock = ManualClock()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    write(log, {"id": [1, 2], "c": ["a", "b"]}, partition_columns=["c"])
+    # drop an orphan file into a partition dir + an orphan dir
+    orphan = os.path.join(tmp_table, "c=a", "orphan.parquet")
+    with open(orphan, "w") as f:
+        f.write("junk")
+    os.makedirs(os.path.join(tmp_table, "c=zzz"))
+    clock.advance(200 * HOUR)
+    res = VacuumCommand(log, retention_hours=168).run()
+    assert res.files_deleted == 1
+    assert not os.path.exists(orphan)
+    assert not os.path.exists(os.path.join(tmp_table, "c=zzz"))
+    # hidden dirs (incl. _delta_log) untouched
+    assert os.path.isdir(os.path.join(tmp_table, "_delta_log"))
+    assert sorted(scan_to_table(log.update()).column("id").to_pylist()) == [1, 2]
+
+
+def test_vacuum_keeps_tombstoned_files_within_retention(tmp_table):
+    clock = ManualClock()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    write(log, {"id": [1]})
+    kept = log.update().all_files[0].path
+    DeleteCommand(log, None).run()
+    clock.advance(10 * HOUR)  # younger than tombstone retention (168h)
+    res = VacuumCommand(log).run()
+    assert res.files_deleted == 0
+    assert os.path.exists(os.path.join(tmp_table, kept))
+
+
+# -- CONVERT ----------------------------------------------------------------
+
+
+def test_convert_unpartitioned(tmp_table):
+    os.makedirs(tmp_table)
+    pq.write_table(pa.table({"id": [1, 2]}), os.path.join(tmp_table, "a.parquet"))
+    pq.write_table(pa.table({"id": [3]}), os.path.join(tmp_table, "b.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    v = ConvertToDeltaCommand(log).run()
+    assert v == 0
+    t = scan_to_table(log.update())
+    assert sorted(t.column("id").to_pylist()) == [1, 2, 3]
+    # idempotent: converting again is a no-op
+    assert ConvertToDeltaCommand(log).run() == 0
+
+
+def test_convert_partitioned(tmp_table):
+    os.makedirs(os.path.join(tmp_table, "c=x"))
+    os.makedirs(os.path.join(tmp_table, "c=y"))
+    pq.write_table(pa.table({"id": [1]}), os.path.join(tmp_table, "c=x", "a.parquet"))
+    pq.write_table(pa.table({"id": [2]}), os.path.join(tmp_table, "c=y", "b.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    part_schema = StructType([StructField("c", StringType())])
+    ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+    snap = log.update()
+    assert snap.metadata.partition_columns == ["c"]
+    t = scan_to_table(snap, ["c = 'y'"])
+    assert t.column("id").to_pylist() == [2]
+
+
+def test_convert_partitioned_requires_partition_schema(tmp_table):
+    os.makedirs(os.path.join(tmp_table, "c=x"))
+    pq.write_table(pa.table({"id": [1]}), os.path.join(tmp_table, "c=x", "a.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    with pytest.raises(DeltaAnalysisError):
+        ConvertToDeltaCommand(log).run()
+
+
+def test_convert_merges_schemas(tmp_table):
+    os.makedirs(tmp_table)
+    pq.write_table(pa.table({"id": [1]}), os.path.join(tmp_table, "a.parquet"))
+    pq.write_table(
+        pa.table({"id": [2], "v": ["x"]}), os.path.join(tmp_table, "b.parquet")
+    )
+    log = DeltaLog.for_table(tmp_table)
+    ConvertToDeltaCommand(log).run()
+    t = scan_to_table(log.update())
+    assert sorted(t.column("id").to_pylist()) == [1, 2]
+    assert set(t.column_names) == {"id", "v"}
+
+
+# -- DESCRIBE ---------------------------------------------------------------
+
+
+def test_describe_detail(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "c": ["a", "b"]}, partition_columns=["c"],
+          configuration={"delta.appendOnly": "false"})
+    d = describe_detail(log)
+    assert d["format"] == "delta"
+    assert d["partitionColumns"] == ["c"]
+    assert d["numFiles"] == 2
+    assert d["sizeInBytes"] > 0
+    assert d["properties"]["delta.appendOnly"] == "false"
+    assert d["minReaderVersion"] == 1
+
+
+def test_describe_history(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1]})
+    DeleteCommand(log, None).run()
+    hist = describe_history(log)
+    assert len(hist) == 2
+    assert hist[0]["operation"] == "DELETE"  # newest first
+    assert hist[1]["operation"] == "WRITE"
+    assert hist[0]["version"] == 1
+    # operation metrics survive into history
+    assert "numRemovedFiles" in hist[0].get("operationMetrics", {})
+
+
+# -- GENERATE ---------------------------------------------------------------
+
+
+def test_generate_full_manifest(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(log, {"id": [1, 2], "c": ["a", "b"]}, partition_columns=["c"])
+    n = generate_full_manifest(log)
+    assert n == 2
+    mpath = os.path.join(tmp_table, MANIFEST_DIR, "c=a", "manifest")
+    with open(mpath) as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) == 1
+    assert lines[0].startswith("file:")
+    assert "c%3Da" in lines[0] or "c=a" in lines[0]
+
+
+def test_incremental_manifest_hook(tmp_table):
+    log = DeltaLog.for_table(tmp_table)
+    write(
+        log,
+        {"id": [1], "c": ["a"]},
+        partition_columns=["c"],
+        configuration={"delta.compatibility.symlinkFormatManifest.enabled": "true"},
+    )
+    mpath = os.path.join(tmp_table, MANIFEST_DIR, "c=a", "manifest")
+    assert os.path.exists(mpath)
+    # a delete that empties the partition removes its manifest
+    DeleteCommand(log, "c = 'a'").run()
+    assert not os.path.exists(mpath)
