@@ -1,0 +1,258 @@
+"""User-facing table API — the `DeltaTable` / `DeltaMergeBuilder` surface.
+
+Mirrors `python/delta/tables.py` (`DeltaTable :23`, `DeltaMergeBuilder :425`)
+and the Scala `io/delta/tables/DeltaTable.scala:45-547` +
+`DeltaMergeBuilder.scala:123-457`: forPath / isDeltaTable / convertToDelta,
+alias, toArrow (the engine's DataFrame analogue), delete / update /
+updateExpr, the fluent merge builder, vacuum, history, detail, generate,
+upgradeTableProtocol — plus optimize/Z-order, which the reference's format
+supports but its API doesn't ship.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from delta_tpu.commands.convert import ConvertToDeltaCommand
+from delta_tpu.commands.delete import DeleteCommand
+from delta_tpu.commands.describe import describe_detail, describe_history
+from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+from delta_tpu.commands.optimize import OptimizeCommand
+from delta_tpu.commands.update import UpdateCommand
+from delta_tpu.commands.vacuum import VacuumCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.exec.scan import scan_files, scan_to_table
+from delta_tpu.expr import ir
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.protocol.actions import Protocol
+from delta_tpu.schema.types import StructType
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["DeltaTable", "DeltaMergeBuilder", "DeltaOptimizeBuilder"]
+
+
+class DeltaTable:
+    """Programmatic handle on a Delta table (`tables.py:23`)."""
+
+    def __init__(self, delta_log: DeltaLog, alias: Optional[str] = None):
+        self.delta_log = delta_log
+        self._alias = alias
+
+    # -- constructors -----------------------------------------------------
+
+    @classmethod
+    def for_path(cls, path: str, store=None, clock=None) -> "DeltaTable":
+        log = DeltaLog.for_table(path, store=store, clock=clock)
+        if not log.table_exists:
+            raise DeltaAnalysisError(f"{path} is not a Delta table")
+        return cls(log)
+
+    @classmethod
+    def is_delta_table(cls, path: str) -> bool:
+        try:
+            return DeltaLog.for_table(path).table_exists
+        except Exception:
+            return False
+
+    @classmethod
+    def convert_to_delta(cls, path: str,
+                         partition_schema: Optional[StructType] = None) -> "DeltaTable":
+        log = DeltaLog.for_table(path)
+        ConvertToDeltaCommand(log, partition_schema=partition_schema).run()
+        return cls(log)
+
+    @classmethod
+    def create(cls, path: str, schema: StructType,
+               partition_columns: Sequence[str] = (),
+               configuration: Optional[Dict[str, str]] = None) -> "DeltaTable":
+        """CREATE TABLE with an explicit schema and no data
+        (`CreateDeltaTableCommand` for the empty-CTAS case)."""
+        from delta_tpu.expr.vectorized import arrow_type_for
+
+        empty = pa.schema(
+            [pa.field(f.name, arrow_type_for(f.data_type), f.nullable)
+             for f in schema.fields]
+        ).empty_table()
+        log = DeltaLog.for_table(path)
+        WriteIntoDelta(
+            log, "errorifexists", empty,
+            partition_columns=partition_columns, configuration=configuration,
+        ).run()
+        return cls(log)
+
+    # -- reads ------------------------------------------------------------
+
+    def alias(self, name: str) -> "DeltaTable":
+        return DeltaTable(self.delta_log, alias=name)
+
+    def to_arrow(self, filters: Sequence[Union[str, ir.Expression]] = (),
+                 columns: Optional[Sequence[str]] = None,
+                 version: Optional[int] = None,
+                 timestamp: Optional[Union[str, int]] = None) -> pa.Table:
+        """Read the table (optionally time-traveled) as an Arrow table —
+        the engine's `toDF` (`DeltaTable.scala` toDF + time-travel options)."""
+        snap = self._snapshot(version, timestamp)
+        return scan_to_table(snap, filters, columns)
+
+    def _snapshot(self, version: Optional[int] = None,
+                  timestamp: Optional[Union[str, int]] = None):
+        if version is not None and timestamp is not None:
+            raise DeltaAnalysisError("Cannot specify both version and timestamp")
+        if version is not None:
+            return self.delta_log.get_snapshot_at(version)
+        if timestamp is not None:
+            ts = timestamp
+            if isinstance(ts, str):
+                import datetime as _dt
+
+                ts = int(
+                    _dt.datetime.fromisoformat(ts.replace(" ", "T"))
+                    .replace(tzinfo=_dt.timezone.utc).timestamp() * 1000
+                )
+            commit = self.delta_log.history.get_active_commit_at_time(
+                ts, can_return_last_commit=True
+            )
+            return self.delta_log.get_snapshot_at(commit.version)
+        return self.delta_log.update()
+
+    @property
+    def version(self) -> int:
+        return self.delta_log.update().version
+
+    def schema(self) -> StructType:
+        return self.delta_log.update().metadata.schema
+
+    # -- writes -----------------------------------------------------------
+
+    def write(self, data: Any, mode: str = "append", **options) -> int:
+        return WriteIntoDelta(self.delta_log, mode, data, **options).run()
+
+    def delete(self, condition: Optional[Union[str, ir.Expression]] = None) -> Dict[str, int]:
+        cmd = DeleteCommand(self.delta_log, condition)
+        cmd.run()
+        return cmd.metrics
+
+    def update(self, set: Dict[str, Union[str, ir.Expression]],
+               condition: Optional[Union[str, ir.Expression]] = None) -> Dict[str, int]:
+        cmd = UpdateCommand(self.delta_log, set, condition)
+        cmd.run()
+        return cmd.metrics
+
+    # updateExpr is the same entry point here: expressions are SQL strings
+    update_expr = update
+
+    def merge(self, source: Any, condition: Union[str, ir.Expression],
+              source_alias: Optional[str] = None) -> "DeltaMergeBuilder":
+        return DeltaMergeBuilder(
+            self, source, condition,
+            source_alias=source_alias, target_alias=self._alias,
+        )
+
+    # -- utilities --------------------------------------------------------
+
+    def vacuum(self, retention_hours: Optional[float] = None,
+               dry_run: bool = False, retention_check_enabled: bool = True):
+        return VacuumCommand(
+            self.delta_log, retention_hours, dry_run=dry_run,
+            retention_check_enabled=retention_check_enabled,
+        ).run()
+
+    def history(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        return describe_history(self.delta_log, limit)
+
+    def detail(self) -> Dict[str, Any]:
+        return describe_detail(self.delta_log)
+
+    def generate(self, mode: str = "symlink_format_manifest") -> None:
+        if mode != "symlink_format_manifest":
+            raise DeltaAnalysisError(
+                f"Specified mode {mode!r} is not supported; only "
+                "'symlink_format_manifest' is"
+            )
+        from delta_tpu.hooks.symlink_manifest import generate_full_manifest
+
+        generate_full_manifest(self.delta_log)
+
+    def optimize(self, predicate: Optional[str] = None) -> "DeltaOptimizeBuilder":
+        return DeltaOptimizeBuilder(self, predicate)
+
+    def upgrade_table_protocol(self, reader_version: int, writer_version: int) -> None:
+        self.delta_log.upgrade_protocol(
+            Protocol(min_reader_version=reader_version, min_writer_version=writer_version)
+        )
+
+
+class DeltaMergeBuilder:
+    """Fluent MERGE builder (`DeltaMergeBuilder.scala:123-457`). Clause order
+    is execution order, as in the reference."""
+
+    def __init__(self, target: DeltaTable, source: Any, condition,
+                 source_alias: Optional[str] = None,
+                 target_alias: Optional[str] = None):
+        self._target = target
+        self._source = source
+        self._condition = condition
+        self._source_alias = source_alias
+        self._target_alias = target_alias
+        self._matched: List[MergeClause] = []
+        self._not_matched: List[MergeClause] = []
+
+    def when_matched_update(self, set: Dict[str, Any],
+                            condition: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._matched.append(MergeClause("update", condition, dict(set)))
+        return self
+
+    def when_matched_update_all(self, condition: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._matched.append(MergeClause("update", condition, None))
+        return self
+
+    def when_matched_delete(self, condition: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._matched.append(MergeClause("delete", condition))
+        return self
+
+    def when_not_matched_insert(self, values: Dict[str, Any],
+                                condition: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._not_matched.append(MergeClause("insert", condition, dict(values)))
+        return self
+
+    def when_not_matched_insert_all(self, condition: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._not_matched.append(MergeClause("insert", condition, None))
+        return self
+
+    def execute(self) -> Dict[str, int]:
+        cmd = MergeIntoCommand(
+            self._target.delta_log,
+            self._source,
+            self._condition,
+            self._matched,
+            self._not_matched,
+            source_alias=self._source_alias,
+            target_alias=self._target_alias,
+        )
+        cmd.run()
+        return cmd.metrics
+
+
+class DeltaOptimizeBuilder:
+    """`table.optimize(predicate).execute_compaction() / execute_z_order_by()`."""
+
+    def __init__(self, target: DeltaTable, predicate: Optional[str] = None):
+        self._target = target
+        self._predicate = predicate
+
+    def execute_compaction(self, target_rows: Optional[int] = None) -> Dict[str, int]:
+        kwargs = {"target_rows": target_rows} if target_rows else {}
+        cmd = OptimizeCommand(self._target.delta_log, self._predicate, **kwargs)
+        cmd.run()
+        return cmd.metrics
+
+    def execute_z_order_by(self, *columns: str,
+                           target_rows: Optional[int] = None) -> Dict[str, int]:
+        kwargs = {"target_rows": target_rows} if target_rows else {}
+        cmd = OptimizeCommand(
+            self._target.delta_log, self._predicate,
+            z_order_by=list(columns), **kwargs,
+        )
+        cmd.run()
+        return cmd.metrics

@@ -1,0 +1,178 @@
+"""ALTER TABLE commands — properties, columns, constraints.
+
+Mirrors `commands/alterDeltaTableCommands.scala:68-578`: SET/UNSET
+TBLPROPERTIES, ADD COLUMNS, CHANGE COLUMN (comment/nullability/type per the
+`can_change_data_type` rules), ADD/DROP CONSTRAINT. Each is one metadata-only
+transaction.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.expr.vectorized import boolean_mask
+from delta_tpu.schema import schema_utils
+from delta_tpu.schema.constraints import CONSTRAINT_PROP_PREFIX
+from delta_tpu.schema.types import StructField, StructType
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = [
+    "set_table_properties",
+    "unset_table_properties",
+    "add_columns",
+    "change_column",
+    "add_constraint",
+    "drop_constraint",
+]
+
+
+def set_table_properties(delta_log, properties: Dict[str, str]) -> int:
+    def body(txn):
+        meta = txn.metadata
+        cfg = dict(meta.configuration or {})
+        cfg.update({k: str(v) for k, v in properties.items()})
+        txn.update_metadata(replace(meta, configuration=cfg))
+        return txn.commit([], ops.SetTableProperties(properties))
+
+    return delta_log.with_new_transaction(body)
+
+
+def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = False) -> int:
+    def body(txn):
+        meta = txn.metadata
+        cfg = dict(meta.configuration or {})
+        norm = {k.lower(): k for k in cfg}
+        for k in keys:
+            actual = norm.get(k.lower())
+            if actual is None:
+                if not if_exists:
+                    raise DeltaAnalysisError(
+                        f"Attempted to unset non-existent property {k!r}"
+                    )
+                continue
+            del cfg[actual]
+        txn.update_metadata(replace(meta, configuration=cfg))
+        return txn.commit([], ops.UnsetTableProperties(list(keys), if_exists))
+
+    return delta_log.with_new_transaction(body)
+
+
+def add_columns(delta_log, new_fields: Sequence[StructField]) -> int:
+    """ADD COLUMNS — appended at the end (`:163`); new columns must be
+    nullable (existing files have no values for them)."""
+
+    def body(txn):
+        meta = txn.metadata
+        schema = meta.schema
+        for f in new_fields:
+            if not f.nullable:
+                raise DeltaAnalysisError(
+                    f"ADD COLUMNS requires nullable columns, {f.name} is NOT NULL"
+                )
+            if f.name in schema:
+                raise DeltaAnalysisError(f"Column {f.name} already exists")
+            schema = schema_utils.add_column(schema, f)
+        txn.update_metadata(replace(meta, schema_string=schema.to_json()))
+        op = ops.AddColumns(
+            [{"column": f.json_value()} for f in new_fields]
+        )
+        return txn.commit([], op)
+
+    return delta_log.with_new_transaction(body)
+
+
+def change_column(
+    delta_log,
+    name: str,
+    new_type=None,
+    nullable: Optional[bool] = None,
+    comment: Optional[str] = None,
+) -> int:
+    """CHANGE COLUMN (`:251`): widen type (int→long etc.), relax nullability
+    (never tighten — existing data may violate it), set a comment."""
+
+    def body(txn):
+        meta = txn.metadata
+        schema = meta.schema
+        field = schema_utils.find_field(schema, name)
+        if field is None:
+            raise DeltaAnalysisError(f"Column {name!r} not found")
+        new_field = field
+        if new_type is not None and new_type != field.data_type:
+            if not schema_utils.can_change_data_type(field.data_type, new_type):
+                raise DeltaAnalysisError(
+                    f"Cannot change column {name} from "
+                    f"{field.data_type.simple_string()} to {new_type.simple_string()}"
+                )
+            new_field = replace(new_field, data_type=new_type)
+        if nullable is not None:
+            if not nullable and field.nullable:
+                raise DeltaAnalysisError(
+                    f"Cannot change nullable column {name} to NOT NULL"
+                )
+            new_field = replace(new_field, nullable=nullable)
+        if comment is not None:
+            md = dict(new_field.metadata or {})
+            md["comment"] = comment
+            new_field = replace(new_field, metadata=md)
+        fields = [
+            new_field if f.name.lower() == field.name.lower() else f
+            for f in schema.fields
+        ]
+        txn.update_metadata(replace(meta, schema_string=StructType(fields).to_json()))
+        op = ops.ChangeColumn(name, new_field.json_value())
+        return txn.commit([], op)
+
+    return delta_log.with_new_transaction(body)
+
+
+def add_constraint(delta_log, name: str, expr_sql: str) -> int:
+    """ADD CONSTRAINT (`:519`): validates existing rows satisfy the check
+    before committing, like the reference (which runs a full scan)."""
+    import pyarrow.compute as pc
+
+    from delta_tpu.exec.scan import scan_to_table
+
+    key = CONSTRAINT_PROP_PREFIX + name.lower()
+
+    def body(txn):
+        meta = txn.metadata
+        cfg = dict(meta.configuration or {})
+        if any(k.lower() == key for k in cfg):
+            raise DeltaAnalysisError(f"Constraint '{name}' already exists")
+        expr = parse_predicate(expr_sql)
+        existing = scan_to_table(txn.snapshot)
+        if existing.num_rows:
+            ok = boolean_mask(expr, existing)
+            bad = (pc.sum(pc.invert(ok)).as_py() or 0)
+            if bad:
+                raise DeltaAnalysisError(
+                    f"{bad} rows in the table violate the new CHECK constraint "
+                    f"{expr_sql!r}"
+                )
+        txn.read_whole_table()
+        cfg[key] = expr_sql
+        txn.update_metadata(replace(meta, configuration=cfg))
+        return txn.commit([], ops.AddConstraint(name, expr_sql))
+
+    return delta_log.with_new_transaction(body)
+
+
+def drop_constraint(delta_log, name: str, if_exists: bool = True) -> int:
+    key = CONSTRAINT_PROP_PREFIX + name.lower()
+
+    def body(txn):
+        meta = txn.metadata
+        cfg = dict(meta.configuration or {})
+        actual = next((k for k in cfg if k.lower() == key), None)
+        if actual is None:
+            if if_exists:
+                return txn.commit([], ops.DropConstraint(name, None))
+            raise DeltaAnalysisError(f"Constraint '{name}' does not exist")
+        expr = cfg.pop(actual)
+        txn.update_metadata(replace(meta, configuration=cfg))
+        return txn.commit([], ops.DropConstraint(name, expr))
+
+    return delta_log.with_new_transaction(body)

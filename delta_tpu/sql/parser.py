@@ -1,0 +1,180 @@
+"""SQL front end for the Delta utility statements.
+
+Scope matches the reference grammar (`antlr4/.../DeltaSqlBase.g4:74-81`):
+VACUUM, DESCRIBE HISTORY | DETAIL, GENERATE, CONVERT TO DELTA — plus
+DELETE FROM / UPDATE, which the reference delegates to Spark SQL but a
+standalone engine must parse itself. Table references are
+``delta.`/path``` or a bare quoted path, like the reference's path-based
+identifiers (`DeltaTableIdentifier.scala`).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.schema.types import StructField, StructType
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["execute_sql"]
+
+_WS = r"\s+"
+
+
+def _table_path(token: str) -> str:
+    token = token.strip()
+    m = re.fullmatch(r"(?:delta\s*\.\s*)?`([^`]+)`", token, re.IGNORECASE)
+    if m:
+        return m.group(1)
+    m = re.fullmatch(r"(?:parquet\s*\.\s*)?`([^`]+)`", token, re.IGNORECASE)
+    if m:
+        return m.group(1)
+    m = re.fullmatch(r"'([^']+)'|\"([^\"]+)\"", token)
+    if m:
+        return m.group(1) or m.group(2)
+    return token
+
+
+def _parse_type(s: str):
+    from delta_tpu.schema.types import (
+        BooleanType, DateType, DoubleType, FloatType, IntegerType, LongType,
+        StringType, TimestampType,
+    )
+
+    t = s.strip().lower()
+    return {
+        "int": IntegerType(), "integer": IntegerType(), "bigint": LongType(),
+        "long": LongType(), "string": StringType(), "double": DoubleType(),
+        "float": FloatType(), "boolean": BooleanType(), "date": DateType(),
+        "timestamp": TimestampType(),
+    }.get(t) or _fail(f"Unsupported type in PARTITIONED BY: {s!r}")
+
+
+def _fail(msg: str):
+    raise DeltaAnalysisError(msg)
+
+
+def execute_sql(sql: str) -> Any:
+    """Parse and run one Delta statement; returns the command's result."""
+    stmt = sql.strip().rstrip(";").strip()
+
+    m = re.fullmatch(
+        r"VACUUM\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
+        r"(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS?)?"
+        r"(?:\s+(?P<dry>DRY\s+RUN))?",
+        stmt, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.vacuum import VacuumCommand
+
+        log = DeltaLog.for_table(_table_path(m.group("tbl")))
+        hours = float(m.group("hours")) if m.group("hours") else None
+        return VacuumCommand(log, hours, dry_run=bool(m.group("dry"))).run()
+
+    m = re.fullmatch(
+        r"DESCRIBE\s+HISTORY\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
+        r"(?:\s+LIMIT\s+(?P<limit>\d+))?",
+        stmt, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.describe import describe_history
+
+        log = DeltaLog.for_table(_table_path(m.group("tbl")))
+        limit = int(m.group("limit")) if m.group("limit") else None
+        return describe_history(log, limit)
+
+    m = re.fullmatch(
+        r"DESCRIBE\s+DETAIL\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)",
+        stmt, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.describe import describe_detail
+
+        return describe_detail(DeltaLog.for_table(_table_path(m.group("tbl"))))
+
+    m = re.fullmatch(
+        r"GENERATE\s+(?P<mode>\w+)\s+FOR\s+TABLE\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)",
+        stmt, re.IGNORECASE,
+    )
+    if m:
+        mode = m.group("mode").lower()
+        if mode != "symlink_format_manifest":
+            _fail(f"Unsupported GENERATE mode: {mode}")
+        from delta_tpu.hooks.symlink_manifest import generate_full_manifest
+
+        return generate_full_manifest(DeltaLog.for_table(_table_path(m.group("tbl"))))
+
+    m = re.fullmatch(
+        r"CONVERT\s+TO\s+DELTA\s+(?P<tbl>parquet\s*\.\s*`[^`]+`|\S+)"
+        r"(?:\s+PARTITIONED\s+BY\s*\((?P<parts>[^)]*)\))?",
+        stmt, re.IGNORECASE,
+    )
+    if m:
+        from delta_tpu.commands.convert import ConvertToDeltaCommand
+
+        part_schema = None
+        if m.group("parts"):
+            fields = []
+            for spec in m.group("parts").split(","):
+                bits = spec.strip().split()
+                if len(bits) != 2:
+                    _fail(f"Bad PARTITIONED BY column spec: {spec.strip()!r}")
+                fields.append(StructField(bits[0], _parse_type(bits[1])))
+            part_schema = StructType(fields)
+        log = DeltaLog.for_table(_table_path(m.group("tbl")))
+        return ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+
+    m = re.fullmatch(
+        r"DELETE\s+FROM\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
+        r"(?:\s+WHERE\s+(?P<cond>.+))?",
+        stmt, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        from delta_tpu.commands.delete import DeleteCommand
+
+        log = DeltaLog.for_table(_table_path(m.group("tbl")))
+        cmd = DeleteCommand(log, m.group("cond"))
+        cmd.run()
+        return cmd.metrics
+
+    m = re.fullmatch(
+        r"UPDATE\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
+        r"\s+SET\s+(?P<sets>.+?)(?:\s+WHERE\s+(?P<cond>.+))?",
+        stmt, re.IGNORECASE | re.DOTALL,
+    )
+    if m:
+        from delta_tpu.commands.update import UpdateCommand
+
+        sets: Dict[str, str] = {}
+        for part in _split_top_level(m.group("sets")):
+            col, _, expr = part.partition("=")
+            if not expr:
+                _fail(f"Bad SET clause: {part!r}")
+            sets[col.strip().strip("`")] = expr.strip()
+        log = DeltaLog.for_table(_table_path(m.group("tbl")))
+        cmd = UpdateCommand(log, sets, m.group("cond"))
+        cmd.run()
+        return cmd.metrics
+
+    _fail(f"Unsupported SQL statement: {stmt[:80]!r}")
+
+
+def _split_top_level(s: str) -> List[str]:
+    """Split on commas not inside parens/quotes."""
+    out, depth, start, in_str = [], 0, 0, None
+    for i, ch in enumerate(s):
+        if in_str:
+            if ch == in_str:
+                in_str = None
+            continue
+        if ch in "'\"":
+            in_str = ch
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    out.append(s[start:])
+    return [p for p in (x.strip() for x in out) if p]

@@ -1,0 +1,194 @@
+"""User API + ALTER + SQL frontend suites.
+
+Behavioral spec: `python/delta/tests/test_deltatable.py`, `test_sql.py`,
+`DeltaAlterTableTests` (SURVEY §4).
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands import alter
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.schema.types import (
+    IntegerType,
+    LongType,
+    StringType,
+    StructField,
+    StructType,
+)
+from delta_tpu.sql.parser import execute_sql
+from delta_tpu.utils.errors import DeltaAnalysisError, InvariantViolationError
+
+
+def make_table(path, data=None):
+    t = DeltaTable.create(
+        path, StructType().add("id", LongType()).add("v", LongType())
+    )
+    if data:
+        t.write(data)
+    return t
+
+
+def test_for_path_and_is_delta_table(tmp_table):
+    with pytest.raises(DeltaAnalysisError):
+        DeltaTable.for_path(tmp_table)
+    assert DeltaTable.is_delta_table(tmp_table) is False
+    make_table(tmp_table)
+    t = DeltaTable.for_path(tmp_table)
+    assert DeltaTable.is_delta_table(tmp_table) is True
+    assert t.version == 0
+
+
+def test_create_write_read_roundtrip(tmp_table):
+    t = make_table(tmp_table, {"id": [1, 2], "v": [10, 20]})
+    out = t.to_arrow(filters=["v > 15"])
+    assert out.column("id").to_pylist() == [2]
+    assert [f.name for f in t.schema().fields] == ["id", "v"]
+
+
+def test_delete_update_via_api(tmp_table):
+    t = make_table(tmp_table, {"id": [1, 2, 3], "v": [1, 2, 3]})
+    t.update({"v": "v * 10"}, condition="id = 2")
+    m = t.delete("id = 3")
+    assert m["numDeletedRows"] == 1
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got == [{"id": 1, "v": 1}, {"id": 2, "v": 20}]
+
+
+def test_merge_builder_fluent(tmp_table):
+    t = make_table(tmp_table, {"id": [1, 2], "v": [1, 2]}).alias("t")
+    metrics = (
+        t.merge({"id": [2, 3], "v": [20, 30]}, "t.id = s.id", source_alias="s")
+        .when_matched_update(set={"v": "s.v"})
+        .when_not_matched_insert_all()
+        .execute()
+    )
+    assert metrics["numTargetRowsUpdated"] == 1
+    assert metrics["numTargetRowsInserted"] == 1
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got == [{"id": 1, "v": 1}, {"id": 2, "v": 20}, {"id": 3, "v": 30}]
+
+
+def test_time_travel_via_api(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [1]})
+    t.write({"id": [2], "v": [2]})
+    assert len(t.to_arrow(version=1)) == 1  # create(v0) + first write(v1)? no:
+    # v0 = create (empty), v1 = first write, v2 = second write
+    assert sorted(t.to_arrow(version=2).column("id").to_pylist()) == [1, 2]
+    assert t.to_arrow(version=0).num_rows == 0
+
+
+def test_optimize_builder(tmp_table):
+    t = make_table(tmp_table)
+    for i in range(3):
+        t.write({"id": [i], "v": [i]})
+    m = t.optimize().execute_compaction()
+    assert m["numRemovedFiles"] == 3
+    assert m["numAddedFiles"] == 1
+
+
+def test_upgrade_protocol(tmp_table):
+    t = make_table(tmp_table)
+    t.upgrade_table_protocol(1, 3)
+    snap = t.delta_log.update()
+    assert snap.protocol.min_writer_version == 3
+
+
+# -- ALTER ------------------------------------------------------------------
+
+
+def test_alter_properties(tmp_table):
+    t = make_table(tmp_table)
+    alter.set_table_properties(t.delta_log, {"delta.appendOnly": "true"})
+    assert t.detail()["properties"]["delta.appendOnly"] == "true"
+    with pytest.raises(DeltaAnalysisError):
+        alter.unset_table_properties(t.delta_log, ["nope"])
+    alter.unset_table_properties(t.delta_log, ["nope"], if_exists=True)
+    alter.unset_table_properties(t.delta_log, ["delta.appendOnly"])
+    assert "delta.appendOnly" not in t.detail()["properties"]
+
+
+def test_alter_append_only_enforced(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [1]})
+    alter.set_table_properties(t.delta_log, {"delta.appendOnly": "true"})
+    with pytest.raises(Exception):
+        t.delete("id = 1")
+    t.write({"id": [2], "v": [2]})  # appends still fine
+
+
+def test_alter_add_columns(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [1]})
+    alter.add_columns(t.delta_log, [StructField("extra", StringType())])
+    assert [f.name for f in t.schema().fields] == ["id", "v", "extra"]
+    assert t.to_arrow().column("extra").to_pylist() == [None]
+    with pytest.raises(DeltaAnalysisError):
+        alter.add_columns(t.delta_log, [StructField("id", StringType())])
+    with pytest.raises(DeltaAnalysisError):
+        alter.add_columns(
+            t.delta_log, [StructField("x", StringType(), nullable=False)]
+        )
+
+
+def test_alter_change_column_widen(tmp_table):
+    path = tmp_table
+    t = DeltaTable.create(path, StructType().add("id", IntegerType()))
+    t.write({"id": pa.array([1], pa.int32())})
+    alter.change_column(t.delta_log, "id", new_type=LongType())
+    assert t.schema()["id"].data_type == LongType()
+    # narrowing refused
+    with pytest.raises(DeltaAnalysisError):
+        alter.change_column(t.delta_log, "id", new_type=IntegerType())
+    t.write({"id": [2**40]})
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2**40]
+
+
+def test_alter_constraints(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [5]})
+    with pytest.raises(DeltaAnalysisError):
+        alter.add_constraint(t.delta_log, "vbig", "v > 10")  # existing row violates
+    alter.add_constraint(t.delta_log, "vpos", "v > 0")
+    with pytest.raises(InvariantViolationError):
+        t.write({"id": [9], "v": [-1]})
+    with pytest.raises(DeltaAnalysisError):
+        alter.add_constraint(t.delta_log, "vpos", "v > 1")  # duplicate name
+    alter.drop_constraint(t.delta_log, "vpos")
+    t.write({"id": [9], "v": [-1]})  # allowed again
+
+
+# -- SQL --------------------------------------------------------------------
+
+
+def test_sql_describe_and_vacuum(tmp_table):
+    make_table(tmp_table, {"id": [1], "v": [1]})
+    hist = execute_sql(f"DESCRIBE HISTORY delta.`{tmp_table}`")
+    assert [h["operation"] for h in hist] == ["WRITE", "CREATE TABLE"] or len(hist) == 2
+    detail = execute_sql(f"DESCRIBE DETAIL delta.`{tmp_table}`")
+    assert detail["numFiles"] == 1
+    res = execute_sql(f"VACUUM delta.`{tmp_table}` RETAIN 200 HOURS DRY RUN")
+    assert res.dry_run is True
+
+
+def test_sql_delete_update(tmp_table):
+    t = make_table(tmp_table, {"id": [1, 2, 3], "v": [1, 2, 3]})
+    execute_sql(f"UPDATE delta.`{tmp_table}` SET v = v + 100 WHERE id >= 2")
+    m = execute_sql(f"DELETE FROM delta.`{tmp_table}` WHERE v > 101")
+    assert m["numDeletedRows"] == 1
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got == [{"id": 1, "v": 1}, {"id": 2, "v": 102}]
+
+
+def test_sql_convert_and_generate(tmp_table):
+    import pyarrow.parquet as pq
+
+    os.makedirs(tmp_table)
+    pq.write_table(pa.table({"id": [1, 2]}), os.path.join(tmp_table, "x.parquet"))
+    execute_sql(f"CONVERT TO DELTA parquet.`{tmp_table}`")
+    assert DeltaTable.is_delta_table(tmp_table)
+    execute_sql(f"GENERATE symlink_format_manifest FOR TABLE delta.`{tmp_table}`")
+    assert os.path.exists(
+        os.path.join(tmp_table, "_symlink_format_manifest", "manifest")
+    )
+    with pytest.raises(DeltaAnalysisError):
+        execute_sql("FROBNICATE TABLE x")
