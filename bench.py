@@ -23,7 +23,7 @@ def build_stream(n_versions=10_000, actions_per_commit=20, n_paths=50_000):
     path_id = rng.randint(0, n_paths, size=n_versions * actions_per_commit).astype(np.int32)
     version = np.repeat(np.arange(n_versions, dtype=np.int64), actions_per_commit)
     pos = np.tile(np.arange(actions_per_commit, dtype=np.int64), n_versions)
-    seq = (version << 20) | pos
+    seq = (version << 31) | pos
     is_add = rng.rand(len(path_id)) < 0.85
     size = rng.randint(1, 1 << 24, size=len(path_id)).astype(np.int64)
     del_ts = np.where(is_add, 0, version * 1000).astype(np.int64)

@@ -94,7 +94,19 @@ def read_files_as_table(
         # column order = requested order
         t = t.select([c for c in out_names if c in t.column_names])
         pieces.append(t)
-    return pa.concat_tables(pieces, promote_options="permissive") if pieces else empty
+    if not pieces:
+        return empty
+    result = pa.concat_tables(pieces, promote_options="permissive")
+    # Cast columns up to the declared table type: files written before an
+    # ALTER ... CHANGE COLUMN widen carry the old narrower type, and concat
+    # only promotes across pieces, not up to the table schema.
+    declared = {f.name: arrow_type_for(f.data_type) for f in schema.fields}
+    for i, name in enumerate(result.column_names):
+        want = declared.get(name)
+        col = result.column(i)
+        if want is not None and col.type != want:
+            result = result.set_column(i, pa.field(name, want, True), col.cast(want))
+    return result
 
 
 def scan_files(snapshot, filters: Sequence[Union[str, ir.Expression]] = ()) -> pruning.DeltaScan:

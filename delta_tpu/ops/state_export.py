@@ -272,8 +272,19 @@ def actions_to_arrays(versioned_actions: Sequence[Tuple[int, Sequence[Action]]])
                 code = mapping[a.path] = len(dictionary)
                 dictionary.append(a.path)
             path_id.append(code)
-            # position fits in 20 bits per commit (1M actions); version in 43
-            seq.append((version << 20) | min(pos, (1 << 20) - 1))
+            # 31 bits of intra-commit position (2B actions/commit), 32 of
+            # version; overflow raises rather than silently sharing a seq
+            # (ties would make the replay sort's last-writer-wins arbitrary)
+            if pos >= 1 << 31:
+                raise ValueError(
+                    f"commit {version} has {pos + 1}+ file actions; "
+                    "more than 2^31 per commit is unsupported"
+                )
+            if version >= 1 << 32:
+                raise ValueError(
+                    f"version {version} exceeds 2^32; seq encoding unsupported"
+                )
+            seq.append((version << 31) | pos)
             is_add.append(add)
             size.append(sz)
             del_ts.append(dts)

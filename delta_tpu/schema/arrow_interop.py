@@ -28,7 +28,7 @@ from delta_tpu.schema.types import (
     StructType,
     TimestampType,
 )
-from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
 
 __all__ = ["delta_type_from_arrow", "schema_from_arrow"]
 
@@ -42,7 +42,14 @@ def delta_type_from_arrow(t: pa.DataType) -> DataType:
         return ShortType()
     if pa.types.is_int32(t) or pa.types.is_uint8(t) or pa.types.is_uint16(t):
         return IntegerType()
-    if pa.types.is_int64(t) or pa.types.is_uint32(t) or pa.types.is_uint64(t):
+    if pa.types.is_uint64(t):
+        # uint64 values >= 2^63 cannot round-trip through LongType; reject
+        # here rather than fail with a confusing cast error at write time
+        raise SchemaMismatchError(
+            "uint64 columns are not supported (Delta long is signed 64-bit); "
+            "cast to int64 or decimal first"
+        )
+    if pa.types.is_int64(t) or pa.types.is_uint32(t):
         return LongType()
     if pa.types.is_float32(t) or pa.types.is_float16(t):
         return FloatType()

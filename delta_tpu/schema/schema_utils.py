@@ -22,6 +22,8 @@ from delta_tpu.schema.types import (
     AtomicType,
     ByteType,
     DataType,
+    DoubleType,
+    FloatType,
     IntegerType,
     LongType,
     MapType,
@@ -111,6 +113,7 @@ _WIDENING: List[Tuple[type, type]] = [
     (ShortType, IntegerType),
     (ShortType, LongType),
     (IntegerType, LongType),
+    (FloatType, DoubleType),
 ]
 
 
@@ -299,10 +302,21 @@ def drop_column(schema: StructType, name: str) -> StructType:
 
 
 def can_change_data_type(from_t: DataType, to_t: DataType) -> bool:
-    """ALTER CHANGE COLUMN type changes (``canChangeDataType :694``): only
-    NullType→anything, or nested containers whose element change is legal.
-    (Comment/nullability-loosening changes are handled by the caller.)"""
+    """ALTER CHANGE COLUMN type changes: NullType→anything, value-preserving
+    numeric widening, or nested containers whose element change is legal.
+    (Comment/nullability-loosening changes are handled by the caller.)
+
+    Deliberate divergence from the reference (``SchemaUtils.scala:694``,
+    which allows only NullType→anything and nested recursion): we also
+    accept the ``_WIDENING`` lattice (byte→short→int→long, float→double).
+    Widening is lossless, our Arrow read path casts old files up to the
+    table schema on scan, and the write path normalizes new data to the
+    widened type — so the strictness the reference needs to protect its
+    fixed-width Parquet readers does not apply here.
+    """
     if isinstance(from_t, NullType):
+        return True
+    if _can_widen(from_t, to_t):
         return True
     if isinstance(from_t, StructType) and isinstance(to_t, StructType):
         to_by_lower = {f.name.lower(): f for f in to_t.fields}

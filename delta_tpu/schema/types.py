@@ -354,83 +354,3 @@ def to_arrow_schema(schema: StructType):
     import pyarrow as pa
 
     return pa.schema([pa.field(f.name, to_arrow_type(f.data_type), f.nullable) for f in schema.fields])
-
-
-def from_arrow_type(at) -> DataType:
-    import pyarrow as pa
-    import pyarrow.types as pat
-
-    if pat.is_boolean(at):
-        return BooleanType()
-    if pat.is_int8(at):
-        return ByteType()
-    if pat.is_int16(at):
-        return ShortType()
-    if pat.is_int32(at):
-        return IntegerType()
-    if pat.is_int64(at):
-        return LongType()
-    if pat.is_uint8(at):
-        return ShortType()
-    if pat.is_uint16(at):
-        return IntegerType()
-    if pat.is_uint32(at) or pat.is_uint64(at):
-        return LongType()
-    if pat.is_float32(at):
-        return FloatType()
-    if pat.is_float64(at):
-        return DoubleType()
-    if pat.is_string(at) or pat.is_large_string(at):
-        return StringType()
-    if pat.is_binary(at) or pat.is_large_binary(at) or pat.is_fixed_size_binary(at):
-        return BinaryType()
-    if pat.is_date(at):
-        return DateType()
-    if pat.is_timestamp(at):
-        return TimestampType()
-    if pat.is_decimal(at):
-        return DecimalType(at.precision, at.scale)
-    if pat.is_null(at):
-        return NullType()
-    if pat.is_list(at) or pat.is_large_list(at):
-        return ArrayType(from_arrow_type(at.value_type))
-    if pat.is_map(at):
-        return MapType(from_arrow_type(at.key_type), from_arrow_type(at.item_type))
-    if pat.is_struct(at):
-        return StructType(
-            [StructField(f.name, from_arrow_type(f.type), f.nullable) for f in at]
-        )
-    raise ValueError(f"No delta mapping for arrow type {at!r}")
-
-
-def from_arrow_schema(aschema) -> StructType:
-    return StructType(
-        [StructField(f.name, from_arrow_type(f.type), f.nullable) for f in aschema]
-    )
-
-
-# ---------------------------------------------------------------------------
-# numpy / device interop (for columns shipped to TPU HBM)
-# ---------------------------------------------------------------------------
-
-_NUMPY_MAP: Dict[str, Any] = {
-    "boolean": np.bool_,
-    "byte": np.int8,
-    "short": np.int16,
-    "integer": np.int32,
-    "long": np.int64,
-    "float": np.float32,
-    "double": np.float64,
-    "date": np.int32,       # days since epoch
-    "timestamp": np.int64,  # micros since epoch
-}
-
-
-def to_numpy_dtype(dt: DataType):
-    """Device-representable dtype, or None if the type must stay on host
-    (strings/binary/decimal/nested) and be dictionary-encoded or hashed first."""
-    return _NUMPY_MAP.get(getattr(dt, "name", None))
-
-
-def is_device_representable(dt: DataType) -> bool:
-    return to_numpy_dtype(dt) is not None

@@ -173,7 +173,7 @@ def test_sql_describe_and_vacuum(tmp_table):
 def test_sql_delete_update(tmp_table):
     t = make_table(tmp_table, {"id": [1, 2, 3], "v": [1, 2, 3]})
     execute_sql(f"UPDATE delta.`{tmp_table}` SET v = v + 100 WHERE id >= 2")
-    m = execute_sql(f"DELETE FROM delta.`{tmp_table}` WHERE v > 101")
+    m = execute_sql(f"DELETE FROM delta.`{tmp_table}` WHERE v > 102")
     assert m["numDeletedRows"] == 1
     got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
     assert got == [{"id": 1, "v": 1}, {"id": 2, "v": 102}]

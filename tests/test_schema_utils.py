@@ -1,0 +1,75 @@
+"""Schema machinery tests (reference spec: ``SchemaUtilsSuite``, 1,311 LoC).
+
+Started with the ALTER widening + Arrow interop edge cases that round-1
+review flagged; grows toward the full SchemaUtilsSuite matrix.
+"""
+import pyarrow as pa
+import pytest
+
+from delta_tpu.schema import schema_utils
+from delta_tpu.schema.arrow_interop import delta_type_from_arrow
+from delta_tpu.schema.types import (
+    ArrayType,
+    ByteType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    MapType,
+    NullType,
+    ShortType,
+    StringType,
+    StructType,
+)
+from delta_tpu.utils.errors import SchemaMismatchError
+
+
+class TestCanChangeDataType:
+    def test_widening_lattice(self):
+        ok = [
+            (ByteType(), ShortType()),
+            (ByteType(), IntegerType()),
+            (ByteType(), LongType()),
+            (ShortType(), IntegerType()),
+            (ShortType(), LongType()),
+            (IntegerType(), LongType()),
+            (FloatType(), DoubleType()),
+        ]
+        for f, t in ok:
+            assert schema_utils.can_change_data_type(f, t), (f, t)
+
+    def test_narrowing_refused(self):
+        bad = [
+            (LongType(), IntegerType()),
+            (IntegerType(), ShortType()),
+            (DoubleType(), FloatType()),
+            (IntegerType(), StringType()),
+            (StringType(), IntegerType()),
+            (IntegerType(), DoubleType()),  # long would lose precision; not in lattice
+        ]
+        for f, t in bad:
+            assert not schema_utils.can_change_data_type(f, t), (f, t)
+
+    def test_null_type_to_anything(self):
+        assert schema_utils.can_change_data_type(NullType(), StringType())
+
+    def test_nested_widening(self):
+        assert schema_utils.can_change_data_type(
+            ArrayType(IntegerType()), ArrayType(LongType())
+        )
+        assert schema_utils.can_change_data_type(
+            MapType(IntegerType(), FloatType()), MapType(LongType(), DoubleType())
+        )
+        inner_f = StructType().add("x", IntegerType())
+        inner_t = StructType().add("x", LongType())
+        assert schema_utils.can_change_data_type(inner_f, inner_t)
+        assert not schema_utils.can_change_data_type(inner_t, inner_f)
+
+
+def test_uint64_arrow_rejected():
+    with pytest.raises(SchemaMismatchError, match="uint64"):
+        delta_type_from_arrow(pa.uint64())
+
+
+def test_uint32_arrow_widens_to_long():
+    assert delta_type_from_arrow(pa.uint32()) == LongType()
