@@ -9,9 +9,17 @@ The reference (`commands/MergeIntoCommand.scala:201-771`) runs MERGE as:
 
 This engine keeps the phase structure but replaces the row interpreter with
 columnar blocks: matched pairs / unmatched target rows / unmatched source
-rows are materialized separately (equi-join via Arrow's hash join — the C++
-kernel; device hash-join kernel for numeric keys lives in ops/join_kernel),
-and every clause becomes a vectorized mask + projection over its block.
+rows are materialized separately, and every clause becomes a vectorized mask
++ projection over its block. The join itself has two executors:
+
+- **device** (`ops/join_kernel.py`): single integer equi-key, no residual
+  conjuncts — the TPC-DS upsert shape. Target keys sharded over the mesh,
+  source keys all-gathered over ICI, per-shard sort-merge probe; phase 1's
+  touched files and phase 2's matched pairs both come from its
+  (count, first-match) output. Toggle: ``delta.tpu.merge.devicePath.enabled``.
+- **host fallback** (Arrow hash join — the C++ kernel) for string /
+  multi-key / non-equi conditions.
+
 Multi-clause ordering, clause conditions, multi-match errors, the insert-only
 fast path (`:397-450`) and `MergeStats` (`:79-174`) follow the reference.
 """
@@ -31,6 +39,7 @@ from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_expression, parse_predicate
 from delta_tpu.expr.vectorized import boolean_mask, evaluate
 from delta_tpu.protocol.actions import Action, AddFile
+from delta_tpu.utils.config import conf
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaUnsupportedOperationError
 
 __all__ = ["MergeIntoCommand", "MergeClause"]
@@ -133,6 +142,9 @@ class MergeIntoCommand:
         self.source_alias = source_alias
         self.target_alias = target_alias
         self.metrics: Dict[str, int] = {}
+        # set by _join when the device kernel ran: JoinResult with exact
+        # per-target match counts and per-source matched flags
+        self._device_join = None
         self._validate_clauses()
 
     def _validate_clauses(self) -> None:
@@ -333,11 +345,35 @@ class MergeIntoCommand:
         target cols bare + source cols prefixed + ids, per-file target
         tables with row ids)."""
         target_cols = [f.name for f in metadata.schema.fields]
+        device_eligible = (
+            bool(conf.get("delta.tpu.merge.devicePath.enabled", True))
+            and len(equi) == 1
+            and not residual
+        )
+        # insert-only merges never rewrite target rows: read only the columns
+        # the join condition touches (the reference's left-anti fast path
+        # reads the full target; we push the projection into the Parquet scan)
+        read_cols: Optional[List[str]] = None
+        if not self.matched_clauses:
+            need = {
+                r.lower()
+                for t_e, _ in equi
+                for r in ir.references(t_e)
+            } | {
+                r.lower()
+                for c in residual
+                for r in ir.references(c)
+                if not r.startswith(_SRC)
+            }
+            cols = [c for c in target_cols if c.lower() in need]
+            read_cols = cols or None
         tgt_tables: Dict[int, pa.Table] = {}
         pieces: List[pa.Table] = []
         row_base = 0
         for fid, add in enumerate(candidates):
-            t = read_files_as_table(self.delta_log.data_path, [add], metadata)
+            t = read_files_as_table(
+                self.delta_log.data_path, [add], metadata, columns=read_cols
+            )
             t = t.append_column(
                 _TID, pa.array(range(row_base, row_base + t.num_rows), pa.int64())
             )
@@ -368,22 +404,31 @@ class MergeIntoCommand:
             return combined, tgt_tables
 
         if equi:
-            tkeys, skeys = [], []
-            t_aug, s_aug = target, src
-            for i, (t_e, s_e) in enumerate(equi):
-                k = f"__k{i}__"
+            key_cols = []
+            for t_e, s_e in equi:
                 t_vals = evaluate(t_e, target)
                 s_vals = evaluate(s_e, src)
-                t_vals, s_vals = _coerce_join_keys(t_vals, s_vals)
-                t_aug = t_aug.append_column(k, t_vals)
-                s_aug = s_aug.append_column(k, s_vals)
-                tkeys.append(k)
-                skeys.append(k)
-            joined = t_aug.join(
-                s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
-                use_threads=False,
-            )
-            joined = joined.drop_columns(tkeys)
+                key_cols.append(_coerce_join_keys(t_vals, s_vals))
+            if (
+                device_eligible
+                and pa.types.is_integer(key_cols[0][0].type)
+                and pa.types.is_integer(key_cols[0][1].type)
+            ):
+                joined = self._device_equi_join(target, src, *key_cols[0])
+            else:
+                tkeys, skeys = [], []
+                t_aug, s_aug = target, src
+                for i, (t_vals, s_vals) in enumerate(key_cols):
+                    k = f"__k{i}__"
+                    t_aug = t_aug.append_column(k, t_vals)
+                    s_aug = s_aug.append_column(k, s_vals)
+                    tkeys.append(k)
+                    skeys.append(k)
+                joined = t_aug.join(
+                    s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
+                    use_threads=False,
+                )
+                joined = joined.drop_columns(tkeys)
         else:
             # general condition: cartesian pairing (small sources only)
             if target.num_rows * src.num_rows > 50_000_000:
@@ -405,16 +450,57 @@ class MergeIntoCommand:
             joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
         return joined, tgt_tables
 
+    def _device_equi_join(
+        self, target: pa.Table, src: pa.Table, t_vals, s_vals
+    ) -> pa.Table:
+        """Phase-1/2 join on device (`ops/join_kernel.py`): exact integer-key
+        sort-merge probe sharded over the mesh. Pairs = target rows with a
+        match gathered against their first matching source row (lossless —
+        multi-match is either an error or duplicate-insensitive; the exact
+        counts are kept in ``self._device_join`` for `_check_multi_match`)."""
+        import numpy as np
+
+        from delta_tpu.ops import join_kernel
+        from delta_tpu.parallel.mesh import state_mesh
+
+        def to_np(vals):
+            arr = vals.combine_chunks() if isinstance(vals, pa.ChunkedArray) else vals
+            valid = ~np.asarray(pc.is_null(arr))
+            keys = np.asarray(arr.fill_null(0).cast(pa.int64()))
+            return keys, valid
+
+        t_keys, t_ok = to_np(t_vals)
+        s_keys, s_ok = to_np(s_vals)
+        import jax
+
+        mesh = state_mesh() if len(jax.devices()) > 1 else None
+        res = join_kernel.inner_join(t_keys, t_ok, s_keys, s_ok, mesh=mesh)
+        self._device_join = res
+        matched = np.nonzero(res.t_count > 0)[0]
+        joined = target.take(pa.array(matched, pa.int64()))
+        s_taken = src.take(pa.array(res.t_first_s[matched], pa.int64()))
+        for name in s_taken.column_names:
+            joined = joined.append_column(name, s_taken.column(name))
+        return joined
+
     def _check_multi_match(self, pairs: pa.Table) -> None:
         """Error when a target row matches multiple source rows, unless the
         merge is a single unconditional DELETE (`:351-365`)."""
-        if pairs.num_rows == 0:
-            return
         single_delete = (
             len(self.matched_clauses) == 1
             and self.matched_clauses[0].kind == "delete"
             and self.matched_clauses[0].condition is None
         )
+        if self._device_join is not None:
+            if not single_delete and self._device_join.max_count > 1:
+                raise DeltaUnsupportedOperationError(
+                    "Cannot perform Merge as multiple source rows matched and "
+                    "attempted to modify the same target row in the Delta table "
+                    "in possibly conflicting ways."
+                )
+            return
+        if pairs.num_rows == 0:
+            return
         if single_delete:
             return
         counts = pairs.group_by(_TID).aggregate([(_TID, "count")])
@@ -449,7 +535,10 @@ class MergeIntoCommand:
                     out_parts.append(self._project_update(block, clause, target_cols))
                     n_updated += count
                 else:
-                    n_deleted += count
+                    # count distinct target ROWS, not pairs: a single
+                    # unconditional DELETE may legally multi-match, and the
+                    # reference's numTargetRowsDeleted is rows deleted
+                    n_deleted += pc.count_distinct(block.column(_TID)).as_py()
             unclaimed = pc.and_(unclaimed, pc.invert(fire))
         # unclaimed matched pairs: copy target row unchanged
         rest = pairs.filter(unclaimed)
@@ -503,7 +592,11 @@ class MergeIntoCommand:
                            target_cols: List[str], source_cols: List[str], metadata):
         if not self.not_matched_clauses:
             return None, 0
-        if pairs.num_rows:
+        if self._device_join is not None:
+            # device kernel computed per-source matched flags via the reverse
+            # probe + psum (exact: the device path requires no residual)
+            unmatched = src.filter(pa.array(~self._device_join.s_matched))
+        elif pairs.num_rows:
             matched_sids = pc.unique(pairs.column(_SID))
             unmatched = src.filter(
                 pc.invert(pc.is_in(src.column(_SID), value_set=matched_sids))

@@ -42,6 +42,9 @@ class SqlConf:
         "delta.tpu.merge.optimizeInsertOnlyMerge.enabled": True,
         # ≈ MERGE_MATCHED_ONLY_ENABLED
         "delta.tpu.merge.optimizeMatchedOnlyMerge.enabled": True,
+        # Run the MERGE equi-join on device (ops/join_kernel) when the
+        # condition is a single integer equi-key with no residual conjuncts.
+        "delta.tpu.merge.devicePath.enabled": True,
         # ≈ DELTA_STATS_SKIPPING (DeltaSQLConf.scala:150) — we actually wire it
         "delta.tpu.stats.skipping": True,
         # ≈ DELTA_COLLECT_STATS — collect per-file min/max/nullCount on write

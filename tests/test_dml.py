@@ -527,3 +527,138 @@ def test_merge_int64_float_keys_no_collapse(tmp_table):
     )
     # only the exactly-equal key may match; big+1 must survive
     assert ids(log) == [big + 1]
+
+
+# -- device join path parity ------------------------------------------------
+
+
+def _run_merge_both_paths(tmp_path, name, target_data, source, cond, matched,
+                          not_matched, **kw):
+    """Run the same MERGE with the device kernel on and off; return the two
+    (final rows, metrics) results plus the device command for inspection."""
+    from delta_tpu.utils.config import conf
+
+    results = []
+    cmds = []
+    for device in (True, False):
+        path = str(tmp_path / f"{name}_{device}")
+        log = DeltaLog.for_table(path)
+        write(log, target_data)
+        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": device}):
+            cmd = MergeIntoCommand(log, source, cond, matched, not_matched, **kw)
+            cmd.run()
+        cmds.append(cmd)
+        results.append((rows(log), {k: v for k, v in cmd.metrics.items()
+                                    if not k.endswith("Ms")}))
+    assert cmds[0]._device_join is not None, "device path did not run"
+    assert cmds[1]._device_join is None, "host path ran the device kernel"
+    return results
+
+
+def test_merge_device_matches_host(tmp_path):
+    import numpy as np
+
+    rng = np.random.RandomState(42)
+    n_t, n_s = 500, 200
+    # duplicate TARGET keys are legal (several target rows match one source
+    # row) and exercise the device/host structural difference; duplicate
+    # SOURCE keys would be a multi-match error, so draw those unique
+    target = {
+        "id": rng.randint(0, 400, n_t).tolist(),
+        "v": rng.randint(0, 1000, n_t).tolist(),
+    }
+    source = pa.table({
+        "id": rng.choice(np.arange(0, 700), size=n_s, replace=False).tolist(),
+        "v": rng.randint(1000, 2000, n_s).tolist(),
+    })
+    (dev_rows, dev_m), (host_rows, host_m) = _run_merge_both_paths(
+        tmp_path, "parity", target, source, "t.id = s.id",
+        matched=[MergeClause("update", assignments=None)],
+        not_matched=[MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    assert dev_rows == host_rows
+    assert dev_m == host_m
+
+
+def test_merge_device_null_keys_never_match(tmp_path):
+    source = pa.table({"id": pa.array([2, None, 5], pa.int64()),
+                       "v": pa.array([200, 999, 500], pa.int64())})
+    (dev_rows, dev_m), (host_rows, host_m) = _run_merge_both_paths(
+        tmp_path, "nulls", {"id": [1, 2, 3], "v": [10, 20, 30]}, source,
+        "t.id = s.id",
+        matched=[MergeClause("update", assignments=None)],
+        not_matched=[MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    assert dev_rows == host_rows
+    assert dev_m == host_m
+    # NULL source key inserts (not-matched), never updates
+    assert dev_m["numTargetRowsInserted"] == 2
+    assert dev_m["numTargetRowsUpdated"] == 1
+
+
+def test_merge_device_multi_match_errors(tmp_path):
+    from delta_tpu.utils.config import conf
+
+    path = str(tmp_path / "mm")
+    log = DeltaLog.for_table(path)
+    write(log, {"id": [1, 2], "v": [10, 20]})
+    src = pa.table({"id": [1, 1], "v": [100, 101]})
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True}):
+        cmd = MergeIntoCommand(
+            log, src, "t.id = s.id",
+            [MergeClause("update", assignments=None)], [],
+            source_alias="s", target_alias="t",
+        )
+        with pytest.raises(DeltaUnsupportedOperationError):
+            cmd.run()
+        assert cmd._device_join is not None
+
+
+def test_merge_device_insert_only_fast_path(tmp_path):
+    # insert-only: device flags drive the anti-join; target data columns are
+    # not needed (only the key column is read)
+    (dev_rows, dev_m), (host_rows, host_m) = _run_merge_both_paths(
+        tmp_path, "io", {"id": [1, 2, 3], "v": [10, 20, 30]},
+        pa.table({"id": [3, 4], "v": [300, 400]}),
+        "t.id = s.id", [],
+        [MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    assert dev_rows == host_rows
+    assert dev_m["numTargetRowsInserted"] == 1
+    assert dev_m == host_m
+
+
+def test_merge_device_string_key_falls_back_to_host(tmp_path):
+    from delta_tpu.utils.config import conf
+
+    path = str(tmp_path / "str")
+    log = DeltaLog.for_table(path)
+    write(log, {"id": ["a", "b"], "v": [1, 2]})
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True}):
+        cmd = MergeIntoCommand(
+            log, pa.table({"id": ["b", "c"], "v": [20, 30]}), "t.id = s.id",
+            [MergeClause("update", assignments=None)],
+            [MergeClause("insert", assignments=None)],
+            source_alias="s", target_alias="t",
+        )
+        cmd.run()
+    assert cmd._device_join is None  # string keys -> Arrow hash join
+    assert rows(log) == [{"id": "a", "v": 1}, {"id": "b", "v": 20},
+                         {"id": "c", "v": 30}]
+
+
+def test_merge_device_multimatch_delete_metrics_parity(tmp_path):
+    # single unconditional DELETE legally multi-matches; numTargetRowsDeleted
+    # must count distinct target rows on both paths
+    (dev_rows, dev_m), (host_rows, host_m) = _run_merge_both_paths(
+        tmp_path, "mmdel", {"id": [1, 2], "v": [10, 20]},
+        pa.table({"id": [1, 1], "v": [0, 0]}),
+        "t.id = s.id", [MergeClause("delete")], [],
+        source_alias="s", target_alias="t",
+    )
+    assert dev_rows == host_rows == [{"id": 2, "v": 20}]
+    assert dev_m == host_m
+    assert dev_m["numTargetRowsDeleted"] == 1
