@@ -1,94 +1,410 @@
-"""Benchmark: snapshot state reconstruction (checkpoint replay) on device.
+"""Benchmarks for the 5 BASELINE.md harness configs, end to end.
 
-BASELINE.json config 5: "DeltaLog checkpoint + 10k-version snapshot
-stateReconstruction replay". The reference replays the action log as a
-50-partition Spark job with per-partition hash maps (`Snapshot.scala:88-111`,
-`actions/InMemoryLogReplay.scala:43-65`); here the same reconciliation is one
-device sort + segmented reduce. ``vs_baseline`` is the speedup over the
-host-side pure-Python replay (the same algorithm the reference's executors
-run per partition, minus JVM overheads) on this machine.
+Every number is wall-clock through the public engine APIs — Parquet IO,
+expression evaluation, log commit and all — not kernel-only. Baselines are
+honest same-machine host implementations, labeled per config:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  1 batch overwrite + filtered read      vs raw pyarrow parquet write+read
+  2 MERGE upsert 1M→10M store_sales      vs the engine's own host-Arrow join
+    (headline: GB/sec)                      path (devicePath.enabled=false)
+  3 Z-ORDER OPTIMIZE + point query       vs the same query pre-OPTIMIZE
+  4 streaming tail of a 1k-commit log    vs snapshot-rebuild-per-batch
+  5 checkpoint replay, 10k versions      vs sequential dict replay (both
+    (JSON decode included)                  including JSON action decode)
+
+Prints ONE JSON line: the headline metric (config 2 MERGE GB/sec) with the
+required {metric, value, unit, vs_baseline} keys plus an ``all`` field
+holding every config's numbers. BENCH_SCALE (default 1.0) scales row counts
+for quick local runs.
 """
 import json
+import os
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
 
-
-def build_stream(n_versions=10_000, actions_per_commit=20, n_paths=50_000):
-    """Synthetic 10k-version log: adds/removes over a bounded path universe."""
-    rng = np.random.RandomState(7)
-    path_id = rng.randint(0, n_paths, size=n_versions * actions_per_commit).astype(np.int32)
-    version = np.repeat(np.arange(n_versions, dtype=np.int64), actions_per_commit)
-    pos = np.tile(np.arange(actions_per_commit, dtype=np.int64), n_versions)
-    seq = (version << 31) | pos
-    is_add = rng.rand(len(path_id)) < 0.85
-    size = rng.randint(1, 1 << 24, size=len(path_id)).astype(np.int64)
-    del_ts = np.where(is_add, 0, version * 1000).astype(np.int64)
-    return path_id, seq, is_add, size, del_ts
+SCALE = float(os.environ.get("BENCH_SCALE", "1.0"))
 
 
-def host_replay_ms(path_id, seq, is_add, size):
-    """The reference algorithm: sequential hash-map replay (one partition)."""
+def _rows(n):
+    return max(int(n * SCALE), 1000)
+
+
+def _dir_bytes(path):
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        if "_delta_log" in root:
+            continue
+        for f in files:
+            if f.endswith(".parquet"):
+                total += os.path.getsize(os.path.join(root, f))
+    return total
+
+
+def _timed(fn):
     t0 = time.perf_counter()
-    active = {}
-    for i in range(len(path_id)):
-        p = path_id[i]
-        if is_add[i]:
-            active[p] = size[i]
-        else:
-            active.pop(p, None)
-    elapsed = (time.perf_counter() - t0) * 1000
-    return elapsed, len(active)
+    out = fn()
+    return time.perf_counter() - t0, out
 
 
-def device_replay_ms(path_id, seq, is_add, size, del_ts):
+# -- config 1: batch overwrite + filtered read -------------------------------
+
+
+def bench_overwrite_read(workdir):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu import DeltaLog
+
+    n = _rows(2_000_000)
+    rng = np.random.RandomState(3)
+    data = pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "v": rng.randint(0, 1000, n).astype(np.int64),
+        "name": pa.array(np.char.add("u", rng.randint(0, 99999, n).astype(str))),
+    })
+    path = os.path.join(workdir, "c1")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", data).run()
+
+    def engine_roundtrip():
+        WriteIntoDelta(log, "overwrite", data).run()
+        t = DeltaTable.for_path(path)
+        out = t.to_arrow(filters=["v < 100"])
+        return out.num_rows
+
+    engine_roundtrip()  # warm device kernel compiles (XLA caches per shape)
+    eng_s, eng_rows = _timed(engine_roundtrip)
+
+    # baseline: raw pyarrow — the floor any engine pays for the same IO
+    raw = os.path.join(workdir, "c1_raw.parquet")
+
+    def raw_roundtrip():
+        pq.write_table(data, raw)
+        t = pq.read_table(raw)
+        return t.filter(pc.less(t.column("v"), 100)).num_rows
+
+    raw_s, raw_rows = _timed(raw_roundtrip)
+    assert eng_rows == raw_rows, (eng_rows, raw_rows)
+    return {
+        "metric": "overwrite_plus_filtered_read_2M_rows",
+        "value": round(eng_s, 3),
+        "unit": "s",
+        "vs_baseline": round(raw_s / eng_s, 2),
+        "baseline": "raw pyarrow parquet write+read+filter (no log, no txn)",
+    }
+
+
+# -- config 2: MERGE upsert (headline) ---------------------------------------
+
+
+def _store_sales(n, rng):
+    import pyarrow as pa
+
+    keys = rng.permutation(n * 2)[:n].astype(np.int64)
+    return pa.table({
+        "ss_item_sk": keys,
+        "ss_customer_sk": rng.randint(0, 1_000_000, n).astype(np.int64),
+        "ss_sold_date_sk": rng.randint(2450000, 2452000, n).astype(np.int64),
+        "ss_store_sk": rng.randint(0, 500, n).astype(np.int64),
+        "ss_quantity": rng.randint(1, 100, n).astype(np.int64),
+        "ss_sales_price": rng.rand(n).astype(np.float64) * 100,
+        "ss_ext_discount_amt": rng.rand(n).astype(np.float64) * 10,
+        "ss_net_paid": rng.rand(n).astype(np.float64) * 90,
+    })
+
+
+def bench_merge_upsert(workdir):
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.utils.config import conf
+
+    n_target, n_source = _rows(10_000_000), _rows(1_000_000)
+    rng = np.random.RandomState(7)
+    target = _store_sales(n_target, rng)
+    path = os.path.join(workdir, "c2")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", target).run()
+
+    # source: half updates (existing keys), half inserts (fresh keys)
+    existing = np.asarray(target.column("ss_item_sk"))[
+        rng.choice(n_target, n_source // 2, replace=False)
+    ]
+    fresh = np.arange(n_target * 2, n_target * 2 + (n_source - n_source // 2),
+                      dtype=np.int64)
+    src_keys = np.concatenate([existing, fresh])
+    rng.shuffle(src_keys)
+    source = _store_sales(n_source, np.random.RandomState(11))
+    source = source.set_column(0, "ss_item_sk", pa.array(src_keys))
+
+    warm_path = os.path.join(workdir, "c2_warm")
+    host_path = os.path.join(workdir, "c2_host")
+    shutil.copytree(path, warm_path)
+    shutil.copytree(path, host_path)
+    gb = (_dir_bytes(path) + source.nbytes) / 1e9
+
+    def run_merge(table_path, device):
+        from delta_tpu import DeltaLog as DL
+
+        DL.clear_cache()
+        lg = DL.for_table(table_path)
+        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": device}):
+            cmd = MergeIntoCommand(
+                lg, source, "t.ss_item_sk = s.ss_item_sk",
+                [MergeClause("update", assignments=None)],
+                [MergeClause("insert", assignments=None)],
+                source_alias="s", target_alias="t",
+            )
+            cmd.run()
+        assert cmd.metrics["numTargetRowsUpdated"] == n_source // 2
+        assert cmd.metrics["numTargetRowsInserted"] == n_source - n_source // 2
+        return cmd
+
+    run_merge(warm_path, True)  # warm the join-kernel compile (same shapes)
+    dev_s, dev_cmd = _timed(lambda: run_merge(path, True))
+    host_s, _ = _timed(lambda: run_merge(host_path, False))
+    assert dev_cmd._device_join is not None, "device join did not run"
+    return {
+        "metric": "tpcds_store_sales_merge_upsert_1M_into_10M",
+        "value": round(gb / dev_s, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(host_s / dev_s, 2),
+        "baseline": "same engine, host Arrow hash-join path (same machine)",
+        "device_s": round(dev_s, 2),
+        "host_s": round(host_s, 2),
+        "gb": round(gb, 3),
+    }
+
+
+# -- config 3: Z-ORDER OPTIMIZE + data-skipping point query ------------------
+
+
+def bench_zorder_point_query(workdir):
+    from delta_tpu import DeltaLog
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.exec.scan import scan_files
+
+    n = _rows(4_000_000)
+    rng = np.random.RandomState(5)
+    data = _store_sales(n, rng)
+    path = os.path.join(workdir, "c3")
+    log = DeltaLog.for_table(path)
+    # write in 8 chunks → 8 files with interleaved key ranges (worst case)
+    step = n // 8
+    for i in range(8):
+        WriteIntoDelta(log, "append", data.slice(i * step, step)).run()
+
+    key = int(np.asarray(data.column("ss_item_sk"))[12345])
+    date = int(np.asarray(data.column("ss_sold_date_sk"))[12345])
+    pred = f"ss_item_sk = {key} AND ss_sold_date_sk = {date}"
+
+    def point_query():
+        DeltaLog.clear_cache()
+        t = DeltaTable.for_path(path)
+        scan = scan_files(t.delta_log.update(), [pred])
+        out = t.to_arrow(filters=[pred])
+        return len(scan.files), out.num_rows
+
+    point_query()  # warm pruning-kernel compiles
+    pre_s, (pre_files, pre_rows) = _timed(point_query)
+    opt_s, _ = _timed(
+        OptimizeCommand(log, z_order_by=["ss_item_sk", "ss_sold_date_sk"],
+                        target_rows=step).run
+    )
+    point_query()  # re-warm: the post-OPTIMIZE file count is a new shape
+    post_s, (post_files, post_rows) = _timed(point_query)
+    assert pre_rows == post_rows
+    return {
+        "metric": "zorder_point_query_4M_rows",
+        "value": round(post_s * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": round(pre_s / post_s, 2),
+        "baseline": "same point query before Z-ORDER OPTIMIZE (files scanned "
+                    f"{pre_files}->{post_files})",
+        "optimize_s": round(opt_s, 2),
+    }
+
+
+# -- config 4: streaming tail of a 1k-commit log -----------------------------
+
+
+def bench_streaming_tail(workdir):
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.streaming.source import DeltaSource
+
+    n_commits = max(int(1000 * SCALE), 100)
+    path = os.path.join(workdir, "c4")
+    log = DeltaLog.for_table(path)
+    rng = np.random.RandomState(9)
+    for i in range(n_commits):
+        WriteIntoDelta(log, "append", pa.table({
+            "id": np.arange(i * 10, i * 10 + 10, dtype=np.int64),
+            "v": rng.randint(0, 100, 10).astype(np.int64),
+        })).run()
+
+    def tail_all():
+        DeltaLog.clear_cache()
+        src = DeltaSource(DeltaLog.for_table(path), max_files_per_trigger=100,
+                          starting_version=0)
+        off = src.initial_offset()
+        total = batches = 0
+        while True:
+            end = src.latest_offset(off)
+            if end is None:
+                break
+            total += src.get_batch(off, end).num_rows
+            off = end
+            batches += 1
+        return total, batches
+
+    tail_s, (rows_read, n_batches) = _timed(tail_all)
+    assert rows_read == n_commits * 10
+
+    # baseline: rebuild the snapshot at each batch boundary (what a
+    # non-incremental consumer pays), same batch count
+    def naive():
+        from delta_tpu.exec.scan import scan_to_table
+
+        total = 0
+        seen = 0
+        for b in range(n_batches):
+            DeltaLog.clear_cache()
+            hi = min((b + 1) * 100, n_commits) - 1
+            snap = DeltaLog.for_table(path).get_snapshot_at(hi)
+            t = scan_to_table(snap)
+            total += t.num_rows - seen
+            seen = t.num_rows
+        return total
+
+    naive_s, naive_rows = _timed(naive)
+    assert naive_rows == rows_read
+    return {
+        "metric": "streaming_tail_1k_commit_log",
+        "value": round(n_commits / tail_s, 1),
+        "unit": "commits/s",
+        "vs_baseline": round(naive_s / tail_s, 2),
+        "baseline": "snapshot rebuild + full rescan per micro-batch",
+    }
+
+
+# -- config 5: checkpoint replay, 10k versions -------------------------------
+
+
+def bench_checkpoint_replay():
     import jax
 
-    from delta_tpu.ops import replay_kernel
-    from delta_tpu.ops.state_export import ReplayArrays
+    from delta_tpu.ops import replay_kernel, state_export
+    from delta_tpu.protocol.actions import action_from_json
 
-    arrays = ReplayArrays(
-        paths=[],  # dictionary not needed for the kernel
-        path_id=path_id,
-        seq=seq,
-        is_add=is_add,
-        size=size,
-        deletion_timestamp=del_ts,
+    n_versions, per_commit, n_paths = (
+        max(int(10_000 * SCALE), 500), 20, 50_000
     )
-    # warm-up: compile
-    r = replay_kernel.replay_alive_mask(arrays)
-    jax.block_until_ready(r.alive)
-    runs = []
-    for _ in range(5):
-        t0 = time.perf_counter()
+    rng = np.random.RandomState(7)
+    lines = []
+    for v in range(n_versions):
+        for i in range(per_commit):
+            p = f"part-{rng.randint(n_paths):05d}-{v}.parquet"
+            if rng.rand() < 0.85:
+                lines.append((v, json.dumps({"add": {
+                    "path": p, "partitionValues": {}, "size": int(rng.randint(1, 1 << 24)),
+                    "modificationTime": v, "dataChange": True}})))
+            else:
+                lines.append((v, json.dumps({"remove": {
+                    "path": p, "deletionTimestamp": v * 1000, "dataChange": True}})))
+
+    def host_end_to_end():
+        active = {}
+        for _v, line in lines:
+            a = action_from_json(line)
+            d = a.__class__.__name__
+            if d == "AddFile":
+                active[a.path] = a.size
+            elif d == "RemoveFile":
+                active.pop(a.path, None)
+        return len(active)
+
+    host_s, host_n = _timed(host_end_to_end)
+
+    def decode():
+        by_version = {}
+        for v, line in lines:
+            by_version.setdefault(v, []).append(action_from_json(line))
+        return state_export.actions_to_arrays(sorted(by_version.items()))
+
+    def device_end_to_end():
+        arrays = decode()
         r = replay_kernel.replay_alive_mask(arrays)
         jax.block_until_ready(r.alive)
-        runs.append((time.perf_counter() - t0) * 1000)
-    return min(runs), int(r.stats.num_files)
+        return int(r.stats.num_files)
+
+    # warm the jit cache, then measure end to end (decode included);
+    # min-of-3 damps tunnel-latency jitter on remote-attached chips
+    device_end_to_end()
+    runs = [_timed(device_end_to_end) for _ in range(3)]
+    dev_s = min(s for s, _ in runs)
+    dev_n = runs[0][1]
+    assert host_n == dev_n, (host_n, dev_n)
+
+    # kernel-only (decode excluded) for the device-side picture
+    arrays = decode()
+    r = replay_kernel.replay_alive_mask(arrays)
+    jax.block_until_ready(r.alive)
+    k_s = min(
+        _timed(lambda: jax.block_until_ready(
+            replay_kernel.replay_alive_mask(arrays).alive))[0]
+        for _ in range(3)
+    )
+    return {
+        "metric": "checkpoint_replay_10k_versions_200k_actions",
+        "value": round(dev_s * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": round(host_s / dev_s, 2),
+        "baseline": "sequential dict replay incl. JSON decode (decode "
+                    "dominates both paths)",
+        "kernel_only_ms": round(k_s * 1000, 2),
+    }
 
 
 def main():
-    path_id, seq, is_add, size, del_ts = build_stream()
-    host_ms, host_n = host_replay_ms(path_id, seq, is_add, size)
-    dev_ms, dev_n = device_replay_ms(path_id, seq, is_add, size, del_ts)
-    if host_n != dev_n:
-        print(
-            f"MISMATCH host={host_n} device={dev_n}", file=sys.stderr
-        )
-        sys.exit(1)
-    print(
-        json.dumps(
-            {
-                "metric": "checkpoint_replay_10k_versions_200k_actions",
-                "value": round(dev_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(host_ms / dev_ms, 2),
-            }
-        )
-    )
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    workdir = tempfile.mkdtemp(prefix="delta_tpu_bench_")
+    configs = {
+        "1": lambda: bench_overwrite_read(workdir),
+        "2": lambda: bench_merge_upsert(workdir),
+        "3": lambda: bench_zorder_point_query(workdir),
+        "4": lambda: bench_streaming_tail(workdir),
+        "5": bench_checkpoint_replay,
+    }
+    try:
+        if only:
+            results = {only: configs[only]()}
+            print(json.dumps(results[only]))
+            return
+        results = {k: fn() for k, fn in configs.items()}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    headline = results["2"]
+    print(json.dumps({
+        "metric": headline["metric"],
+        "value": headline["value"],
+        "unit": headline["unit"],
+        "vs_baseline": headline["vs_baseline"],
+        "all": results,
+    }))
 
 
 if __name__ == "__main__":

@@ -49,7 +49,17 @@ class DeltaTable:
         return cls(log)
 
     @classmethod
+    def for_name(cls, name: str, catalog=None) -> "DeltaTable":
+        """Resolve a table by catalog name (``DeltaTable.forName :690``;
+        `catalog/catalog.py`). ``delta.`/path``` identifiers bypass the
+        catalog."""
+        from delta_tpu.catalog.catalog import resolve_identifier
+
+        return cls.for_path(resolve_identifier(name, catalog))
+
+    @classmethod
     def is_delta_table(cls, path: str) -> bool:
+        """``DeltaTable.isDeltaTable :726``; unreadable paths are False."""
         try:
             return DeltaLog.for_table(path).table_exists
         except Exception:
@@ -63,23 +73,34 @@ class DeltaTable:
         return cls(log)
 
     @classmethod
-    def create(cls, path: str, schema: StructType,
+    def create(cls, path: str, schema: Optional[StructType] = None,
                partition_columns: Sequence[str] = (),
-               configuration: Optional[Dict[str, str]] = None) -> "DeltaTable":
-        """CREATE TABLE with an explicit schema and no data
-        (`CreateDeltaTableCommand` for the empty-CTAS case)."""
-        from delta_tpu.expr.vectorized import arrow_type_for
+               configuration: Optional[Dict[str, str]] = None,
+               data: Any = None, mode: str = "create") -> "DeltaTable":
+        """CREATE [OR REPLACE] TABLE [AS SELECT] (`commands/create.py` ≈
+        `CreateDeltaTableCommand.scala`). ``mode`` is one of ``create``,
+        ``create_if_not_exists``, ``replace``, ``create_or_replace``;
+        ``data`` makes it a CTAS."""
+        from delta_tpu.commands.create import CreateDeltaTableCommand
 
-        empty = pa.schema(
-            [pa.field(f.name, arrow_type_for(f.data_type), f.nullable)
-             for f in schema.fields]
-        ).empty_table()
         log = DeltaLog.for_table(path)
-        WriteIntoDelta(
-            log, "errorifexists", empty,
+        CreateDeltaTableCommand(
+            log, schema=schema, mode=mode,
             partition_columns=partition_columns, configuration=configuration,
+            data=data,
         ).run()
         return cls(log)
+
+    @classmethod
+    def replace(cls, path: str, schema: Optional[StructType] = None,
+                partition_columns: Sequence[str] = (),
+                configuration: Optional[Dict[str, str]] = None,
+                data: Any = None, or_create: bool = False) -> "DeltaTable":
+        """REPLACE TABLE / CREATE OR REPLACE TABLE [AS SELECT]."""
+        return cls.create(
+            path, schema, partition_columns, configuration, data,
+            mode="create_or_replace" if or_create else "replace",
+        )
 
     # -- reads ------------------------------------------------------------
 

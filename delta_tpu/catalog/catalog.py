@@ -1,0 +1,170 @@
+"""Name-based table catalog.
+
+The reference resolves table *names* through Spark's DSv2 catalog plugin
+(`catalog/DeltaCatalog.scala:57`, `DeltaTableV2.scala:50`), backed by a
+metastore. This engine has no metastore; the equivalent is a small
+name→path registry with optional JSON-file persistence, giving the API
+surface (`DeltaTable.for_name`, CREATE/DROP by name) without path-typing
+every call site.
+
+Identifiers are case-insensitive, optionally qualified (``db.table``; the
+default database is ``default``). ``delta.`/abs/path``` identifiers resolve
+directly to paths, mirroring the reference's path-table escape hatch
+(`DeltaTableIdentifier.scala`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Sequence
+
+from delta_tpu.utils.config import conf
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["Catalog", "default_catalog", "resolve_identifier"]
+
+
+def _normalize(name: str) -> str:
+    parts = [p.strip().strip("`") for p in name.split(".")]
+    if len(parts) == 1:
+        parts = ["default"] + parts
+    if len(parts) != 2 or not all(parts):
+        raise DeltaAnalysisError(f"Invalid table identifier: {name!r}")
+    return ".".join(p.lower() for p in parts)
+
+
+class Catalog:
+    """name → path registry; optionally persisted as a JSON file so
+    multiple processes share one namespace."""
+
+    def __init__(self, store_path: Optional[str] = None):
+        self._store_path = store_path
+        self._tables: Dict[str, str] = {}
+        self._lock = threading.RLock()
+        if store_path and os.path.exists(store_path):
+            self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self._store_path) as f:
+                data = json.load(f)
+            self._tables = dict(data.get("tables", {}))
+        except (OSError, json.JSONDecodeError):
+            self._tables = {}
+
+    def _save(self) -> None:
+        if not self._store_path:
+            return
+        os.makedirs(os.path.dirname(self._store_path) or ".", exist_ok=True)
+        tmp = self._store_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"tables": self._tables}, f, indent=1, sort_keys=True)
+        os.replace(tmp, self._store_path)
+
+    # -- registry ---------------------------------------------------------
+
+    def register(self, name: str, path: str) -> None:
+        """Point ``name`` at an existing table location (external table)."""
+        key = _normalize(name)
+        with self._lock:
+            if self._store_path:
+                self._load()
+            if key in self._tables:
+                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
+            self._tables[key] = os.path.abspath(path)
+            self._save()
+
+    def create_table(self, name: str, path: str, schema=None,
+                     partition_columns: Sequence[str] = (),
+                     configuration=None, data=None, mode: str = "create"):
+        """CREATE TABLE by name: registers the identifier and runs the
+        create command at ``path`` (`DeltaCatalog.createTable :183`)."""
+        from delta_tpu.api.tables import DeltaTable
+
+        key = _normalize(name)
+        with self._lock:
+            if self._store_path:
+                self._load()
+            existing = self._tables.get(key)
+            if existing is not None and mode == "create":
+                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
+            table = DeltaTable.create(
+                path, schema, partition_columns, configuration, data, mode=mode
+            )
+            self._tables[key] = os.path.abspath(path)
+            self._save()
+        return table
+
+    def drop_table(self, name: str) -> None:
+        """Remove the name mapping (the data/log stay on disk, like dropping
+        an external table)."""
+        key = _normalize(name)
+        with self._lock:
+            if self._store_path:
+                self._load()
+            if key not in self._tables:
+                raise DeltaAnalysisError(f"Table {name!r} not found in catalog")
+            del self._tables[key]
+            self._save()
+
+    def table_path(self, name: str) -> str:
+        key = _normalize(name)
+        with self._lock:
+            if self._store_path:
+                self._load()
+            path = self._tables.get(key)
+        if path is None:
+            raise DeltaAnalysisError(f"Table {name!r} not found in catalog")
+        return path
+
+    def table_exists(self, name: str) -> bool:
+        try:
+            self.table_path(name)
+            return True
+        except DeltaAnalysisError:
+            return False
+
+    def load_table(self, name: str):
+        from delta_tpu.api.tables import DeltaTable
+
+        return DeltaTable.for_path(self.table_path(name))
+
+    def list_tables(self, database: str = "default"):
+        with self._lock:
+            if self._store_path:
+                self._load()
+            prefix = database.lower() + "."
+            return sorted(
+                k[len(prefix):] for k in self._tables if k.startswith(prefix)
+            )
+
+
+_default: Optional[Catalog] = None
+_default_lock = threading.Lock()
+
+
+def default_catalog() -> Catalog:
+    """Process-default catalog; persists to ``delta.tpu.catalog.path`` when
+    that conf is set, else stays in-memory."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Catalog(conf.get("delta.tpu.catalog.path"))
+        return _default
+
+
+def reset_default_catalog() -> None:
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def resolve_identifier(identifier: str, catalog: Optional[Catalog] = None) -> str:
+    """``delta.`/path``` → the path; anything else → catalog lookup."""
+    ident = identifier.strip()
+    if ident.lower().startswith("delta.`") and ident.endswith("`"):
+        return ident[len("delta.`"):-1]
+    return (catalog or default_catalog()).table_path(ident)

@@ -274,7 +274,7 @@ class MergeIntoCommand:
                 removes.append(candidates[fid].remove())
             # matched block → per-clause masks
             upd, n_updated, n_deleted, n_pair_copied = self._apply_matched(
-                matched_pairs, target_cols
+                matched_pairs, target_cols, metadata
             )
             n_copied += n_pair_copied
             if upd is not None:
@@ -476,7 +476,7 @@ class MergeIntoCommand:
         mesh = state_mesh() if len(jax.devices()) > 1 else None
         res = join_kernel.inner_join(t_keys, t_ok, s_keys, s_ok, mesh=mesh)
         self._device_join = res
-        matched = np.nonzero(res.t_count > 0)[0]
+        matched = np.nonzero(res.t_matched)[0]
         joined = target.take(pa.array(matched, pa.int64()))
         s_taken = src.take(pa.array(res.t_first_s[matched], pa.int64()))
         for name in s_taken.column_names:
@@ -492,7 +492,7 @@ class MergeIntoCommand:
             and self.matched_clauses[0].condition is None
         )
         if self._device_join is not None:
-            if not single_delete and self._device_join.max_count > 1:
+            if not single_delete and self._device_join.any_multi:
                 raise DeltaUnsupportedOperationError(
                     "Cannot perform Merge as multiple source rows matched and "
                     "attempted to modify the same target row in the Delta table "
@@ -513,7 +513,7 @@ class MergeIntoCommand:
 
     # -- clause application ------------------------------------------------
 
-    def _apply_matched(self, pairs: pa.Table, target_cols: List[str]):
+    def _apply_matched(self, pairs: pa.Table, target_cols: List[str], metadata):
         """Matched block: rows claimed by update clauses are projected, by
         delete clauses dropped, unclaimed pairs copy the target row."""
         if pairs.num_rows == 0 or not self.matched_clauses:
@@ -532,7 +532,9 @@ class MergeIntoCommand:
             if count:
                 block = pairs.filter(fire)
                 if clause.kind == "update":
-                    out_parts.append(self._project_update(block, clause, target_cols))
+                    out_parts.append(
+                        self._project_update(block, clause, target_cols, metadata)
+                    )
                     n_updated += count
                 else:
                     # count distinct target ROWS, not pairs: a single
@@ -560,7 +562,7 @@ class MergeIntoCommand:
         return self._resolve(e, tgt_cols, src_cols)
 
     def _project_update(self, block: pa.Table, clause: MergeClause,
-                        target_cols: List[str]) -> pa.Table:
+                        target_cols: List[str], metadata) -> pa.Table:
         src_cols = [c[len(_SRC):] for c in block.column_names if c.startswith(_SRC)]
         if clause.is_star:
             # updateAll: SET t.c = s.c for every target column present in source
@@ -586,7 +588,13 @@ class MergeIntoCommand:
             else:
                 new = evaluate(e, block)
                 cols.append(pc.cast(new, block.column(c).type, safe=False))
-        return pa.table(cols, names=target_cols)
+        out = pa.table(cols, names=target_cols)
+        # recompute generated columns whose referenced base columns were
+        # assigned (stale copies fail write-time checks); uses the txn's
+        # metadata, the same schema the rest of the merge writes against
+        from delta_tpu.schema import generated as generated_mod
+
+        return generated_mod.recompute_stale(out, metadata.schema, list(assignments))
 
     def _apply_not_matched(self, pairs: pa.Table, src: pa.Table,
                            target_cols: List[str], source_cols: List[str], metadata):
@@ -633,7 +641,13 @@ class MergeIntoCommand:
                         col.split(".")[-1]: self._resolve(e, [], source_cols)
                         for col, e in clause.assignments.items()
                     }
-                cols = []
+                from delta_tpu.schema import generated as generated_mod
+
+                gen_cols = {
+                    c.lower()
+                    for c in generated_mod.generation_expressions(metadata.schema)
+                }
+                cols, names = [], []
                 for f in metadata.schema.fields:
                     e = None
                     for k, v in assignments.items():
@@ -642,10 +656,17 @@ class MergeIntoCommand:
                             break
                     at = arrow_type_for(f.data_type)
                     if e is None:
+                        # unassigned generated columns are computed from the
+                        # built row, not nulled (GeneratedColumn.scala:267)
+                        if f.name.lower() in gen_cols:
+                            continue
                         cols.append(pa.nulls(block.num_rows, at))
                     else:
                         cols.append(pc.cast(evaluate(e, block), at, safe=False))
-                parts.append(pa.table(cols, names=target_cols))
+                    names.append(f.name)
+                part = pa.table(cols, names=names)
+                part = generated_mod.compute_on_write(part, metadata.schema)
+                parts.append(part.select(target_cols))
                 n_inserted += count
             unclaimed = pc.and_(unclaimed, pc.invert(fire))
         out = pa.concat_tables(parts, promote_options="permissive") if parts else None

@@ -109,4 +109,11 @@ class UpdateCommand:
                     )
                 cols.append(pc.if_else(mask, new, old))
             names.append(name)
-        return pa.table(cols, names=names)
+        out = pa.table(cols, names=names)
+        # generated columns whose referenced base columns were assigned must
+        # be recomputed, not copied (stale values fail write-time checks)
+        from delta_tpu.schema import generated as generated_mod
+
+        return generated_mod.recompute_stale(
+            out, metadata.schema, list(self.set_exprs), mask=mask
+        )

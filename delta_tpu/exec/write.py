@@ -157,6 +157,11 @@ def write_files(
     """Write a normalized batch as partitioned Parquet; return AddFiles."""
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
+    # generated columns: compute the missing, verify the provided — must see
+    # the batch before normalize_data turns missing columns into nulls
+    from delta_tpu.schema import generated as generated_mod
+
+    table = generated_mod.compute_on_write(table, schema)
     table = normalize_data(table, schema)
     if constraints is None:
         constraints = constraints_mod.from_metadata(metadata)

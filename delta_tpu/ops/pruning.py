@@ -27,6 +27,7 @@ from delta_tpu.expr import ir
 from delta_tpu.expr import partition as partition_expr
 from delta_tpu.protocol.actions import AddFile, Metadata
 from delta_tpu.ops import state_export
+from delta_tpu.utils.config import conf
 
 __all__ = ["DataSize", "DeltaScan", "skipping_predicate", "prune_files", "files_for_scan"]
 
@@ -224,7 +225,12 @@ def prune_files(
     pcols = frozenset(c.lower() for c in metadata.partition_columns)
     pred = skipping_predicate(ir.and_all(list(data_filters)), pcols)
     keep: Optional[np.ndarray] = None
-    if prefer_device:
+    # The device path pays a dispatch + transfer per scan; below a few
+    # thousand files the vectorized host evaluator finishes before a single
+    # device round-trip even on PCIe-attached chips, so route small file
+    # lists to the host (delta.tpu.device.pruning.minFiles to tune).
+    min_files = int(conf.get("delta.tpu.device.pruning.minFiles", 4096))
+    if prefer_device and len(files) >= min_files:
         arrays = state_export.files_to_arrays(files, metadata)
         keep = _prune_device(arrays, pred)
     if keep is None:

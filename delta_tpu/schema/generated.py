@@ -1,0 +1,201 @@
+"""Generated columns — compute-on-write + validation.
+
+Mirrors `GeneratedColumn.scala:79-365`: a column whose value is computed
+from other columns via an expression stored in its field metadata under
+``delta.generationExpression``; gated on writer version 4 (the protocol
+bump lives in `txn/transaction.py`). On write (`exec/write.py`):
+
+* column missing from the batch → computed from the expression;
+* column provided → verified null-safe-equal to the computed value
+  (the reference emits an equivalent CHECK constraint, `:267`).
+
+The determinism whitelist (`SupportedGenerationExpressions.scala`) is the
+expression IR itself: every node the parser can produce — arithmetic,
+comparisons, CASE, casts, and the fixed `ir.Func.FUNCS` scalar set — is
+deterministic, so "parses into IR" = "whitelisted". Validation adds the
+structural rules: references must exist and must not be generated columns
+themselves (no chains, no self-reference).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression
+from delta_tpu.expr.vectorized import arrow_type_for, evaluate
+from delta_tpu.schema.types import DataType, StructField, StructType
+from delta_tpu.utils.errors import DeltaAnalysisError, InvariantViolationError
+
+__all__ = [
+    "GENERATION_EXPRESSION_KEY",
+    "generated_field",
+    "generation_expressions",
+    "has_generated_columns",
+    "validate_generated_columns",
+    "compute_on_write",
+    "columns_to_recompute",
+]
+
+GENERATION_EXPRESSION_KEY = "delta.generationExpression"
+
+
+def generated_field(
+    name: str, data_type: DataType, expr_sql: str, nullable: bool = True
+) -> StructField:
+    """Build a StructField carrying a generation expression (DDL helper)."""
+    return StructField(
+        name, data_type, nullable, metadata={GENERATION_EXPRESSION_KEY: expr_sql}
+    )
+
+
+def generation_expressions(schema: StructType) -> Dict[str, ir.Expression]:
+    """column name → parsed generation expression (whitelist-enforced: the
+    parser only produces deterministic IR; unknown functions raise)."""
+    out: Dict[str, ir.Expression] = {}
+    for f in schema.fields:
+        sql = (f.metadata or {}).get(GENERATION_EXPRESSION_KEY)
+        if sql is not None:
+            try:
+                out[f.name] = parse_expression(sql)
+            except DeltaAnalysisError as e:
+                raise DeltaAnalysisError(
+                    f"Invalid generation expression for column {f.name!r}: {e}"
+                ) from e
+    return out
+
+
+def has_generated_columns(schema: StructType) -> bool:
+    return any(
+        GENERATION_EXPRESSION_KEY in (f.metadata or {}) for f in schema.fields
+    )
+
+
+def validate_generated_columns(schema: StructType) -> None:
+    """Structural rules (`GeneratedColumn.scala` validateGeneratedColumns):
+    expressions parse, references exist, and no generated column references
+    another generated column (or itself)."""
+    exprs = generation_expressions(schema)
+    names = {f.name.lower() for f in schema.fields}
+    gen_names = {c.lower() for c in exprs}
+    for col, e in exprs.items():
+        for r in ir.references(e):
+            rl = r.lower()
+            if rl not in names:
+                raise DeltaAnalysisError(
+                    f"Generation expression for {col!r} references unknown "
+                    f"column {r!r}"
+                )
+            if rl in gen_names:
+                raise DeltaAnalysisError(
+                    f"Generation expression for {col!r} references generated "
+                    f"column {r!r}; generated columns cannot reference each other"
+                )
+
+
+def _computed(col_name: str, e: ir.Expression, table: pa.Table,
+              dtype: DataType) -> pa.ChunkedArray:
+    vals = evaluate(e, table)
+    at = arrow_type_for(dtype)
+    if vals.type != at:
+        try:
+            vals = pc.cast(vals, at)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as exc:
+            raise DeltaAnalysisError(
+                f"Generation expression for {col_name!r} produces type "
+                f"{vals.type}, which cannot become declared type {at}: {exc}"
+            )
+    return vals
+
+
+def compute_on_write(table: pa.Table, schema: StructType) -> pa.Table:
+    """Fill in missing generated columns; verify provided ones match.
+
+    Must run *before* ``normalize_data`` (which turns missing columns into
+    nulls, losing provided-ness)."""
+    exprs = generation_expressions(schema)
+    if not exprs:
+        return table
+    lower_present = {c.lower() for c in table.column_names}
+    by_lower = {f.name.lower(): f for f in schema.fields}
+    # a batch may legally omit a nullable base column the expressions
+    # reference (normalize_data null-fills it later) — null-fill it here
+    # first so generation expressions compute over NULLs instead of failing
+    for f in schema.fields:
+        if f.name.lower() in lower_present or f.name.lower() in {
+            c.lower() for c in exprs
+        }:
+            continue
+        table = table.append_column(
+            pa.field(f.name, arrow_type_for(f.data_type), True),
+            pa.nulls(table.num_rows, arrow_type_for(f.data_type)),
+        )
+        lower_present.add(f.name.lower())
+    for col, e in exprs.items():
+        f = by_lower[col.lower()]
+        if col.lower() not in lower_present:
+            table = table.append_column(
+                pa.field(col, arrow_type_for(f.data_type), f.nullable),
+                _computed(col, e, table, f.data_type),
+            )
+        else:
+            provided = None
+            for c in table.column_names:
+                if c.lower() == col.lower():
+                    provided = table.column(c)
+                    break
+            expected = _computed(col, e, table, f.data_type)
+            if provided.type != expected.type:
+                provided = pc.cast(provided, expected.type)
+            # null-safe equality: values equal, or both NULL
+            eq = pc.fill_null(pc.equal(provided, expected), False)
+            both_null = pc.and_(pc.is_null(provided), pc.is_null(expected))
+            ok = pc.or_(eq, both_null)
+            bad = pc.sum(pc.invert(ok)).as_py() or 0
+            if bad:
+                raise InvariantViolationError(
+                    f"CHECK constraint Generated Column ({col} <=> {e.sql()}) "
+                    f"violated by {bad} row(s): provided values do not match "
+                    "the generation expression"
+                )
+    return table
+
+
+def recompute_stale(
+    table: pa.Table, schema: StructType, assigned: List[str], mask=None
+) -> pa.Table:
+    """Recompute generated columns whose referenced base columns appear in
+    ``assigned`` (an UPDATE / MERGE-update's SET targets) over ``table``;
+    rows where ``mask`` is false keep their existing values. Stale copies
+    would fail the write-time verification in :func:`compute_on_write`."""
+    stale = columns_to_recompute(schema, assigned)
+    if not stale:
+        return table
+    exprs = generation_expressions(schema)
+    by_lower = {f.name.lower(): f for f in schema.fields}
+    for col in stale:
+        f = by_lower[col.lower()]
+        actual = next(c for c in table.column_names if c.lower() == col.lower())
+        new = pc.cast(evaluate(exprs[col], table), table.column(actual).type)
+        if mask is not None:
+            new = pc.if_else(mask, new, table.column(actual))
+        i = table.column_names.index(actual)
+        table = table.set_column(i, pa.field(actual, new.type, f.nullable), new)
+    return table
+
+
+def columns_to_recompute(schema: StructType, assigned: List[str]) -> List[str]:
+    """Generated columns whose references intersect ``assigned`` (an UPDATE /
+    MERGE-update's SET targets) and which were not explicitly assigned —
+    these must be recomputed, not copied, or write-time verification would
+    reject the stale values."""
+    assigned_low = {a.lower() for a in assigned}
+    out = []
+    for col, e in generation_expressions(schema).items():
+        if col.lower() in assigned_low:
+            continue
+        if any(r.lower() in assigned_low for r in ir.references(e)):
+            out.append(col)
+    return out

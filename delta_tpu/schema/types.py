@@ -203,6 +203,10 @@ class StructType(DataType):
         self.fields.append(StructField(name, data_type, nullable, dict(metadata or {})))
         return self
 
+    def add_field(self, field: "StructField") -> "StructType":
+        self.fields.append(field)
+        return self
+
     @property
     def field_names(self) -> List[str]:
         return [f.name for f in self.fields]

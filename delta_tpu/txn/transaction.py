@@ -137,6 +137,9 @@ class OptimisticTransaction:
         if metadata.schema_string is not None:
             schema_utils.check_column_names(metadata.schema)
             schema_utils.check_partition_columns(metadata.partition_columns, metadata.schema)
+            from delta_tpu.schema import generated as generated_mod
+
+            generated_mod.validate_generated_columns(metadata.schema)
         cfg = DeltaConfigs.validate_configuration(metadata.configuration)
         metadata = replace(metadata, configuration=cfg)
         # keep table id stable across metadata updates

@@ -69,6 +69,9 @@ class SqlConf:
         "delta.tpu.mesh.axis": "shards",
         # Use the JAX device path for scan planning / pruning when possible.
         "delta.tpu.device.pruning": True,
+        # Below this many candidate files, stats skipping runs on the host
+        # (one device round-trip costs more than the whole numpy pass).
+        "delta.tpu.device.pruning.minFiles": 4096,
         # ≈ DELTA_CONVERT_METADATA_CHECK_ENABLED and misc
         "delta.tpu.import.batchSize.statsCollection": 50_000,
         # partition-dir listing parallelism for vacuum/convert

@@ -1,0 +1,174 @@
+"""CREATE / REPLACE / CTAS command (reference spec:
+``DeltaTableCreationTests``, 1,923 LoC core cases) and the name catalog."""
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.create import CreateDeltaTableCommand
+from delta_tpu.exec.scan import scan_to_table
+from delta_tpu.schema.types import IntegerType, LongType, StringType, StructType
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+SCHEMA = StructType().add("id", LongType()).add("v", StringType())
+
+
+def test_create_empty_table(tmp_table):
+    t = DeltaTable.create(tmp_table, SCHEMA, configuration={"delta.appendOnly": "false"})
+    snap = t.delta_log.update()
+    assert snap.version == 0
+    assert snap.metadata.schema.to_json() == SCHEMA.to_json()
+    assert snap.all_files == []
+    h = t.delta_log.history.get_history()
+    assert h[0].operation == "CREATE TABLE"
+
+
+def test_create_existing_errors(tmp_table):
+    DeltaTable.create(tmp_table, SCHEMA)
+    with pytest.raises(DeltaAnalysisError, match="already exists"):
+        DeltaTable.create(tmp_table, SCHEMA)
+
+
+def test_create_if_not_exists_noop_when_matching(tmp_table):
+    DeltaTable.create(tmp_table, SCHEMA)
+    v = DeltaTable.create(tmp_table, SCHEMA, mode="create_if_not_exists")
+    assert v.delta_log.snapshot.version == 0
+
+
+def test_create_if_not_exists_schema_mismatch_errors(tmp_table):
+    DeltaTable.create(tmp_table, SCHEMA)
+    other = StructType().add("x", IntegerType())
+    with pytest.raises(DeltaAnalysisError, match="does not match"):
+        DeltaTable.create(tmp_table, other, mode="create_if_not_exists")
+
+
+def test_create_if_not_exists_partitioning_mismatch_errors(tmp_table):
+    DeltaTable.create(tmp_table, SCHEMA, partition_columns=["v"])
+    with pytest.raises(DeltaAnalysisError, match="partitioning"):
+        DeltaTable.create(tmp_table, SCHEMA, partition_columns=["id"],
+                          mode="create_if_not_exists")
+
+
+def test_ctas_one_commit(tmp_table):
+    data = pa.table({"id": [1, 2], "v": ["a", "b"]})
+    t = DeltaTable.create(tmp_table, data=data)
+    snap = t.delta_log.update()
+    assert snap.version == 0  # metadata + files in ONE commit
+    assert len(snap.all_files) >= 1
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2]
+    h = t.delta_log.history.get_history()
+    assert h[0].operation == "CREATE TABLE AS SELECT"
+
+
+def test_replace_requires_existing(tmp_table):
+    with pytest.raises(DeltaAnalysisError, match="REPLACE requires"):
+        DeltaTable.replace(tmp_table, SCHEMA)
+
+
+def test_create_or_replace_fresh(tmp_table):
+    t = DeltaTable.replace(tmp_table, SCHEMA, or_create=True)
+    assert t.delta_log.snapshot.version == 0
+
+
+def test_replace_swaps_schema_and_drops_files_atomically(tmp_table):
+    t = DeltaTable.create(tmp_table, data=pa.table({"id": [1, 2], "v": ["a", "b"]}))
+    new_schema = StructType().add("x", LongType())
+    t2 = DeltaTable.replace(tmp_table, new_schema,
+                            data=pa.table({"x": [10]}))
+    snap = t2.delta_log.update()
+    assert snap.version == 1  # one commit for the whole replace
+    assert snap.metadata.schema.field_names == ["x"]
+    assert scan_to_table(snap).to_pylist() == [{"x": 10}]
+    h = t2.delta_log.history.get_history()
+    assert h[0].operation == "REPLACE TABLE AS SELECT"
+    # old data files are tombstoned, not orphaned
+    assert len(snap.tombstones) >= 1
+
+
+def test_replace_keeps_table_id(tmp_table):
+    t = DeltaTable.create(tmp_table, SCHEMA)
+    tid = t.delta_log.update().metadata.id
+    DeltaTable.replace(tmp_table, StructType().add("x", LongType()))
+    assert DeltaLog.for_table(tmp_table).update().metadata.id == tid
+
+
+def test_create_requires_schema_or_data(tmp_table):
+    with pytest.raises(DeltaAnalysisError, match="schema or data"):
+        CreateDeltaTableCommand(DeltaLog.for_table(tmp_table)).run()
+
+
+def test_create_partitioned_ctas(tmp_table):
+    data = pa.table({"id": [1, 2, 3], "p": ["a", "a", "b"]})
+    t = DeltaTable.create(tmp_table, data=data, partition_columns=["p"])
+    snap = t.delta_log.update()
+    assert snap.metadata.partition_columns == ["p"]
+    assert sorted(t.to_arrow(filters=["p = 'a'"]).column("id").to_pylist()) == [1, 2]
+
+
+# -- name catalog (≈ DeltaCatalog.scala:57) ---------------------------------
+
+
+def test_catalog_create_load_drop(tmp_path):
+    from delta_tpu.catalog.catalog import Catalog
+
+    cat = Catalog()
+    data = pa.table({"id": [1, 2]})
+    cat.create_table("db1.sales", str(tmp_path / "sales"), data=data)
+    assert cat.table_exists("db1.sales")
+    assert cat.table_exists("DB1.SALES")  # case-insensitive
+    t = cat.load_table("db1.sales")
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2]
+    assert cat.list_tables("db1") == ["sales"]
+    cat.drop_table("db1.sales")
+    assert not cat.table_exists("db1.sales")
+    # dropping is external-table style: the data survives on disk
+    assert DeltaTable.is_delta_table(str(tmp_path / "sales"))
+
+
+def test_catalog_duplicate_name_errors(tmp_path):
+    from delta_tpu.catalog.catalog import Catalog
+
+    cat = Catalog()
+    cat.create_table("t", str(tmp_path / "a"), SCHEMA)
+    with pytest.raises(DeltaAnalysisError, match="already exists"):
+        cat.create_table("t", str(tmp_path / "b"), SCHEMA)
+
+
+def test_catalog_persistence(tmp_path):
+    from delta_tpu.catalog.catalog import Catalog
+
+    store = str(tmp_path / "catalog.json")
+    cat = Catalog(store)
+    cat.create_table("t", str(tmp_path / "t"), SCHEMA)
+    cat2 = Catalog(store)  # fresh instance sees the registration
+    assert cat2.table_exists("t")
+    assert cat2.table_path("t") == str(tmp_path / "t")
+
+
+def test_for_name_and_path_identifier(tmp_path):
+    from delta_tpu.catalog.catalog import Catalog
+    from delta_tpu.utils.config import conf
+    from delta_tpu.catalog import catalog as cat_mod
+
+    store = str(tmp_path / "cat.json")
+    with conf.set_temporarily(**{"delta.tpu.catalog.path": store}):
+        cat_mod.reset_default_catalog()
+        cat_mod.default_catalog().create_table(
+            "people", str(tmp_path / "people"), data=pa.table({"id": [7]})
+        )
+        t = DeltaTable.for_name("people")
+        assert t.to_arrow().column("id").to_pylist() == [7]
+        # delta.`path` escape hatch
+        t2 = DeltaTable.for_name(f"delta.`{tmp_path / 'people'}`")
+        assert t2.to_arrow().column("id").to_pylist() == [7]
+    cat_mod.reset_default_catalog()
+
+
+def test_register_external_table(tmp_path):
+    from delta_tpu.catalog.catalog import Catalog
+
+    path = str(tmp_path / "ext")
+    DeltaTable.create(path, data=pa.table({"id": [9]}))
+    cat = Catalog()
+    cat.register("ext", path)
+    assert cat.load_table("ext").to_arrow().column("id").to_pylist() == [9]
