@@ -105,23 +105,37 @@ def replay_alive_mask(arrays: ReplayArrays, min_retention_ts: int = 0) -> Replay
     return ReplayResult(alive[:n], tombstone[:n], stats)
 
 
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer: decorrelates shard choice from path-id locality
+    (sequential dictionary codes would otherwise stripe shards unevenly
+    whenever n_shards shares factors with the id assignment pattern)."""
+    z = x.astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
 def _bucket_by_path(arrays: ReplayArrays, n_shards: int):
-    """Host-side bucketing: row → shard ``path_id % n_shards`` (every action
-    for a path lands on one shard), padded to equal per-shard length. Returns
-    stacked (n_shards, cap) arrays + the row permutation for unscattering."""
-    bucket = arrays.path_id.astype(np.int64) % n_shards
+    """Host-side bucketing: row → shard ``mix(path_id) % n_shards`` (every
+    action for a path lands on one shard), padded to equal per-shard length.
+    Fully vectorized — one argsort + one scatter per column, no Python loop
+    over shards (a true single-path hot spot still cannot be split: replay
+    correctness requires a path's whole history on one shard; the mixer only
+    protects against accidental clustering). Returns stacked (n_shards, cap)
+    arrays + the flat destination map for unscattering."""
+    bucket = (_mix64(arrays.path_id) % np.uint64(n_shards)).astype(np.int64)
     order = np.argsort(bucket, kind="stable")
     counts = np.bincount(bucket, minlength=n_shards)
     cap = _next_pow2(int(counts.max()) if len(counts) else 1)
+    # position of each (ordered) row within its shard slab
+    starts = np.cumsum(counts) - counts
+    within = np.arange(len(order), dtype=np.int64) - np.repeat(starts, counts)
+    dest = bucket[order] * cap + within  # flat index into (n_shards*cap)
 
     def stack(col, fill):
-        out = np.full((n_shards, cap), fill, dtype=col.dtype)
-        start = 0
-        for s in range(n_shards):
-            c = counts[s]
-            out[s, :c] = col[order[start : start + c]]
-            start += c
-        return out
+        out = np.full(n_shards * cap, fill, dtype=col.dtype)
+        out[dest] = col[order]
+        return out.reshape(n_shards, cap)
 
     cols = (
         stack(arrays.path_id, np.int32(-1)),
@@ -130,7 +144,7 @@ def _bucket_by_path(arrays: ReplayArrays, n_shards: int):
         stack(arrays.size, np.int64(0)),
         stack(arrays.deletion_timestamp, np.int64(0)),
     )
-    return cols, order, counts, cap
+    return cols, order, dest
 
 
 def replay_sharded(
@@ -143,7 +157,7 @@ def replay_sharded(
     state counts are reduced with `psum` over ICI.
     """
     n = shard_count(mesh)
-    (path_id, seq, is_add, size, del_ts), order, counts, cap = _bucket_by_path(arrays, n)
+    (path_id, seq, is_add, size, del_ts), order, dest = _bucket_by_path(arrays, n)
 
     @functools.partial(
         shard_map,
@@ -166,17 +180,11 @@ def replay_sharded(
             path_id, seq, is_add, size, del_ts
         )
 
-    # Unscatter: stacked (n, cap) → original row order.
-    alive_np = np.asarray(alive_sh)
-    tomb_np = np.asarray(tomb_sh)
+    # Unscatter: stacked (n, cap) → original row order, one gather each.
     alive = np.zeros(arrays.num_rows, bool)
     tombstone = np.zeros(arrays.num_rows, bool)
-    start = 0
-    for s in range(n):
-        c = counts[s]
-        alive[order[start : start + c]] = alive_np[s, :c]
-        tombstone[order[start : start + c]] = tomb_np[s, :c]
-        start += c
+    alive[order] = np.asarray(alive_sh).reshape(-1)[dest]
+    tombstone[order] = np.asarray(tomb_sh).reshape(-1)[dest]
     return ReplayResult(
         jnp.asarray(alive),
         jnp.asarray(tombstone),
