@@ -1,0 +1,100 @@
+"""SQL tokenizer for the Delta statement front end.
+
+The reference parses its statements with a real ANTLR grammar
+(`antlr4/.../DeltaSqlBase.g4`); the round-1 regex matcher mis-parsed quoted
+strings containing keywords, comments, and newlines. This lexer produces a
+proper token stream — with source offsets, so embedded expressions (WHERE /
+SET / CHECK bodies) can be sliced out verbatim for the expression parser.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from delta_tpu.utils.errors import DeltaParseError
+
+__all__ = ["Token", "tokenize"]
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # WORD | QUOTED_IDENT | STRING | NUMBER | PUNCT | END
+    value: str  # normalized text (keywords upper-cased via .upper() at use)
+    start: int  # offset of first char in source
+    end: int  # offset past last char
+
+    def is_word(self, *words: str) -> bool:
+        return self.kind == "WORD" and self.value.upper() in words
+
+
+_PUNCT = set("(),.=*<>!+-/%;")
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise DeltaParseError("Unterminated block comment")
+            i = j + 2
+            continue
+        if c == "`":
+            j = i + 1
+            while j < n and sql[j] != "`":
+                j += 1
+            if j >= n:
+                raise DeltaParseError("Unterminated backquoted identifier")
+            out.append(Token("QUOTED_IDENT", sql[i + 1 : j], i, j + 1))
+            i = j + 1
+            continue
+        if c in "'\"":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == c:
+                    if j + 1 < n and sql[j + 1] == c:  # doubled-quote escape
+                        buf.append(c)
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            if j >= n:
+                raise DeltaParseError("Unterminated string literal")
+            out.append(Token("STRING", "".join(buf), i, j + 1))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            while j < n and (sql[j].isdigit() or sql[j] in ".eE+-"):
+                # stop a trailing +/- that isn't an exponent sign
+                if sql[j] in "+-" and sql[j - 1] not in "eE":
+                    break
+                j += 1
+            out.append(Token("NUMBER", sql[i:j], i, j))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            out.append(Token("WORD", sql[i:j], i, j))
+            i = j
+            continue
+        if c in _PUNCT:
+            out.append(Token("PUNCT", c, i, i + 1))
+            i += 1
+            continue
+        raise DeltaParseError(f"Unexpected character {c!r} at offset {i}")
+    out.append(Token("END", "", n, n))
+    return out
