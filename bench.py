@@ -304,78 +304,100 @@ def bench_streaming_tail(workdir):
 # -- config 5: checkpoint replay, 10k versions -------------------------------
 
 
-def bench_checkpoint_replay():
-    import jax
+def bench_checkpoint_replay(workdir):
+    """End-to-end snapshot state reconstruction from a cold on-disk log:
+    checkpoint Parquet at the midpoint + a JSON commit tail, both paths
+    reading the same files. Device path = columnar decode (log/columnar.py)
+    + the slim winner kernel; baseline = the reference-shaped sequential
+    object replay (checkpoint rows + per-line JSON decode into a dict)."""
+    from delta_tpu.log import checkpoints as ckpt_mod
+    from delta_tpu.log.columnar import decode_segment
+    from delta_tpu.ops import replay_kernel
+    from delta_tpu.protocol import filenames
+    from delta_tpu.protocol.actions import AddFile, action_from_json
+    from delta_tpu.storage.logstore import get_log_store
 
-    from delta_tpu.ops import replay_kernel, state_export
-    from delta_tpu.protocol.actions import action_from_json
-
-    n_versions, per_commit, n_paths = (
-        max(int(10_000 * SCALE), 500), 20, 50_000
-    )
+    n_versions, per_commit, n_paths = max(int(10_000 * SCALE), 500), 20, 50_000
+    ckpt_v = n_versions // 2
     rng = np.random.RandomState(7)
-    lines = []
+    log_path = os.path.join(workdir, "c5", "_delta_log")
+    store = get_log_store(log_path)
+
+    active = {}
     for v in range(n_versions):
-        for i in range(per_commit):
+        lines = []
+        for _ in range(per_commit):
             p = f"part-{rng.randint(n_paths):05d}-{v}.parquet"
             if rng.rand() < 0.85:
-                lines.append((v, json.dumps({"add": {
-                    "path": p, "partitionValues": {}, "size": int(rng.randint(1, 1 << 24)),
-                    "modificationTime": v, "dataChange": True}})))
+                sz = int(rng.randint(1, 1 << 24))
+                lines.append(json.dumps({"add": {
+                    "path": p, "partitionValues": {}, "size": sz,
+                    "modificationTime": v, "dataChange": True}}))
+                active[p] = sz
             else:
-                lines.append((v, json.dumps({"remove": {
-                    "path": p, "deletionTimestamp": v * 1000, "dataChange": True}})))
+                lines.append(json.dumps({"remove": {
+                    "path": p, "deletionTimestamp": v * 1000, "dataChange": True}}))
+                active.pop(p, None)
+        store.write(f"{log_path}/{filenames.delta_file(v)}", lines)
+        if v == ckpt_v:
+            ckpt_actions = [AddFile(path=p, size=s, modification_time=0,
+                                    data_change=False) for p, s in active.items()]
+            ckpt_mod.write_checkpoint(store, log_path, v, ckpt_actions)
+
+    ckpt_paths = [f"{log_path}/{filenames.checkpoint_file_single(ckpt_v)}"]
+    deltas = [f"{log_path}/{filenames.delta_file(v)}" for v in range(ckpt_v + 1, n_versions)]
 
     def host_end_to_end():
-        active = {}
-        for _v, line in lines:
-            a = action_from_json(line)
+        state = {}
+        for a in ckpt_mod.read_checkpoint_actions(store, ckpt_paths):
             d = a.__class__.__name__
             if d == "AddFile":
-                active[a.path] = a.size
-            elif d == "RemoveFile":
-                active.pop(a.path, None)
-        return len(active)
+                state[a.path] = a.size
+        for p in deltas:
+            for line in store.read_iter(p):
+                a = action_from_json(line)
+                d = a.__class__.__name__
+                if d == "AddFile":
+                    state[a.path] = a.size
+                elif d == "RemoveFile":
+                    state.pop(a.path, None)
+        return len(state)
 
     host_s, host_n = _timed(host_end_to_end)
+    assert host_n == len(active)
 
-    def decode():
-        by_version = {}
-        for v, line in lines:
-            by_version.setdefault(v, []).append(action_from_json(line))
-        return state_export.actions_to_arrays(sorted(by_version.items()))
+    phases = {}
 
     def device_end_to_end():
-        arrays = decode()
-        r = replay_kernel.replay_alive_mask(arrays)
-        jax.block_until_ready(r.alive)
+        t0 = time.perf_counter()
+        cols = decode_segment(store, ckpt_paths, deltas)
+        t1 = time.perf_counter()
+        r = replay_kernel.replay_columns(cols, min_retention_ts=0, device=True)
+        t2 = time.perf_counter()
+        phases["decode_ms"] = round((t1 - t0) * 1000, 1)
+        phases["device_winner_ms"] = round((t2 - t1) * 1000, 1)
         return int(r.stats.num_files)
 
-    # warm the jit cache, then measure end to end (decode included);
-    # min-of-3 damps tunnel-latency jitter on remote-attached chips
+    # warm the jit cache, then min-of-3 to damp tunnel-latency jitter
     device_end_to_end()
     runs = [_timed(device_end_to_end) for _ in range(3)]
     dev_s = min(s for s, _ in runs)
     dev_n = runs[0][1]
     assert host_n == dev_n, (host_n, dev_n)
 
-    # kernel-only (decode excluded) for the device-side picture
-    arrays = decode()
-    r = replay_kernel.replay_alive_mask(arrays)
-    jax.block_until_ready(r.alive)
-    k_s = min(
-        _timed(lambda: jax.block_until_ready(
-            replay_kernel.replay_alive_mask(arrays).alive))[0]
-        for _ in range(3)
-    )
+    # host-winner variant (no device round trip) for the breakdown
+    cols = decode_segment(store, ckpt_paths, deltas)
+    hw_s = min(_timed(lambda: replay_kernel.replay_columns(
+        cols, min_retention_ts=0, device=False))[0] for _ in range(3))
     return {
         "metric": "checkpoint_replay_10k_versions_200k_actions",
         "value": round(dev_s * 1000, 1),
         "unit": "ms",
         "vs_baseline": round(host_s / dev_s, 2),
-        "baseline": "sequential dict replay incl. JSON decode (decode "
-                    "dominates both paths)",
-        "kernel_only_ms": round(k_s * 1000, 2),
+        "baseline": "sequential object replay incl. checkpoint Parquet read "
+                    "+ per-line JSON decode (reference Snapshot.scala shape)",
+        "host_baseline_ms": round(host_s * 1000, 1),
+        "phases": dict(phases, host_winner_ms=round(hw_s * 1000, 2)),
     }
 
 
@@ -387,7 +409,7 @@ def main():
         "2": lambda: bench_merge_upsert(workdir),
         "3": lambda: bench_zorder_point_query(workdir),
         "4": lambda: bench_streaming_tail(workdir),
-        "5": bench_checkpoint_replay,
+        "5": lambda: bench_checkpoint_replay(workdir),
     }
     try:
         if only:

@@ -127,7 +127,7 @@ class DeltaLog:
                 if (
                     previous is not None
                     and previous.version >= 0
-                    and "_replay" in previous.__dict__
+                    and "_columnar" in previous.__dict__
                     and "metadata" in previous.__dict__
                 ):
                     prev_id = previous.metadata.id

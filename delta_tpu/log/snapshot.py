@@ -1,26 +1,29 @@
 """Snapshot: immutable table state at a version.
 
 Reference: ``Snapshot.scala:55-410``. The reference reconstructs state as a
-50-partition Spark Dataset replay; here state reconstruction has two paths:
+50-partition Spark Dataset replay of per-action JVM objects; here the
+reconstruction is **columnar end to end**:
 
-* **host path** (this module): stream checkpoint Parquet + delta JSON through
-  :class:`delta_tpu.log.replay.LogReplay` — exact, used for all transactional
-  decisions;
-* **device path** (``delta_tpu.ops.replay_kernel``): the AddFile metadata is
-  exported as fixed-width columns (:meth:`Snapshot.files_arrays`) and the
-  last-writer-wins reconciliation / pruning run as sharded JAX kernels over a
-  device mesh — used for scan planning and the checkpoint-replay benchmark.
+* the whole segment (checkpoint Parquet + delta JSON) decodes directly to
+  SoA columns in C++ (``delta_tpu.log.columnar``) — no per-action Python
+  object is ever built on this path;
+* last-writer-wins is one vectorized winner computation (host scatter, or
+  the device kernel ``delta_tpu.ops.replay_kernel`` for the sharded path);
+* :class:`AddFile` / :class:`RemoveFile` dataclasses are materialized
+  *lazily*, only for the rows a caller actually touches
+  (``Snapshot.all_files`` et al.).
+
+The object-per-action host replay (``delta_tpu.log.replay.LogReplay``)
+remains the correctness oracle and serves the small-N transactional paths.
 """
 from __future__ import annotations
 
-import json
-import time
 from functools import cached_property
-from typing import Any, Dict, List, Optional, Sequence, TYPE_CHECKING
+from typing import Any, Dict, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
-from delta_tpu.log.replay import LogReplay
-from delta_tpu.log import checkpoints as ckpt_mod
-from delta_tpu.protocol import filenames
+import numpy as np
+
+from delta_tpu.log.columnar import SegmentColumns, decode_segment
 from delta_tpu.protocol.actions import (
     Action,
     AddFile,
@@ -28,10 +31,8 @@ from delta_tpu.protocol.actions import (
     Protocol,
     RemoveFile,
     SetTransaction,
-    actions_from_lines,
 )
 from delta_tpu.storage.logstore import FileStatus, LogStore
-from delta_tpu.utils.errors import DeltaIllegalStateError
 from delta_tpu.utils.config import DeltaConfigs
 
 if TYPE_CHECKING:
@@ -111,87 +112,100 @@ class Snapshot:
         return self.delta_log.clock() - retention
 
     @cached_property
-    def _replay(self) -> LogReplay:
-        """Replay checkpoint + deltas (``Snapshot.scala:88-111``)."""
-        # Tombstone expiry needs metadata (retention conf) which itself comes
-        # from replay; do a first pass with retention 0 then compute cutoff.
-        replay = LogReplay(min_file_retention_timestamp=0)
-        ckpt_actions = self._checkpoint_actions()
-        if ckpt_actions:
-            base_version = self.segment.checkpoint_version
-            replay.current_version = base_version - 1 if base_version is not None else -1
-            replay.append(base_version if base_version is not None else 0, ckpt_actions)
-        for fs in self.segment.deltas:
-            v = filenames.delta_version(fs.name)
-            replay.append(v, actions_from_lines(self.store.read_iter(fs.path)))
-        if replay.current_version == -1 and self.version >= 0:
-            replay.current_version = self.version
-        return replay
-
-    def _checkpoint_actions(self) -> List[Action]:
-        if not self.segment.checkpoint_files:
-            return []
-        return ckpt_mod.read_checkpoint_actions(
-            self.store, [f.path for f in self.segment.checkpoint_files]
+    def _columnar(self) -> SegmentColumns:
+        """Columnar decode of the whole segment (``Snapshot.scala:88-111``
+        equivalent, minus the per-action objects)."""
+        return decode_segment(
+            self.store,
+            [f.path for f in self.segment.checkpoint_files],
+            [f.path for f in self.segment.deltas],
         )
+
+    @cached_property
+    def _winner(self) -> np.ndarray:
+        """Last-action-per-path boolean row mask over the columnar stream."""
+        return self._columnar.winner_mask()
+
+    @cached_property
+    def _other_state(self) -> Tuple[Optional[Protocol], Optional[Metadata], Dict[str, SetTransaction]]:
+        proto: Optional[Protocol] = None
+        meta: Optional[Metadata] = None
+        txns: Dict[str, SetTransaction] = {}
+        for a in self._columnar.other_actions:
+            if isinstance(a, Protocol):
+                proto = a
+            elif isinstance(a, Metadata):
+                meta = a
+            elif isinstance(a, SetTransaction):
+                txns[a.app_id] = a
+        return proto, meta, txns
 
     # -- reconciled state ------------------------------------------------
 
     @cached_property
     def protocol(self) -> Protocol:
-        p = self._replay.current_protocol
-        if p is None:
-            return Protocol()
-        return p
+        p = self._other_state[0]
+        return p if p is not None else Protocol()
 
     @cached_property
     def metadata(self) -> Metadata:
-        m = self._replay.current_metadata
-        if m is None:
-            return Metadata()
-        return m
+        m = self._other_state[1]
+        return m if m is not None else Metadata()
 
     @cached_property
     def set_transactions(self) -> Dict[str, SetTransaction]:
-        return dict(self._replay.transactions)
+        return dict(self._other_state[2])
 
     def transaction_version(self, app_id: str) -> int:
         t = self.set_transactions.get(app_id)
         return t.version if t else -1
 
     @cached_property
+    def _alive_mask(self) -> np.ndarray:
+        alive, _ = self._columnar.replay(winner=self._winner)
+        return alive
+
+    @cached_property
     def all_files(self) -> List[AddFile]:
-        """Active AddFiles sorted by path (deterministic scan order)."""
-        return sorted(self._replay.active_files.values(), key=lambda a: a.path)
+        """Active AddFiles sorted by path (deterministic scan order).
+        Materializes dataclasses for exactly the surviving rows."""
+        files = self._columnar.materialize(self._alive_mask)
+        return sorted(files, key=lambda a: a.path)
+
+    def _tombstone_mask(self, cutoff_ms: int) -> np.ndarray:
+        _, tomb = self._columnar.replay(cutoff_ms, winner=self._winner)
+        return tomb
 
     @cached_property
     def tombstones(self) -> List[RemoveFile]:
         cutoff = self.min_file_retention_timestamp()
-        return [r for r in self._replay.get_tombstones() if r.delete_timestamp > cutoff]
+        return list(self._columnar.materialize(self._tombstone_mask(cutoff)))
 
     def tombstones_newer_than(self, cutoff_ms: int) -> List[RemoveFile]:
         """Un-expired tombstones against a caller-supplied horizon — VACUUM
         must apply its own retention, not the snapshot's clock-cached one."""
-        return self._replay.get_tombstones(cutoff_ms)
+        return list(self._columnar.materialize(self._tombstone_mask(cutoff_ms)))
 
     @property
     def num_of_files(self) -> int:
-        return len(self.all_files)
+        return int(self._alive_mask.sum())
 
     @property
     def size_in_bytes(self) -> int:
-        return sum(a.size for a in self.all_files)
+        return int(self._columnar.size[self._alive_mask].sum())
 
     @property
     def num_of_metadata(self) -> int:
-        return 1 if self._replay.current_metadata is not None else 0
+        return 1 if self._other_state[1] is not None else 0
 
     @property
     def num_of_protocol(self) -> int:
-        return 1 if self._replay.current_protocol is not None else 0
+        return 1 if self._other_state[0] is not None else 0
 
     @property
     def num_of_removes(self) -> int:
+        # len() of the cached list: consistent with checkpoint_actions() even
+        # when the clock-derived retention cutoff advances between accesses
         return len(self.tombstones)
 
     @property
@@ -207,9 +221,21 @@ class Snapshot:
         return self.metadata.partition_columns
 
     def checkpoint_actions(self) -> List[Action]:
-        replay = self._replay
-        replay.min_file_retention_timestamp = self.min_file_retention_timestamp()
-        return replay.checkpoint_actions()
+        """The complete reconciled state, the content of a checkpoint
+        (``InMemoryLogReplay.scala:71-77``): protocol, metadata, txns,
+        retained tombstones, active files, ``dataChange=False`` normalized."""
+        from dataclasses import replace as _dc_replace
+
+        out: List[Action] = []
+        proto, meta, txns = self._other_state
+        if proto is not None:
+            out.append(proto)
+        if meta is not None:
+            out.append(meta)
+        out.extend(txns.values())
+        out.extend(_dc_replace(r, data_change=False) for r in self.tombstones)
+        out.extend(a.with_data_change(False) for a in self.all_files)
+        return out
 
     def checkpoint_size_estimate(self) -> int:
         return (
@@ -231,7 +257,7 @@ class Snapshot:
         return files_to_arrays(self.all_files, self.metadata, stats_columns)
 
     def __repr__(self) -> str:
-        return f"Snapshot(version={self.version}, files={len(self.all_files)})"
+        return f"Snapshot(version={self.version}, files={self.num_of_files})"
 
 
 class InitialSnapshot(Snapshot):
@@ -251,8 +277,8 @@ class InitialSnapshot(Snapshot):
         )
 
     @cached_property
-    def _replay(self) -> LogReplay:
-        return LogReplay(0)
+    def _columnar(self) -> SegmentColumns:
+        return decode_segment(self.store, [], [])
 
     @cached_property
     def metadata(self) -> Metadata:

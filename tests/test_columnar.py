@@ -1,0 +1,187 @@
+"""Columnar segment decode vs the object-per-action LogReplay oracle.
+
+The columnar path (``log/columnar.py``) must produce byte-identical state to
+``LogReplay`` (the PROTOCOL.md "Action Reconciliation" reference) on random
+logs exercising: unicode paths, "./" canonicalization, stats strings,
+partition values, tags, metadata/protocol/txn evolution, commitInfo and cdc
+noise, multi-part checkpoints, and empty lines.
+"""
+import json
+import random
+
+import numpy as np
+import pytest
+
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.log.columnar import decode_segment
+from delta_tpu.log.replay import LogReplay, canonicalize_path
+from delta_tpu.ops.replay_kernel import replay_columns
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import (
+    AddFile,
+    CommitInfo,
+    Metadata,
+    Protocol,
+    RemoveFile,
+    SetTransaction,
+)
+from delta_tpu.storage.logstore import get_log_store
+
+
+def _random_commit(rng, v, n_paths):
+    actions = []
+    actions.append(CommitInfo(operation="WRITE", operation_parameters={"mode": '"Append"'},
+                              user_metadata='note with "txn" inside' if rng.random() < 0.2 else None))
+    if v == 0:
+        actions.append(Protocol())
+        actions.append(Metadata(schema_string='{"type":"struct","fields":[]}',
+                                partition_columns=["p"]))
+    if rng.random() < 0.05:
+        actions.append(Metadata(id=f"meta-{v}", schema_string='{"type":"struct","fields":[]}'))
+    if rng.random() < 0.1:
+        actions.append(SetTransaction(app_id=f"app-{rng.randrange(3)}", version=v))
+    for _ in range(rng.randint(1, 8)):
+        kind = rng.random()
+        p = rng.choice([
+            f"p=1/part-{rng.randrange(n_paths):05d}.parquet",
+            f"./part-{rng.randrange(n_paths):05d}.parquet",
+            f"ünï-{rng.randrange(n_paths):05d}.parquet",
+        ])
+        if kind < 0.7:
+            actions.append(AddFile(
+                path=p, partition_values={"p": "1"} if p.startswith("p=") else {},
+                size=rng.randrange(1, 10_000), modification_time=v,
+                data_change=True,
+                stats=json.dumps({"numRecords": rng.randrange(100),
+                                  "minValues": {"x": rng.randrange(50)}}) if rng.random() < 0.5 else None,
+                tags=({"tag": "zorder"} if rng.random() < 0.2 else None),
+            ))
+        else:
+            actions.append(RemoveFile(path=p, deletion_timestamp=v * 1000,
+                                      data_change=True, size=rng.randrange(1, 10_000)))
+    return actions
+
+
+def _write_log(tmp_path, rng, n_versions, n_paths, checkpoint_at=None):
+    log_path = str(tmp_path / "_delta_log")
+    store = get_log_store(log_path)
+    replay = LogReplay(min_file_retention_timestamp=0)
+    for v in range(n_versions):
+        actions = _random_commit(rng, v, n_paths)
+        lines = [a.json() for a in actions]
+        if rng.random() < 0.1:
+            lines.insert(rng.randrange(len(lines)), "")  # stray empty line
+        store.write(f"{log_path}/{filenames.delta_file(v)}", lines)
+        replay.append(v, actions)
+        if checkpoint_at is not None and v == checkpoint_at:
+            ckpt_replay = LogReplay(0)
+            ckpt_replay.current_version = -1
+            # reconciled state so far becomes the checkpoint
+            ckpt_actions = replay.checkpoint_actions()
+            parts = 3 if len(ckpt_actions) > 10 else None
+            ckpt_mod.write_checkpoint(store, log_path, v, ckpt_actions, parts=parts)
+    return log_path, store, replay
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_decode_segment_matches_oracle(tmp_path, seed):
+    rng = random.Random(seed)
+    n_versions = 30
+    log_path, store, replay = _write_log(tmp_path, rng, n_versions, n_paths=40)
+    deltas = [f"{log_path}/{filenames.delta_file(v)}" for v in range(n_versions)]
+    cols = decode_segment(store, [], deltas)
+
+    alive, tomb = cols.replay(min_retention_ts=0)
+    alive_paths = set(cols.paths_for(np.nonzero(alive)[0]))
+    assert alive_paths == set(replay.active_files.keys())
+    tomb_paths = set(cols.paths_for(np.nonzero(tomb)[0]))
+    assert tomb_paths == {r.path for r in replay.get_tombstones()}
+
+    # lazy materialization must equal the oracle's dataclasses exactly
+    files = {a.path: a for a in cols.materialize(alive)}
+    assert files == replay.active_files
+
+    # non-file actions
+    proto = [a for a in cols.other_actions if isinstance(a, Protocol)]
+    metas = [a for a in cols.other_actions if isinstance(a, Metadata)]
+    txns = {}
+    for a in cols.other_actions:
+        if isinstance(a, SetTransaction):
+            txns[a.app_id] = a
+    assert proto[-1] == replay.current_protocol
+    assert metas[-1] == replay.current_metadata
+    assert txns == replay.transactions
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_decode_segment_with_checkpoint_matches_oracle(tmp_path, seed):
+    rng = random.Random(seed)
+    n_versions = 25
+    ckpt_v = 12
+    log_path, store, replay = _write_log(tmp_path, rng, n_versions, n_paths=30,
+                                         checkpoint_at=ckpt_v)
+    inst = ckpt_mod.read_last_checkpoint(store, log_path)
+    assert inst is not None and inst.version == ckpt_v
+    ckpt_paths = ckpt_mod.CheckpointInstance(inst.version, inst.parts).paths(log_path)
+    deltas = [f"{log_path}/{filenames.delta_file(v)}" for v in range(ckpt_v + 1, n_versions)]
+    cols = decode_segment(store, ckpt_paths, deltas)
+
+    alive, tomb = cols.replay(min_retention_ts=0)
+    alive_paths = set(cols.paths_for(np.nonzero(alive)[0]))
+    assert alive_paths == set(replay.active_files.keys())
+
+    files = {a.path: a for a in cols.materialize(alive)}
+    oracle = {p: a.with_data_change(False) if p in files and files[p].data_change is False else a
+              for p, a in replay.active_files.items()}
+    # files surviving from the checkpoint were normalized to dataChange=False
+    for p, a in files.items():
+        expect = replay.active_files[p]
+        assert a == expect or a == expect.with_data_change(False)
+
+    metas = [a for a in cols.other_actions if isinstance(a, Metadata)]
+    assert metas[-1] == replay.current_metadata
+    txns = {}
+    for a in cols.other_actions:
+        if isinstance(a, SetTransaction):
+            txns[a.app_id] = a
+    assert txns == replay.transactions
+
+
+def test_winner_device_matches_host():
+    import pyarrow as pa
+
+    from delta_tpu.log.columnar import SegmentColumns
+
+    rng = np.random.RandomState(0)
+    n = 5000
+    path_id = rng.randint(0, 700, n).astype(np.int32)
+    is_add = rng.rand(n) < 0.8
+    cols = SegmentColumns(
+        path_dict=pa.array([f"p{i}" for i in range(700)]),
+        path_id=path_id,
+        is_add=is_add,
+        size=rng.randint(0, 100, n).astype(np.int64),
+        modification_time=np.zeros(n, np.int64),
+        deletion_timestamp=np.where(is_add, 0, rng.randint(1, 1000, n)).astype(np.int64),
+        stats=None,
+        other_actions=[],
+    )
+    dev = replay_columns(cols, min_retention_ts=50, device=True)
+    host = replay_columns(cols, min_retention_ts=50, device=False)
+    assert (dev.alive == host.alive).all()
+    assert (dev.tombstone == host.tombstone).all()
+    assert int(dev.stats.num_files) == int(host.stats.num_files)
+    assert int(dev.stats.total_size) == int(host.stats.total_size)
+    assert int(dev.stats.num_tombstones) == int(host.stats.num_tombstones)
+
+
+def test_tombstone_retention_masks(tmp_path):
+    rng = random.Random(7)
+    log_path, store, replay = _write_log(tmp_path, rng, 10, n_paths=12)
+    deltas = [f"{log_path}/{filenames.delta_file(v)}" for v in range(10)]
+    cols = decode_segment(store, [], deltas)
+    for cutoff in (0, 3000, 100_000):
+        _alive, tomb = cols.replay(min_retention_ts=cutoff)
+        got = set(cols.paths_for(np.nonzero(tomb)[0]))
+        expect = {r.path for r in replay.get_tombstones(cutoff)}
+        assert got == expect, cutoff
