@@ -1,0 +1,254 @@
+"""Network object-store LogStore: atomic commits via conditional PUT.
+
+The reference's LogStore contract (``storage/LogStore.scala:30-43``) demands
+(1) atomic visibility, (2) mutual exclusion, (3) consistent listing. Over
+HDFS it uses atomic rename (``HDFSLogStore.scala:46-90``); real object
+stores need none of that machinery because a conditional create maps the
+contract directly onto one HTTP request:
+
+* **GCS dialect** — upload with ``x-goog-if-generation-match: 0``: the PUT
+  succeeds only if no live generation of the object exists; a losing racer
+  gets ``412 Precondition Failed``.
+* **S3 dialect** — ``If-None-Match: *`` conditional PUT (supported by S3
+  since 2024 and by most S3-compatible stores); same 412 semantics.
+
+Either way the object becomes visible atomically (object stores have no
+partial objects), so ``is_partial_write_visible() == False`` and checkpoint
+writers can skip the temp+rename dance (``Checkpoints.scala:271-303``).
+
+Retry policy: idempotent requests (GET/HEAD/DELETE/LIST, unconditional PUT)
+retry on connection errors / timeouts / 429 / 5xx with exponential backoff.
+A *conditional* PUT is also retried, but a 412 on a retry attempt is
+ambiguous — our first attempt may have landed before the response was lost.
+The client disambiguates by reading the object back: byte-identical content
+means we won (commit succeeded), anything else is a genuine conflict. The
+commit payload embeds a unique CommitInfo txnId upstream, so byte-equality
+is a reliable ownership test for log commits.
+
+The server side of this dialect (for tests and local development) lives in
+``delta_tpu.storage.object_store_emulator``.
+"""
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import socket
+import time
+import urllib.parse
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from delta_tpu.storage.logstore import FileStatus, LogStore
+from delta_tpu.utils.errors import DeltaIOError
+
+__all__ = ["HttpObjectLogStore", "RetryPolicy"]
+
+_RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+
+
+class RetryPolicy:
+    """Bounded exponential backoff for transient object-store failures."""
+
+    def __init__(self, max_attempts: int = 5, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, timeout_s: float = 30.0):
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.timeout_s = timeout_s
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+
+
+class _Response:
+    def __init__(self, status: int, body: bytes, headers):
+        self.status = status
+        self.body = body
+        self.headers = headers
+
+
+class HttpObjectLogStore(LogStore):
+    """LogStore over an HTTP object store (GCS- or S3-style conditional PUT).
+
+    ``endpoint`` is the server base URL (e.g. ``http://127.0.0.1:4443``);
+    paths are ``gs://bucket/key`` or ``s3://bucket/key`` URIs mapped
+    path-style onto the endpoint (``{endpoint}/{bucket}/{key}``).
+    """
+
+    def __init__(self, endpoint: str, dialect: str = "gcs",
+                 retry: Optional[RetryPolicy] = None):
+        if dialect not in ("gcs", "s3"):
+            raise DeltaIOError(f"Unknown object-store dialect {dialect!r}")
+        parsed = urllib.parse.urlparse(endpoint)
+        if parsed.scheme not in ("http", "https") or not parsed.netloc:
+            raise DeltaIOError(
+                f"Object-store endpoint must be an http(s) URL, got {endpoint!r}"
+            )
+        self.endpoint = endpoint.rstrip("/")
+        self._host = parsed.netloc
+        self._tls = parsed.scheme == "https"
+        self._base_path = parsed.path.rstrip("/")
+        self.dialect = dialect
+        self.retry = retry or RetryPolicy()
+
+    # -- request plumbing ------------------------------------------------
+
+    @staticmethod
+    def _split(path: str) -> Tuple[str, str]:
+        parsed = urllib.parse.urlparse(path)
+        if not parsed.scheme or not parsed.netloc:
+            raise DeltaIOError(f"Expected scheme://bucket/key URI, got {path!r}")
+        return parsed.netloc, parsed.path.lstrip("/")
+
+    def _url(self, bucket: str, key: str = "", query: str = "") -> str:
+        url = f"{self._base_path}/{bucket}"
+        if key:
+            url += "/" + urllib.parse.quote(key)
+        if query:
+            url += "?" + query
+        return url
+
+    def _request_once(self, method: str, url: str, body: Optional[bytes],
+                      headers: dict) -> _Response:
+        conn = (http.client.HTTPSConnection if self._tls
+                else http.client.HTTPConnection)(self._host, timeout=self.retry.timeout_s)
+        try:
+            conn.request(method, url, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return _Response(resp.status, data, resp.headers)
+        finally:
+            conn.close()
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None,
+                 headers: Optional[dict] = None, *,
+                 ambiguous_hook=None) -> _Response:
+        """Run a request with retries. ``ambiguous_hook(attempt)`` is invoked
+        before each retry of a non-idempotent request so the caller can
+        resolve did-my-first-attempt-land ambiguity."""
+        headers = dict(headers or {})
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retry.max_attempts):
+            if attempt and ambiguous_hook is not None:
+                resolved = ambiguous_hook(attempt)
+                if resolved is not None:
+                    return resolved
+            try:
+                resp = self._request_once(method, url, body, headers)
+            except (ConnectionError, socket.timeout, http.client.HTTPException, OSError) as e:
+                last_exc = e
+                time.sleep(self.retry.delay(attempt))
+                continue
+            if resp.status in _RETRYABLE_STATUS:
+                last_exc = DeltaIOError(
+                    f"{method} {url} -> HTTP {resp.status}: {resp.body[:200]!r}"
+                )
+                time.sleep(self.retry.delay(attempt))
+                continue
+            return resp
+        raise DeltaIOError(
+            f"{method} {self.endpoint}{url} failed after "
+            f"{self.retry.max_attempts} attempts: {last_exc}"
+        )
+
+    # -- LogStore API ----------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        bucket, key = self._split(path)
+        resp = self._request("GET", self._url(bucket, key))
+        if resp.status == 404:
+            raise FileNotFoundError(path)
+        if resp.status != 200:
+            raise DeltaIOError(f"GET {path} -> HTTP {resp.status}")
+        return resp.body
+
+    def read_iter(self, path: str) -> Iterator[str]:
+        data = self.read_bytes(path)
+        for line in io.StringIO(data.decode("utf-8")):
+            yield line.rstrip("\r\n")
+
+    def write(self, path: str, lines: Iterable[str], overwrite: bool = False) -> None:
+        data = ("".join(line + "\n" for line in lines)).encode("utf-8")
+        self.write_bytes(path, data, overwrite=overwrite)
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        bucket, key = self._split(path)
+        headers = {"Content-Length": str(len(data))}
+        if not overwrite:
+            if self.dialect == "gcs":
+                headers["x-goog-if-generation-match"] = "0"
+            else:
+                headers["If-None-Match"] = "*"
+
+        def resolve_ambiguity(attempt: int) -> Optional[_Response]:
+            # A retried conditional PUT that now sees the object existing may
+            # be observing its *own* first attempt (response lost in flight).
+            # Byte-identical content = we won.
+            if overwrite:
+                return None
+            try:
+                existing = self.read_bytes(path)
+            except FileNotFoundError:
+                return None  # not created yet; retry the PUT
+            if existing == data:
+                return _Response(200, b"", {})
+            raise FileExistsError(path)
+
+        resp = self._request("PUT", self._url(bucket, key), body=data,
+                             headers=headers, ambiguous_hook=resolve_ambiguity)
+        if resp.status in (412, 409):
+            raise FileExistsError(path)
+        if resp.status not in (200, 201):
+            raise DeltaIOError(f"PUT {path} -> HTTP {resp.status}: {resp.body[:200]!r}")
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        bucket, key = self._split(path)
+        parent, _, start = key.rpartition("/")
+        prefix = parent + "/" if parent else ""
+        query = urllib.parse.urlencode({"prefix": prefix, "start-after-name": start})
+        resp = self._request("GET", self._url(bucket, query=f"list&{query}"))
+        if resp.status == 404:
+            raise FileNotFoundError(path)
+        if resp.status != 200:
+            raise DeltaIOError(f"LIST {path} -> HTTP {resp.status}")
+        payload = json.loads(resp.body.decode("utf-8"))
+        objects = payload.get("objects", [])
+        if not objects and not payload.get("prefix_exists", False):
+            # object stores have no directories; an empty prefix with no
+            # objects at all is the contract's missing-directory case
+            raise FileNotFoundError(path)
+        scheme = urllib.parse.urlparse(path).scheme
+        for o in sorted(objects, key=lambda o: o["name"]):
+            name = o["name"]
+            # listing is prefix-recursive; emulate directory listing by
+            # excluding deeper "subdirectory" objects
+            rest = name[len(prefix):]
+            if "/" in rest:
+                continue
+            yield FileStatus(
+                f"{scheme}://{bucket}/{name}", int(o["size"]), int(o["updated"])
+            )
+
+    def exists(self, path: str) -> bool:
+        bucket, key = self._split(path)
+        resp = self._request("HEAD", self._url(bucket, key))
+        if resp.status == 200:
+            return True
+        if resp.status == 404:
+            return False
+        raise DeltaIOError(f"HEAD {path} -> HTTP {resp.status}")
+
+    def delete(self, path: str) -> bool:
+        bucket, key = self._split(path)
+        resp = self._request("DELETE", self._url(bucket, key))
+        if resp.status in (200, 204):
+            return True
+        if resp.status == 404:
+            return False
+        raise DeltaIOError(f"DELETE {path} -> HTTP {resp.status}")
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return False  # object PUTs are atomic: no partial objects, ever
+
+    def mkdirs(self, path: str) -> None:
+        pass  # object stores have no directories

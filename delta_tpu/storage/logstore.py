@@ -405,18 +405,44 @@ def register_log_store(scheme: str, factory: Callable[[], LogStore]) -> None:
 
 def get_log_store(path: str = "") -> LogStore:
     scheme = split_scheme(path)[0] or "file"
+    cache_key = scheme
     with _REG_LOCK:
-        if scheme not in _INSTANCES:
-            factory = _REGISTRY.get(scheme)
+        factory = _REGISTRY.get(scheme)
+    if factory is None and scheme in ("s3", "s3a", "s3n", "gs"):
+        # Network object store: requires an endpoint — never silently fall
+        # back to local disk for a cloud scheme.
+        from delta_tpu.utils.config import conf
+
+        endpoint = conf.get("delta.tpu.storage.objectStore.endpoint")
+        if not endpoint:
+            raise DeltaIOError(
+                f"Path {path!r} uses object-store scheme {scheme!r} but no "
+                "endpoint is configured. Set session conf "
+                "'delta.tpu.storage.objectStore.endpoint' to the store's URL "
+                "(conditional-PUT commits; see delta_tpu.storage.http_store), "
+                "or register a custom store for this scheme via "
+                "register_log_store()."
+            )
+        dialect = conf.get(
+            "delta.tpu.storage.objectStore.dialect",
+            "gcs" if scheme == "gs" else "s3",
+        )
+        cache_key = f"{scheme}|{endpoint}|{dialect}"
+
+        def factory(endpoint=endpoint, dialect=dialect):
+            from delta_tpu.storage.http_store import HttpObjectLogStore
+
+            return HttpObjectLogStore(endpoint, dialect=dialect)
+
+    with _REG_LOCK:
+        if cache_key not in _INSTANCES:
             if factory is None:
                 if scheme in ("file", ""):
                     factory = LocalLogStore
-                elif scheme in ("s3", "s3a", "s3n", "gs"):
-                    factory = ObjectStoreLogStore
                 else:
                     raise DeltaIOError(f"No LogStore registered for scheme {scheme!r}")
-            _INSTANCES[scheme] = factory()
-        return _INSTANCES[scheme]
+            _INSTANCES[cache_key] = factory()
+        return _INSTANCES[cache_key]
 
 
 def split_scheme(path: str):

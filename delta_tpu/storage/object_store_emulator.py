@@ -1,0 +1,226 @@
+"""In-process object-store emulator speaking the conditional-PUT dialect.
+
+Serves the HTTP surface :class:`delta_tpu.storage.http_store.HttpObjectLogStore`
+expects — path-style ``/{bucket}/{key}`` objects with GCS
+(``x-goog-if-generation-match``) and S3 (``If-None-Match: *``) conditional
+creates, prefix listing, and per-object generation numbers — plus the
+fault-injection hooks the reference exercises through fake Hadoop
+filesystems (``LogStoreSuite.scala:293-339``):
+
+* ``fail_next(n, status)`` — fail the next *n* requests with an HTTP status
+  (or, with ``status=0``, drop the connection mid-response);
+* ``drop_response_next_put()`` — **commit** the next PUT server-side but
+  sever the connection before the client sees the response: the
+  lost-200 ambiguity a real store can produce;
+* ``before_put`` — callback run under no lock before the conditional check,
+  to widen race windows deterministically.
+
+Concurrency: one server-wide mutex around each object mutation makes the
+conditional PUT check-and-set atomic, which is exactly the guarantee GCS
+generation-match gives per object.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = ["ObjectStoreEmulator"]
+
+
+class _Object:
+    __slots__ = ("data", "generation", "updated_ms")
+
+    def __init__(self, data: bytes, generation: int, updated_ms: int):
+        self.data = data
+        self.generation = generation
+        self.updated_ms = updated_ms
+
+
+class ObjectStoreEmulator:
+    """A threaded HTTP object store bound to 127.0.0.1:<free port>."""
+
+    def __init__(self):
+        self._objects: Dict[Tuple[str, str], _Object] = {}
+        self._mutex = threading.Lock()
+        self._generation = 0
+        self._clock_ms = 0
+        self.request_count = 0
+        # fault injection
+        self._fail_budget = 0
+        self._fail_status = 503
+        self._drop_next_put = False
+        self.before_put: Optional[Callable[[str, str], None]] = None
+
+        emulator = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence request logging in tests
+                pass
+
+            def _split(self) -> Tuple[str, str, dict]:
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+                return bucket, key, query
+
+            def _respond(self, status: int, body: bytes = b"",
+                         content_type: str = "application/octet-stream") -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _maybe_fail(self) -> bool:
+                with emulator._mutex:
+                    emulator.request_count += 1
+                    if emulator._fail_budget > 0:
+                        emulator._fail_budget -= 1
+                        status = emulator._fail_status
+                    else:
+                        return False
+                if status == 0:
+                    # drop the connection without any response
+                    self.close_connection = True
+                    self.connection.close()
+                    return True
+                self._respond(status, b"injected failure")
+                return True
+
+            def do_GET(self):
+                if self._maybe_fail():
+                    return
+                bucket, key, query = self._split()
+                if not key and ("list" in query or "prefix" in query):
+                    prefix = query.get("prefix", [""])[0]
+                    start_after = query.get("start-after-name", [""])[0]
+                    with emulator._mutex:
+                        objs = [
+                            {"name": k, "size": len(o.data), "updated": o.updated_ms,
+                             "generation": o.generation}
+                            for (b, k), o in emulator._objects.items()
+                            if b == bucket and k.startswith(prefix)
+                            and k[len(prefix):] >= start_after
+                        ]
+                        prefix_exists = any(
+                            b == bucket and k.startswith(prefix)
+                            for (b, k) in emulator._objects
+                        )
+                    body = json.dumps({"objects": sorted(objs, key=lambda o: o["name"]),
+                                       "prefix_exists": prefix_exists})
+                    self._respond(200, body.encode(), "application/json")
+                    return
+                with emulator._mutex:
+                    obj = emulator._objects.get((bucket, key))
+                if obj is None:
+                    self._respond(404)
+                else:
+                    self._respond(200, obj.data)
+
+            def do_HEAD(self):
+                if self._maybe_fail():
+                    return
+                bucket, key, _ = self._split()
+                with emulator._mutex:
+                    obj = emulator._objects.get((bucket, key))
+                self._respond(404 if obj is None else 200)
+
+            def do_PUT(self):
+                if self._maybe_fail():
+                    return
+                bucket, key, _ = self._split()
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length)
+                gen_match = self.headers.get("x-goog-if-generation-match")
+                if_none_match = self.headers.get("If-None-Match")
+                conditional = gen_match == "0" or if_none_match == "*"
+                if emulator.before_put is not None:
+                    emulator.before_put(bucket, key)
+                with emulator._mutex:
+                    exists = (bucket, key) in emulator._objects
+                    if conditional and exists:
+                        committed = False
+                        status = 412
+                    else:
+                        emulator._generation += 1
+                        # real wall-clock mtimes (retention/cleanup logic
+                        # compares them to now), kept strictly increasing
+                        emulator._clock_ms = max(
+                            int(time.time() * 1000), emulator._clock_ms + 1
+                        )
+                        emulator._objects[(bucket, key)] = _Object(
+                            data, emulator._generation, emulator._clock_ms
+                        )
+                        committed = True
+                        status = 200
+                    drop = emulator._drop_next_put and committed
+                    if drop:
+                        emulator._drop_next_put = False
+                if drop:
+                    self.close_connection = True
+                    self.connection.close()
+                    return
+                self._respond(status)
+
+            def do_DELETE(self):
+                if self._maybe_fail():
+                    return
+                bucket, key, _ = self._split()
+                with emulator._mutex:
+                    existed = emulator._objects.pop((bucket, key), None) is not None
+                self._respond(204 if existed else 404)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ObjectStoreEmulator":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "ObjectStoreEmulator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    # -- fault injection ---------------------------------------------------
+
+    def fail_next(self, n: int, status: int = 503) -> None:
+        with self._mutex:
+            self._fail_budget = n
+            self._fail_status = status
+
+    def drop_response_next_put(self) -> None:
+        with self._mutex:
+            self._drop_next_put = True
+
+    # -- inspection --------------------------------------------------------
+
+    def object_count(self) -> int:
+        with self._mutex:
+            return len(self._objects)
+
+    def get_object(self, bucket: str, key: str) -> Optional[bytes]:
+        with self._mutex:
+            obj = self._objects.get((bucket, key))
+            return None if obj is None else obj.data

@@ -7,7 +7,9 @@ Three tiers, mirroring the reference (SURVEY §5 "Config / flag system"):
 2. **Table properties** (:class:`DeltaConfigs`) ≈ ``DeltaConfig.scala:114-433``
    — typed, validated ``delta.*`` keys persisted in ``Metadata.configuration``,
    with session-level defaults via ``delta.tpu.properties.defaults.*``.
-3. Per-operation reader/writer options live in ``delta_tpu.api.options``.
+3. Per-operation reader/writer options (≈ ``DeltaOptions.scala``) are keyword
+   arguments on the command constructors (e.g. ``merge_schema`` /
+   ``replace_where`` on ``delta_tpu.commands.write.WriteIntoDelta``).
 """
 from __future__ import annotations
 
