@@ -19,6 +19,12 @@ for quick local runs.
 """
 import json
 import os
+
+# must precede the first pyarrow import: jemalloc (the default) returns
+# freed pages to the OS aggressively, so every bench phase re-faults its
+# working set; mimalloc retains, giving steadier wall-clock
+os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "mimalloc")
+
 import shutil
 import sys
 import tempfile
@@ -146,18 +152,20 @@ def bench_merge_upsert(workdir):
     source = _store_sales(n_source, np.random.RandomState(11))
     source = source.set_column(0, "ss_item_sk", pa.array(src_keys))
 
-    warm_path = os.path.join(workdir, "c2_warm")
-    host_path = os.path.join(workdir, "c2_host")
-    shutil.copytree(path, warm_path)
-    shutil.copytree(path, host_path)
+    copies = {
+        name: os.path.join(workdir, f"c2_{name}")
+        for name in ("warm", "dev2", "host1", "host2", "forced")
+    }
+    for p in copies.values():
+        shutil.copytree(path, p)
     gb = (_dir_bytes(path) + source.nbytes) / 1e9
 
-    def run_merge(table_path, device):
+    def run_merge(table_path, mode):
         from delta_tpu import DeltaLog as DL
 
         DL.clear_cache()
         lg = DL.for_table(table_path)
-        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": device}):
+        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.mode": mode}):
             cmd = MergeIntoCommand(
                 lg, source, "t.ss_item_sk = s.ss_item_sk",
                 [MergeClause("update", assignments=None)],
@@ -169,19 +177,42 @@ def bench_merge_upsert(workdir):
         assert cmd.metrics["numTargetRowsInserted"] == n_source - n_source // 2
         return cmd
 
-    run_merge(warm_path, True)  # warm the join-kernel compile (same shapes)
-    dev_s, dev_cmd = _timed(lambda: run_merge(path, True))
-    host_s, _ = _timed(lambda: run_merge(host_path, False))
-    assert dev_cmd._device_join is not None, "device join did not run"
+    run_merge(copies["warm"], "force")  # warm the join-kernel compile
+    # headline: auto mode (the engine's link-aware executor routing) vs the
+    # host-pinned baseline. min of 2 fresh-table trials per mode damps the
+    # 2x allocator/page-fault noise single trials show on this host.
+    auto_trials = [_timed(lambda: run_merge(path, "auto")),
+                   _timed(lambda: run_merge(copies["dev2"], "auto"))]
+    host_trials = [_timed(lambda: run_merge(copies["host1"], "off")),
+                   _timed(lambda: run_merge(copies["host2"], "off"))]
+    forced_s, forced_cmd = _timed(lambda: run_merge(copies["forced"], "force"))
+    auto_s, auto_cmd = min(auto_trials, key=lambda x: x[0])
+    host_s, host_cmd = min(host_trials, key=lambda x: x[0])
+    assert forced_cmd._device_join is not None, "forced device join did not run"
+
+    from delta_tpu.parallel import link
+
+    lp = link.profile()
     return {
         "metric": "tpcds_store_sales_merge_upsert_1M_into_10M",
-        "value": round(gb / dev_s, 3),
+        "value": round(gb / auto_s, 3),
         "unit": "GB/s",
-        "vs_baseline": round(host_s / dev_s, 2),
+        "vs_baseline": round(host_s / auto_s, 2),
         "baseline": "same engine, host Arrow hash-join path (same machine)",
-        "device_s": round(dev_s, 2),
+        "auto_s": round(auto_s, 2),
         "host_s": round(host_s, 2),
         "gb": round(gb, 3),
+        "auto_used_device": auto_cmd._device_join is not None,
+        "auto_phases": dict(auto_cmd.phase_ms),
+        "host_phases": dict(host_cmd.phase_ms),
+        # the pinned-device run: honest cost of the kernel path on THIS
+        # link (bulk uploads collapse to single-digit MB/s once XLA has
+        # executed — see link profile); on PCIe/DMA-attached chips the
+        # auto router engages the same kernel
+        "device_forced_s": round(forced_s, 2),
+        "device_forced_phases": dict(forced_cmd.phase_ms),
+        "link_MBps": {"up": round(lp.up_mbps, 1), "down": round(lp.down_mbps, 1),
+                      "latency_ms": round(lp.latency_s * 1000, 1)},
     }
 
 

@@ -34,6 +34,9 @@ class Timer:
         self.t0 = now
         return ms
 
+    def peek_ms(self) -> int:
+        return int((time.perf_counter() - self.t0) * 1000)
+
 
 @dataclass
 class TouchedFile:
@@ -64,10 +67,10 @@ def read_candidates(
     metadata,
     predicate: Optional[ir.Expression],
 ) -> List[TouchedFile]:
-    """Read each candidate and compute its per-row match mask."""
+    """Read each candidate (parallel decode) and compute its match mask."""
     out: List[TouchedFile] = []
-    for add in files:
-        t = read_files_as_table(data_path, [add], metadata)
+    tables = read_files_as_table(data_path, files, metadata, per_file=True)
+    for add, t in zip(files, tables):
         if predicate is None:
             mask = pa.chunked_array([pa.array([True] * t.num_rows)])
         else:

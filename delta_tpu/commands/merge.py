@@ -90,6 +90,26 @@ _SID = "__s_row__"
 _FID = "__t_file__"
 
 
+def _rows_from_stats(candidates) -> Optional[int]:
+    """Total numRecords over the candidate files, None when any file lacks
+    stats (routing then falls back to the post-decode estimate)."""
+    import json as _json
+
+    total = 0
+    for add in candidates:
+        if not add.stats:
+            return None
+        try:
+            parsed = _json.loads(add.stats)
+        except (ValueError, TypeError):
+            return None
+        n = parsed.get("numRecords") if isinstance(parsed, dict) else None
+        if not isinstance(n, (int, float)):
+            return None
+        total += int(n)
+    return total
+
+
 @dataclass
 class MergeClause:
     """One WHEN clause (`catalyst/plans/logical/deltaMerge.scala:161-221`)."""
@@ -142,6 +162,9 @@ class MergeIntoCommand:
         self.source_alias = source_alias
         self.target_alias = target_alias
         self.metrics: Dict[str, int] = {}
+        # wall-clock per phase (decode/key/join/apply/write ms) — the bench
+        # breakdown the optimization loop steers by
+        self.phase_ms: Dict[str, float] = {}
         # set by _join when the device kernel ran: JoinResult with exact
         # per-target match counts and per-source matched flags
         self._device_join = None
@@ -233,6 +256,10 @@ class MergeIntoCommand:
         return self.delta_log.with_new_transaction(self._body)
 
     def _body(self, txn) -> int:
+        # reset per-execution state: a re-run that takes the host or empty
+        # path must not consume a previous run's device-join flags
+        self._device_join = None
+        self.phase_ms.clear()
         timer = Timer()
         metadata = txn.metadata
         target_cols = [f.name for f in metadata.schema.fields]
@@ -279,16 +306,26 @@ class MergeIntoCommand:
             n_copied += n_pair_copied
             if upd is not None:
                 out_blocks.append(upd)
-            # unmatched target rows inside touched files → copy
+            # unmatched target rows inside touched files → copy. _TID is the
+            # global row index over the candidate concat, so one boolean
+            # scatter replaces a per-file hash-set probe
+            import numpy as np
+
+            total_rows = sum(t.num_rows for t in tgt_tables.values())
+            claimed = np.zeros(total_rows, bool)
+            claimed[matched_pairs.column(_TID).to_numpy(zero_copy_only=False)] = True
+            row_start = 0
+            starts = {}
+            for fid in sorted(tgt_tables):
+                starts[fid] = row_start
+                row_start += tgt_tables[fid].num_rows
             for fid in sorted(touched_ids):
                 t = tgt_tables[fid]
-                matched_rows = matched_pairs.filter(
-                    pc.equal(matched_pairs.column(_FID), fid)
-                ).column(_TID)
-                keep = pc.invert(
-                    pc.is_in(t.column(_TID), value_set=pc.unique(matched_rows))
-                )
-                copied = t.filter(keep).select(target_cols)
+                keep = ~claimed[starts[fid]: starts[fid] + t.num_rows]
+                if not keep.all():
+                    copied = t.filter(pa.array(keep)).select(target_cols)
+                else:
+                    copied = t.select(target_cols)
                 n_copied += copied.num_rows
                 if copied.num_rows:
                     out_blocks.append(copied)
@@ -300,6 +337,7 @@ class MergeIntoCommand:
         if inserts is not None and inserts.num_rows:
             out_blocks.append(inserts)
 
+        self.phase_ms["apply_ms"] = timer.peek_ms()
         adds: List[Action] = []
         if out_blocks:
             out = pa.concat_tables(out_blocks, promote_options="permissive")
@@ -310,6 +348,7 @@ class MergeIntoCommand:
                     )
                 )
         rewrite_ms = timer.lap_ms()
+        self.phase_ms["write_ms"] = rewrite_ms - self.phase_ms["apply_ms"]
 
         self.metrics.update(
             numSourceRows=self.source.num_rows,
@@ -343,23 +382,23 @@ class MergeIntoCommand:
               metadata) -> Tuple[pa.Table, Dict[int, pa.Table]]:
         """Inner-join source×candidate-target. Returns (pair table with
         target cols bare + source cols prefixed + ids, per-file target
-        tables with row ids)."""
+        tables with row ids).
+
+        Device path: the join-key columns decode first (a cheap projected
+        Parquet read), the membership kernel launches asynchronously, and
+        the full-column decode of the candidates runs on the host *while the
+        device probes* — the kernel's wall-clock hides under the decode."""
+        import numpy as np
+
         target_cols = [f.name for f in metadata.schema.fields]
-        device_eligible = (
-            bool(conf.get("delta.tpu.merge.devicePath.enabled", True))
-            and len(equi) == 1
-            and not residual
-        )
+        insert_only = not self.matched_clauses
+        key_need = {r.lower() for t_e, _ in equi for r in ir.references(t_e)}
         # insert-only merges never rewrite target rows: read only the columns
         # the join condition touches (the reference's left-anti fast path
         # reads the full target; we push the projection into the Parquet scan)
         read_cols: Optional[List[str]] = None
-        if not self.matched_clauses:
-            need = {
-                r.lower()
-                for t_e, _ in equi
-                for r in ir.references(t_e)
-            } | {
+        if insert_only:
+            need = key_need | {
                 r.lower()
                 for c in residual
                 for r in ir.references(c)
@@ -367,20 +406,76 @@ class MergeIntoCommand:
             }
             cols = [c for c in target_cols if c.lower() in need]
             read_cols = cols or None
+
+        mode = str(conf.get("delta.tpu.merge.devicePath.mode", "auto"))
+        device_eligible = (
+            bool(conf.get("delta.tpu.merge.devicePath.enabled", True))
+            and mode != "off"
+            and 1 <= len(equi) <= 2
+            and not residual
+            and candidates
+            and src.num_rows > 0
+        )
+        if device_eligible and mode == "auto":
+            # pre-decode routing check from AddFile stats row counts: on a
+            # slow link even the *optimistic* plan (int32 keys) loses to the
+            # host hash join — skip the early key decode entirely then
+            n_est = _rows_from_stats(candidates)
+            if n_est is not None:
+                import jax
+
+                from delta_tpu.parallel import link
+
+                rows = n_est + src.num_rows
+                est = link.estimate_device_s(
+                    up_bytes=rows * 4,
+                    down_bytes=rows // 8,
+                    kernel_rows=rows,
+                    shards=len(jax.devices()),
+                )
+                if est.device_s > rows * link.HOST_JOIN_S_PER_ROW:
+                    device_eligible = False
+
+        decode_t = Timer()
+        pending = None
+        key_pieces: Optional[List[pa.Table]] = None
+        if device_eligible:
+            key_cols = [c for c in target_cols if c.lower() in key_need]
+            key_pieces = read_files_as_table(
+                self.delta_log.data_path, candidates, metadata,
+                columns=key_cols or None, per_file=True,
+            )
+            key_tab = pa.concat_tables(key_pieces, promote_options="permissive")
+            if key_tab.num_rows:
+                pending = self._launch_device_join(key_tab, src, equi)
+        self.phase_ms["key_decode_ms"] = decode_t.lap_ms()
+
+        # full-column decode (overlaps the in-flight device probe); when the
+        # key projection already covers every needed column, reuse it
+        if key_pieces is not None and read_cols is not None and set(
+            c.lower() for c in read_cols
+        ) <= key_need:
+            raw_pieces = key_pieces
+        else:
+            raw_pieces = read_files_as_table(
+                self.delta_log.data_path, candidates, metadata,
+                columns=read_cols, per_file=True,
+            )
         tgt_tables: Dict[int, pa.Table] = {}
         pieces: List[pa.Table] = []
         row_base = 0
-        for fid, add in enumerate(candidates):
-            t = read_files_as_table(
-                self.delta_log.data_path, [add], metadata, columns=read_cols
+        for fid, t in enumerate(raw_pieces):
+            t = t.append_column(
+                _TID,
+                pa.array(np.arange(row_base, row_base + t.num_rows, dtype=np.int64)),
             )
             t = t.append_column(
-                _TID, pa.array(range(row_base, row_base + t.num_rows), pa.int64())
+                _FID, pa.array(np.full(t.num_rows, fid, dtype=np.int64))
             )
-            t = t.append_column(_FID, pa.array([fid] * t.num_rows, pa.int64()))
             row_base += t.num_rows
             tgt_tables[fid] = t
             pieces.append(t)
+        self.phase_ms["decode_ms"] = decode_t.lap_ms()
         if not pieces:
             empty = pa.schema(
                 [pa.field(_TID, pa.int64()), pa.field(_FID, pa.int64())]
@@ -389,19 +484,37 @@ class MergeIntoCommand:
         else:
             target = pa.concat_tables(pieces, promote_options="permissive")
 
-        if target.num_rows == 0 or src.num_rows == 0:
-            # empty pair table with full combined schema
-            combined = pa.concat_tables(
-                [
-                    target.slice(0, 0),
-                ],
-                promote_options="permissive",
-            )
+        def empty_pairs() -> pa.Table:
+            # empty pair table with the full combined (target + source) schema
+            combined = target.slice(0, 0)
             for name in src.column_names:
                 combined = combined.append_column(
                     name, pa.nulls(0, src.column(name).type)
                 )
-            return combined, tgt_tables
+            return combined
+
+        if target.num_rows == 0 or src.num_rows == 0:
+            return empty_pairs(), tgt_tables
+
+        join_t = Timer()
+        if pending is not None:
+            res = pending.result()
+            if res is not None:
+                self._device_join = res
+                # insert-only never consumes the pair rows (the not-matched
+                # block comes from s_matched): skip materializing them
+                if insert_only:
+                    joined = empty_pairs()
+                else:
+                    matched = np.flatnonzero(res.t_matched)
+                    joined = target.take(pa.array(matched, pa.int64()))
+                    s_taken = src.take(
+                        pa.array(res.t_first_s[matched], pa.int64())
+                    )
+                    for name in s_taken.column_names:
+                        joined = joined.append_column(name, s_taken.column(name))
+                self.phase_ms["join_ms"] = join_t.lap_ms()
+                return joined, tgt_tables
 
         if equi:
             key_cols = []
@@ -409,26 +522,21 @@ class MergeIntoCommand:
                 t_vals = evaluate(t_e, target)
                 s_vals = evaluate(s_e, src)
                 key_cols.append(_coerce_join_keys(t_vals, s_vals))
-            if (
-                device_eligible
-                and pa.types.is_integer(key_cols[0][0].type)
-                and pa.types.is_integer(key_cols[0][1].type)
-            ):
-                joined = self._device_equi_join(target, src, *key_cols[0])
-            else:
-                tkeys, skeys = [], []
-                t_aug, s_aug = target, src
-                for i, (t_vals, s_vals) in enumerate(key_cols):
-                    k = f"__k{i}__"
-                    t_aug = t_aug.append_column(k, t_vals)
-                    s_aug = s_aug.append_column(k, s_vals)
-                    tkeys.append(k)
-                    skeys.append(k)
-                joined = t_aug.join(
-                    s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
-                    use_threads=False,
-                )
-                joined = joined.drop_columns(tkeys)
+            tkeys, skeys = [], []
+            t_aug, s_aug = target, src
+            for i, (t_vals, s_vals) in enumerate(key_cols):
+                k = f"__k{i}__"
+                t_aug = t_aug.append_column(k, t_vals)
+                s_aug = s_aug.append_column(k, s_vals)
+                tkeys.append(k)
+                skeys.append(k)
+            joined = t_aug.join(
+                s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
+                use_threads=False,
+            )
+            # the hash join emits one chunk per batch: defragment once here
+            # or every downstream mask/projection/encode pays per-chunk costs
+            joined = joined.drop_columns(tkeys).combine_chunks()
         else:
             # general condition: cartesian pairing (small sources only)
             if target.num_rows * src.num_rows > 50_000_000:
@@ -448,17 +556,20 @@ class MergeIntoCommand:
                 joined = joined.append_column(name, s_taken.column(name))
         if residual:
             joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
+        self.phase_ms["join_ms"] = join_t.lap_ms()
         return joined, tgt_tables
 
-    def _device_equi_join(
-        self, target: pa.Table, src: pa.Table, t_vals, s_vals
-    ) -> pa.Table:
-        """Phase-1/2 join on device (`ops/join_kernel.py`): exact integer-key
-        sort-merge probe sharded over the mesh. Pairs = target rows with a
-        match gathered against their first matching source row (lossless —
-        multi-match is either an error or duplicate-insensitive; the exact
-        counts are kept in ``self._device_join`` for `_check_multi_match`)."""
+    def _launch_device_join(self, key_tab: pa.Table, src: pa.Table, equi):
+        """Evaluate + coerce the join keys and launch the device membership
+        probe asynchronously (`ops/join_kernel.py`). Composite integer keys
+        pack into one int64 lane (hi<<32 | lo) when both components fit in
+        int32. Returns a PendingJoin, or None when the keys aren't device-
+        representable (caller falls back to the host hash join) or — in
+        ``devicePath.mode=auto`` — when the link cost model says shipping
+        the keys costs more than the host hash join (`parallel/link.py`)."""
         import numpy as np
+
+        import jax
 
         from delta_tpu.ops import join_kernel
         from delta_tpu.parallel.mesh import state_mesh
@@ -469,19 +580,48 @@ class MergeIntoCommand:
             keys = np.asarray(arr.fill_null(0).cast(pa.int64()))
             return keys, valid
 
-        t_keys, t_ok = to_np(t_vals)
-        s_keys, s_ok = to_np(s_vals)
-        import jax
+        lanes = []
+        for t_e, s_e in equi:
+            try:
+                t_vals = evaluate(t_e, key_tab)
+                s_vals = evaluate(s_e, src)
+            except Exception:
+                return None
+            t_vals, s_vals = _coerce_join_keys(t_vals, s_vals)
+            if not (
+                pa.types.is_integer(t_vals.type) and pa.types.is_integer(s_vals.type)
+            ):
+                return None
+            lanes.append((to_np(t_vals), to_np(s_vals)))
 
+        if len(lanes) == 1:
+            (t_keys, t_ok), (s_keys, s_ok) = lanes[0]
+        else:
+            i32 = np.iinfo(np.int32)
+            for (tk, t_ok_i), (sk, s_ok_i) in lanes:
+                if (
+                    np.min(tk, where=t_ok_i, initial=0) < i32.min
+                    or np.max(tk, where=t_ok_i, initial=0) > i32.max
+                    or np.min(sk, where=s_ok_i, initial=0) < i32.min
+                    or np.max(sk, where=s_ok_i, initial=0) > i32.max
+                ):
+                    return None  # component exceeds 32 bits: host join
+            (t0, t_ok0), (s0, s_ok0) = lanes[0]
+            (t1, t_ok1), (s1, s_ok1) = lanes[1]
+            t_keys = (t0 << 32) | (t1 & 0xFFFFFFFF)
+            s_keys = (s0 << 32) | (s1 & 0xFFFFFFFF)
+            t_ok = t_ok0 & t_ok1
+            s_ok = s_ok0 & s_ok1
+
+        budget_s = None
+        if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "auto":
+            from delta_tpu.parallel import link
+
+            budget_s = (len(t_keys) + len(s_keys)) * link.HOST_JOIN_S_PER_ROW
         mesh = state_mesh() if len(jax.devices()) > 1 else None
-        res = join_kernel.inner_join(t_keys, t_ok, s_keys, s_ok, mesh=mesh)
-        self._device_join = res
-        matched = np.nonzero(res.t_matched)[0]
-        joined = target.take(pa.array(matched, pa.int64()))
-        s_taken = src.take(pa.array(res.t_first_s[matched], pa.int64()))
-        for name in s_taken.column_names:
-            joined = joined.append_column(name, s_taken.column(name))
-        return joined
+        return join_kernel.inner_join_async(
+            t_keys, t_ok, s_keys, s_ok, mesh=mesh, budget_s=budget_s
+        )
 
     def _check_multi_match(self, pairs: pa.Table) -> None:
         """Error when a target row matches multiple source rows, unless the

@@ -39,8 +39,15 @@ def read_files_as_table(
     files: Sequence[AddFile],
     metadata,
     columns: Optional[Sequence[str]] = None,
-) -> pa.Table:
-    """Decode AddFiles to one Arrow table, materializing partition columns."""
+    per_file: bool = False,
+):
+    """Decode AddFiles to one Arrow table, materializing partition columns.
+
+    Files decode in parallel on a thread pool (Arrow's Parquet reader drops
+    the GIL) — the host fan-out the reference gets from Spark executors
+    (`files/TahoeFileIndex.scala:58-81`). ``per_file=True`` returns the list
+    of per-file tables (same order as ``files``) instead of one concat.
+    """
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
     part_schema = metadata.partition_schema
@@ -54,12 +61,11 @@ def read_files_as_table(
     ]
     empty = pa.schema(arrow_fields).empty_table()
     if not files:
-        return empty
+        return [] if per_file else empty
 
     import pyarrow.parquet as pq
 
-    pieces: List[pa.Table] = []
-    for add in files:
+    def read_one(add: AddFile) -> pa.Table:
         abs_path = _abs_data_path(data_path, add.path)
         pf = pq.ParquetFile(abs_path)
         # project to the columns this file actually has (files written before
@@ -93,20 +99,27 @@ def read_files_as_table(
                 t = t.append_column(pa.field(c, at, f.nullable), arr)
         # column order = requested order
         t = t.select([c for c in out_names if c in t.column_names])
-        pieces.append(t)
-    if not pieces:
-        return empty
-    result = pa.concat_tables(pieces, promote_options="permissive")
-    # Cast columns up to the declared table type: files written before an
-    # ALTER ... CHANGE COLUMN widen carry the old narrower type, and concat
-    # only promotes across pieces, not up to the table schema.
-    declared = {f.name: arrow_type_for(f.data_type) for f in schema.fields}
-    for i, name in enumerate(result.column_names):
-        want = declared.get(name)
-        col = result.column(i)
-        if want is not None and col.type != want:
-            result = result.set_column(i, pa.field(name, want, True), col.cast(want))
-    return result
+        # Cast columns up to the declared table type: files written before an
+        # ALTER ... CHANGE COLUMN widen carry the old narrower type.
+        declared = {f.name: arrow_type_for(f.data_type) for f in schema.fields}
+        for i, name in enumerate(t.column_names):
+            want = declared.get(name)
+            col = t.column(i)
+            if want is not None and col.type != want:
+                t = t.set_column(i, pa.field(name, want, True), col.cast(want))
+        return t
+
+    if len(files) == 1:
+        pieces = [read_one(files[0])]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(len(files), os.cpu_count() or 4)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            pieces = list(pool.map(read_one, files))
+    if per_file:
+        return pieces
+    return pa.concat_tables(pieces, promote_options="permissive")
 
 
 def scan_files(snapshot, filters: Sequence[Union[str, ir.Expression]] = ()) -> pruning.DeltaScan:

@@ -15,43 +15,54 @@ Shape of the kernel (TPU-first, not a shuffle translation):
   `all_gather`ed over ICI (tiled, one collective). Each shard then runs a
   static-shaped sort-merge probe:
 
-      sort source by (key, invalid)          # valid rows first in a key run
-      lo/hi = searchsorted(target slab keys) # bitonic-sort-backed on TPU
-      count = valid-prefix-sum[hi] - [lo]    # exact per-target match count
-      first = source-perm[lo]                # first matching source row
+      sort source keys                       # bitonic-sort-backed on TPU
+      lo/hi = searchsorted(slab keys)        # left/right bounds per key
+      count = hi - lo                        # exact per-target match count
 
   and the per-source matched flags (needed for NOT MATCHED inserts and the
   reference's insert-only left-anti fast path, `:397-450`) come from the
   reverse probe reduced with `psum` over ICI.
 
-Exactness: keys are int64 *values* (no hashing), so there are no false
-matches; NULL keys never join (validity masks, SQL semantics). Non-integer
-or multi-column join keys stay on the host Arrow hash join.
+Link economics (this is the part a CUDA translation would get wrong):
 
-The per-target output is (match count, first matching source row). This is
-lossless for MERGE because a target row matching >1 source rows is an error
-(`:351-365`) except when duplicates are harmless (single unconditional
-DELETE, insert-only) — in which case any one match carries the decision.
+  - NULL/invalid keys are encoded as *sentinels* (a value provably outside
+    both sides' valid range, distinct per side so invalid never matches
+    invalid) instead of shipping validity arrays — halves the upload.
+  - The device returns only **bit-packed match masks** (n/8 + m/8 bytes)
+    plus a scalar multi-match flag. The target→source *pairing* for
+    matched rows is recovered on the host with a vectorized searchsorted
+    over the matched subset: the device answers the O(n) membership
+    question, the host the O(matched) pairing one.
+  - `inner_join_async` stages the upload + dispatch on a background thread
+    (JAX transfers drop the GIL), so callers overlap the whole device leg
+    with host-side Parquet decode and only block in `.result()`.
+  - Before launching, the transfer plan is priced against the link profile
+    (`parallel/link.py`); when the caller passes the host-join cost as
+    ``budget_s`` and the link can't beat it, the launch is declined — on a
+    network-tunneled chip bulk uploads run ~6 MB/s and the host hash join
+    wins any cold >few-MB join, while on PCIe/DMA hosts the device path
+    engages automatically.
+
+Exactness: keys are int64 *values* (no hashing), so there are no false
+matches. Composite integer keys are packed into one int64 lane by the
+caller (`commands/merge.py`); non-integer keys stay on the host Arrow
+hash join.
 """
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+import threading
+from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
-__all__ = ["JoinResult", "inner_join"]
+__all__ = ["JoinResult", "PendingJoin", "inner_join", "inner_join_async"]
 
 
 class JoinResult(NamedTuple):
-    """Per-row join outcome (host numpy, unpadded).
+    """Per-row join outcome (host numpy, unpadded)."""
 
-    Outputs are packed to minimize device→host transfer (the dominant cost
-    on PCIe- or tunnel-attached chips): one int32 per target row instead of
-    separate count/index arrays, and the multi-match signal reduced to a
-    scalar on device."""
-
-    t_first_s: np.ndarray  # int32 per target row: first matching source row, -1 = no match
+    t_first_s: np.ndarray  # int64 per target row: first matching source row, -1 = no match
     s_matched: np.ndarray  # bool per source row: has at least one target match
     any_multi: bool  # some target row matched more than one source row
 
@@ -60,28 +71,39 @@ class JoinResult(NamedTuple):
         return self.t_first_s >= 0
 
 
-def _next_pow2(n: int) -> int:
+class PendingJoin:
+    """Handle for an in-flight device join; `.result()` blocks on the
+    device→host transfer and finishes the host-side pairing recovery."""
+
+    def __init__(self, finalize: Callable[[], JoinResult]):
+        self._finalize = finalize
+        self._result: Optional[JoinResult] = None
+
+    def result(self) -> JoinResult:
+        if self._result is None:
+            self._result = self._finalize()
+        return self._result
+
+
+def _bucket(n: int) -> int:
+    """Pad size: pow2 up to 4M (few compile shapes), then 2M granularity
+    (padding a 10M-row slab to 16.7M would ship 67% more bytes over a
+    ~6 MB/s link just to save a compile)."""
     p = 8
     while p < n:
         p *= 2
-    return p
+        if p >= 4_194_304:
+            break
+    if n <= p <= 4_194_304:
+        return p
+    g = 2_097_152
+    return ((n + g - 1) // g) * g
 
 
-def _sorted_probe(jnp, jax, probe_keys, probe_valid, base_key, base_invalid):
-    """count of valid base rows whose key equals each probe key, plus the
-    position of the first such row in the (key, invalid)-sorted base."""
-    m = base_key.shape[0]
-    perm = jnp.arange(m, dtype=jnp.int32)
-    k_sorted, inv_sorted, perm_sorted = jax.lax.sort(
-        (base_key, base_invalid, perm), num_keys=2
-    )
-    valid_sorted = (inv_sorted == 0).astype(jnp.int32)
-    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(valid_sorted, dtype=jnp.int32)])
-    lo = jnp.searchsorted(k_sorted, probe_keys, side="left", method="sort")
-    hi = jnp.searchsorted(k_sorted, probe_keys, side="right", method="sort")
-    count = jnp.where(probe_valid, cum[hi] - cum[lo], 0)
-    first = perm_sorted[jnp.clip(lo, 0, m - 1)]
-    return count, first
+def _probe_counts(jnp, base_sorted, probe_keys):
+    lo = jnp.searchsorted(base_sorted, probe_keys, side="left", method="sort")
+    hi = jnp.searchsorted(base_sorted, probe_keys, side="right", method="sort")
+    return hi - lo
 
 
 @functools.lru_cache(maxsize=None)
@@ -95,13 +117,14 @@ def _single_device_kernel(jax):
     import jax.numpy as jnp
 
     @jax.jit
-    def kernel(t_key, t_invalid, s_key, s_invalid):
-        t_valid = t_invalid == 0
-        s_valid = s_invalid == 0
-        count, first = _sorted_probe(jnp, jax, t_key, t_valid, s_key, s_invalid)
-        s_count, _ = _sorted_probe(jnp, jax, s_key, s_valid, t_key, t_invalid)
-        packed = jnp.where(count > 0, first, -1)
-        return packed, s_count > 0, jnp.any(count > 1)
+    def kernel(t_key, s_key):
+        s_sorted = jax.lax.sort(s_key)
+        t_sorted = jax.lax.sort(t_key)
+        count = _probe_counts(jnp, s_sorted, t_key)
+        s_count = _probe_counts(jnp, t_sorted, s_key)
+        t_bits = jnp.packbits((count > 0).astype(jnp.uint8))
+        s_bits = jnp.packbits((s_count > 0).astype(jnp.uint8))
+        return t_bits, s_bits, jnp.any(count > 1)
 
     return kernel
 
@@ -121,25 +144,22 @@ def _sharded_kernel(jax, mesh, axis):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        in_specs=(P(axis), P(axis)),
         out_specs=(P(axis), P(), P()),
     )
-    def kernel(t_key, t_invalid, s_key, s_invalid):
+    def kernel(t_key, s_key):
         # slabs arrive stacked (1, cap); source is gathered over ICI so every
         # shard probes the full (padded) source in original order
-        tk, ti = t_key[0], t_invalid[0]
-        s_full_key = jax.lax.all_gather(s_key[0], axis, tiled=True)
-        s_full_inv = jax.lax.all_gather(s_invalid[0], axis, tiled=True)
-        t_valid = ti == 0
-        s_valid = s_full_inv == 0
-        count, first = _sorted_probe(jnp, jax, tk, t_valid, s_full_key, s_full_inv)
-        packed = jnp.where(count > 0, first, -1)
+        tk = t_key[0]
+        s_full = jax.lax.all_gather(s_key[0], axis, tiled=True)
+        count = _probe_counts(jnp, jax.lax.sort(s_full), tk)
+        t_bits = jnp.packbits((count > 0).astype(jnp.uint8))
         # reverse probe: this shard's target slab vs the full source; a source
         # row is matched iff any shard finds a hit → psum over ICI
-        s_count, _ = _sorted_probe(jnp, jax, s_full_key, s_valid, tk, ti)
+        s_count = _probe_counts(jnp, jax.lax.sort(tk), s_full)
         s_hits = jax.lax.psum(jnp.minimum(s_count, 1), axis)
         multi = jax.lax.psum(jnp.any(count > 1).astype(jnp.int32), axis)
-        return packed[None], s_hits > 0, multi > 0
+        return t_bits[None], jnp.packbits(s_hits.astype(jnp.uint8)), multi > 0
 
     return jax.jit(kernel)
 
@@ -150,6 +170,202 @@ def _pad(col: np.ndarray, cap: int, fill) -> np.ndarray:
     return out
 
 
+def _first_match_recovery(
+    t_keys: np.ndarray,
+    t_matched_idx: np.ndarray,
+    s_keys: np.ndarray,
+    s_ok: np.ndarray,
+) -> np.ndarray:
+    """For each matched target row, the lowest source row index with an equal
+    key — vectorized binary search over the valid source keys, stable-sorted
+    so ties resolve to the earliest original row."""
+    vidx = np.flatnonzero(s_ok)
+    vk = s_keys[vidx]
+    order = np.argsort(vk, kind="stable")
+    sk = vk[order]
+    si = vidx[order]
+    pos = np.searchsorted(sk, t_keys[t_matched_idx], side="left")
+    return si[pos]
+
+
+def _host_join(t_key64, t_ok, s_key64, s_ok) -> JoinResult:
+    """Vectorized numpy sort-merge join — the device kernel's semantics
+    without the device (used when no sentinel value exists)."""
+    n, m = len(t_key64), len(s_key64)
+    sk = np.sort(s_key64[s_ok])
+    lo = np.searchsorted(sk, t_key64, side="left")
+    hi = np.searchsorted(sk, t_key64, side="right")
+    count = np.where(t_ok, hi - lo, 0)
+    t_first_s = np.full(n, -1, np.int64)
+    idx = np.flatnonzero(count > 0)
+    if idx.size:
+        t_first_s[idx] = _first_match_recovery(t_key64, idx, s_key64, s_ok)
+    ts = np.sort(t_key64[t_ok])
+    s_matched = s_ok & (
+        np.searchsorted(ts, s_key64, side="right")
+        > np.searchsorted(ts, s_key64, side="left")
+    )
+    return JoinResult(t_first_s, s_matched, bool((count > 1).any()))
+
+
+def _sentinel_encode(t_key, t_ok, s_key, s_ok, dtype):
+    """Replace invalid keys with per-side sentinels outside both sides'
+    valid range (invalid never matches anything, including other invalids).
+    Returns (t_enc, s_enc, t_pad_fill, s_pad_fill) or None when the valid
+    values span the entire dtype range (fall back to the host join)."""
+    info = np.iinfo(dtype)
+    lo = min(
+        np.min(t_key, where=t_ok, initial=info.max),
+        np.min(s_key, where=s_ok, initial=info.max),
+    )
+    hi = max(
+        np.max(t_key, where=t_ok, initial=info.min),
+        np.max(s_key, where=s_ok, initial=info.min),
+    )
+    if hi <= info.max - 2:
+        t_sent, s_sent = info.max, info.max - 1
+    elif lo >= info.min + 2:
+        t_sent, s_sent = info.min, info.min + 1
+    else:
+        return None
+    t_enc = t_key if t_ok.all() else np.where(t_ok, t_key, dtype(t_sent))
+    s_enc = s_key if s_ok.all() else np.where(s_ok, s_key, dtype(s_sent))
+    return (
+        np.ascontiguousarray(t_enc, dtype),
+        np.ascontiguousarray(s_enc, dtype),
+        dtype(t_sent),
+        dtype(s_sent),
+    )
+
+
+def inner_join_async(
+    t_keys: np.ndarray,
+    t_valid: np.ndarray,
+    s_keys: np.ndarray,
+    s_valid: np.ndarray,
+    mesh=None,
+    budget_s: Optional[float] = None,
+) -> Optional[PendingJoin]:
+    """Launch the device membership probe without blocking.
+
+    ``mesh`` is a 1-D `jax.sharding.Mesh` (target sharded contiguously,
+    source gathered); None runs the single-device kernel. Rows with
+    ``valid == False`` (SQL NULL keys) never match. Keys are narrowed to
+    int32 when both sides' values fit — halves the upload.
+
+    ``budget_s``: decline the launch (return None) when the link cost
+    model prices the device leg above this budget — the caller's estimate
+    of its fallback (host hash join) cost. None = always launch.
+    """
+    n, m = len(t_keys), len(s_keys)
+    if n == 0 or m == 0:
+        return PendingJoin(
+            lambda: JoinResult(np.full(n, -1, np.int64), np.zeros(m, bool), False)
+        )
+
+    t_key64 = np.ascontiguousarray(t_keys, np.int64)
+    s_key64 = np.ascontiguousarray(s_keys, np.int64)
+    t_ok = np.asarray(t_valid, bool)
+    s_ok = np.asarray(s_valid, bool)
+
+    # narrow to int32 when exact; margin of 2 keeps sentinel room
+    i32 = np.iinfo(np.int32)
+    if (
+        np.min(t_key64, where=t_ok, initial=0) >= i32.min + 2
+        and np.max(t_key64, where=t_ok, initial=0) <= i32.max
+        and np.min(s_key64, where=s_ok, initial=0) >= i32.min + 2
+        and np.max(s_key64, where=s_ok, initial=0) <= i32.max
+    ):
+        kdtype: type = np.int32
+        enc = _sentinel_encode(
+            np.where(t_ok, t_key64, 0).astype(np.int32), t_ok,
+            np.where(s_ok, s_key64, 0).astype(np.int32), s_ok, np.int32,
+        )
+    else:
+        kdtype = np.int64
+        enc = _sentinel_encode(t_key64, t_ok, s_key64, s_ok, np.int64)
+    if enc is None:
+        # valid keys span the whole dtype: no sentinel room. With a budget
+        # the caller has its own fallback; without one, honor the contract
+        # with the host numpy sort-merge join.
+        if budget_s is not None:
+            return None
+        return PendingJoin(
+            lambda: _host_join(t_key64, t_ok, s_key64, s_ok)
+        )
+    t_enc, s_enc, t_fill, s_fill = enc
+
+    if mesh is None or getattr(mesh, "devices", np.empty(0)).size <= 1:
+        p = 1
+        cap_t, cap_s = _bucket(n), _bucket(m)
+    else:
+        from delta_tpu.parallel.mesh import shard_count
+
+        p = shard_count(mesh)
+        cap_t = _bucket((n + p - 1) // p) * p
+        cap_s = _bucket((m + p - 1) // p) * p
+
+    if budget_s is not None:
+        from delta_tpu.parallel import link
+
+        itemsize = np.dtype(kdtype).itemsize
+        est = link.estimate_device_s(
+            up_bytes=(cap_t + cap_s) * itemsize,
+            down_bytes=cap_t // 8 + cap_s // 8,
+            # per-shard work: the target slab sorts locally, the gathered
+            # source is probed in full on every shard
+            kernel_rows=cap_t // p + cap_s,
+        )
+        if est.device_s > budget_s:
+            return None
+
+    t_in = _pad(t_enc, cap_t, t_fill)
+    s_in = _pad(s_enc, cap_s, s_fill)
+
+    state: dict = {}
+
+    def launch():
+        import jax
+
+        try:
+            with jax.enable_x64():
+                if p == 1:
+                    kernel = _single_device_kernel_cached()
+                    args = [jax.device_put(t_in), jax.device_put(s_in)]
+                    state["out"] = kernel(*args)
+                else:
+                    from delta_tpu.parallel.mesh import STATE_AXIS
+
+                    kernel = _sharded_kernel_cached(mesh, STATE_AXIS)
+                    state["out"] = kernel(
+                        t_in.reshape(p, -1), s_in.reshape(p, -1)
+                    )
+                jax.block_until_ready(state["out"])
+        except BaseException as e:  # surface in .result(), not on the thread
+            state["err"] = e
+
+    # uploads drop the GIL: stage transfer + dispatch off-thread so callers
+    # overlap the device leg with host-side decode
+    th = threading.Thread(target=launch, daemon=True)
+    th.start()
+
+    def finalize() -> JoinResult:
+        th.join()
+        if "err" in state:
+            raise state["err"]
+        t_bits, s_bits, multi = state["out"]
+        t_matched = np.unpackbits(np.asarray(t_bits).reshape(-1))[:n].astype(bool)
+        s_matched = np.unpackbits(np.asarray(s_bits).reshape(-1))[:m].astype(bool)
+        any_multi = bool(multi)
+        t_first_s = np.full(n, -1, np.int64)
+        idx = np.flatnonzero(t_matched)
+        if idx.size:
+            t_first_s[idx] = _first_match_recovery(t_key64, idx, s_key64, s_ok)
+        return JoinResult(t_first_s, s_matched, any_multi)
+
+    return PendingJoin(finalize)
+
+
 def inner_join(
     t_keys: np.ndarray,
     t_valid: np.ndarray,
@@ -157,66 +373,8 @@ def inner_join(
     s_valid: np.ndarray,
     mesh=None,
 ) -> JoinResult:
-    """Join int64 target keys against int64 source keys on device.
-
-    ``mesh`` is a 1-D `jax.sharding.Mesh` (target sharded contiguously,
-    source gathered); None runs the single-device kernel. Rows with
-    ``valid == False`` (SQL NULL keys, padding) never match. Keys are
-    narrowed to int32 when both sides' values fit — halves the host→device
-    transfer, which dominates on remote-attached chips.
-    """
-    import jax
-
-    n, m = len(t_keys), len(s_keys)
-    if n == 0 or m == 0:
-        return JoinResult(np.full(n, -1, np.int32), np.zeros(m, bool), False)
-
-    t_key64 = np.ascontiguousarray(t_keys, np.int64)
-    s_key64 = np.ascontiguousarray(s_keys, np.int64)
-    t_ok = np.asarray(t_valid, bool)
-    s_ok = np.asarray(s_valid, bool)
-    t_inv = (~t_ok).astype(np.int32)
-    s_inv = (~s_ok).astype(np.int32)
-
-    # narrow to int32 when exact (valid keys only; invalid rows never match);
-    # where= reductions avoid materializing boolean-indexed copies
-    kdtype = np.int64
-    i32 = np.iinfo(np.int32)
-    if (
-        np.min(t_key64, where=t_ok, initial=0) >= i32.min
-        and np.max(t_key64, where=t_ok, initial=0) <= i32.max
-        and np.min(s_key64, where=s_ok, initial=0) >= i32.min
-        and np.max(s_key64, where=s_ok, initial=0) <= i32.max
-    ):
-        kdtype = np.int32
-        t_key64 = np.where(t_ok, t_key64, 0).astype(np.int32)
-        s_key64 = np.where(s_ok, s_key64, 0).astype(np.int32)
-
-    if mesh is None or mesh.devices.size == 1:
-        cap_t, cap_s = _next_pow2(n), _next_pow2(m)
-        kernel = _single_device_kernel_cached()
-        with jax.enable_x64():
-            packed, s_matched, multi = kernel(
-                _pad(t_key64, cap_t, kdtype(0)), _pad(t_inv, cap_t, 1),
-                _pad(s_key64, cap_s, kdtype(0)), _pad(s_inv, cap_s, 1),
-            )
-        return JoinResult(
-            np.asarray(packed)[:n], np.asarray(s_matched)[:m], bool(multi)
-        )
-
-    from delta_tpu.parallel.mesh import STATE_AXIS, shard_count
-
-    p = shard_count(mesh)
-    cap_t = _next_pow2((n + p - 1) // p) * p
-    cap_s = _next_pow2((m + p - 1) // p) * p
-    kernel = _sharded_kernel_cached(mesh, STATE_AXIS)
-    with jax.enable_x64():
-        packed, s_matched, multi = kernel(
-            _pad(t_key64, cap_t, kdtype(0)).reshape(p, -1),
-            _pad(t_inv, cap_t, 1).reshape(p, -1),
-            _pad(s_key64, cap_s, kdtype(0)).reshape(p, -1),
-            _pad(s_inv, cap_s, 1).reshape(p, -1),
-        )
-    return JoinResult(
-        np.asarray(packed).reshape(-1)[:n], np.asarray(s_matched)[:m], bool(multi)
-    )
+    """Blocking wrapper: join int64 target keys against int64 source keys on
+    device (see `inner_join_async`)."""
+    pending = inner_join_async(t_keys, t_valid, s_keys, s_valid, mesh=mesh)
+    assert pending is not None  # no budget → always launches
+    return pending.result()

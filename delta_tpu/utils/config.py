@@ -45,8 +45,17 @@ class SqlConf:
         # ≈ MERGE_MATCHED_ONLY_ENABLED
         "delta.tpu.merge.optimizeMatchedOnlyMerge.enabled": True,
         # Run the MERGE equi-join on device (ops/join_kernel) when the
-        # condition is a single integer equi-key with no residual conjuncts.
+        # condition is 1-2 integer equi-keys with no residual conjuncts
+        # (composite keys pack into one int64 lane).
         "delta.tpu.merge.devicePath.enabled": True,
+        # Executor routing for the MERGE join: "auto" prices the key upload
+        # against the measured link profile (parallel/link.py) and declines
+        # the device when the host hash join is cheaper; "force" always
+        # launches the kernel; "off" never does.
+        "delta.tpu.merge.devicePath.mode": "auto",
+        # Link profile overrides (MB/s). Unset = probe once per process.
+        "delta.tpu.link.uploadMBps": None,
+        "delta.tpu.link.downloadMBps": None,
         # ≈ DELTA_STATS_SKIPPING (DeltaSQLConf.scala:150) — we actually wire it
         "delta.tpu.stats.skipping": True,
         # ≈ DELTA_COLLECT_STATS — collect per-file min/max/nullCount on write
@@ -67,6 +76,13 @@ class SqlConf:
         "delta.tpu.writeChecksum.enabled": True,
         # Target max rows per written data file (write-path sharding unit).
         "delta.tpu.write.targetFileRows": 4_000_000,
+        # BYTE_STREAM_SPLIT encoding for float columns: much faster decode,
+        # equal size. Disable for parquet-mr < 1.12 readers (Spark <= 3.1).
+        "delta.tpu.write.byteStreamSplit": True,
+        # "auto" = snappy only on string/float columns, high-entropy ints
+        # uncompressed (snappy on random int64 is 14x slower to decode for
+        # ~10% size); or a codec name applied to all columns.
+        "delta.tpu.write.compression": "auto",
         # Device mesh axis name used by sharded kernels.
         "delta.tpu.mesh.axis": "shards",
         # Use the JAX device path for scan planning / pruning when possible.

@@ -544,7 +544,12 @@ def _run_merge_both_paths(tmp_path, name, target_data, source, cond, matched,
         path = str(tmp_path / f"{name}_{device}")
         log = DeltaLog.for_table(path)
         write(log, target_data)
-        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": device}):
+        with conf.set_temporarily(**{
+            "delta.tpu.merge.devicePath.enabled": device,
+            # force: routing economics are exercised separately; these tests
+            # pin the executor to check kernel/host parity
+            "delta.tpu.merge.devicePath.mode": "force" if device else "off",
+        }):
             cmd = MergeIntoCommand(log, source, cond, matched, not_matched, **kw)
             cmd.run()
         cmds.append(cmd)
@@ -605,7 +610,8 @@ def test_merge_device_multi_match_errors(tmp_path):
     log = DeltaLog.for_table(path)
     write(log, {"id": [1, 2], "v": [10, 20]})
     src = pa.table({"id": [1, 1], "v": [100, 101]})
-    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True}):
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True,
+                                 "delta.tpu.merge.devicePath.mode": "force"}):
         cmd = MergeIntoCommand(
             log, src, "t.id = s.id",
             [MergeClause("update", assignments=None)], [],
@@ -637,7 +643,8 @@ def test_merge_device_string_key_falls_back_to_host(tmp_path):
     path = str(tmp_path / "str")
     log = DeltaLog.for_table(path)
     write(log, {"id": ["a", "b"], "v": [1, 2]})
-    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True}):
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.enabled": True,
+                                 "delta.tpu.merge.devicePath.mode": "force"}):
         cmd = MergeIntoCommand(
             log, pa.table({"id": ["b", "c"], "v": [20, 30]}), "t.id = s.id",
             [MergeClause("update", assignments=None)],

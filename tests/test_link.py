@@ -1,0 +1,133 @@
+"""Link cost model + MERGE executor routing (parallel/link.py).
+
+The device join's profitability is decided by the host↔device link, not
+the FLOPs — these tests pin the routing decisions with conf-overridden
+link profiles (no probe, deterministic)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.merge import MergeClause, MergeIntoCommand, _rows_from_stats
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.ops import join_kernel
+from delta_tpu.parallel import link
+from delta_tpu.utils.config import conf
+
+
+@pytest.fixture(autouse=True)
+def fresh_link():
+    link.reset()
+    yield
+    link.reset()
+
+
+def _with_link(up, down):
+    return conf.set_temporarily(**{
+        "delta.tpu.link.uploadMBps": up,
+        "delta.tpu.link.downloadMBps": down,
+    })
+
+
+def test_profile_conf_override_skips_probe():
+    with _with_link(6.0, 4.0):
+        p = link.profile()
+    assert not p.probed
+    assert p.up_mbps == 6.0 and p.down_mbps == 4.0
+    # 60 MB at 6 MB/s ~ 10s
+    assert 9.9 < p.upload_s(60_000_000) < 10.2
+
+
+def test_estimate_scales_kernel_by_shards():
+    with _with_link(10_000.0, 10_000.0):
+        one = link.estimate_device_s(1 << 20, 1 << 10, kernel_rows=8_000_000)
+        link.reset()
+    with _with_link(10_000.0, 10_000.0):
+        eight = link.estimate_device_s(
+            1 << 20, 1 << 10, kernel_rows=8_000_000, shards=8
+        )
+    assert eight.kernel_s < one.kernel_s
+    assert eight.device_s < one.device_s
+
+
+def test_budget_declines_on_slow_link():
+    rng = np.random.RandomState(0)
+    t = rng.randint(0, 1000, 50_000).astype(np.int64)
+    s = rng.randint(500, 1500, 5_000).astype(np.int64)
+    ok_t, ok_s = np.ones(len(t), bool), np.ones(len(s), bool)
+    with _with_link(6.0, 4.0):
+        # host estimate for 55k rows ~ 5.5ms; shipping 220KB at 6MB/s alone
+        # costs ~37ms -> decline
+        budget = (len(t) + len(s)) * link.HOST_JOIN_S_PER_ROW
+        assert join_kernel.inner_join_async(t, ok_t, s, ok_s, budget_s=budget) is None
+
+
+def test_budget_accepts_on_fast_link():
+    rng = np.random.RandomState(0)
+    t = rng.randint(0, 1000, 50_000).astype(np.int64)
+    s = rng.randint(500, 1500, 5_000).astype(np.int64)
+    ok_t, ok_s = np.ones(len(t), bool), np.ones(len(s), bool)
+    with _with_link(50_000.0, 50_000.0):  # PCIe-class
+        pending = join_kernel.inner_join_async(
+            t, ok_t, s, ok_s, budget_s=10.0
+        )
+        assert pending is not None
+        res = pending.result()
+    assert (res.t_matched == np.isin(t, s)).all()
+    assert (res.s_matched == np.isin(s, t)).all()
+
+
+def test_merge_auto_mode_declines_and_stays_correct(tmp_path):
+    path = str(tmp_path / "auto")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({
+        "id": np.arange(1000, dtype=np.int64),
+        "v": np.zeros(1000, np.int64),
+    })).run()
+    src = pa.table({"id": np.arange(500, 1500, dtype=np.int64),
+                    "v": np.ones(1000, np.int64)})
+    with _with_link(6.0, 4.0), conf.set_temporarily(**{
+        "delta.tpu.merge.devicePath.mode": "auto",
+    }):
+        cmd = MergeIntoCommand(
+            log, src, "t.id = s.id",
+            [MergeClause("update", assignments=None)],
+            [MergeClause("insert", assignments=None)],
+            source_alias="s", target_alias="t",
+        )
+        cmd.run()
+    assert cmd._device_join is None  # routed to the host hash join
+    assert cmd.metrics["numTargetRowsUpdated"] == 500
+    assert cmd.metrics["numTargetRowsInserted"] == 500
+
+
+def test_rows_from_stats_reads_numrecords(tmp_path):
+    path = str(tmp_path / "stats")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({
+        "id": np.arange(100, dtype=np.int64)})).run()
+    files = log.update().all_files
+    assert _rows_from_stats(files) == 100
+    # files without stats -> None (fall back to post-decode routing)
+    import dataclasses
+
+    no_stats = [dataclasses.replace(f, stats=None) for f in files]
+    assert _rows_from_stats(no_stats) is None
+
+
+def test_host_join_fallback_when_no_sentinel_room():
+    info = np.iinfo(np.int64)
+    # valid keys span the whole int64 range -> no sentinel fits
+    t = np.array([info.min, info.min + 1, 5, info.max - 1, info.max], np.int64)
+    t_ok = np.array([True, True, True, True, True])
+    s = np.array([info.min, 5, 7, info.max], np.int64)
+    s_ok = np.array([True, True, False, True])
+    pending = join_kernel.inner_join_async(t, t_ok, s, s_ok)
+    assert pending is not None
+    res = pending.result()
+    assert list(res.t_matched) == [True, False, True, False, True]
+    assert list(res.s_matched) == [True, True, False, True]
+    assert res.any_multi is False
+    # with a budget the caller's fallback is preferred
+    with _with_link(6.0, 4.0):
+        assert join_kernel.inner_join_async(t, t_ok, s, s_ok, budget_s=100.0) is None
