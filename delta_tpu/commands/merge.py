@@ -93,18 +93,10 @@ _FID = "__t_file__"
 def _rows_from_stats(candidates) -> Optional[int]:
     """Total numRecords over the candidate files, None when any file lacks
     stats (routing then falls back to the post-decode estimate)."""
-    import json as _json
-
     total = 0
     for add in candidates:
-        if not add.stats:
-            return None
-        try:
-            parsed = _json.loads(add.stats)
-        except (ValueError, TypeError):
-            return None
-        n = parsed.get("numRecords") if isinstance(parsed, dict) else None
-        if not isinstance(n, (int, float)):
+        n = add.num_logical_records
+        if n is None:
             return None
         total += int(n)
     return total
@@ -186,6 +178,35 @@ class MergeIntoCommand:
                         "only the last can omit its condition"
                     )
 
+    def _migrate_schema(self, txn):
+        """MERGE schema evolution (`deltaMerge.scala:224-424`,
+        `PreprocessTableMerge.scala:65-71`): when
+        ``delta.tpu.schema.autoMerge.enabled`` is on and the merge has a
+        star clause (updateAll/insertAll), the target schema widens to
+        ``mergeSchemas(target, source)`` — new source columns append, and
+        existing columns keep the target's name case/position with types
+        implicitly widened. Returns the (possibly evolved) txn metadata."""
+        from dataclasses import replace
+
+        from delta_tpu.schema import schema_utils
+        from delta_tpu.schema.arrow_interop import schema_from_arrow
+
+        metadata = txn.metadata
+        auto = bool(conf.get("delta.tpu.schema.autoMerge.enabled", False))
+        has_star = any(
+            c.is_star for c in list(self.matched_clauses) + list(self.not_matched_clauses)
+        )
+        if not (auto and has_star):
+            return metadata
+        src_schema = schema_from_arrow(self.source.schema)
+        merged = schema_utils.merge_schemas(
+            metadata.schema, src_schema, allow_implicit_conversions=True
+        )
+        if merged.to_json() != metadata.schema.to_json():
+            txn.update_metadata(replace(metadata, schema_string=merged.to_json()))
+            metadata = txn.metadata
+        return metadata
+
     # -- name resolution --------------------------------------------------
 
     def _resolve(self, e: ir.Expression, target_cols: Sequence[str],
@@ -261,9 +282,20 @@ class MergeIntoCommand:
         self._device_join = None
         self.phase_ms.clear()
         timer = Timer()
-        metadata = txn.metadata
+        metadata = self._migrate_schema(txn)
         target_cols = [f.name for f in metadata.schema.fields]
         source_cols = list(self.source.column_names)
+        # static star-coverage analysis (the reference resolves stars at
+        # analysis time, `deltaMerge.scala:322-328` — the error must not
+        # depend on whether any row fires the clause)
+        for clause in self.matched_clauses:
+            if clause.is_star:
+                self._check_star_coverage(target_cols, source_cols, "UPDATE", metadata)
+                break
+        for clause in self.not_matched_clauses:
+            if clause.is_star:
+                self._check_star_coverage(target_cols, source_cols, "INSERT", metadata)
+                break
         cond = self._resolve(self.condition, target_cols, source_cols)
         equi, residual = self._split_equi_keys(cond)
 
@@ -623,6 +655,33 @@ class MergeIntoCommand:
             t_keys, t_ok, s_keys, s_ok, mesh=mesh, budget_s=budget_s
         )
 
+    def _check_star_coverage(
+        self, target_cols: Sequence[str], src_cols: Sequence[str], typ: str,
+        metadata,
+    ) -> None:
+        """Star clauses resolve every target column against the source unless
+        schema evolution is on (then the star expands over source columns)."""
+        if bool(conf.get("delta.tpu.schema.autoMerge.enabled", False)):
+            return
+        src_low = {s.lower() for s in src_cols}
+        # generated columns are computed, not resolved from the source
+        from delta_tpu.schema import generated as generated_mod
+
+        gen = {
+            g.lower()
+            for g in generated_mod.generation_expressions(metadata.schema)
+        }
+        missing = [
+            c for c in target_cols
+            if c.lower() not in src_low and c.lower() not in gen
+        ]
+        if missing:
+            raise DeltaAnalysisError(
+                f"cannot resolve {missing[0]} in {typ} clause given columns "
+                f"{list(src_cols)} (enable delta.tpu.schema.autoMerge.enabled "
+                f"to evolve the target schema instead)"
+            )
+
     def _check_multi_match(self, pairs: pa.Table) -> None:
         """Error when a target row matches multiple source rows, unless the
         merge is a single unconditional DELETE (`:351-365`)."""
@@ -705,7 +764,8 @@ class MergeIntoCommand:
                         target_cols: List[str], metadata) -> pa.Table:
         src_cols = [c[len(_SRC):] for c in block.column_names if c.startswith(_SRC)]
         if clause.is_star:
-            # updateAll: SET t.c = s.c for every target column present in source
+            # updateAll: SET t.c = s.c (star coverage validated statically
+            # in _body; with evolution target-only columns are no-ops)
             assignments = {
                 c: ir.Column(_SRC + next(s for s in src_cols if s.lower() == c.lower()))
                 for c in target_cols

@@ -90,9 +90,13 @@ def _compresses_well(col: pa.ChunkedArray, sample_bytes: int = 65536) -> bool:
         chunk = col.chunk(0) if col.num_chunks else None
         if chunk is None or len(chunk) == 0:
             return True
-        raw = b"".join(
-            bytes(b)[:sample_bytes] for b in chunk.buffers() if b is not None
-        )[:sample_bytes]
+        # sample the DATA buffer (last) — the validity bitmap compresses to
+        # nothing and would misjudge every nullable high-entropy column
+        bufs = [b for b in chunk.buffers() if b is not None]
+        if not bufs:
+            return True
+        data = bufs[-1]
+        raw = bytes(data.slice(0, min(sample_bytes, data.size)))  # zero-copy slice
         if len(raw) < 1024:
             return True
         return len(pa.compress(raw, codec="snappy", asbytes=True)) < 0.9 * len(raw)

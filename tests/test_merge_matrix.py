@@ -1,0 +1,410 @@
+"""MERGE behavioral matrix — ported from the reference's MergeIntoSuiteBase
+(`core/src/test/scala/org/apache/spark/sql/delta/MergeIntoSuiteBase.scala`,
+2,922 LoC) high-value cases: NULL-key semantics, star expansion with
+extra/missing/reordered source columns, per-clause conditions referencing
+both sides, clause ordering, self-merge, and schema evolution
+(`deltaMerge.scala:224-424`). Every case runs on both executors (device
+kernel forced / host Arrow join) via the ``executor`` fixture."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.utils.config import conf
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    DeltaUnsupportedOperationError,
+)
+
+
+@pytest.fixture(params=["device", "host"])
+def executor(request):
+    mode = "force" if request.param == "device" else "off"
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.mode": mode}):
+        yield request.param
+
+
+def _write(path, data, **kw):
+    log = DeltaLog.for_table(str(path))
+    WriteIntoDelta(log, "append", pa.table(data) if isinstance(data, dict) else data,
+                   **kw).run()
+    return log
+
+
+def _rows(log, sort="id"):
+    from delta_tpu.exec.scan import scan_to_table
+
+    t = scan_to_table(log.update())
+    if sort and sort in t.column_names:
+        t = t.sort_by(sort)
+    return t.to_pylist()
+
+
+def _merge(log, source, cond, matched=(), not_matched=(), **kw):
+    cmd = MergeIntoCommand(
+        log, pa.table(source) if isinstance(source, dict) else source, cond,
+        matched, not_matched, **kw
+    )
+    cmd.run()
+    return cmd
+
+
+UP = MergeClause("update", assignments=None)
+INS = MergeClause("insert", assignments=None)
+ALIAS = dict(source_alias="s", target_alias="t")
+
+
+# -- basic shapes -----------------------------------------------------------
+
+
+def test_update_only(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2, 3], "v": [10, 20, 30]})
+    cmd = _merge(log, {"id": [2, 4], "v": [99, 99]}, "t.id = s.id", [UP], [], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 99}, {"id": 3, "v": 30}]
+    assert cmd.metrics["numTargetRowsUpdated"] == 1
+    assert cmd.metrics["numTargetRowsInserted"] == 0
+
+
+def test_insert_only(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    cmd = _merge(log, {"id": [2, 3], "v": [0, 30]}, "t.id = s.id", [], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 20}, {"id": 3, "v": 30}]
+    assert cmd.metrics["numTargetRowsInserted"] == 1
+
+
+def test_delete_only(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2, 3], "v": [10, 20, 30]})
+    cmd = _merge(log, {"id": [1, 3]}, "t.id = s.id", [MergeClause("delete")], [],
+                 **ALIAS)
+    assert _rows(log) == [{"id": 2, "v": 20}]
+    assert cmd.metrics["numTargetRowsDeleted"] == 2
+
+
+def test_upsert_update_and_insert(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    _merge(log, {"id": [2, 3], "v": [21, 31]}, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 21}, {"id": 3, "v": 31}]
+
+
+def test_update_delete_insert_three_clauses(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2, 3], "v": [10, 20, 30]})
+    _merge(
+        log, {"id": [1, 2, 4], "v": [-1, 99, 40]}, "t.id = s.id",
+        [MergeClause("delete", condition="s.v < 0"), UP],
+        [INS], **ALIAS,
+    )
+    assert _rows(log) == [{"id": 2, "v": 99}, {"id": 3, "v": 30}, {"id": 4, "v": 40}]
+
+
+def test_empty_source_is_noop(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    cmd = _merge(log, pa.table({"id": pa.array([], pa.int64()),
+                                "v": pa.array([], pa.int64())}),
+                 "t.id = s.id", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}]
+    assert cmd.metrics["numTargetRowsUpdated"] == 0
+    assert cmd.metrics["numTargetRowsInserted"] == 0
+
+
+def test_empty_target_inserts_all(tmp_path, executor):
+    path = str(tmp_path / "t")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table(
+        {"id": pa.array([], pa.int64()), "v": pa.array([], pa.int64())})).run()
+    _merge(log, {"id": [1, 2], "v": [10, 20]}, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 20}]
+
+
+# -- clause conditions & ordering -------------------------------------------
+
+
+def test_matched_condition_references_both_sides(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    _merge(
+        log, {"id": [1, 2], "v": [5, 50]}, "t.id = s.id",
+        [MergeClause("update", condition="s.v > t.v", assignments=None)],
+        [], **ALIAS,
+    )
+    # only id=2 satisfies s.v > t.v
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 50}]
+
+
+def test_matched_clause_order_first_wins(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    _merge(
+        log, {"id": [1, 2], "v": [100, 200]}, "t.id = s.id",
+        [
+            MergeClause("update", condition="t.v = 10",
+                        assignments={"v": "s.v + 1"}),
+            MergeClause("update", assignments={"v": "s.v + 2"}),
+        ],
+        [], **ALIAS,
+    )
+    # id=1 hits clause 1 (101), id=2 falls through to clause 2 (202)
+    assert _rows(log) == [{"id": 1, "v": 101}, {"id": 2, "v": 202}]
+
+
+def test_conditional_insert(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    _merge(
+        log, {"id": [2, 3], "v": [20, 30]}, "t.id = s.id", [],
+        [MergeClause("insert", condition="s.v > 25", assignments=None)],
+        **ALIAS,
+    )
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 3, "v": 30}]
+
+
+def test_only_last_clause_may_omit_condition(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    with pytest.raises(DeltaAnalysisError):
+        MergeIntoCommand(
+            log, pa.table({"id": [1], "v": [1]}), "t.id = s.id",
+            [MergeClause("update", assignments=None),
+             MergeClause("delete")], [], **ALIAS,
+        )
+
+
+def test_update_expression_uses_both_sides(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    _merge(
+        log, {"id": [1, 2], "v": [1, 2]}, "t.id = s.id",
+        [MergeClause("update", assignments={"v": "t.v + s.v"})], [], **ALIAS,
+    )
+    assert _rows(log) == [{"id": 1, "v": 11}, {"id": 2, "v": 22}]
+
+
+# -- NULL-key matrix (MergeIntoSuiteBase "Merge with null keys") -------------
+
+
+def test_null_source_keys_insert_not_update(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    src = pa.table({"id": pa.array([1, None], pa.int64()),
+                    "v": pa.array([100, 999], pa.int64())})
+    cmd = _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert cmd.metrics["numTargetRowsUpdated"] == 1
+    assert cmd.metrics["numTargetRowsInserted"] == 1
+    assert _rows(log) == [{"id": 1, "v": 100}, {"id": 2, "v": 20},
+                          {"id": None, "v": 999}]
+
+
+def test_null_target_keys_never_match(tmp_path, executor):
+    log = _write(tmp_path / "t", pa.table({
+        "id": pa.array([None, 2], pa.int64()),
+        "v": pa.array([0, 20], pa.int64())}))
+    cmd = _merge(log, {"id": [2, 3], "v": [21, 31]}, "t.id = s.id", [UP], [INS],
+                 **ALIAS)
+    assert cmd.metrics["numTargetRowsUpdated"] == 1
+    assert _rows(log) == [{"id": 2, "v": 21}, {"id": 3, "v": 31},
+                          {"id": None, "v": 0}]
+
+
+def test_null_never_matches_null(tmp_path, executor):
+    log = _write(tmp_path / "t", pa.table({
+        "id": pa.array([None], pa.int64()), "v": pa.array([0], pa.int64())}))
+    src = pa.table({"id": pa.array([None], pa.int64()),
+                    "v": pa.array([99], pa.int64())})
+    cmd = _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert cmd.metrics["numTargetRowsUpdated"] == 0
+    assert cmd.metrics["numTargetRowsInserted"] == 1
+    got = sorted(_rows(log, sort=None), key=lambda r: r["v"])
+    assert got == [{"id": None, "v": 0}, {"id": None, "v": 99}]
+
+
+# -- star expansion ----------------------------------------------------------
+
+
+def test_star_with_reordered_source_columns(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10], "w": [5]})
+    src = pa.table({"w": [50], "v": [100], "id": [1]})  # reordered
+    _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 100, "w": 50}]
+
+
+def test_star_missing_source_column_errors_without_evolution(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10], "w": [5]})
+    src = pa.table({"id": [1], "v": [100]})  # no "w"
+    with pytest.raises(DeltaAnalysisError, match="cannot resolve"):
+        _merge(log, src, "t.id = s.id", [UP], [], **ALIAS)
+
+
+def test_star_extra_source_column_ignored_without_evolution(tmp_path, executor):
+    # star expands over TARGET columns without evolution
+    # (`deltaMerge.scala:322-328`): extra source columns are never referenced
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    src = pa.table({"id": [1, 2], "v": [100, 200], "extra": [7, 8]})
+    _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert [f.name for f in log.update().metadata.schema.fields] == ["id", "v"]
+    assert _rows(log) == [{"id": 1, "v": 100}, {"id": 2, "v": 200}]
+
+
+def test_explicit_assignments_ignore_extra_source_columns(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    src = pa.table({"id": [1, 2], "v": [100, 200], "extra": [7, 8]})
+    _merge(
+        log, src, "t.id = s.id",
+        [MergeClause("update", assignments={"v": "s.v"})],
+        [MergeClause("insert", assignments={"id": "s.id", "v": "s.extra"})],
+        **ALIAS,
+    )
+    assert _rows(log) == [{"id": 1, "v": 100}, {"id": 2, "v": 8}]
+
+
+def test_case_insensitive_column_resolution(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "Value": [10, 20]})
+    src = pa.table({"ID": [2, 3], "VALUE": [21, 31]})
+    _merge(log, src, "t.id = s.ID", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "Value": 10}, {"id": 2, "Value": 21},
+                          {"id": 3, "Value": 31}]
+
+
+# -- schema evolution --------------------------------------------------------
+
+
+def _evolved(on=True):
+    return conf.set_temporarily(**{"delta.tpu.schema.autoMerge.enabled": on})
+
+
+def test_evolution_insert_all_adds_new_column(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    src = pa.table({"id": [2, 3], "v": [21, 31], "extra": ["a", "b"]})
+    with _evolved():
+        _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    snap = log.update()
+    assert [f.name for f in snap.metadata.schema.fields] == ["id", "v", "extra"]
+    assert _rows(log) == [
+        {"id": 1, "v": 10, "extra": None},
+        {"id": 2, "v": 21, "extra": "a"},
+        {"id": 3, "v": 31, "extra": "b"},
+    ]
+
+
+def test_evolution_update_all_adds_new_column(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    src = pa.table({"id": [2], "v": [99], "flag": [True]})
+    with _evolved():
+        _merge(log, src, "t.id = s.id", [UP], [], **ALIAS)
+    assert _rows(log) == [
+        {"id": 1, "v": 10, "flag": None},
+        {"id": 2, "v": 99, "flag": True},
+    ]
+
+
+def test_evolution_requires_star_clause(tmp_path, executor):
+    # explicit assignments never migrate the schema, even with the conf on
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    src = pa.table({"id": [1], "v": [100], "extra": [1]})
+    with _evolved():
+        _merge(log, src, "t.id = s.id",
+               [MergeClause("update", assignments={"v": "s.v"})], [], **ALIAS)
+    assert [f.name for f in log.update().metadata.schema.fields] == ["id", "v"]
+
+
+def test_evolution_off_is_default_schema_unchanged(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    src = pa.table({"id": [1], "v": [100], "extra": [1]})
+    _merge(log, src, "t.id = s.id", [UP], [], **ALIAS)
+    assert [f.name for f in log.update().metadata.schema.fields] == ["id", "v"]
+    assert _rows(log) == [{"id": 1, "v": 100}]
+
+
+def test_evolution_preserves_target_column_order_and_case(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "Val": [10]})
+    src = pa.table({"val": [99], "id": [1], "z": [0]})
+    with _evolved():
+        _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert [f.name for f in log.update().metadata.schema.fields] == [
+        "id", "Val", "z"
+    ]
+
+
+# -- self-merge & multi-match ------------------------------------------------
+
+
+def test_self_merge_dedupe_pattern(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    from delta_tpu.exec.scan import scan_to_table
+
+    src = scan_to_table(log.update())
+    _merge(log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 20}]
+
+
+def test_multi_match_update_errors(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    with pytest.raises(DeltaUnsupportedOperationError, match="multiple source rows"):
+        _merge(log, {"id": [1, 1], "v": [1, 2]}, "t.id = s.id", [UP], [], **ALIAS)
+
+
+def test_multi_match_single_unconditional_delete_ok(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1, 2], "v": [10, 20]})
+    cmd = _merge(log, {"id": [1, 1], "v": [0, 0]}, "t.id = s.id",
+                 [MergeClause("delete")], [], **ALIAS)
+    assert _rows(log) == [{"id": 2, "v": 20}]
+    assert cmd.metrics["numTargetRowsDeleted"] == 1
+
+
+def test_multi_match_insert_only_is_duplicate_insensitive(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    cmd = _merge(log, {"id": [1, 1, 2], "v": [0, 0, 20]}, "t.id = s.id",
+                 [], [INS], **ALIAS)
+    assert cmd.metrics["numTargetRowsInserted"] == 1
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 2, "v": 20}]
+
+
+# -- key expressions & aliases ----------------------------------------------
+
+
+def test_key_expression_on_source_side(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [5, 6], "v": [10, 20]})
+    # updateAll replaces EVERY target column, including the key: the matched
+    # row (t.id=5) takes the source row's id=4
+    _merge(log, {"id": [4], "v": [99]}, "t.id = s.id + 1",
+           [UP], [], **ALIAS)
+    assert _rows(log) == [{"id": 4, "v": 99}, {"id": 6, "v": 20}]
+
+
+def test_unknown_qualifier_errors(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    with pytest.raises(DeltaAnalysisError, match="qualifier"):
+        _merge(log, {"id": [1], "v": [2]}, "x.id = s.id", [UP], [], **ALIAS)
+
+
+def test_composite_key_with_nulls(tmp_path, executor):
+    log = _write(tmp_path / "t", pa.table({
+        "a": pa.array([1, 1, None], pa.int64()),
+        "b": pa.array([1, 2, 3], pa.int64()),
+        "v": pa.array([10, 20, 30], pa.int64()),
+    }))
+    src = pa.table({
+        "a": pa.array([1, None], pa.int64()),
+        "b": pa.array([2, 3], pa.int64()),
+        "v": pa.array([99, 98], pa.int64()),
+    })
+    cmd = _merge(log, src, "t.a = s.a AND t.b = s.b", [UP], [INS], **ALIAS)
+    assert cmd.metrics["numTargetRowsUpdated"] == 1  # (1,2) only
+    assert cmd.metrics["numTargetRowsInserted"] == 1  # null-a source row
+    got = sorted(_rows(log, sort=None), key=lambda r: r["v"])
+    assert got == [
+        {"a": 1, "b": 1, "v": 10},
+        {"a": None, "b": 3, "v": 30},
+        {"a": None, "b": 3, "v": 98},
+        {"a": 1, "b": 2, "v": 99},
+    ]
+
+
+def test_matched_only_merge_never_inserts(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    cmd = _merge(log, {"id": [1, 9], "v": [11, 90]}, "t.id = s.id", [UP], [],
+                 **ALIAS)
+    assert cmd.metrics["numTargetRowsInserted"] == 0
+    assert _rows(log) == [{"id": 1, "v": 11}]
+
+
+def test_insert_only_merge_never_updates(tmp_path, executor):
+    log = _write(tmp_path / "t", {"id": [1], "v": [10]})
+    cmd = _merge(log, {"id": [1, 9], "v": [11, 90]}, "t.id = s.id", [], [INS],
+                 **ALIAS)
+    assert cmd.metrics["numTargetRowsUpdated"] == 0
+    assert _rows(log) == [{"id": 1, "v": 10}, {"id": 9, "v": 90}]
