@@ -59,9 +59,67 @@ def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = Fal
     return delta_log.with_new_transaction(body)
 
 
-def add_columns(delta_log, new_fields: Sequence[StructField]) -> int:
-    """ADD COLUMNS — appended at the end (`:163`); new columns must be
-    nullable (existing files have no values for them)."""
+def _position_spec(schema: StructType, parent_parts, leaf_spec):
+    """Resolve a column position: ``parent_parts`` is the dotted path to the
+    enclosing struct ([] = top level), ``leaf_spec`` is None (append),
+    "first", or ("after", sibling)."""
+    from delta_tpu.schema.types import ArrayType, MapType
+
+    if parent_parts:
+        parent_pos = schema_utils.find_column_position(parent_parts, schema)
+        parent = schema
+        for step in parent_pos:
+            if isinstance(parent, StructType):
+                parent = parent.fields[step].data_type
+            elif isinstance(parent, ArrayType):
+                parent = parent.element_type
+            elif isinstance(parent, MapType):
+                parent = (
+                    parent.key_type
+                    if step == schema_utils.MAP_KEY_INDEX
+                    else parent.value_type
+                )
+            else:
+                raise DeltaAnalysisError(
+                    f"Parent {'.'.join(parent_parts)} is not a struct"
+                )
+        if not isinstance(parent, StructType):
+            raise DeltaAnalysisError(
+                f"Parent {'.'.join(parent_parts)} is not a struct"
+            )
+    else:
+        parent_pos = []
+        parent = schema
+    if leaf_spec is None:
+        idx = len(parent.fields)
+    elif leaf_spec == "first":
+        idx = 0
+    elif isinstance(leaf_spec, tuple) and leaf_spec[0] == "after":
+        sib = leaf_spec[1].lower()
+        match = next(
+            (i for i, f in enumerate(parent.fields) if f.name.lower() == sib), None
+        )
+        if match is None:
+            raise DeltaAnalysisError(
+                f"Couldn't find column {leaf_spec[1]} to position AFTER"
+            )
+        idx = match + 1
+    else:
+        raise DeltaAnalysisError(f"Invalid column position spec {leaf_spec!r}")
+    return list(parent_pos) + [idx]
+
+
+def add_columns(
+    delta_log,
+    new_fields: Sequence[StructField],
+    positions: Optional[Dict[str, object]] = None,
+) -> int:
+    """ADD COLUMNS (`:163`). New columns must be nullable (existing files
+    have no values for them). A dotted field name (``s.x``) adds inside the
+    named nested struct; ``positions`` maps a field name to ``"first"`` or
+    ``("after", sibling)`` within its parent (default: append at the end),
+    matching the reference's FIRST/AFTER grammar."""
+    positions = positions or {}
 
     def body(txn):
         meta = txn.metadata
@@ -71,9 +129,10 @@ def add_columns(delta_log, new_fields: Sequence[StructField]) -> int:
                 raise DeltaAnalysisError(
                     f"ADD COLUMNS requires nullable columns, {f.name} is NOT NULL"
                 )
-            if f.name in schema:
-                raise DeltaAnalysisError(f"Column {f.name} already exists")
-            schema = schema_utils.add_column(schema, f)
+            parts = f.name.split(".")
+            leaf = replace(f, name=parts[-1])
+            pos = _position_spec(schema, parts[:-1], positions.get(f.name))
+            schema = schema_utils.add_column(schema, leaf, pos)
         txn.update_metadata(replace(meta, schema_string=schema.to_json()))
         op = ops.AddColumns(
             [{"column": f.json_value()} for f in new_fields]
@@ -89,13 +148,18 @@ def change_column(
     new_type=None,
     nullable: Optional[bool] = None,
     comment: Optional[str] = None,
+    position=None,
 ) -> int:
     """CHANGE COLUMN (`:251`): widen type (int→long etc.), relax nullability
-    (never tighten — existing data may violate it), set a comment."""
+    (never tighten — existing data may violate it), set a comment. Dotted
+    names edit nested struct fields in place; ``position`` ("first" or
+    ("after", sibling)) moves the column within its parent."""
 
     def body(txn):
         meta = txn.metadata
         schema = meta.schema
+        parts = name.split(".")
+        pos = schema_utils.find_column_position(parts, schema)
         field = schema_utils.find_field(schema, name)
         if field is None:
             raise DeltaAnalysisError(f"Column {name!r} not found")
@@ -117,11 +181,13 @@ def change_column(
             md = dict(new_field.metadata or {})
             md["comment"] = comment
             new_field = replace(new_field, metadata=md)
-        fields = [
-            new_field if f.name.lower() == field.name.lower() else f
-            for f in schema.fields
-        ]
-        txn.update_metadata(replace(meta, schema_string=StructType(fields).to_json()))
+        if position is None:
+            schema = schema_utils.replace_column_at(schema, pos, new_field)
+        else:
+            schema, _ = schema_utils.drop_column_at(schema, pos)
+            new_pos = _position_spec(schema, parts[:-1], position)
+            schema = schema_utils.add_column(schema, new_field, new_pos)
+        txn.update_metadata(replace(meta, schema_string=schema.to_json()))
         op = ops.ChangeColumn(name, new_field.json_value())
         return txn.commit([], op)
 

@@ -198,9 +198,12 @@ class MergeIntoCommand:
         )
         if not (auto and has_star):
             return metadata
+        from delta_tpu.schema import generated as generated_mod
+
         src_schema = schema_from_arrow(self.source.schema)
         merged = schema_utils.merge_schemas(
-            metadata.schema, src_schema, allow_implicit_conversions=True
+            metadata.schema, src_schema, allow_implicit_conversions=True,
+            fixed_type_columns=generated_mod.fixed_type_columns(metadata.schema),
         )
         if merged.to_json() != metadata.schema.to_json():
             txn.update_metadata(replace(metadata, schema_string=merged.to_json()))
@@ -667,10 +670,7 @@ class MergeIntoCommand:
         # generated columns are computed, not resolved from the source
         from delta_tpu.schema import generated as generated_mod
 
-        gen = {
-            g.lower()
-            for g in generated_mod.generation_expressions(metadata.schema)
-        }
+        gen = generated_mod.generated_column_names(metadata.schema)
         missing = [
             c for c in target_cols
             if c.lower() not in src_low and c.lower() not in gen
@@ -843,10 +843,7 @@ class MergeIntoCommand:
                     }
                 from delta_tpu.schema import generated as generated_mod
 
-                gen_cols = {
-                    c.lower()
-                    for c in generated_mod.generation_expressions(metadata.schema)
-                }
+                gen_cols = generated_mod.generated_column_names(metadata.schema)
                 cols, names = [], []
                 for f in metadata.schema.fields:
                     e = None

@@ -182,9 +182,9 @@ class AddFile(FileAction):
     @property
     def num_logical_records(self) -> Optional[int]:
         s = self.stats_dict()
-        if s and "numRecords" in s:
-            return int(s["numRecords"])
-        return None
+        n = s.get("numRecords") if isinstance(s, dict) else None
+        # foreign writers may emit "numRecords": null — treat as absent
+        return int(n) if isinstance(n, (int, float)) else None
 
 
 @dataclass(frozen=True)

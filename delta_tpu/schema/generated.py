@@ -33,6 +33,8 @@ __all__ = [
     "GENERATION_EXPRESSION_KEY",
     "generated_field",
     "generation_expressions",
+    "generated_column_names",
+    "fixed_type_columns",
     "has_generated_columns",
     "validate_generated_columns",
     "compute_on_write",
@@ -64,6 +66,24 @@ def generation_expressions(schema: StructType) -> Dict[str, ir.Expression]:
                 raise DeltaAnalysisError(
                     f"Invalid generation expression for column {f.name!r}: {e}"
                 ) from e
+    return out
+
+
+def generated_column_names(schema: StructType) -> set:
+    """Lowered names of generated columns (shared by MERGE's star-coverage
+    check and insert projection — one definition, or they diverge)."""
+    return {name.lower() for name in generation_expressions(schema)}
+
+
+def fixed_type_columns(schema: StructType) -> set:
+    """Lowered names whose types schema evolution must never change:
+    generated columns and every column their expressions reference
+    (≈ GeneratedColumn.getGeneratedColumnsAndColumnsUsedByGeneratedColumns,
+    consumed by mergeSchemas' fixedTypeColumns)."""
+    out = set()
+    for name, expr in generation_expressions(schema).items():
+        out.add(name.lower())
+        out.update(r.lower() for r in ir.references(expr))
     return out
 
 

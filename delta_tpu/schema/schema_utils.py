@@ -36,17 +36,29 @@ from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
 
 __all__ = [
     "check_column_names",
+    "check_column_name_duplication",
     "check_partition_columns",
     "find_field",
+    "find_column_position",
     "merge_schemas",
     "enforce_write_compatibility",
     "normalize_column_names",
     "is_read_compatible",
     "add_column",
     "drop_column",
+    "drop_column_at",
+    "replace_column_at",
     "can_change_data_type",
     "column_path_to_name",
+    "ARRAY_ELEMENT_INDEX",
+    "MAP_KEY_INDEX",
+    "MAP_VALUE_INDEX",
 ]
+
+# Nested-position markers inside non-struct containers (SchemaUtils.scala:44-46)
+ARRAY_ELEMENT_INDEX = 0
+MAP_KEY_INDEX = 0
+MAP_VALUE_INDEX = 1
 
 # checkFieldNames (SchemaUtils.scala:1049): these break Parquet/Hive paths.
 _INVALID_CHARS = set(' ,;{}()\n\t=')
@@ -121,14 +133,65 @@ def _can_widen(from_t: DataType, to_t: DataType) -> bool:
     return any(isinstance(from_t, a) and isinstance(to_t, b) for a, b in _WIDENING)
 
 
+# Numeric precedence for implicit SQL casts (Spark's TypeCoercion order):
+# a type can implicitly cast to any type with higher precedence.
+_NUMERIC_PRECEDENCE: List[type] = [
+    ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType,
+]
+
+
+def _precedence(t: DataType) -> Optional[int]:
+    for i, cls in enumerate(_NUMERIC_PRECEDENCE):
+        if isinstance(t, cls):
+            return i
+    return None
+
+
+def check_column_name_duplication(schema: StructType, context: str) -> None:
+    """Reject case-insensitively duplicated column names, at any nesting
+    level (the reference delegates to Spark's SchemaUtils before merging)."""
+
+    def walk(dt: DataType, path: str):
+        if isinstance(dt, StructType):
+            seen = {}
+            for f in dt.fields:
+                low = f.name.lower()
+                if low in seen:
+                    raise DeltaAnalysisError(
+                        f"Found duplicate column(s) {context}: "
+                        f"{path}{seen[low]}, {path}{f.name}"
+                    )
+                seen[low] = f.name
+                walk(f.data_type, path + f.name + ".")
+        elif isinstance(dt, ArrayType):
+            walk(dt.element_type, path + "element.")
+        elif isinstance(dt, MapType):
+            walk(dt.key_type, path + "key.")
+            walk(dt.value_type, path + "value.")
+
+    walk(schema, "")
+
+
 def merge_schemas(
     current: StructType,
     new: StructType,
     allow_implicit_conversions: bool = False,
+    keep_existing_type: bool = False,
+    fixed_type_columns: Iterable[str] = (),
     path: str = "",
 ) -> StructType:
-    """Merge ``new`` into ``current``: existing columns keep the current
-    type/position/case, new columns are appended (``SchemaUtils.scala:817``)."""
+    """Merge ``new`` into ``current`` (``SchemaUtils.scala:817-922``):
+    existing columns keep the current name case, position, nullability and
+    metadata; new columns are appended. Byte/short/int always unify to the
+    widest (Parquet stores all three as INT32, ``:901-909``);
+    ``allow_implicit_conversions`` additionally accepts any valid implicit
+    numeric cast (MERGE evolution, ``PreprocessTableMerge.scala:71``);
+    ``keep_existing_type`` keeps the current type for any primitive clash
+    (metadata-only evolution); ``fixed_type_columns`` (generated columns)
+    may not change type at all."""
+    if not path:
+        check_column_name_duplication(new, "in the data to save")
+    fixed = {c.lower() for c in fixed_type_columns}
     merged: List[StructField] = []
     new_by_lower = {f.name.lower(): f for f in new.fields}
     for cur in current.fields:
@@ -136,15 +199,23 @@ def merge_schemas(
         if incoming is None:
             merged.append(cur)
             continue
+        if (
+            not path
+            and cur.name.lower() in fixed
+            and cur.data_type != incoming.data_type
+        ):
+            raise DeltaAnalysisError(
+                f"Column {cur.name} is a generated column or a column used by a "
+                f"generated column; its data type {cur.data_type.simple_string()} "
+                f"cannot be changed to {incoming.data_type.simple_string()}"
+            )
         merged_type = _merge_types(
             cur.data_type, incoming.data_type, allow_implicit_conversions,
-            path + cur.name,
+            keep_existing_type, path + cur.name,
         )
-        metadata = dict(cur.metadata)
-        if incoming.metadata:
-            metadata.update(incoming.metadata)
+        # the reference keeps the CURRENT field's nullability and metadata
         merged.append(
-            StructField(cur.name, merged_type, cur.nullable or incoming.nullable, metadata)
+            StructField(cur.name, merged_type, cur.nullable, dict(cur.metadata))
         )
     # Append genuinely new fields, preserving their order in `new`.
     remaining = set(new_by_lower)
@@ -154,19 +225,28 @@ def merge_schemas(
     return StructType(merged)
 
 
-def _merge_types(cur: DataType, new: DataType, widen: bool, path: str) -> DataType:
+def _merge_types(
+    cur: DataType, new: DataType, widen: bool, keep_existing: bool, path: str
+) -> DataType:
+    from delta_tpu.schema.types import DecimalType
+
     if isinstance(cur, StructType) and isinstance(new, StructType):
-        return merge_schemas(cur, new, widen, path + ".")
+        return merge_schemas(
+            cur, new, widen, keep_existing, path=path + ".",
+        )
     if isinstance(cur, ArrayType) and isinstance(new, ArrayType):
         return ArrayType(
-            _merge_types(cur.element_type, new.element_type, widen, path + ".element"),
-            cur.contains_null or new.contains_null,
+            _merge_types(cur.element_type, new.element_type, widen, keep_existing,
+                         path + ".element"),
+            cur.contains_null,
         )
     if isinstance(cur, MapType) and isinstance(new, MapType):
         return MapType(
-            _merge_types(cur.key_type, new.key_type, widen, path + ".key"),
-            _merge_types(cur.value_type, new.value_type, widen, path + ".value"),
-            cur.value_contains_null or new.value_contains_null,
+            _merge_types(cur.key_type, new.key_type, widen, keep_existing,
+                         path + ".key"),
+            _merge_types(cur.value_type, new.value_type, widen, keep_existing,
+                         path + ".value"),
+            cur.value_contains_null,
         )
     if isinstance(cur, NullType):
         return new
@@ -174,10 +254,33 @@ def _merge_types(cur: DataType, new: DataType, widen: bool, path: str) -> DataTy
         return cur
     if cur == new:
         return cur
-    if widen and _can_widen(new, cur):
+    if keep_existing and not isinstance(cur, (StructType, ArrayType, MapType)):
         return cur
-    if widen and _can_widen(cur, new):
-        return new
+    if widen:
+        # implicit SQL cast: new side may cast up to current, or vice versa
+        pc, pn = _precedence(cur), _precedence(new)
+        if pc is not None and pn is not None:
+            return cur if pn <= pc else new
+    if isinstance(cur, DecimalType) and isinstance(new, DecimalType):
+        if cur.precision != new.precision and cur.scale != new.scale:
+            raise SchemaMismatchError(
+                f"Failed to merge decimal types with incompatible precision "
+                f"{cur.precision} and {new.precision} & scale {cur.scale} and {new.scale}"
+            )
+        if cur.precision != new.precision:
+            raise SchemaMismatchError(
+                f"Failed to merge decimal types with incompatible precision "
+                f"{cur.precision} and {new.precision}"
+            )
+        raise SchemaMismatchError(
+            f"Failed to merge decimal types with incompatible scale "
+            f"{cur.scale} and {new.scale}"
+        )
+    # Parquet stores byte/short/int as INT32: always unify to the widest
+    int32_family = (ByteType, ShortType, IntegerType)
+    if isinstance(cur, int32_family) and isinstance(new, int32_family):
+        order = {ByteType: 0, ShortType: 1, IntegerType: 2}
+        return cur if order[type(cur)] >= order[type(new)] else new
     raise SchemaMismatchError(
         f"Failed to merge fields '{path}': incompatible types "
         f"{cur.simple_string()} and {new.simple_string()}"
@@ -279,26 +382,227 @@ def _type_read_compatible(old: DataType, new: DataType) -> bool:
 # ALTER helpers
 # ---------------------------------------------------------------------------
 
-def add_column(schema: StructType, field: StructField, position: Optional[int] = None) -> StructType:
-    """Insert a top-level column at ``position`` (``addColumn :573``)."""
-    if any(f.name.lower() == field.name.lower() for f in schema.fields):
+def add_column(
+    schema: StructType,
+    field: StructField,
+    position: Optional[Sequence[int]] = None,
+) -> StructType:
+    """Insert ``field`` at ``position`` (``addColumn :573-651``).
+
+    ``position`` is a list of 0-based ordinals denoting a path through
+    nested structs — e.g. ``[2, 1]`` inserts at index 1 inside the struct at
+    top-level index 2. Inside containers, path steps use
+    ``ARRAY_ELEMENT_INDEX`` / ``MAP_KEY_INDEX`` / ``MAP_VALUE_INDEX``. An
+    int or None keeps the historical top-level behavior (None = append)."""
+    if position is None:
+        position = [len(schema.fields)]
+    elif isinstance(position, int):
+        position = [min(position, len(schema.fields))]
+    position = list(position)
+    if not position:
+        raise DeltaAnalysisError(f"Don't know where to add the column {field.name}")
+    slice_pos = position[0]
+    if slice_pos < 0:
+        raise DeltaAnalysisError(
+            f"Index {slice_pos} to add column {field.name} is lower than 0"
+        )
+    length = len(schema.fields)
+    if slice_pos > length:
+        raise DeltaAnalysisError(
+            f"Index {slice_pos} to add column {field.name} is larger than struct "
+            f"length: {length}"
+        )
+    if len(position) == 1 and any(
+        f.name.lower() == field.name.lower() for f in schema.fields
+    ):
         raise DeltaAnalysisError(f"Column {field.name} already exists")
+    if slice_pos == length:
+        if len(position) > 1:
+            raise DeltaAnalysisError(f"Struct not found at position {slice_pos}")
+        return StructType(list(schema.fields) + [field])
     fields = list(schema.fields)
-    if position is None or position >= len(fields):
-        fields.append(field)
+    if len(position) == 1:
+        fields.insert(slice_pos, field)
+        return StructType(fields)
+
+    parent = fields[slice_pos]
+    tail = position[1:]
+    if not field.nullable and parent.nullable:
+        raise DeltaAnalysisError(
+            "A non-nullable nested field can't be added to a nullable parent. "
+            "Please set the nullability of the parent column accordingly."
+        )
+    dt = parent.data_type
+    if isinstance(dt, StructType):
+        new_dt: DataType = add_column(dt, field, tail)
+    elif isinstance(dt, ArrayType) and isinstance(dt.element_type, StructType):
+        if tail[0] != ARRAY_ELEMENT_INDEX:
+            raise DeltaAnalysisError(
+                "Incorrectly accessing an ArrayType. Use arrayname.element."
+                "elementname position to add to an array."
+            )
+        new_dt = ArrayType(
+            add_column(dt.element_type, field, tail[1:]), dt.contains_null
+        )
+    elif isinstance(dt, MapType):
+        if tail[0] == MAP_KEY_INDEX and isinstance(dt.key_type, StructType):
+            new_dt = MapType(
+                add_column(dt.key_type, field, tail[1:]),
+                dt.value_type, dt.value_contains_null,
+            )
+        elif tail[0] == MAP_VALUE_INDEX and isinstance(dt.value_type, StructType):
+            new_dt = MapType(
+                dt.key_type,
+                add_column(dt.value_type, field, tail[1:]),
+                dt.value_contains_null,
+            )
+        else:
+            raise DeltaAnalysisError(
+                f"Cannot add {field.name} because its parent is not a StructType."
+            )
     else:
-        fields.insert(position, field)
+        raise DeltaAnalysisError(
+            f"Cannot add {field.name} because its parent is not a StructType. "
+            f"Found {dt.simple_string()}"
+        )
+    fields[slice_pos] = StructField(
+        parent.name, new_dt, parent.nullable, dict(parent.metadata)
+    )
     return StructType(fields)
 
 
 def drop_column(schema: StructType, name: str) -> StructType:
-    """Remove a top-level column (``dropColumn :663``)."""
+    """Remove a top-level column by name (convenience over
+    ``drop_column_at``; ``dropColumn :663``)."""
     kept = [f for f in schema.fields if f.name.lower() != name.lower()]
     if len(kept) == len(schema.fields):
         raise DeltaAnalysisError(f"Column {name} does not exist")
     if not kept:
         raise DeltaAnalysisError("Cannot drop all columns from a table")
     return StructType(kept)
+
+
+def replace_column_at(
+    schema: StructType, position: Sequence[int], new_field: StructField
+) -> StructType:
+    """Replace the field at a nested struct ``position`` (CHANGE COLUMN's
+    in-place edit; container-index steps are not valid here)."""
+    position = list(position)
+    if not position:
+        raise DeltaAnalysisError("Don't know which column to replace")
+    slice_pos = position[0]
+    if not 0 <= slice_pos < len(schema.fields):
+        raise DeltaAnalysisError(
+            f"Index {slice_pos} to replace column is out of bounds"
+        )
+    fields = list(schema.fields)
+    if len(position) == 1:
+        fields[slice_pos] = new_field
+        return StructType(fields)
+    parent = fields[slice_pos]
+    if not isinstance(parent.data_type, StructType):
+        raise DeltaAnalysisError(
+            f"Can only replace nested columns inside StructType. Found: "
+            f"{parent.data_type.simple_string()}"
+        )
+    fields[slice_pos] = StructField(
+        parent.name,
+        replace_column_at(parent.data_type, position[1:], new_field),
+        parent.nullable,
+        dict(parent.metadata),
+    )
+    return StructType(fields)
+
+
+def drop_column_at(
+    schema: StructType, position: Sequence[int]
+) -> Tuple[StructType, StructField]:
+    """Drop the field at a nested ``position``; returns (new schema, dropped
+    field) (``dropColumn :663-689``)."""
+    position = list(position)
+    if not position:
+        raise DeltaAnalysisError("Don't know where to drop the column")
+    slice_pos = position[0]
+    if slice_pos < 0:
+        raise DeltaAnalysisError(f"Index {slice_pos} to drop column is lower than 0")
+    length = len(schema.fields)
+    if slice_pos >= length:
+        raise DeltaAnalysisError(
+            f"Index {slice_pos} to drop column equals to or is larger than struct "
+            f"length: {length}"
+        )
+    fields = list(schema.fields)
+    if len(position) == 1:
+        # an empty struct is legal here: CHANGE COLUMN moves are
+        # drop-then-add, transiently emptying single-field structs; the
+        # user-facing DROP path (`drop_column`) still refuses emptying a table
+        dropped = fields.pop(slice_pos)
+        return StructType(fields), dropped
+    parent = fields[slice_pos]
+    if not isinstance(parent.data_type, StructType):
+        raise DeltaAnalysisError(
+            f"Can only drop nested columns from StructType. Found: "
+            f"{parent.data_type.simple_string()}"
+        )
+    inner, dropped = drop_column_at(parent.data_type, position[1:])
+    fields[slice_pos] = StructField(
+        parent.name, inner, parent.nullable, dict(parent.metadata)
+    )
+    return StructType(fields), dropped
+
+
+def find_column_position(column: Sequence[str], schema: StructType) -> List[int]:
+    """Resolve a dotted column path to nested ordinals
+    (``findColumnPosition :480-530``): struct fields by case-insensitive
+    name; ``element`` steps into an array's struct element, ``key``/``value``
+    into a map's struct sides."""
+    out: List[int] = []
+    current: DataType = schema
+    parts = list(column)
+    i = 0
+    while i < len(parts):
+        name = parts[i]
+        if not isinstance(current, StructType):
+            if isinstance(current, ArrayType):
+                if name.lower() != "element":
+                    raise DeltaAnalysisError(
+                        f"An ArrayType was found. In order to access elements of an "
+                        f"ArrayType, specify "
+                        f"{'.'.join(parts[:i] + ['element'] + parts[i:])}"
+                    )
+                out.append(ARRAY_ELEMENT_INDEX)
+                current = current.element_type
+                i += 1
+                continue
+            if isinstance(current, MapType):
+                if name.lower() == "key":
+                    out.append(MAP_KEY_INDEX)
+                    current = current.key_type
+                elif name.lower() == "value":
+                    out.append(MAP_VALUE_INDEX)
+                    current = current.value_type
+                else:
+                    raise DeltaAnalysisError(
+                        f"Cannot access {name} in a MapType: use key or value"
+                    )
+                i += 1
+                continue
+            raise DeltaAnalysisError(
+                f"Column path {'.'.join(parts)} descends into a non-nested type"
+            )
+        pos = next(
+            (j for j, f in enumerate(current.fields) if f.name.lower() == name.lower()),
+            -1,
+        )
+        if pos == -1:
+            raise DeltaAnalysisError(
+                f"Couldn't find column {'.'.join(parts[: i + 1])} in schema "
+                f"{schema.simple_string()}"
+            )
+        out.append(pos)
+        current = current.fields[pos].data_type
+        i += 1
+    return out
 
 
 def can_change_data_type(from_t: DataType, to_t: DataType) -> bool:
@@ -319,15 +623,33 @@ def can_change_data_type(from_t: DataType, to_t: DataType) -> bool:
     if _can_widen(from_t, to_t):
         return True
     if isinstance(from_t, StructType) and isinstance(to_t, StructType):
-        to_by_lower = {f.name.lower(): f for f in to_t.fields}
-        for f in from_t.fields:
-            t = to_by_lower.get(f.name.lower())
-            if t is None or not can_change_data_type(f.data_type, t.data_type):
+        from_by_lower = {f.name.lower(): f for f in from_t.fields}
+        seen = set()
+        for t in to_t.fields:
+            f = from_by_lower.get(t.name.lower())
+            if f is None:
+                # adding a column mid-change is legal only when nullable
+                # (SchemaUtils.scala:731-733)
+                if not t.nullable:
+                    return False
+                continue
+            seen.add(t.name.lower())
+            # tightening nullability is never legal (:705-707)
+            if f.nullable and not t.nullable:
                 return False
+            if not can_change_data_type(f.data_type, t.data_type):
+                return False
+        # dropping columns via CHANGE COLUMN is not legal (:735-737)
+        if len(seen) < len(from_t.fields):
+            return False
         return True
     if isinstance(from_t, ArrayType) and isinstance(to_t, ArrayType):
+        if from_t.contains_null and not to_t.contains_null:
+            return False
         return can_change_data_type(from_t.element_type, to_t.element_type)
     if isinstance(from_t, MapType) and isinstance(to_t, MapType):
+        if from_t.value_contains_null and not to_t.value_contains_null:
+            return False
         return can_change_data_type(from_t.key_type, to_t.key_type) and can_change_data_type(
             from_t.value_type, to_t.value_type
         )

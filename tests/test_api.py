@@ -131,6 +131,73 @@ def test_alter_add_columns(tmp_table):
         )
 
 
+def test_alter_add_columns_first_and_after(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [1]})
+    alter.add_columns(t.delta_log, [StructField("front", StringType())],
+                      positions={"front": "first"})
+    alter.add_columns(t.delta_log, [StructField("mid", StringType())],
+                      positions={"mid": ("after", "id")})
+    assert [f.name for f in t.schema().fields] == ["front", "id", "mid", "v"]
+    # data written before the ALTERs reads back with nulls in the new slots
+    assert t.to_arrow().to_pylist() == [
+        {"front": None, "id": 1, "mid": None, "v": 1}
+    ]
+
+
+def test_alter_add_nested_column(tmp_table):
+    from delta_tpu.schema.types import StructType as ST
+
+    path = tmp_table
+    inner = ST().add("x", IntegerType())
+    t = DeltaTable.create(path, ST().add("id", IntegerType()).add("s", inner))
+    alter.add_columns(t.delta_log, [StructField("s.y", StringType())])
+    s_type = t.schema()["s"].data_type
+    assert [f.name for f in s_type.fields] == ["x", "y"]
+    alter.add_columns(t.delta_log, [StructField("s.z", StringType())],
+                      positions={"s.z": "first"})
+    s_type = t.schema()["s"].data_type
+    assert [f.name for f in s_type.fields] == ["z", "x", "y"]
+
+
+def test_alter_change_nested_column_comment(tmp_table):
+    from delta_tpu.schema.types import StructType as ST
+
+    inner = ST().add("x", IntegerType())
+    t = DeltaTable.create(tmp_table, ST().add("s", inner).add("id", IntegerType()))
+    alter.change_column(t.delta_log, "s.x", new_type=LongType(),
+                        comment="widened")
+    s_type = t.schema()["s"].data_type
+    assert s_type["x"].data_type == LongType()
+    assert s_type["x"].metadata["comment"] == "widened"
+
+
+def test_alter_change_column_position_move(tmp_table):
+    t = make_table(tmp_table, {"id": [1], "v": [2]})
+    alter.change_column(t.delta_log, "v", position="first")
+    assert [f.name for f in t.schema().fields] == ["v", "id"]
+    alter.change_column(t.delta_log, "v", position=("after", "id"))
+    assert [f.name for f in t.schema().fields] == ["id", "v"]
+    assert t.to_arrow().to_pylist() == [{"id": 1, "v": 2}]
+
+
+def test_alter_change_column_move_sole_column_is_noop(tmp_table):
+    t = DeltaTable.create(tmp_table, StructType().add("only", IntegerType()))
+    alter.change_column(t.delta_log, "only", position="first")
+    assert [f.name for f in t.schema().fields] == ["only"]
+
+
+def test_alter_add_column_inside_array_element(tmp_table):
+    from delta_tpu.schema.types import ArrayType, StructType as ST
+
+    elem = ST().add("x", IntegerType())
+    t = DeltaTable.create(
+        tmp_table, ST().add("id", IntegerType()).add("arr", ArrayType(elem))
+    )
+    alter.add_columns(t.delta_log, [StructField("arr.element.y", StringType())])
+    arr_t = t.schema()["arr"].data_type
+    assert [f.name for f in arr_t.element_type.fields] == ["x", "y"]
+
+
 def test_alter_change_column_widen(tmp_table):
     path = tmp_table
     t = DeltaTable.create(path, StructType().add("id", IntegerType()))

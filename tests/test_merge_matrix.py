@@ -309,6 +309,25 @@ def test_evolution_off_is_default_schema_unchanged(tmp_path, executor):
     assert _rows(log) == [{"id": 1, "v": 100}]
 
 
+def test_evolution_cannot_retype_generated_column(tmp_path, executor):
+    from delta_tpu.schema.generated import generated_field
+    from delta_tpu.schema.types import IntegerType, LongType, StructType
+
+    from delta_tpu.api.tables import DeltaTable
+
+    schema = (
+        StructType()
+        .add("id", LongType())
+        .add_field(generated_field("twice", LongType(), "id + id"))
+    )
+    t = DeltaTable.create(str(tmp_path / "gen"), schema)
+    t.write({"id": [1]})
+    src = pa.table({"id": pa.array([2], pa.int64()),
+                    "twice": pa.array([4.5], pa.float64())})  # type change
+    with _evolved(), pytest.raises(DeltaAnalysisError, match="generated column"):
+        _merge(t.delta_log, src, "t.id = s.id", [UP], [INS], **ALIAS)
+
+
 def test_evolution_preserves_target_column_order_and_case(tmp_path, executor):
     log = _write(tmp_path / "t", {"id": [1], "Val": [10]})
     src = pa.table({"val": [99], "id": [1], "z": [0]})

@@ -19,6 +19,7 @@ from delta_tpu.schema.types import (
     NullType,
     ShortType,
     StringType,
+    StructField,
     StructType,
 )
 from delta_tpu.utils.errors import SchemaMismatchError
@@ -73,3 +74,405 @@ def test_uint64_arrow_rejected():
 
 def test_uint32_arrow_widens_to_long():
     assert delta_type_from_arrow(pa.uint32()) == LongType()
+
+
+# ---------------------------------------------------------------------------
+# mergeSchemas matrix (SchemaUtilsSuite "schema merging" cases)
+# ---------------------------------------------------------------------------
+
+
+def _s(*fields) -> StructType:
+    st = StructType()
+    for name, dt, *rest in fields:
+        nullable = rest[0] if rest else True
+        st = st.add(name, dt, nullable)
+    return st
+
+
+class TestMergeSchemas:
+    def test_append_new_column_at_end(self):
+        merged = schema_utils.merge_schemas(
+            _s(("a", IntegerType())), _s(("a", IntegerType()), ("b", StringType()))
+        )
+        assert [f.name for f in merged.fields] == ["a", "b"]
+
+    def test_existing_column_keeps_position_and_case(self):
+        merged = schema_utils.merge_schemas(
+            _s(("Alpha", IntegerType()), ("beta", StringType())),
+            _s(("NEW", DoubleType()), ("ALPHA", IntegerType())),
+        )
+        assert [f.name for f in merged.fields] == ["Alpha", "beta", "NEW"]
+
+    def test_existing_column_keeps_current_nullability_and_metadata(self):
+        cur = StructType().add("a", IntegerType(), False, {"comment": "keep me"})
+        new = StructType().add("a", IntegerType(), True, {"comment": "ignore"})
+        merged = schema_utils.merge_schemas(cur, new)
+        assert merged.fields[0].nullable is False
+        assert merged.fields[0].metadata == {"comment": "keep me"}
+
+    def test_nested_struct_merge_appends_inner_field(self):
+        cur = _s(("s", _s(("x", IntegerType()))))
+        new = _s(("s", _s(("x", IntegerType()), ("y", StringType()))))
+        merged = schema_utils.merge_schemas(cur, new)
+        inner = merged.fields[0].data_type
+        assert [f.name for f in inner.fields] == ["x", "y"]
+
+    def test_deeply_nested_struct_merge(self):
+        cur = _s(("a", _s(("b", _s(("c", IntegerType()))))))
+        new = _s(("a", _s(("b", _s(("c", IntegerType()), ("d", LongType()))))))
+        merged = schema_utils.merge_schemas(cur, new)
+        inner = merged.fields[0].data_type.fields[0].data_type
+        assert [f.name for f in inner.fields] == ["c", "d"]
+
+    def test_array_of_struct_merge(self):
+        cur = _s(("arr", ArrayType(_s(("x", IntegerType())))))
+        new = _s(("arr", ArrayType(_s(("x", IntegerType()), ("y", LongType())))))
+        merged = schema_utils.merge_schemas(cur, new)
+        elem = merged.fields[0].data_type.element_type
+        assert [f.name for f in elem.fields] == ["x", "y"]
+
+    def test_map_of_struct_merge_both_sides(self):
+        cur = _s(("m", MapType(_s(("k", IntegerType())), _s(("v", IntegerType())))))
+        new = _s(("m", MapType(
+            _s(("k", IntegerType()), ("k2", StringType())),
+            _s(("v", IntegerType()), ("v2", StringType())),
+        )))
+        merged = schema_utils.merge_schemas(cur, new)
+        mt = merged.fields[0].data_type
+        assert [f.name for f in mt.key_type.fields] == ["k", "k2"]
+        assert [f.name for f in mt.value_type.fields] == ["v", "v2"]
+
+    def test_array_keeps_current_contains_null(self):
+        cur = _s(("arr", ArrayType(IntegerType(), contains_null=False)))
+        new = _s(("arr", ArrayType(IntegerType(), contains_null=True)))
+        merged = schema_utils.merge_schemas(cur, new)
+        assert merged.fields[0].data_type.contains_null is False
+
+    def test_int32_family_always_unifies_to_widest(self):
+        # parquet stores byte/short/int as INT32 (SchemaUtils.scala:901-909)
+        for cur, new, want in [
+            (ByteType(), ShortType(), ShortType()),
+            (ShortType(), ByteType(), ShortType()),
+            (ByteType(), IntegerType(), IntegerType()),
+            (IntegerType(), ByteType(), IntegerType()),
+            (ShortType(), IntegerType(), IntegerType()),
+            (IntegerType(), ShortType(), IntegerType()),
+        ]:
+            merged = schema_utils.merge_schemas(_s(("a", cur)), _s(("a", new)))
+            assert merged.fields[0].data_type == want, (cur, new)
+
+    def test_int_to_long_requires_implicit_conversions(self):
+        with pytest.raises(SchemaMismatchError, match="Failed to merge"):
+            schema_utils.merge_schemas(
+                _s(("a", LongType())), _s(("a", IntegerType()))
+            )
+        merged = schema_utils.merge_schemas(
+            _s(("a", LongType())), _s(("a", IntegerType())),
+            allow_implicit_conversions=True,
+        )
+        assert merged.fields[0].data_type == LongType()
+
+    def test_implicit_conversion_picks_higher_precedence(self):
+        merged = schema_utils.merge_schemas(
+            _s(("a", IntegerType())), _s(("a", DoubleType())),
+            allow_implicit_conversions=True,
+        )
+        assert merged.fields[0].data_type == DoubleType()
+
+    def test_null_type_upgrades_either_direction(self):
+        assert schema_utils.merge_schemas(
+            _s(("a", NullType())), _s(("a", StringType()))
+        ).fields[0].data_type == StringType()
+        assert schema_utils.merge_schemas(
+            _s(("a", StringType())), _s(("a", NullType()))
+        ).fields[0].data_type == StringType()
+
+    def test_incompatible_types_error_names_the_path(self):
+        cur = _s(("s", _s(("x", StringType()))))
+        new = _s(("s", _s(("x", IntegerType()))))
+        with pytest.raises(SchemaMismatchError, match="s.x"):
+            schema_utils.merge_schemas(cur, new)
+
+    def test_keep_existing_type_squashes_primitive_clash(self):
+        merged = schema_utils.merge_schemas(
+            _s(("a", StringType())), _s(("a", IntegerType())),
+            keep_existing_type=True,
+        )
+        assert merged.fields[0].data_type == StringType()
+
+    def test_fixed_type_columns_refuse_type_change(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        with pytest.raises(DeltaAnalysisError, match="generated column"):
+            schema_utils.merge_schemas(
+                _s(("g", IntegerType())), _s(("g", LongType())),
+                allow_implicit_conversions=True, fixed_type_columns={"g"},
+            )
+
+    def test_duplicate_columns_in_incoming_schema_rejected(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        dup = StructType().add("a", IntegerType()).add("A", StringType())
+        with pytest.raises(DeltaAnalysisError, match="duplicate"):
+            schema_utils.merge_schemas(_s(("a", IntegerType())), dup)
+
+    def test_decimal_mismatch_errors(self):
+        from delta_tpu.schema.types import DecimalType
+
+        with pytest.raises(SchemaMismatchError, match="precision 10 and 12"):
+            schema_utils.merge_schemas(
+                _s(("d", DecimalType(10, 2))), _s(("d", DecimalType(12, 2)))
+            )
+        with pytest.raises(SchemaMismatchError, match="scale 2 and 4"):
+            schema_utils.merge_schemas(
+                _s(("d", DecimalType(10, 2))), _s(("d", DecimalType(10, 4)))
+            )
+        with pytest.raises(SchemaMismatchError, match="precision 10 and 12"):
+            schema_utils.merge_schemas(
+                _s(("d", DecimalType(10, 2))), _s(("d", DecimalType(12, 4)))
+            )
+
+
+# ---------------------------------------------------------------------------
+# addColumn / dropColumn positions (SchemaUtilsSuite "add/drop column" cases)
+# ---------------------------------------------------------------------------
+
+
+class TestAddColumn:
+    def test_add_at_front_middle_end(self):
+        base = _s(("a", IntegerType()), ("b", StringType()))
+        f = StructField("x", LongType())
+        assert [f2.name for f2 in schema_utils.add_column(base, f, [0]).fields] == [
+            "x", "a", "b"
+        ]
+        assert [f2.name for f2 in schema_utils.add_column(base, f, [1]).fields] == [
+            "a", "x", "b"
+        ]
+        assert [f2.name for f2 in schema_utils.add_column(base, f, [2]).fields] == [
+            "a", "b", "x"
+        ]
+
+    def test_add_nested_inside_struct(self):
+        # tableSchema: <a:STRUCT<a1,a2,a3>, b, c:STRUCT<c1,c3>>; add c2 at [2,1]
+        base = _s(
+            ("a", _s(("a1", IntegerType()), ("a2", IntegerType()), ("a3", IntegerType()))),
+            ("b", IntegerType()),
+            ("c", _s(("c1", IntegerType()), ("c3", IntegerType()))),
+        )
+        out = schema_utils.add_column(base, StructField("c2", LongType()), [2, 1])
+        inner = out.fields[2].data_type
+        assert [f.name for f in inner.fields] == ["c1", "c2", "c3"]
+
+    def test_add_inside_array_element_struct(self):
+        base = _s(("arr", ArrayType(_s(("x", IntegerType())))))
+        out = schema_utils.add_column(
+            base, StructField("y", LongType()),
+            [0, schema_utils.ARRAY_ELEMENT_INDEX, 1],
+        )
+        elem = out.fields[0].data_type.element_type
+        assert [f.name for f in elem.fields] == ["x", "y"]
+
+    def test_add_inside_map_key_and_value(self):
+        base = _s(("m", MapType(_s(("k", IntegerType())), _s(("v", IntegerType())))))
+        out = schema_utils.add_column(
+            base, StructField("k2", LongType()),
+            [0, schema_utils.MAP_KEY_INDEX, 1],
+        )
+        assert [f.name for f in out.fields[0].data_type.key_type.fields] == ["k", "k2"]
+        out = schema_utils.add_column(
+            base, StructField("v2", LongType()),
+            [0, schema_utils.MAP_VALUE_INDEX, 0],
+        )
+        assert [f.name for f in out.fields[0].data_type.value_type.fields] == ["v2", "v"]
+
+    def test_add_non_nullable_into_nullable_parent_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("s", _s(("x", IntegerType()))))  # parent nullable
+        with pytest.raises(DeltaAnalysisError, match="non-nullable nested field"):
+            schema_utils.add_column(
+                base, StructField("y", LongType(), nullable=False), [0, 0]
+            )
+
+    def test_add_position_out_of_bounds_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", IntegerType()))
+        with pytest.raises(DeltaAnalysisError, match="larger than struct length"):
+            schema_utils.add_column(base, StructField("x", LongType()), [5])
+        with pytest.raises(DeltaAnalysisError, match="lower than 0"):
+            schema_utils.add_column(base, StructField("x", LongType()), [-1])
+
+    def test_add_into_non_struct_parent_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", IntegerType()))
+        with pytest.raises(DeltaAnalysisError, match="parent is not a StructType"):
+            schema_utils.add_column(base, StructField("x", LongType()), [0, 0])
+
+    def test_add_duplicate_top_level_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", IntegerType()))
+        with pytest.raises(DeltaAnalysisError, match="already exists"):
+            schema_utils.add_column(base, StructField("A", LongType()), [1])
+
+
+class TestDropColumn:
+    def test_drop_top_level_by_position(self):
+        base = _s(("a", IntegerType()), ("b", StringType()), ("c", LongType()))
+        out, dropped = schema_utils.drop_column_at(base, [1])
+        assert [f.name for f in out.fields] == ["a", "c"]
+        assert dropped.name == "b"
+
+    def test_drop_nested(self):
+        base = _s(
+            ("a", IntegerType()),
+            ("c", _s(("c1", IntegerType()), ("c2", LongType()), ("c3", StringType()))),
+        )
+        out, dropped = schema_utils.drop_column_at(base, [1, 1])
+        assert dropped.name == "c2"
+        assert [f.name for f in out.fields[1].data_type.fields] == ["c1", "c3"]
+
+    def test_drop_out_of_bounds_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", IntegerType()))
+        with pytest.raises(DeltaAnalysisError, match="larger than struct length"):
+            schema_utils.drop_column_at(base, [1])
+
+    def test_drop_last_column_by_name_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", IntegerType()))
+        with pytest.raises(DeltaAnalysisError, match="Cannot drop all columns"):
+            schema_utils.drop_column(base, "a")
+        # the positional API allows it (CHANGE COLUMN moves drop-then-add)
+        out, dropped = schema_utils.drop_column_at(base, [0])
+        assert dropped.name == "a" and len(out.fields) == 0
+
+    def test_drop_from_non_struct_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        base = _s(("a", ArrayType(IntegerType())))
+        with pytest.raises(DeltaAnalysisError, match="StructType"):
+            schema_utils.drop_column_at(base, [0, 0])
+
+
+class TestFindColumnPosition:
+    BASE = _s(
+        ("a", _s(("a1", IntegerType()), ("a2", IntegerType()))),
+        ("b", IntegerType()),
+        ("arr", ArrayType(_s(("x", IntegerType()), ("y", IntegerType())))),
+        ("m", MapType(_s(("k", IntegerType())), _s(("v", IntegerType())))),
+    )
+
+    def test_top_level(self):
+        assert schema_utils.find_column_position(["b"], self.BASE) == [1]
+
+    def test_nested_struct_case_insensitive(self):
+        assert schema_utils.find_column_position(["A", "A2"], self.BASE) == [0, 1]
+
+    def test_array_element(self):
+        assert schema_utils.find_column_position(
+            ["arr", "element", "y"], self.BASE
+        ) == [2, schema_utils.ARRAY_ELEMENT_INDEX, 1]
+
+    def test_map_key_value(self):
+        assert schema_utils.find_column_position(
+            ["m", "key", "k"], self.BASE
+        ) == [3, schema_utils.MAP_KEY_INDEX, 0]
+        assert schema_utils.find_column_position(
+            ["m", "value", "v"], self.BASE
+        ) == [3, schema_utils.MAP_VALUE_INDEX, 0]
+
+    def test_missing_column_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        with pytest.raises(DeltaAnalysisError, match="Couldn't find column"):
+            schema_utils.find_column_position(["zz"], self.BASE)
+
+    def test_array_without_element_keyword_errors(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        with pytest.raises(DeltaAnalysisError, match="ArrayType"):
+            schema_utils.find_column_position(["arr", "x"], self.BASE)
+
+    def test_round_trips_with_add_column(self):
+        pos = schema_utils.find_column_position(["a", "a2"], self.BASE)
+        out = schema_utils.add_column(self.BASE, StructField("mid", LongType()), pos)
+        inner = out.fields[0].data_type
+        assert [f.name for f in inner.fields] == ["a1", "mid", "a2"]
+
+
+# ---------------------------------------------------------------------------
+# duplication + read compatibility + name hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestDuplication:
+    def test_top_level_case_insensitive(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        dup = StructType().add("x", IntegerType()).add("X", LongType())
+        with pytest.raises(DeltaAnalysisError, match="duplicate"):
+            schema_utils.check_column_name_duplication(dup, "in test")
+
+    def test_nested_duplicate_detected(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        dup = _s(("s", StructType().add("y", IntegerType()).add("Y", LongType())))
+        with pytest.raises(DeltaAnalysisError, match="duplicate"):
+            schema_utils.check_column_name_duplication(dup, "in test")
+
+    def test_same_name_at_different_levels_is_fine(self):
+        ok = _s(("x", _s(("x", IntegerType()))))
+        schema_utils.check_column_name_duplication(ok, "in test")
+
+
+class TestReadCompatibility:
+    def test_adding_nullable_column_is_compatible(self):
+        old = _s(("a", IntegerType()))
+        new = _s(("a", IntegerType()), ("b", StringType()))
+        assert schema_utils.is_read_compatible(old, new)
+
+    def test_dropping_column_is_incompatible(self):
+        old = _s(("a", IntegerType()), ("b", StringType()))
+        new = _s(("a", IntegerType()))
+        assert not schema_utils.is_read_compatible(old, new)
+
+    def test_type_change_is_incompatible(self):
+        old = _s(("a", IntegerType()))
+        new = _s(("a", LongType()))
+        assert not schema_utils.is_read_compatible(old, new)
+
+    def test_tightening_nullability_is_incompatible(self):
+        old = StructType().add("a", IntegerType(), True)
+        new = StructType().add("a", IntegerType(), False)
+        assert not schema_utils.is_read_compatible(old, new)
+
+    def test_nested_struct_checked(self):
+        old = _s(("s", _s(("x", IntegerType()))))
+        new = _s(("s", _s(("x", LongType()))))
+        assert not schema_utils.is_read_compatible(old, new)
+
+
+class TestNameHygiene:
+    def test_invalid_characters_rejected(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        for bad in ["a b", "a,b", "a;b", "a{b", "a(b", "a=b", "a\tb"]:
+            with pytest.raises(DeltaAnalysisError, match="invalid character"):
+                schema_utils.check_column_names(_s((bad, IntegerType())))
+
+    def test_nested_invalid_name_rejected(self):
+        from delta_tpu.utils.errors import DeltaAnalysisError
+
+        bad = _s(("ok", _s(("bad name", IntegerType()))))
+        with pytest.raises(DeltaAnalysisError, match="invalid character"):
+            schema_utils.check_column_names(bad)
+
+    def test_normalize_reports_case_fixups(self):
+        table = _s(("Alpha", IntegerType()), ("beta", StringType()))
+        data = _s(("ALPHA", IntegerType()), ("beta", StringType()))
+        assert schema_utils.normalize_column_names(table, data) == [("ALPHA", "Alpha")]
