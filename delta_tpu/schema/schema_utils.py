@@ -94,14 +94,22 @@ def check_partition_columns(partition_columns: Sequence[str], schema: StructType
 
 
 def find_field(schema: StructType, name: str) -> Optional[StructField]:
-    """Case-insensitive lookup; dotted names traverse nested structs."""
+    """Case-insensitive lookup; dotted names traverse nested structs, with
+    ``element`` / ``key`` / ``value`` stepping through arrays and maps."""
     parts = name.split(".")
     current: DataType = schema
     field = None
     for p in parts:
+        low = p.lower()
+        if isinstance(current, ArrayType) and low == "element":
+            current = current.element_type
+            continue
+        if isinstance(current, MapType) and low in ("key", "value"):
+            current = current.key_type if low == "key" else current.value_type
+            continue
         if not isinstance(current, StructType):
             return None
-        field = next((f for f in current.fields if f.name.lower() == p.lower()), None)
+        field = next((f for f in current.fields if f.name.lower() == low), None)
         if field is None:
             return None
         current = field.data_type
@@ -500,18 +508,48 @@ def replace_column_at(
         fields[slice_pos] = new_field
         return StructType(fields)
     parent = fields[slice_pos]
-    if not isinstance(parent.data_type, StructType):
-        raise DeltaAnalysisError(
-            f"Can only replace nested columns inside StructType. Found: "
-            f"{parent.data_type.simple_string()}"
-        )
+    new_dt = _descend_replace(
+        parent.data_type, position[1:],
+        lambda inner, tail: replace_column_at(inner, tail, new_field),
+        "replace",
+    )
     fields[slice_pos] = StructField(
-        parent.name,
-        replace_column_at(parent.data_type, position[1:], new_field),
-        parent.nullable,
-        dict(parent.metadata),
+        parent.name, new_dt, parent.nullable, dict(parent.metadata)
     )
     return StructType(fields)
+
+
+def _descend_replace(dt: DataType, tail: Sequence[int], recurse, verb: str):
+    """Shared container traversal for positional edits: struct positions
+    index fields; array/map positions use ARRAY_ELEMENT_INDEX /
+    MAP_KEY_INDEX / MAP_VALUE_INDEX (the steps `find_column_position`
+    emits). ``recurse(inner_struct, remaining_tail)`` produces the edited
+    struct."""
+    tail = list(tail)
+    if isinstance(dt, StructType):
+        return recurse(dt, tail)
+    if isinstance(dt, ArrayType) and isinstance(dt.element_type, StructType):
+        if tail[0] != ARRAY_ELEMENT_INDEX:
+            raise DeltaAnalysisError(
+                f"Incorrectly accessing an ArrayType during {verb}: use the "
+                f"element step"
+            )
+        return ArrayType(recurse(dt.element_type, tail[1:]), dt.contains_null)
+    if isinstance(dt, MapType):
+        if tail[0] == MAP_KEY_INDEX and isinstance(dt.key_type, StructType):
+            return MapType(
+                recurse(dt.key_type, tail[1:]), dt.value_type,
+                dt.value_contains_null,
+            )
+        if tail[0] == MAP_VALUE_INDEX and isinstance(dt.value_type, StructType):
+            return MapType(
+                dt.key_type, recurse(dt.value_type, tail[1:]),
+                dt.value_contains_null,
+            )
+    raise DeltaAnalysisError(
+        f"Can only {verb} nested columns inside StructType. Found: "
+        f"{dt.simple_string()}"
+    )
 
 
 def drop_column_at(
@@ -539,16 +577,18 @@ def drop_column_at(
         dropped = fields.pop(slice_pos)
         return StructType(fields), dropped
     parent = fields[slice_pos]
-    if not isinstance(parent.data_type, StructType):
-        raise DeltaAnalysisError(
-            f"Can only drop nested columns from StructType. Found: "
-            f"{parent.data_type.simple_string()}"
-        )
-    inner, dropped = drop_column_at(parent.data_type, position[1:])
+    box: List[StructField] = []
+
+    def recurse(inner: StructType, tail):
+        new_inner, dropped = drop_column_at(inner, tail)
+        box.append(dropped)
+        return new_inner
+
+    new_dt = _descend_replace(parent.data_type, position[1:], recurse, "drop")
     fields[slice_pos] = StructField(
-        parent.name, inner, parent.nullable, dict(parent.metadata)
+        parent.name, new_dt, parent.nullable, dict(parent.metadata)
     )
-    return StructType(fields), dropped
+    return StructType(fields), box[0]
 
 
 def find_column_position(column: Sequence[str], schema: StructType) -> List[int]:

@@ -1,180 +1,794 @@
-"""SQL front end for the Delta utility statements.
+"""SQL front end for the Delta statements — token-based recursive descent.
 
-Scope matches the reference grammar (`antlr4/.../DeltaSqlBase.g4:74-81`):
-VACUUM, DESCRIBE HISTORY | DETAIL, GENERATE, CONVERT TO DELTA — plus
-DELETE FROM / UPDATE, which the reference delegates to Spark SQL but a
-standalone engine must parse itself. Table references are
-``delta.`/path``` or a bare quoted path, like the reference's path-based
-identifiers (`DeltaTableIdentifier.scala`).
+Scope is a superset of the reference grammar
+(`antlr4/io/delta/sql/parser/DeltaSqlBase.g4:74-81`): VACUUM,
+DESCRIBE HISTORY | DETAIL, GENERATE, CONVERT TO DELTA — plus the DML and
+DDL the reference delegates to Spark SQL but a standalone engine must parse
+itself: DELETE, UPDATE, MERGE INTO, CREATE [OR REPLACE] TABLE (columns,
+generated columns, PARTITIONED BY, TBLPROPERTIES) and ALTER TABLE
+(properties, columns incl. FIRST/AFTER, constraints).
+
+The statement structure parses from the token stream (`sql/lexer.py` — a
+real tokenizer, so keywords inside string literals, comments, and newlines
+cannot mis-parse); embedded *expressions* (WHERE / ON / SET bodies / CHECK)
+are sliced out of the source verbatim via token offsets and handed to the
+expression parser (`expr/parser.py`), mirroring how the reference's
+delegating parser hands expression text to Spark.
+
+Table references are ``delta.`/path``` / ``parquet.`/path``` or a bare
+quoted path, like the reference's path-based identifiers
+(`DeltaTableIdentifier.scala`).
 """
 from __future__ import annotations
 
-import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from delta_tpu.log.deltalog import DeltaLog
 from delta_tpu.schema.types import StructField, StructType
-from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.sql.lexer import Token, tokenize
+from delta_tpu.utils.errors import DeltaAnalysisError, DeltaParseError
 
-__all__ = ["execute_sql"]
-
-_WS = r"\s+"
-
-
-def _table_path(token: str) -> str:
-    token = token.strip()
-    m = re.fullmatch(r"(?:delta\s*\.\s*)?`([^`]+)`", token, re.IGNORECASE)
-    if m:
-        return m.group(1)
-    m = re.fullmatch(r"(?:parquet\s*\.\s*)?`([^`]+)`", token, re.IGNORECASE)
-    if m:
-        return m.group(1)
-    m = re.fullmatch(r"'([^']+)'|\"([^\"]+)\"", token)
-    if m:
-        return m.group(1) or m.group(2)
-    return token
+__all__ = ["execute_sql", "parse_statement"]
 
 
-def _parse_type(s: str):
-    from delta_tpu.schema.types import (
-        BooleanType, DateType, DoubleType, FloatType, IntegerType, LongType,
-        StringType, TimestampType,
-    )
-
-    t = s.strip().lower()
-    return {
-        "int": IntegerType(), "integer": IntegerType(), "bigint": LongType(),
-        "long": LongType(), "string": StringType(), "double": DoubleType(),
-        "float": FloatType(), "boolean": BooleanType(), "date": DateType(),
-        "timestamp": TimestampType(),
-    }.get(t) or _fail(f"Unsupported type in PARTITIONED BY: {s!r}")
+_TYPES = {
+    "int": "IntegerType", "integer": "IntegerType", "bigint": "LongType",
+    "long": "LongType", "smallint": "ShortType", "short": "ShortType",
+    "tinyint": "ByteType", "byte": "ByteType", "string": "StringType",
+    "varchar": "StringType", "double": "DoubleType", "float": "FloatType",
+    "real": "FloatType", "boolean": "BooleanType", "bool": "BooleanType",
+    "date": "DateType", "timestamp": "TimestampType", "binary": "BinaryType",
+}
 
 
-def _fail(msg: str):
-    raise DeltaAnalysisError(msg)
+def _make_type(name: str, args: List[str]):
+    import delta_tpu.schema.types as T
+
+    low = name.lower()
+    if low == "decimal":
+        try:
+            p = int(args[0]) if args else 10
+            s = int(args[1]) if len(args) > 1 else 0
+        except ValueError:
+            raise DeltaParseError(f"Invalid DECIMAL precision/scale: {args}")
+        return T.DecimalType(p, s)
+    cls = _TYPES.get(low)
+    if cls is None:
+        raise DeltaParseError(f"Unsupported SQL type: {name!r}")
+    return getattr(T, cls)()
+
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks: List[Token] = tokenize(sql)
+        self.i = 0
+
+    # -- token plumbing ----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        j = min(self.i + ahead, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "END":
+            self.i += 1
+        return t
+
+    def at_end(self) -> bool:
+        t = self.peek()
+        return t.kind == "END" or (t.kind == "PUNCT" and t.value == ";")
+
+    def accept_word(self, *words: str) -> Optional[Token]:
+        if self.peek().is_word(*words):
+            return self.next()
+        return None
+
+    def expect_word(self, *words: str) -> Token:
+        t = self.next()
+        if not t.is_word(*words):
+            raise DeltaParseError(
+                f"Expected {' or '.join(words)} at offset {t.start}, got {t.value!r}"
+            )
+        return t
+
+    def accept_punct(self, p: str) -> bool:
+        t = self.peek()
+        if t.kind == "PUNCT" and t.value == p:
+            self.next()
+            return True
+        return False
+
+    def expect_punct(self, p: str) -> None:
+        t = self.next()
+        if not (t.kind == "PUNCT" and t.value == p):
+            raise DeltaParseError(
+                f"Expected {p!r} at offset {t.start}, got {t.value!r}"
+            )
+
+    def expect_end(self) -> None:
+        if not self.at_end():
+            t = self.peek()
+            raise DeltaParseError(
+                f"Unexpected trailing input at offset {t.start}: {t.value!r}"
+            )
+
+    # -- shared pieces -----------------------------------------------------
+
+    def table_path(self) -> Tuple[str, str]:
+        """[delta|parquet] . `path` | `path` | 'path' | bare path | name.
+
+        Returns ("path", p) for explicit paths and ("name", n) for bare
+        identifiers (resolved through the catalog at run time)."""
+        t = self.next()
+        if t.kind == "WORD" and t.value.lower() in ("delta", "parquet") and (
+            self.peek().kind == "PUNCT" and self.peek().value == "."
+        ):
+            self.next()  # '.'
+            ident = self.next()
+            if ident.kind not in ("QUOTED_IDENT", "WORD", "STRING"):
+                raise DeltaParseError(
+                    f"Expected table identifier after {t.value}. at offset {ident.start}"
+                )
+            # delta.`/p` is a path; delta.name is a catalog name
+            if ident.kind == "WORD":
+                return ("name", ident.value)
+            return ("path", ident.value)
+        if t.kind in ("QUOTED_IDENT", "STRING"):
+            return ("path", t.value)
+        path_start = (t.kind == "WORD") or (
+            t.kind == "PUNCT" and t.value in "./"
+        )
+        if not path_start:
+            raise DeltaParseError(f"Expected table reference at offset {t.start}")
+        # greedy run of ADJACENT tokens (no whitespace) forming a bare path
+        # (/tmp/x, ./rel/x) or a dotted catalog name
+        text = t.value
+        end = t.end
+        while True:
+            nxt = self.peek()
+            if nxt.kind == "END" or nxt.start != end:
+                break
+            if nxt.kind in ("WORD", "NUMBER") or (
+                nxt.kind == "PUNCT" and nxt.value in "./-"
+            ):
+                text += nxt.value
+                end = nxt.end
+                self.next()
+            else:
+                break
+        return ("path", text) if "/" in text else ("name", text)
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind in ("WORD", "QUOTED_IDENT"):
+            return t.value
+        raise DeltaParseError(f"Expected identifier at offset {t.start}")
+
+    def slice_expr(
+        self, stop_words: Tuple[str, ...] = (), stop_comma: bool = False
+    ) -> Optional[str]:
+        """Source text from here to the next boundary: a depth-0 stop
+        keyword, an unbalanced ')', a depth-0 comma (when ``stop_comma``),
+        ';' or end of input. CASE...END bodies are opaque — their WHEN/THEN
+        keywords never terminate the slice. Returns None when empty."""
+        depth = 0
+        case_depth = 0
+        start_tok = self.peek()
+        last_end = start_tok.start
+        while True:
+            t = self.peek()
+            if t.kind == "END" or (
+                t.kind == "PUNCT" and t.value == ";" and depth == 0
+            ):
+                break
+            if t.kind == "PUNCT" and t.value == "(":
+                depth += 1
+            elif t.kind == "PUNCT" and t.value == ")":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif t.kind == "WORD" and t.value.upper() == "CASE":
+                case_depth += 1
+            elif t.kind == "WORD" and t.value.upper() == "END" and case_depth > 0:
+                case_depth -= 1
+            elif depth == 0 and case_depth == 0:
+                if stop_comma and t.kind == "PUNCT" and t.value == ",":
+                    break
+                if t.kind == "WORD" and t.value.upper() in stop_words:
+                    break
+            self.next()
+            last_end = t.end
+        text = self.sql[start_tok.start:last_end].strip()
+        return text or None
+
+    def number(self, as_int: bool = False):
+        t = self.next()
+        if t.kind != "NUMBER":
+            raise DeltaParseError(f"Expected a number at offset {t.start}")
+        try:
+            return int(t.value) if as_int else float(t.value)
+        except ValueError:
+            raise DeltaParseError(
+                f"Invalid {'integer' if as_int else 'number'} {t.value!r} "
+                f"at offset {t.start}"
+            )
+
+    def string_or_number(self) -> str:
+        t = self.next()
+        if t.kind in ("STRING", "NUMBER", "WORD"):
+            return t.value
+        raise DeltaParseError(f"Expected literal at offset {t.start}")
+
+    def properties(self) -> Dict[str, str]:
+        """( 'k' = 'v' [, ...] )"""
+        self.expect_punct("(")
+        out: Dict[str, str] = {}
+        while True:
+            key = self.string_or_number()
+            # dotted bare keys: delta.appendOnly
+            while self.accept_punct("."):
+                key += "." + self.string_or_number()
+            self.expect_punct("=")
+            out[key] = self.string_or_number()
+            if self.accept_punct(")"):
+                return out
+            self.expect_punct(",")
+
+    def column_type(self):
+        name = self.ident()
+        args: List[str] = []
+        if self.accept_punct("("):
+            while not self.accept_punct(")"):
+                t = self.next()
+                if t.kind == "NUMBER":
+                    args.append(t.value)
+                elif not (t.kind == "PUNCT" and t.value == ","):
+                    raise DeltaParseError(
+                        f"Bad type argument at offset {t.start}: {t.value!r}"
+                    )
+        return _make_type(name, args)
+
+    def column_def(self) -> StructField:
+        """name TYPE [GENERATED ALWAYS AS (expr)] [NOT NULL] [COMMENT 's'].
+        Dotted names (``s.x``) address nested structs (ALTER ADD COLUMNS)."""
+        name = self.ident()
+        while self.accept_punct("."):
+            name += "." + self.ident()
+        dtype = self.column_type()
+        nullable = True
+        metadata: Dict[str, Any] = {}
+        while True:
+            if self.accept_word("NOT"):
+                self.expect_word("NULL")
+                nullable = False
+            elif self.accept_word("COMMENT"):
+                t = self.next()
+                if t.kind != "STRING":
+                    raise DeltaParseError(f"Expected comment string at {t.start}")
+                metadata["comment"] = t.value
+            elif self.accept_word("GENERATED"):
+                self.expect_word("ALWAYS")
+                self.expect_word("AS")
+                self.expect_punct("(")
+                expr = self.slice_expr()
+                if expr is None:
+                    raise DeltaParseError("Empty generation expression")
+                self.expect_punct(")")
+                from delta_tpu.schema.generated import GENERATION_EXPRESSION_KEY
+
+                metadata[GENERATION_EXPRESSION_KEY] = expr
+            else:
+                break
+        return StructField(name, dtype, nullable, metadata)
+
+    def column_name_list(self) -> List[str]:
+        self.expect_punct("(")
+        out = [self.ident()]
+        while self.accept_punct(","):
+            out.append(self.ident())
+        self.expect_punct(")")
+        return out
+
+
+def _log_for(ref: Tuple[str, str]) -> DeltaLog:
+    kind, value = ref
+    if kind == "name":
+        from delta_tpu.catalog.catalog import resolve_identifier
+
+        return DeltaLog.for_table(resolve_identifier(value))
+    return DeltaLog.for_table(value)
+
+
+def parse_statement(sql: str):
+    """Parse one statement into a zero-argument runner (late-bound command
+    construction so parse errors surface before any table IO)."""
+    p = _Parser(sql)
+    t = p.peek()
+    if t.kind != "WORD":
+        raise DeltaParseError(f"Expected a statement keyword, got {t.value!r}")
+    head = t.value.upper()
+    if head == "VACUUM":
+        return _vacuum(p)
+    if head == "DESCRIBE" or head == "DESC":
+        return _describe(p)
+    if head == "GENERATE":
+        return _generate(p)
+    if head == "CONVERT":
+        return _convert(p)
+    if head == "DELETE":
+        return _delete(p)
+    if head == "UPDATE":
+        return _update(p)
+    if head == "MERGE":
+        return _merge(p)
+    if head == "CREATE":
+        return _create(p)
+    if head == "ALTER":
+        return _alter(p)
+    raise DeltaAnalysisError(f"Unsupported SQL statement: {sql.strip()[:80]!r}")
 
 
 def execute_sql(sql: str) -> Any:
     """Parse and run one Delta statement; returns the command's result."""
-    stmt = sql.strip().rstrip(";").strip()
+    return parse_statement(sql)()
 
-    m = re.fullmatch(
-        r"VACUUM\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
-        r"(?:\s+RETAIN\s+(?P<hours>[\d.]+)\s+HOURS?)?"
-        r"(?:\s+(?P<dry>DRY\s+RUN))?",
-        stmt, re.IGNORECASE,
-    )
-    if m:
+
+# -- statement parsers -------------------------------------------------------
+
+
+def _vacuum(p: _Parser):
+    p.expect_word("VACUUM")
+    path = p.table_path()
+    hours = None
+    dry = False
+    if p.accept_word("RETAIN"):
+        hours = p.number()
+        p.expect_word("HOURS", "HOUR")
+    if p.accept_word("DRY"):
+        p.expect_word("RUN")
+        dry = True
+    p.expect_end()
+
+    def run():
         from delta_tpu.commands.vacuum import VacuumCommand
 
-        log = DeltaLog.for_table(_table_path(m.group("tbl")))
-        hours = float(m.group("hours")) if m.group("hours") else None
-        return VacuumCommand(log, hours, dry_run=bool(m.group("dry"))).run()
+        return VacuumCommand(_log_for(path), hours, dry_run=dry).run()
 
-    m = re.fullmatch(
-        r"DESCRIBE\s+HISTORY\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
-        r"(?:\s+LIMIT\s+(?P<limit>\d+))?",
-        stmt, re.IGNORECASE,
-    )
-    if m:
-        from delta_tpu.commands.describe import describe_history
+    return run
 
-        log = DeltaLog.for_table(_table_path(m.group("tbl")))
-        limit = int(m.group("limit")) if m.group("limit") else None
-        return describe_history(log, limit)
 
-    m = re.fullmatch(
-        r"DESCRIBE\s+DETAIL\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)",
-        stmt, re.IGNORECASE,
-    )
-    if m:
-        from delta_tpu.commands.describe import describe_detail
+def _describe(p: _Parser):
+    p.expect_word("DESCRIBE", "DESC")
+    which = p.expect_word("HISTORY", "DETAIL").value.upper()
+    path = p.table_path()
+    limit = None
+    if which == "HISTORY" and p.accept_word("LIMIT"):
+        limit = p.number(as_int=True)
+    p.expect_end()
 
-        return describe_detail(DeltaLog.for_table(_table_path(m.group("tbl"))))
+    def run():
+        from delta_tpu.commands.describe import describe_detail, describe_history
 
-    m = re.fullmatch(
-        r"GENERATE\s+(?P<mode>\w+)\s+FOR\s+TABLE\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)",
-        stmt, re.IGNORECASE,
-    )
-    if m:
-        mode = m.group("mode").lower()
-        if mode != "symlink_format_manifest":
-            _fail(f"Unsupported GENERATE mode: {mode}")
+        log = _log_for(path)
+        if which == "HISTORY":
+            return describe_history(log, limit)
+        return describe_detail(log)
+
+    return run
+
+
+def _generate(p: _Parser):
+    p.expect_word("GENERATE")
+    t = p.next()
+    mode = t.value if t.kind in ("WORD", "STRING") else None
+    if mode is None or mode.lower() != "symlink_format_manifest":
+        raise DeltaAnalysisError(f"Unsupported GENERATE mode: {mode}")
+    p.expect_word("FOR")
+    p.expect_word("TABLE")
+    path = p.table_path()
+    p.expect_end()
+
+    def run():
         from delta_tpu.hooks.symlink_manifest import generate_full_manifest
 
-        return generate_full_manifest(DeltaLog.for_table(_table_path(m.group("tbl"))))
+        return generate_full_manifest(_log_for(path))
 
-    m = re.fullmatch(
-        r"CONVERT\s+TO\s+DELTA\s+(?P<tbl>parquet\s*\.\s*`[^`]+`|\S+)"
-        r"(?:\s+PARTITIONED\s+BY\s*\((?P<parts>[^)]*)\))?",
-        stmt, re.IGNORECASE,
-    )
-    if m:
+    return run
+
+
+def _convert(p: _Parser):
+    p.expect_word("CONVERT")
+    p.expect_word("TO")
+    p.expect_word("DELTA")
+    path = p.table_path()
+    part_schema = None
+    if p.accept_word("PARTITIONED"):
+        p.expect_word("BY")
+        p.expect_punct("(")
+        fields = [p.column_def()]
+        while p.accept_punct(","):
+            fields.append(p.column_def())
+        p.expect_punct(")")
+        part_schema = StructType(fields)
+    p.expect_end()
+
+    def run():
         from delta_tpu.commands.convert import ConvertToDeltaCommand
 
-        part_schema = None
-        if m.group("parts"):
-            fields = []
-            for spec in m.group("parts").split(","):
-                bits = spec.strip().split()
-                if len(bits) != 2:
-                    _fail(f"Bad PARTITIONED BY column spec: {spec.strip()!r}")
-                fields.append(StructField(bits[0], _parse_type(bits[1])))
-            part_schema = StructType(fields)
-        log = DeltaLog.for_table(_table_path(m.group("tbl")))
-        return ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+        return ConvertToDeltaCommand(
+            _log_for(path), partition_schema=part_schema
+        ).run()
 
-    m = re.fullmatch(
-        r"DELETE\s+FROM\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
-        r"(?:\s+WHERE\s+(?P<cond>.+))?",
-        stmt, re.IGNORECASE | re.DOTALL,
-    )
-    if m:
+    return run
+
+
+def _delete(p: _Parser):
+    p.expect_word("DELETE")
+    p.expect_word("FROM")
+    path = p.table_path()
+    cond = None
+    if p.accept_word("WHERE"):
+        cond = p.slice_expr()
+        if cond is None:
+            raise DeltaParseError("Empty WHERE clause")
+    p.expect_end()
+
+    def run():
         from delta_tpu.commands.delete import DeleteCommand
 
-        log = DeltaLog.for_table(_table_path(m.group("tbl")))
-        cmd = DeleteCommand(log, m.group("cond"))
+        cmd = DeleteCommand(_log_for(path), cond)
         cmd.run()
         return cmd.metrics
 
-    m = re.fullmatch(
-        r"UPDATE\s+(?P<tbl>\S+|delta\s*\.\s*`[^`]+`)"
-        r"\s+SET\s+(?P<sets>.+?)(?:\s+WHERE\s+(?P<cond>.+))?",
-        stmt, re.IGNORECASE | re.DOTALL,
-    )
-    if m:
+    return run
+
+
+def _set_assignments(p: _Parser, stop_words: Tuple[str, ...]) -> Dict[str, str]:
+    """col = expr [, col = expr ...] with verbatim expression slices."""
+    sets: Dict[str, str] = {}
+    while True:
+        col = p.ident()
+        while p.accept_punct("."):
+            col += "." + p.ident()
+        p.expect_punct("=")
+        expr = p.slice_expr(stop_words, stop_comma=True)
+        if expr is None:
+            raise DeltaParseError(f"Empty SET expression for column {col!r}")
+        sets[col] = expr
+        if not p.accept_punct(","):
+            return sets
+
+
+def _update(p: _Parser):
+    p.expect_word("UPDATE")
+    path = p.table_path()
+    p.expect_word("SET")
+    sets = _set_assignments(p, ("WHERE",))
+    cond = None
+    if p.accept_word("WHERE"):
+        cond = p.slice_expr()
+        if cond is None:
+            raise DeltaParseError("Empty WHERE clause")
+    p.expect_end()
+
+    def run():
         from delta_tpu.commands.update import UpdateCommand
 
-        sets: Dict[str, str] = {}
-        for part in _split_top_level(m.group("sets")):
-            col, _, expr = part.partition("=")
-            if not expr:
-                _fail(f"Bad SET clause: {part!r}")
-            sets[col.strip().strip("`")] = expr.strip()
-        log = DeltaLog.for_table(_table_path(m.group("tbl")))
-        cmd = UpdateCommand(log, sets, m.group("cond"))
+        cmd = UpdateCommand(_log_for(path), sets, cond)
         cmd.run()
         return cmd.metrics
 
-    _fail(f"Unsupported SQL statement: {stmt[:80]!r}")
+    return run
 
 
-def _split_top_level(s: str) -> List[str]:
-    """Split on commas not inside parens/quotes."""
-    out, depth, start, in_str = [], 0, 0, None
-    for i, ch in enumerate(s):
-        if in_str:
-            if ch == in_str:
-                in_str = None
-            continue
-        if ch in "'\"":
-            in_str = ch
-        elif ch == "(":
-            depth += 1
-        elif ch == ")":
-            depth -= 1
-        elif ch == "," and depth == 0:
-            out.append(s[start:i])
-            start = i + 1
-    out.append(s[start:])
-    return [p for p in (x.strip() for x in out) if p]
+def _merge(p: _Parser):
+    from delta_tpu.commands.merge import MergeClause
+
+    p.expect_word("MERGE")
+    p.expect_word("INTO")
+    target_path = p.table_path()
+    target_alias = None
+    if p.accept_word("AS"):
+        target_alias = p.ident()
+    elif p.peek().kind == "WORD" and not p.peek().is_word("USING"):
+        target_alias = p.ident()
+    p.expect_word("USING")
+    source_path = p.table_path()
+    source_alias = None
+    if p.accept_word("AS"):
+        source_alias = p.ident()
+    elif p.peek().kind == "WORD" and not p.peek().is_word("ON"):
+        source_alias = p.ident()
+    p.expect_word("ON")
+    cond = p.slice_expr(("WHEN",))
+    if cond is None:
+        raise DeltaParseError("Empty MERGE condition")
+
+    matched: List[MergeClause] = []
+    not_matched: List[MergeClause] = []
+    while p.accept_word("WHEN"):
+        negated = False
+        if p.accept_word("NOT"):
+            negated = True
+        p.expect_word("MATCHED")
+        clause_cond = None
+        if p.accept_word("AND"):
+            clause_cond = p.slice_expr(("THEN",))
+            if clause_cond is None:
+                raise DeltaParseError("Empty clause condition")
+        p.expect_word("THEN")
+        if negated:
+            p.expect_word("INSERT")
+            if p.accept_punct("*"):
+                not_matched.append(
+                    MergeClause("insert", condition=clause_cond, assignments=None)
+                )
+            else:
+                cols = p.column_name_list()
+                p.expect_word("VALUES")
+                p.expect_punct("(")
+                vals: List[str] = []
+                while True:
+                    v = p.slice_expr(stop_comma=True)
+                    if v is None:
+                        raise DeltaParseError("Empty VALUES expression")
+                    vals.append(v)
+                    if p.accept_punct(")"):
+                        break
+                    p.expect_punct(",")
+                if len(cols) != len(vals):
+                    raise DeltaParseError(
+                        f"INSERT columns ({len(cols)}) and VALUES ({len(vals)}) differ"
+                    )
+                not_matched.append(
+                    MergeClause(
+                        "insert", condition=clause_cond,
+                        assignments=dict(zip(cols, vals)),
+                    )
+                )
+        elif p.accept_word("DELETE"):
+            matched.append(MergeClause("delete", condition=clause_cond))
+        else:
+            p.expect_word("UPDATE")
+            p.expect_word("SET")
+            if p.accept_punct("*"):
+                matched.append(
+                    MergeClause("update", condition=clause_cond, assignments=None)
+                )
+            else:
+                sets = _set_assignments(p, ("WHEN",))
+                matched.append(
+                    MergeClause("update", condition=clause_cond, assignments=sets)
+                )
+    p.expect_end()
+
+    def run():
+        from delta_tpu.commands.merge import MergeIntoCommand
+        from delta_tpu.exec.scan import scan_to_table
+
+        source = scan_to_table(_log_for(source_path).update())
+        cmd = MergeIntoCommand(
+            _log_for(target_path), source, cond,
+            matched, not_matched,
+            source_alias=source_alias, target_alias=target_alias,
+        )
+        cmd.run()
+        return cmd.metrics
+
+    return run
+
+
+def _create(p: _Parser):
+    p.expect_word("CREATE")
+    replace = False
+    if p.accept_word("OR"):
+        p.expect_word("REPLACE")
+        replace = True
+    p.expect_word("TABLE")
+    if_not_exists = False
+    if p.accept_word("IF"):
+        p.expect_word("NOT")
+        p.expect_word("EXISTS")
+        if_not_exists = True
+    path = p.table_path()
+    fields: List[StructField] = []
+    if p.accept_punct("("):
+        fields.append(p.column_def())
+        while p.accept_punct(","):
+            fields.append(p.column_def())
+        p.expect_punct(")")
+    if p.accept_word("USING"):
+        fmt = p.ident()
+        if fmt.lower() != "delta":
+            raise DeltaAnalysisError(f"Unsupported table format: {fmt!r}")
+    part_cols: List[str] = []
+    props: Dict[str, str] = {}
+    comment = None
+    location = None
+    while not p.at_end():
+        if p.accept_word("PARTITIONED"):
+            p.expect_word("BY")
+            part_cols = p.column_name_list()
+        elif p.accept_word("TBLPROPERTIES"):
+            props = p.properties()
+        elif p.accept_word("COMMENT"):
+            t = p.next()
+            if t.kind != "STRING":
+                raise DeltaParseError(f"Expected comment string at {t.start}")
+            comment = t.value
+        elif p.accept_word("LOCATION"):
+            t = p.next()
+            if t.kind != "STRING":
+                raise DeltaParseError(f"Expected location string at {t.start}")
+            location = t.value
+        else:
+            t = p.peek()
+            raise DeltaParseError(
+                f"Unexpected token at offset {t.start}: {t.value!r}"
+            )
+    p.expect_end()
+    if replace and if_not_exists:
+        raise DeltaParseError("CREATE OR REPLACE cannot have IF NOT EXISTS")
+
+    def run():
+        from delta_tpu.commands.create import CreateDeltaTableCommand
+
+        kind, value = path
+        register_name = None
+        if kind == "name":
+            from delta_tpu.catalog.catalog import default_catalog
+
+            cat = default_catalog()
+            if location is not None:
+                target = location
+                register_name = value
+            elif cat.table_exists(value):
+                target = cat.table_path(value)
+            else:
+                raise DeltaAnalysisError(
+                    f"CREATE TABLE {value}: unregistered name needs LOCATION "
+                    f"(or use delta.`/path`)"
+                )
+        else:
+            target = location or value
+        mode = "create_or_replace" if replace else (
+            "create_if_not_exists" if if_not_exists else "create"
+        )
+        result = CreateDeltaTableCommand(
+            DeltaLog.for_table(target),
+            schema=StructType(fields) if fields else None,
+            mode=mode,
+            partition_columns=part_cols,
+            configuration=props or None,
+            name=register_name,
+            description=comment,
+        ).run()
+        if register_name is not None:
+            from delta_tpu.catalog.catalog import default_catalog
+
+            cat = default_catalog()
+            if not cat.table_exists(register_name):
+                cat.register(register_name, target)
+        return result
+
+    return run
+
+
+def _alter(p: _Parser):
+    from delta_tpu.commands import alter as alter_mod
+
+    p.expect_word("ALTER")
+    p.expect_word("TABLE")
+    path = p.table_path()
+
+    if p.accept_word("SET"):
+        p.expect_word("TBLPROPERTIES")
+        props = p.properties()
+        p.expect_end()
+        return lambda: alter_mod.set_table_properties(
+            _log_for(path), props
+        )
+    if p.accept_word("UNSET"):
+        p.expect_word("TBLPROPERTIES")
+        if_exists = False
+        if p.accept_word("IF"):
+            p.expect_word("EXISTS")
+            if_exists = True
+        p.expect_punct("(")
+        keys = [p.string_or_number()]
+        while p.accept_punct(","):
+            keys.append(p.string_or_number())
+        p.expect_punct(")")
+        p.expect_end()
+        return lambda: alter_mod.unset_table_properties(
+            _log_for(path), keys, if_exists=if_exists
+        )
+    if p.accept_word("ADD"):
+        if p.accept_word("COLUMNS", "COLUMN"):
+            p.expect_punct("(")
+            specs: List[Tuple[StructField, Any]] = []
+            while True:
+                f = p.column_def()
+                pos = None
+                if p.accept_word("FIRST"):
+                    pos = "first"
+                elif p.accept_word("AFTER"):
+                    pos = ("after", p.ident())
+                specs.append((f, pos))
+                if p.accept_punct(")"):
+                    break
+                p.expect_punct(",")
+            p.expect_end()
+
+            def run_add():
+                positions = {f.name: pos for f, pos in specs if pos is not None}
+                return alter_mod.add_columns(
+                    _log_for(path), [f for f, _ in specs],
+                    positions=positions or None,
+                )
+
+            return run_add
+        p.expect_word("CONSTRAINT")
+        name = p.ident()
+        p.expect_word("CHECK")
+        p.expect_punct("(")
+        expr = p.slice_expr()
+        if expr is None:
+            raise DeltaParseError("Empty CHECK expression")
+        p.expect_punct(")")
+        p.expect_end()
+        return lambda: alter_mod.add_constraint(_log_for(path), name, expr)
+    if p.accept_word("DROP"):
+        p.expect_word("CONSTRAINT")
+        if_exists = False
+        if p.accept_word("IF"):
+            p.expect_word("EXISTS")
+            if_exists = True
+        name = p.ident()
+        p.expect_end()
+        return lambda: alter_mod.drop_constraint(
+            _log_for(path), name, if_exists=if_exists
+        )
+    if p.accept_word("ALTER", "CHANGE"):
+        p.accept_word("COLUMN")
+        name = p.ident()
+        while p.accept_punct("."):
+            name += "." + p.ident()
+        new_type = None
+        comment = None
+        position = None
+        nullable = None
+        while not p.at_end():
+            if p.accept_word("TYPE"):
+                new_type = p.column_type()
+            elif p.accept_word("COMMENT"):
+                t = p.next()
+                if t.kind != "STRING":
+                    raise DeltaParseError(f"Expected comment string at {t.start}")
+                comment = t.value
+            elif p.accept_word("FIRST"):
+                position = "first"
+            elif p.accept_word("AFTER"):
+                position = ("after", p.ident())
+            elif p.accept_word("DROP"):
+                p.expect_word("NOT")
+                p.expect_word("NULL")
+                nullable = True
+            elif p.accept_word("SET"):
+                p.expect_word("NOT")
+                p.expect_word("NULL")
+                nullable = False
+            else:
+                t = p.peek()
+                raise DeltaParseError(
+                    f"Unexpected token at offset {t.start}: {t.value!r}"
+                )
+        p.expect_end()
+        return lambda: alter_mod.change_column(
+            _log_for(path), name, new_type=new_type,
+            nullable=nullable, comment=comment, position=position,
+        )
+    t = p.peek()
+    raise DeltaParseError(f"Unsupported ALTER TABLE action at offset {t.start}")

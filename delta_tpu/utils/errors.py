@@ -64,6 +64,10 @@ class InvariantViolationError(DeltaError):
     (``schema/InvariantViolationException.scala``)."""
 
 
+class DeltaParseError(DeltaAnalysisError):
+    """SQL statement failed to tokenize or parse (≈ Spark ParseException)."""
+
+
 class SchemaMismatchError(DeltaAnalysisError):
     """Write schema incompatible with table schema
     (``DeltaErrors.failedToMergeFields`` etc.)."""
