@@ -52,7 +52,19 @@ class DeltaLog:
         self._snapshot: Optional[Snapshot] = None
         self._last_update_ms: int = 0
         self._update_lock = threading.Lock()
+        # checkpoint versions that failed to decode (Snapshot._columnar
+        # recovery): listings skip them so update()'s early-exit holds
+        self._corrupt_checkpoints: frozenset = frozenset()
         self._initialize()
+
+    @property
+    def corrupt_checkpoints(self) -> frozenset:
+        return self._corrupt_checkpoints
+
+    def mark_corrupt_checkpoint(self, version: int) -> frozenset:
+        """Memoize a checkpoint that failed to decode; returns the set."""
+        self._corrupt_checkpoints = self._corrupt_checkpoints | {version}
+        return self._corrupt_checkpoints
 
     # -- singleton cache (DeltaLog.scala:373-387) -----------------------
 
@@ -112,7 +124,8 @@ class DeltaLog:
             if last is not None:
                 start_ckpt = last.version
             segment = sm.get_log_segment_for_version(
-                self.store, self.log_path, start_checkpoint=start_ckpt
+                self.store, self.log_path, start_checkpoint=start_ckpt,
+                excluded_checkpoints=self.corrupt_checkpoints,
             )
             if segment is None:
                 snap: Snapshot = InitialSnapshot(self)

@@ -18,6 +18,7 @@ remains the correctness oracle and serves the small-N transactional paths.
 """
 from __future__ import annotations
 
+import logging
 from functools import cached_property
 from typing import Any, Dict, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
@@ -39,6 +40,8 @@ if TYPE_CHECKING:
     from delta_tpu.log.deltalog import DeltaLog
 
 __all__ = ["LogSegment", "Snapshot", "InitialSnapshot"]
+
+logger = logging.getLogger(__name__)
 
 
 class LogSegment:
@@ -114,12 +117,63 @@ class Snapshot:
     @cached_property
     def _columnar(self) -> SegmentColumns:
         """Columnar decode of the whole segment (``Snapshot.scala:88-111``
-        equivalent, minus the per-action objects)."""
-        return decode_segment(
-            self.store,
-            [f.path for f in self.segment.checkpoint_files],
-            [f.path for f in self.segment.deltas],
-        )
+        equivalent, minus the per-action objects).
+
+        Corruption recovery (≈ ``Checkpoints.scala:152-175`` /
+        ``SnapshotManagement.scala:118-126``): a checkpoint part that fails
+        to decode (truncated / garbage parquet) is excluded and the segment
+        recomputed from the listing — falling back to an earlier complete
+        checkpoint, or a full JSON replay from version 0. The corrupt
+        version is memoized on the DeltaLog so later listings skip it (and
+        ``update()``'s segment-equality early-exit keeps working)."""
+        segment = self.segment
+        while True:
+            try:
+                return decode_segment(
+                    self.store,
+                    [f.path for f in segment.checkpoint_files],
+                    [f.path for f in segment.deltas],
+                )
+            except Exception as e:
+                if segment.checkpoint_version is None:
+                    raise
+                # attribute the failure: only exclude the checkpoint when its
+                # parquet itself is unreadable — a corrupt delta JSON must
+                # surface, not burn through every good checkpoint
+                if self._checkpoint_readable(segment):
+                    raise
+                from delta_tpu.log import snapshot_management as sm
+
+                excluded = self.delta_log.mark_corrupt_checkpoint(
+                    segment.checkpoint_version
+                )
+                logger.warning(
+                    "checkpoint at version %s failed to decode (%s: %s); "
+                    "recovering from the log listing",
+                    segment.checkpoint_version, type(e).__name__, e,
+                )
+                retry = sm.get_log_segment_for_version(
+                    self.store, segment.log_path,
+                    version_to_load=self.version,
+                    excluded_checkpoints=excluded,
+                )
+                if retry is None or retry.checkpoint_version in excluded:
+                    raise
+                segment = retry
+                self.segment = retry
+
+    def _checkpoint_readable(self, segment: LogSegment) -> bool:
+        """Can every checkpoint part's parquet footer be opened?"""
+        import io
+
+        import pyarrow.parquet as pq
+
+        try:
+            for f in segment.checkpoint_files:
+                pq.ParquetFile(io.BytesIO(self.store.read_bytes(f.path)))
+            return True
+        except Exception:
+            return False
 
     @cached_property
     def _winner(self) -> np.ndarray:

@@ -63,13 +63,18 @@ def get_log_segment_for_version(
     log_path: str,
     version_to_load: Optional[int] = None,
     start_checkpoint: Optional[int] = None,
+    excluded_checkpoints: frozenset = frozenset(),
 ) -> Optional[LogSegment]:
     """Compute the LogSegment for a version (latest if None), starting the
     listing at ``start_checkpoint`` (from ``_last_checkpoint``) when given
     (``SnapshotManagement.scala:82-179``). Returns None when the directory
-    has no delta files at all (uninitialized table)."""
+    has no delta files at all (uninitialized table).
+    ``excluded_checkpoints``: checkpoint versions known corrupt — skipped
+    during selection (decode-failure recovery, `snapshot.py:_columnar`)."""
     if version_to_load is not None and start_checkpoint is not None and start_checkpoint > version_to_load:
         start_checkpoint = None  # pointer is past the requested version: list from scratch
+    if excluded_checkpoints and start_checkpoint in excluded_checkpoints:
+        start_checkpoint = None
     list_start = start_checkpoint or 0
     files = [f for f in list_log_files(store, log_path, list_start) if f.size > 0 or filenames.is_delta_file(f.name)]
 
@@ -80,7 +85,10 @@ def get_log_segment_for_version(
         if start_checkpoint:
             # _last_checkpoint points at a vanished checkpoint: re-list from 0
             # (SnapshotManagement.scala:118-126).
-            return get_log_segment_for_version(store, log_path, version_to_load, None)
+            return get_log_segment_for_version(
+                store, log_path, version_to_load, None,
+                excluded_checkpoints=excluded_checkpoints,
+            )
         return None
 
     checkpoint_candidates: List[CheckpointInstance] = []
@@ -89,6 +97,8 @@ def get_log_segment_for_version(
     for f in files:
         if filenames.is_checkpoint_file(f.name) and f.size > 0:
             v = filenames.checkpoint_version(f.name)
+            if v in excluded_checkpoints:
+                continue
             part = filenames.checkpoint_part(f.name)
             inst = CheckpointInstance(v, part[1] if part else None)
             checkpoint_candidates.append(inst)
@@ -125,7 +135,10 @@ def get_log_segment_for_version(
     # pointer, it lied (checkpoint deleted/corrupt): recover by re-listing the
     # whole log from 0 (``SnapshotManagement.scala:118-126``).
     if start_checkpoint:
-        return get_log_segment_for_version(store, log_path, version_to_load, None)
+        return get_log_segment_for_version(
+            store, log_path, version_to_load, None,
+            excluded_checkpoints=excluded_checkpoints,
+        )
     deltas.sort(key=lambda f: filenames.delta_version(f.name))
     versions = [filenames.delta_version(f.name) for f in deltas]
     if not versions:
