@@ -27,6 +27,7 @@ from delta_tpu.expr import ir
 from delta_tpu.log.deltalog import DeltaLog
 from delta_tpu.protocol.actions import Protocol
 from delta_tpu.schema.types import StructType
+from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import DeltaAnalysisError
 
 __all__ = ["DeltaTable", "DeltaMergeBuilder", "DeltaOptimizeBuilder"]
@@ -45,7 +46,7 @@ class DeltaTable:
     def for_path(cls, path: str, store=None, clock=None) -> "DeltaTable":
         log = DeltaLog.for_table(path, store=store, clock=clock)
         if not log.table_exists:
-            raise DeltaAnalysisError(f"{path} is not a Delta table")
+            raise errors_mod.not_a_delta_table(path)
         return cls(log)
 
     @classmethod

@@ -46,6 +46,31 @@ class Catalog:
             self._load()
 
     # -- persistence ------------------------------------------------------
+    #
+    # Cross-process safety: every load-mutate-save cycle holds an OS file
+    # lock (flock on <store>.lock) in addition to the in-process RLock, so
+    # two processes registering tables concurrently cannot lose a write
+    # (the in-process lock alone only orders threads).
+
+    def _file_lock(self):
+        import contextlib
+
+        if not self._store_path:
+            return contextlib.nullcontext()
+
+        import fcntl
+
+        @contextlib.contextmanager
+        def locked():
+            os.makedirs(os.path.dirname(self._store_path) or ".", exist_ok=True)
+            with open(self._store_path + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+
+        return locked()
 
     def _load(self) -> None:
         try:
@@ -69,7 +94,7 @@ class Catalog:
     def register(self, name: str, path: str) -> None:
         """Point ``name`` at an existing table location (external table)."""
         key = _normalize(name)
-        with self._lock:
+        with self._lock, self._file_lock():
             if self._store_path:
                 self._load()
             if key in self._tables:
@@ -85,15 +110,28 @@ class Catalog:
         from delta_tpu.api.tables import DeltaTable
 
         key = _normalize(name)
-        with self._lock:
+        # Pre-check under the lock, run the (possibly long) CTAS/create
+        # outside it so unrelated catalog operations aren't serialized behind
+        # data writes, then re-check + register in a second critical section.
+        with self._lock, self._file_lock():
+            if self._store_path:
+                self._load()
+            if self._tables.get(key) is not None and mode == "create":
+                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
+        table = DeltaTable.create(
+            path, schema, partition_columns, configuration, data, mode=mode
+        )
+        with self._lock, self._file_lock():
             if self._store_path:
                 self._load()
             existing = self._tables.get(key)
-            if existing is not None and mode == "create":
-                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
-            table = DeltaTable.create(
-                path, schema, partition_columns, configuration, data, mode=mode
-            )
+            if (existing is not None and mode == "create"
+                    and existing != os.path.abspath(path)):
+                raise DeltaAnalysisError(
+                    f"Table {name!r} was registered concurrently (at "
+                    f"{existing}). The table data created at {path} was NOT "
+                    "registered; remove it or register it under another name."
+                )
             self._tables[key] = os.path.abspath(path)
             self._save()
         return table
@@ -102,7 +140,7 @@ class Catalog:
         """Remove the name mapping (the data/log stay on disk, like dropping
         an external table)."""
         key = _normalize(name)
-        with self._lock:
+        with self._lock, self._file_lock():
             if self._store_path:
                 self._load()
             if key not in self._tables:

@@ -16,6 +16,7 @@ from delta_tpu.expr.vectorized import boolean_mask
 from delta_tpu.schema import schema_utils
 from delta_tpu.schema.constraints import CONSTRAINT_PROP_PREFIX
 from delta_tpu.schema.types import StructField, StructType
+from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import DeltaAnalysisError
 
 __all__ = [
@@ -48,8 +49,8 @@ def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = Fal
             actual = norm.get(k.lower())
             if actual is None:
                 if not if_exists:
-                    raise DeltaAnalysisError(
-                        f"Attempted to unset non-existent property {k!r}"
+                    raise errors_mod.unset_nonexistent_property(
+                        k, delta_log.data_path
                     )
                 continue
             del cfg[actual]
@@ -214,9 +215,8 @@ def add_constraint(delta_log, name: str, expr_sql: str) -> int:
             ok = boolean_mask(expr, existing)
             bad = (pc.sum(pc.invert(ok)).as_py() or 0)
             if bad:
-                raise DeltaAnalysisError(
-                    f"{bad} rows in the table violate the new CHECK constraint "
-                    f"{expr_sql!r}"
+                raise errors_mod.new_check_constraint_violated(
+                    bad, delta_log.data_path, expr_sql
                 )
         txn.read_whole_table()
         cfg[key] = expr_sql

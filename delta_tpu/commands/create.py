@@ -104,12 +104,17 @@ class CreateDeltaTableCommand:
 
     def run(self) -> int:
         log = self.delta_log
-        exists = log.table_exists
+        # pre-checks run on the current snapshot for fast failure, but the
+        # authoritative existence read happens INSIDE the transaction (from
+        # its pinned snapshot) — a table created concurrently between this
+        # check and the commit is then caught by conflict detection instead
+        # of slipping past a stale `exists` flag
+        exists = log.update().version >= 0
         if exists:
             if self.mode == "create":
                 raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
             if self.mode == "create_if_not_exists":
-                self._reconcile_existing(log.update().metadata)
+                self._reconcile_existing(log.snapshot.metadata)
                 return log.snapshot.version
         elif self.mode == "replace":
             raise DeltaAnalysisError(
@@ -118,6 +123,12 @@ class CreateDeltaTableCommand:
             )
 
         def body(txn) -> int:
+            exists_now = txn.snapshot.version >= 0
+            if exists_now and self.mode == "create":
+                raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
+            if exists_now and self.mode == "create_if_not_exists":
+                self._reconcile_existing(txn.snapshot.metadata)
+                return txn.snapshot.version
             metadata = Metadata(
                 name=self.name,
                 description=self.description,
@@ -127,7 +138,7 @@ class CreateDeltaTableCommand:
             )
             txn.update_metadata(metadata)
             actions: List[Action] = []
-            replacing = exists and self.mode in ("replace", "create_or_replace")
+            replacing = exists_now and self.mode in ("replace", "create_or_replace")
             if replacing:
                 actions.extend(f.remove() for f in txn.filter_files())
             if self.data is not None and self.data.num_rows:

@@ -18,8 +18,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from delta_tpu.commands import operations as ops
-from delta_tpu.utils.config import DeltaConfigs
-from delta_tpu.utils.errors import DeltaIllegalArgumentError
+from delta_tpu.utils.config import DeltaConfigs, conf
+from delta_tpu.utils import errors
 
 __all__ = ["VacuumCommand", "VacuumResult"]
 
@@ -66,13 +66,12 @@ class VacuumCommand:
             retention_ms = tombstone_retention_ms
         else:
             retention_ms = int(self.retention_hours * MS_PER_HOUR)
-        if self.retention_check_enabled and retention_ms < tombstone_retention_ms:
-            raise DeltaIllegalArgumentError(
-                f"Are you sure you would like to vacuum files with such a low "
-                f"retention period ({self.retention_hours}h)? The table's "
-                f"deletedFileRetentionDuration is "
-                f"{tombstone_retention_ms // MS_PER_HOUR}h. Disable the retention "
-                "duration check to proceed."
+        check_enabled = self.retention_check_enabled and bool(
+            conf.get("delta.tpu.retentionDurationCheck.enabled", True)
+        )
+        if check_enabled and retention_ms < tombstone_retention_ms:
+            raise errors.retention_period_too_short(
+                self.retention_hours, tombstone_retention_ms / MS_PER_HOUR
             )
         cutoff = log.clock() - retention_ms
 

@@ -24,6 +24,7 @@ from delta_tpu.protocol.actions import Action, AddFile, Metadata
 from delta_tpu.schema import schema_utils
 from delta_tpu.schema.arrow_interop import schema_from_arrow
 from delta_tpu.schema.types import StructType
+from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalArgumentError
 
 __all__ = ["WriteIntoDelta", "update_metadata_on_write", "coerce_to_table"]
@@ -195,9 +196,8 @@ class WriteIntoDelta:
             )
         for add in written:
             if not partition_expr.matches(pred, add, part_schema):
-                raise DeltaAnalysisError(
-                    f"Written data does not match replaceWhere {pred.sql()!r}: "
-                    f"partition {add.partition_values}"
+                raise errors_mod.replace_where_mismatch(
+                    pred.sql(), f"partitions {add.partition_values}"
                 )
         matched = txn.filter_files([pred])
         data_change = not self.rearrange_only

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
@@ -30,6 +31,7 @@ from delta_tpu.protocol.actions import (
     SetTransaction,
 )
 from delta_tpu.storage.logstore import LogStore
+from delta_tpu.utils import errors
 from delta_tpu.utils.errors import DeltaIllegalStateError
 
 __all__ = [
@@ -352,7 +354,11 @@ def read_checkpoint_actions(store: LogStore, paths: Sequence[str]) -> List[Actio
 
     out: List[Action] = []
     for path in paths:
-        data = store.read_bytes(path)
+        try:
+            data = store.read_bytes(path)
+        except FileNotFoundError as e:
+            version = filenames.get_file_version(os.path.basename(path))
+            raise errors.missing_part_files(version, e) from e
         table = pq.read_table(pa.BufferReader(data))
         for name in ("protocol", "metaData", "txn", "remove", "add"):
             if name not in table.column_names:

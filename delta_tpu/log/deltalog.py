@@ -26,6 +26,7 @@ from delta_tpu.protocol.actions import (
 )
 from delta_tpu.storage.logstore import LogStore, get_log_store
 from delta_tpu.utils.config import DeltaConfigs, conf
+from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import (
     DeltaIllegalStateError,
     ProtocolError,
@@ -210,16 +211,16 @@ class DeltaLog:
 
     def assert_protocol_read(self, protocol: Protocol) -> None:
         if protocol is not None and READER_VERSION < protocol.min_reader_version:
-            raise ProtocolError(
-                f"Table requires reader version {protocol.min_reader_version}, "
-                f"but this client supports up to {READER_VERSION}."
+            raise errors_mod.invalid_protocol_version(
+                READER_VERSION, WRITER_VERSION,
+                protocol.min_reader_version, protocol.min_writer_version or 0,
             )
 
     def assert_protocol_write(self, protocol: Protocol, log_upgrade_message: bool = True) -> None:
         if protocol is not None and WRITER_VERSION < protocol.min_writer_version:
-            raise ProtocolError(
-                f"Table requires writer version {protocol.min_writer_version}, "
-                f"but this client supports up to {WRITER_VERSION}."
+            raise errors_mod.invalid_protocol_version(
+                READER_VERSION, WRITER_VERSION,
+                protocol.min_reader_version or 0, protocol.min_writer_version,
             )
 
     def upgrade_protocol(self, new_protocol: Protocol) -> None:

@@ -26,6 +26,7 @@ from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_predicate
 from delta_tpu.protocol.actions import Metadata
 from delta_tpu.schema.types import StructType
+from delta_tpu.utils import errors
 from delta_tpu.utils.errors import InvariantViolationError
 
 __all__ = ["Constraint", "NotNull", "Check", "from_metadata", "enforce"]
@@ -88,9 +89,7 @@ def enforce(constraints: List[Constraint], table: pa.Table) -> None:
                 )
             nulls = col.null_count
             if nulls:
-                raise InvariantViolationError(
-                    f"NOT NULL constraint violated for column: {c.column}. ({nulls} null rows)"
-                )
+                raise errors.not_null_invariant_violated(c.column, nulls)
         elif isinstance(c, Check):
             verdict = evaluate(c.expr, table)
             # violation = rows where the check is FALSE or NULL
@@ -99,6 +98,4 @@ def enforce(constraints: List[Constraint], table: pa.Table) -> None:
             if bad:
                 idx = pc.index(ok, False).as_py()
                 sample = {k: table.column(k)[idx].as_py() for k in table.column_names}
-                raise InvariantViolationError(
-                    f"CHECK constraint {c.name} {c.expr.sql()} violated by row: {sample}"
-                )
+                raise errors.check_constraint_violated(c.name, c.expr.sql(), sample)

@@ -92,17 +92,11 @@ def check_for_conflicts(txn, winning_version: int, actions: Sequence[Action]) ->
     if summary.protocol is not None:
         txn.delta_log.assert_protocol_read(summary.protocol)
         txn.delta_log.assert_protocol_write(summary.protocol)
-        raise errors.ProtocolChangedException(
-            "The protocol version of the Delta table has been changed by a "
-            "concurrent update.", brief,
-        )
+        raise errors.protocol_changed_exception(brief)
 
     # 2. Metadata changed (scala:774-778)
     if summary.metadata_updates:
-        raise errors.MetadataChangedException(
-            "The metadata of the Delta table has been changed by a concurrent update.",
-            brief,
-        )
+        raise errors.metadata_changed_exception(brief)
 
     # 3. Concurrent appends in regions we read (scala:795-826)
     level = txn.commit_isolation_level
@@ -126,36 +120,24 @@ def check_for_conflicts(txn, winning_version: int, actions: Sequence[Action]) ->
                 if conflicting:
                     break
         if conflicting is not None:
-            raise errors.ConcurrentAppendException(
-                f"Files were added to the table by a concurrent update "
-                f"(e.g. {conflicting.path}). Please try the operation again.",
-                brief,
+            raise errors.concurrent_append_exception(
+                f"the table (for example {conflicting.path})", brief
             )
 
     # 4. Deleted files that we read (scala:829-839)
     read_paths: Set[str] = set(txn.read_files)
     for r in summary.removed_files:
         if r.path in read_paths or txn.read_the_whole_table:
-            raise errors.ConcurrentDeleteReadException(
-                f"This transaction attempted to read one or more files that were "
-                f"deleted (e.g. {r.path}) by a concurrent update.", brief,
-            )
+            raise errors.concurrent_delete_read_exception(r.path, brief)
 
     # 5. Delete/delete overlap (scala:842-845)
     our_removed = {a.path for a in txn.staged_removes}
     for r in summary.removed_files:
         if r.path in our_removed:
-            raise errors.ConcurrentDeleteDeleteException(
-                f"This transaction attempted to delete one or more files that were "
-                f"deleted (e.g. {r.path}) by a concurrent update.", brief,
-            )
+            raise errors.concurrent_delete_delete_exception(r.path, brief)
 
     # 6. SetTransaction overlap (scala:848-852)
     read_apps = set(txn.read_txn)
     for t in summary.txns:
         if t.app_id in read_apps:
-            raise errors.ConcurrentTransactionException(
-                f"This error occurs when multiple streaming queries are using the "
-                f"same checkpoint to write into this table (appId={t.app_id}).",
-                brief,
-            )
+            raise errors.concurrent_transaction_exception(brief, app_id=t.app_id)

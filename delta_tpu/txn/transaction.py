@@ -318,9 +318,7 @@ class OptimisticTransaction:
         if DeltaConfigs.IS_APPEND_ONLY.from_metadata(current_metadata):
             for a in actions:
                 if isinstance(a, RemoveFile) and a.data_change:
-                    raise errors.DeltaUnsupportedOperationError(
-                        "This table is configured to only allow appends (delta.appendOnly=true)."
-                    )
+                    raise errors.modify_append_only_table()
 
         # Protocol write gate for the (possibly updated) protocol
         proto = next((a for a in actions if isinstance(a, Protocol)), self.protocol)
@@ -379,10 +377,7 @@ class OptimisticTransaction:
             if next_attempt == failed_version:
                 # The write failed but the file doesn't exist: storage lied about
                 # mutual exclusion (scala:683-691).
-                raise errors.ConcurrentWriteException(
-                    "A concurrent transaction has written new data since the current "
-                    "transaction read the table, and the commit file is not readable."
-                )
+                raise errors.concurrent_write_exception()
             return next_attempt
 
     def _post_commit(self, version: int) -> None:

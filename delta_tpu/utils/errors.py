@@ -14,6 +14,7 @@ __all__ = [
     "DeltaFileNotFoundError",
     "DeltaIOError",
     "DeltaUnsupportedOperationError",
+    "DeltaParseError",
     "MetadataChangedException",
     "ProtocolChangedException",
     "ConcurrentWriteException",
@@ -147,21 +148,181 @@ class ConcurrentTransactionException(DeltaConcurrentModificationException):
     """Overlapping SetTransaction appId with a concurrent commit."""
 
 
-def concurrent_modification(kind: str, message: str, commit: Optional[dict] = None):
-    cls = {
-        "write": ConcurrentWriteException,
-        "metadata": MetadataChangedException,
-        "protocol": ProtocolChangedException,
-        "append": ConcurrentAppendException,
-        "deleteRead": ConcurrentDeleteReadException,
-        "deleteDelete": ConcurrentDeleteDeleteException,
-        "txn": ConcurrentTransactionException,
-    }[kind]
-    return cls(message, commit)
-
-
 def versions_not_contiguous(versions: Iterable[int]) -> DeltaIllegalStateError:
     return DeltaIllegalStateError(
         f"Versions ({list(versions)}) are not contiguous. This can happen when "
         "files have been manually deleted from the transaction log."
+    )
+
+
+# ---------------------------------------------------------------------------
+# Error factories — the user-facing message contract, mirroring the relevant
+# subset of ``DeltaErrors.scala`` (message text and remediation advice kept
+# 1:1 where the situation exists in this engine).
+# ---------------------------------------------------------------------------
+
+_CONCURRENCY_DOC = "https://docs.delta.io/latest/concurrency-control.html"
+
+
+def _concurrent_msg(base: str, commit: Optional[dict]) -> str:
+    """``DeltaErrors.concurrentModificationExceptionMsg`` composition: base
+    message + conflicting-commit provenance + doc pointer."""
+    import json
+
+    msg = base
+    if commit:
+        msg += f"\nConflicting commit: {json.dumps(commit, default=str)}"
+    return msg + f"\nRefer to {_CONCURRENCY_DOC} for more details."
+
+
+def concurrent_write_exception(commit: Optional[dict] = None) -> ConcurrentWriteException:
+    return ConcurrentWriteException(_concurrent_msg(
+        "A concurrent transaction has written new data since the current "
+        "transaction read the table. Please try the operation again.",
+        commit), commit)
+
+
+def metadata_changed_exception(commit: Optional[dict] = None) -> MetadataChangedException:
+    return MetadataChangedException(_concurrent_msg(
+        "The metadata of the Delta table has been changed by a concurrent "
+        "update. Please try the operation again.", commit), commit)
+
+
+def protocol_changed_exception(commit: Optional[dict] = None) -> ProtocolChangedException:
+    additional = ""
+    if commit and commit.get("version") == 0:
+        # DeltaErrors.scala:1164-1171 — empty-directory race hint
+        additional = (
+            "This happens when multiple writers are writing to an empty "
+            "directory. Creating the table ahead of time will avoid this "
+            "conflict. "
+        )
+    return ProtocolChangedException(_concurrent_msg(
+        "The protocol version of the Delta table has been changed by a "
+        f"concurrent update. {additional}Please try the operation again.",
+        commit), commit)
+
+
+def concurrent_append_exception(
+    partition: str, commit: Optional[dict] = None,
+    custom_retry: Optional[str] = None,
+) -> ConcurrentAppendException:
+    return ConcurrentAppendException(_concurrent_msg(
+        f"Files were added to {partition} by a concurrent update. "
+        + (custom_retry or "Please try the operation again."), commit), commit)
+
+
+def concurrent_delete_read_exception(
+    file: str, commit: Optional[dict] = None
+) -> ConcurrentDeleteReadException:
+    return ConcurrentDeleteReadException(_concurrent_msg(
+        "This transaction attempted to read one or more files that were "
+        f"deleted (for example {file}) by a concurrent update. "
+        "Please try the operation again.", commit), commit)
+
+
+def concurrent_delete_delete_exception(
+    file: str, commit: Optional[dict] = None
+) -> ConcurrentDeleteDeleteException:
+    return ConcurrentDeleteDeleteException(_concurrent_msg(
+        "This transaction attempted to delete one or more files that were "
+        f"deleted (for example {file}) by a concurrent update. "
+        "Please try the operation again.", commit), commit)
+
+
+def concurrent_transaction_exception(
+    commit: Optional[dict] = None, app_id: Optional[str] = None,
+) -> ConcurrentTransactionException:
+    detail = f" (conflicting appId={app_id})" if app_id else ""
+    return ConcurrentTransactionException(_concurrent_msg(
+        "This error occurs when multiple streaming queries are using the "
+        f"same checkpoint to write into this table{detail}. Did you run "
+        "multiple instances of the same streaming query at the same time?",
+        commit), commit)
+
+
+def not_a_delta_table(identifier: str, operation: Optional[str] = None) -> DeltaAnalysisError:
+    if operation:
+        return DeltaAnalysisError(
+            f"{identifier} is not a Delta table. {operation} is only "
+            "supported for Delta tables."
+        )
+    return DeltaAnalysisError(f"{identifier} is not a Delta table.")
+
+
+def modify_append_only_table() -> DeltaUnsupportedOperationError:
+    return DeltaUnsupportedOperationError(
+        "This table is configured to only allow appends. If you would like "
+        "to permit updates or deletes, use 'ALTER TABLE <table_name> SET "
+        "TBLPROPERTIES (delta.appendOnly=false)'."
+    )
+
+
+def invalid_protocol_version(
+    client_reader: int, client_writer: int, table_reader: int, table_writer: int
+) -> ProtocolError:
+    return ProtocolError(
+        "Delta protocol version "
+        f"(reader={table_reader}, writer={table_writer}) is too new for this "
+        f"client (supports reader={client_reader}, writer={client_writer}). "
+        "Please upgrade to a newer release."
+    )
+
+
+def not_null_invariant_violated(
+    column: str, null_rows: Optional[int] = None
+) -> InvariantViolationError:
+    detail = f" ({null_rows} null rows)" if null_rows else ""
+    return InvariantViolationError(
+        f"NOT NULL constraint violated for column: {column}{detail}."
+    )
+
+
+def check_constraint_violated(
+    name: str, expr_sql: str, values: Optional[dict] = None
+) -> InvariantViolationError:
+    lines = "".join(f"\n - {c} : {v}" for c, v in (values or {}).items())
+    return InvariantViolationError(
+        f"CHECK constraint {name} ({expr_sql}) violated by row with values:"
+        f"{lines}"
+    )
+
+
+def new_check_constraint_violated(num: int, table: str, expr: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"{num} rows in {table} violate the new CHECK constraint ({expr})"
+    )
+
+
+def replace_where_mismatch(replace_where: str, detail: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Data written out does not match replaceWhere '{replace_where}'.\n"
+        f"Invalid data would be written to {detail}."
+    )
+
+
+def unset_nonexistent_property(key: str, table: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Attempted to unset non-existent property '{key}' in table {table}"
+    )
+
+
+def retention_period_too_short(retention_hours: float, configured_hours: float):
+    return DeltaIllegalArgumentError(
+        "Are you sure you would like to vacuum files with such a low "
+        f"retention period ({retention_hours} hours)? If you have writers "
+        "that are currently writing to this table, there is a risk that you "
+        "may corrupt the state of your Delta table.\nIf you are certain "
+        "there are no operations being performed on this table, such as "
+        "insert/upsert/delete/optimize, then you may turn off this check by "
+        "setting delta.tpu.retentionDurationCheck.enabled = false\nIf you "
+        "are not sure, please use a value not less than "
+        f"{configured_hours} hours."
+    )
+
+
+def missing_part_files(version: int, cause: Exception) -> DeltaIllegalStateError:
+    return DeltaIllegalStateError(
+        f"Couldn't find all part files of the checkpoint version: {version} "
+        f"({cause})"
     )

@@ -1,0 +1,108 @@
+"""Error-factory contract: message text mirrors the reference's
+DeltaErrors.scala for the situations this engine can hit, and the factories
+are actually wired into the raise sites."""
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.utils import errors
+
+
+def test_concurrent_message_composition():
+    e = errors.concurrent_write_exception({"version": 7, "operation": "WRITE"})
+    msg = str(e)
+    assert "A concurrent transaction has written new data" in msg
+    assert '"version": 7' in msg
+    assert "concurrency-control.html" in msg
+    assert e.conflicting_commit["version"] == 7
+
+
+def test_protocol_changed_empty_dir_hint():
+    plain = errors.protocol_changed_exception({"version": 3})
+    assert "multiple writers are writing to an empty directory" not in str(plain)
+    v0 = errors.protocol_changed_exception({"version": 0})
+    assert "multiple writers are writing to an empty directory" in str(v0)
+
+
+def test_conflict_checker_raises_factory_messages(tmp_path):
+    # two txns race: loser's error carries the winning commit provenance
+    path = str(tmp_path / "t")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({"a": [1]})).run()
+    txn = log.start_transaction()
+    txn.read_whole_table()
+    WriteIntoDelta(log, "overwrite", pa.table({"a": [9]})).run()  # winner
+    from delta_tpu.commands import operations as ops
+    from delta_tpu.protocol.actions import AddFile
+
+    with pytest.raises(errors.DeltaConcurrentModificationException) as exc:
+        txn.commit(
+            [AddFile(path="x.parquet", size=1, modification_time=0,
+                     data_change=True)],
+            ops.Write("Append"),
+        )
+    assert "Conflicting commit" in str(exc.value)
+    assert "concurrency-control.html" in str(exc.value)
+
+
+def test_append_only_error_text(tmp_path):
+    from delta_tpu.commands import alter
+    from delta_tpu.commands.delete import DeleteCommand
+
+    path = str(tmp_path / "ao")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({"a": [1]})).run()
+    alter.set_table_properties(log, {"delta.appendOnly": "true"})
+    with pytest.raises(errors.DeltaUnsupportedOperationError,
+                       match="configured to only allow appends"):
+        DeleteCommand(log, None).run()
+
+
+def test_not_null_and_check_constraint_texts(tmp_path):
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.commands import alter
+    from delta_tpu.schema.types import LongType, StructType
+
+    t = DeltaTable.create(
+        str(tmp_path / "nn"),
+        StructType().add("id", LongType(), nullable=False).add("v", LongType()),
+    )
+    with pytest.raises(errors.InvariantViolationError,
+                       match="NOT NULL constraint violated for column: id"):
+        t.write(pa.table({"id": pa.array([None], pa.int64()),
+                          "v": pa.array([1], pa.int64())}))
+    t.write({"id": [1], "v": [5]})
+    alter.add_constraint(t.delta_log, "vpos", "v > 0")
+    with pytest.raises(errors.InvariantViolationError,
+                       match=r"CHECK constraint vpos \(.*\) violated by row"):
+        t.write({"id": [2], "v": [-3]})
+
+
+def test_vacuum_retention_error_text(tmp_path):
+    from delta_tpu.commands.vacuum import VacuumCommand
+
+    path = str(tmp_path / "v")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({"a": [1]})).run()
+    with pytest.raises(errors.DeltaIllegalArgumentError,
+                       match="such a low retention period"):
+        VacuumCommand(log, retention_hours=0.0).run()
+
+
+def test_not_a_delta_table_text(tmp_path):
+    from delta_tpu.api.tables import DeltaTable
+
+    with pytest.raises(errors.DeltaAnalysisError, match="is not a Delta table"):
+        DeltaTable.for_path(str(tmp_path / "nope"))
+
+
+def test_unset_nonexistent_property_text(tmp_path):
+    from delta_tpu.commands import alter
+
+    path = str(tmp_path / "p")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({"a": [1]})).run()
+    with pytest.raises(errors.DeltaAnalysisError,
+                       match="unset non-existent property"):
+        alter.unset_table_properties(log, ["nope"])
