@@ -140,6 +140,12 @@ def bench_merge_upsert(workdir):
     path = os.path.join(workdir, "c2")
     log = DeltaLog.for_table(path)
     WriteIntoDelta(log, "append", target).run()
+    # the engine's default MERGE policy on this table: deletion vectors
+    # (rows marked, only changed rows written). The baseline mode pins the
+    # reference-shaped full-rewrite path via the session kill switch below.
+    from delta_tpu.commands.alter import set_table_properties
+
+    set_table_properties(log, {"delta.tpu.enableDeletionVectors": "true"})
 
     # source: half updates (existing keys), half inserts (fresh keys)
     existing = np.asarray(target.column("ss_item_sk"))[
@@ -157,7 +163,10 @@ def bench_merge_upsert(workdir):
         for name in ("warm", "dev2", "host1", "host2", "forced")
     }
     for p in copies.values():
-        shutil.copytree(path, p)
+        # hardlink copies: delta table files are immutable (writes always
+        # create new files), so linking shares the data without queuing
+        # ~2GB of writeback that would pollute the timed trials below
+        shutil.copytree(path, p, copy_function=os.link)
     gb = (_dir_bytes(path) + source.nbytes) / 1e9
 
     def run_merge(table_path, mode):
@@ -165,7 +174,13 @@ def bench_merge_upsert(workdir):
 
         DL.clear_cache()
         lg = DL.for_table(table_path)
-        with conf.set_temporarily(**{"delta.tpu.merge.devicePath.mode": mode}):
+        # baseline ("off") = the reference's algorithm on this host: Arrow
+        # hash join + whole-file rewrite (MergeIntoCommand.scala:456-561).
+        # Engine modes keep the deletion-vector policy (changed rows only).
+        with conf.set_temporarily(**{
+            "delta.tpu.merge.devicePath.mode": mode,
+            "delta.tpu.deletionVectors.enabled": mode != "off",
+        }):
             cmd = MergeIntoCommand(
                 lg, source, "t.ss_item_sk = s.ss_item_sk",
                 [MergeClause("update", assignments=None)],
@@ -179,12 +194,19 @@ def bench_merge_upsert(workdir):
 
     run_merge(copies["warm"], "force")  # warm the join-kernel compile
     # headline: auto mode (the engine's link-aware executor routing) vs the
-    # host-pinned baseline. min of 2 fresh-table trials per mode damps the
-    # 2x allocator/page-fault noise single trials show on this host.
-    auto_trials = [_timed(lambda: run_merge(path, "auto")),
-                   _timed(lambda: run_merge(copies["dev2"], "auto"))]
-    host_trials = [_timed(lambda: run_merge(copies["host1"], "off")),
-                   _timed(lambda: run_merge(copies["host2"], "off"))]
+    # host-pinned baseline. Trials INTERLEAVE modes (auto, host, auto, host)
+    # so page-cache/writeback drift hits both modes equally; min of 2 per
+    # mode damps the allocator/page-fault noise single trials show here.
+    def drain():
+        # drain page-cache writeback so each trial starts from a quiet
+        # disk — otherwise earlier trials' dirty pages throttle later ones
+        os.sync()
+
+    auto_trials, host_trials = [], []
+    drain(); auto_trials.append(_timed(lambda: run_merge(path, "auto")))
+    drain(); host_trials.append(_timed(lambda: run_merge(copies["host1"], "off")))
+    drain(); auto_trials.append(_timed(lambda: run_merge(copies["dev2"], "auto")))
+    drain(); host_trials.append(_timed(lambda: run_merge(copies["host2"], "off")))
     forced_s, forced_cmd = _timed(lambda: run_merge(copies["forced"], "force"))
     auto_s, auto_cmd = min(auto_trials, key=lambda x: x[0])
     host_s, host_cmd = min(host_trials, key=lambda x: x[0])
@@ -198,7 +220,8 @@ def bench_merge_upsert(workdir):
         "value": round(gb / auto_s, 3),
         "unit": "GB/s",
         "vs_baseline": round(host_s / auto_s, 2),
-        "baseline": "same engine, host Arrow hash-join path (same machine)",
+        "baseline": "reference-shaped path on the same machine: host Arrow "
+                    "hash-join + whole-file rewrite (deletion vectors off)",
         "auto_s": round(auto_s, 2),
         "host_s": round(host_s, 2),
         "gb": round(gb, 3),

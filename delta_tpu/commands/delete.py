@@ -16,7 +16,13 @@ from typing import Dict, List, Optional, Union
 import pyarrow.compute as pc
 
 from delta_tpu.commands import operations as ops
-from delta_tpu.commands.dml_common import Timer, candidate_files, read_candidates
+from delta_tpu.commands.dml_common import (
+    Timer,
+    candidate_files,
+    dv_enabled,
+    dv_mark_from_mask,
+    read_candidates,
+)
 from delta_tpu.exec import write as write_exec
 from delta_tpu.expr import ir
 from delta_tpu.expr import partition as partition_expr
@@ -71,10 +77,12 @@ class DeleteCommand:
             )
             return [f.remove() for f in to_remove]
 
-        # case 3: scan + rewrite
+        # case 3: scan + rewrite (or DV-mark when deletion vectors are on)
+        use_dv = dv_enabled(metadata)
         candidates = candidate_files(txn, self.condition)
         touched = read_candidates(
-            self.delta_log.data_path, candidates, metadata, self.condition
+            self.delta_log.data_path, candidates, metadata, self.condition,
+            with_positions=use_dv,
         )
         scan_ms = timer.lap_ms()
 
@@ -86,6 +94,14 @@ class DeleteCommand:
             if not matches:
                 continue  # file untouched
             deleted_rows += matches
+            if use_dv:
+                rm, re_add = dv_mark_from_mask(
+                    self.delta_log.data_path, tf.add, tf.table, tf.mask
+                )
+                removes.append(rm)
+                if re_add is not None:
+                    adds.append(re_add)
+                continue
             removes.append(tf.add.remove())
             if matches < tf.table.num_rows:
                 survivors = tf.table.filter(pc.invert(tf.mask))

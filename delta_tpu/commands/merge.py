@@ -32,7 +32,8 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from delta_tpu.commands import operations as ops
-from delta_tpu.commands.dml_common import Timer, candidate_files
+from delta_tpu.commands import dml_common as dv_common
+from delta_tpu.commands.dml_common import POSITION_COL, Timer, candidate_files
 from delta_tpu.exec import write as write_exec
 from delta_tpu.exec.scan import read_files_as_table
 from delta_tpu.expr import ir
@@ -328,42 +329,63 @@ class MergeIntoCommand:
             touched_ids = set(pc.unique(matched_pairs.column(_FID)).to_pylist())
 
         removes: List[Action] = []
+        dv_adds: List[Action] = []
         out_blocks: List[pa.Table] = []
         n_copied = n_updated = n_deleted = 0
+        use_dv = not insert_only and dv_common.dv_enabled(metadata)
 
         if not insert_only:
-            for fid in sorted(touched_ids):
-                removes.append(candidates[fid].remove())
             # matched block → per-clause masks
-            upd, n_updated, n_deleted, n_pair_copied = self._apply_matched(
-                matched_pairs, target_cols, metadata
+            upd, n_updated, n_deleted, n_pair_copied, claimed_tbl = (
+                self._apply_matched(
+                    matched_pairs, target_cols, metadata, dv_mode=use_dv
+                )
             )
             n_copied += n_pair_copied
             if upd is not None:
                 out_blocks.append(upd)
-            # unmatched target rows inside touched files → copy. _TID is the
-            # global row index over the candidate concat, so one boolean
-            # scatter replaces a per-file hash-set probe
             import numpy as np
 
-            total_rows = sum(t.num_rows for t in tgt_tables.values())
-            claimed = np.zeros(total_rows, bool)
-            claimed[matched_pairs.column(_TID).to_numpy(zero_copy_only=False)] = True
-            row_start = 0
-            starts = {}
-            for fid in sorted(tgt_tables):
-                starts[fid] = row_start
-                row_start += tgt_tables[fid].num_rows
-            for fid in sorted(touched_ids):
-                t = tgt_tables[fid]
-                keep = ~claimed[starts[fid]: starts[fid] + t.num_rows]
-                if not keep.all():
-                    copied = t.filter(pa.array(keep)).select(target_cols)
-                else:
-                    copied = t.select(target_cols)
-                n_copied += copied.num_rows
-                if copied.num_rows:
-                    out_blocks.append(copied)
+            if use_dv:
+                # claimed rows are marked deleted via per-file deletion
+                # vectors; everything else stays live in place — the file
+                # rewrite (and its copy block below) disappears entirely
+                if claimed_tbl is not None and claimed_tbl.num_rows:
+                    fids = claimed_tbl.column(_FID).to_numpy(zero_copy_only=False)
+                    poss = claimed_tbl.column(POSITION_COL).to_numpy(zero_copy_only=False)
+                    for fid in np.unique(fids):
+                        rm, re_add = dv_common.dv_mark_deleted(
+                            self.delta_log.data_path,
+                            candidates[int(fid)],
+                            poss[fids == fid],
+                        )
+                        removes.append(rm)
+                        if re_add is not None:
+                            dv_adds.append(re_add)
+            else:
+                for fid in sorted(touched_ids):
+                    removes.append(candidates[fid].remove())
+                # unmatched target rows inside touched files → copy. _TID is
+                # the global row index over the candidate concat, so one
+                # boolean scatter replaces a per-file hash-set probe
+                total_rows = sum(t.num_rows for t in tgt_tables.values())
+                claimed = np.zeros(total_rows, bool)
+                claimed[matched_pairs.column(_TID).to_numpy(zero_copy_only=False)] = True
+                row_start = 0
+                starts = {}
+                for fid in sorted(tgt_tables):
+                    starts[fid] = row_start
+                    row_start += tgt_tables[fid].num_rows
+                for fid in sorted(touched_ids):
+                    t = tgt_tables[fid]
+                    keep = ~claimed[starts[fid]: starts[fid] + t.num_rows]
+                    if not keep.all():
+                        copied = t.filter(pa.array(keep)).select(target_cols)
+                    else:
+                        copied = t.select(target_cols)
+                    n_copied += copied.num_rows
+                    if copied.num_rows:
+                        out_blocks.append(copied)
 
         # not-matched source rows → insert clauses
         inserts, n_inserted = self._apply_not_matched(
@@ -373,11 +395,13 @@ class MergeIntoCommand:
             out_blocks.append(inserts)
 
         self.phase_ms["apply_ms"] = timer.peek_ms()
-        adds: List[Action] = []
+        adds: List[Action] = list(dv_adds)
         if out_blocks:
             out = pa.concat_tables(out_blocks, promote_options="permissive")
+            if out.column_names != target_cols:
+                out = out.select(target_cols)
             if out.num_rows:
-                adds = list(
+                adds += list(
                     write_exec.write_files(
                         self.delta_log.data_path, out, metadata, data_change=True
                     )
@@ -495,6 +519,13 @@ class MergeIntoCommand:
             raw_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
                 columns=read_cols, per_file=True,
+                # DV-mode matched clauses mark physical rows deleted — the
+                # scan must carry each row's physical file position
+                position_column=(
+                    POSITION_COL
+                    if (not insert_only and dv_common.dv_enabled(metadata))
+                    else None
+                ),
             )
         tgt_tables: Dict[int, pa.Table] = {}
         pieces: List[pa.Table] = []
@@ -712,11 +743,16 @@ class MergeIntoCommand:
 
     # -- clause application ------------------------------------------------
 
-    def _apply_matched(self, pairs: pa.Table, target_cols: List[str], metadata):
+    def _apply_matched(self, pairs: pa.Table, target_cols: List[str], metadata,
+                       dv_mode: bool = False):
         """Matched block: rows claimed by update clauses are projected, by
-        delete clauses dropped, unclaimed pairs copy the target row."""
+        delete clauses dropped, unclaimed pairs copy the target row.
+
+        ``dv_mode``: unclaimed pairs stay in their files (no copy block);
+        the 5th return value is a (file id, physical position) table of the
+        claimed rows for deletion-vector marking."""
         if pairs.num_rows == 0 or not self.matched_clauses:
-            return None, 0, 0, 0
+            return None, 0, 0, 0, None
         n = pairs.num_rows
         unclaimed = pa.chunked_array([pa.array([True] * n)])
         out_parts: List[pa.Table] = []
@@ -741,16 +777,26 @@ class MergeIntoCommand:
                     # reference's numTargetRowsDeleted is rows deleted
                     n_deleted += pc.count_distinct(block.column(_TID)).as_py()
             unclaimed = pc.and_(unclaimed, pc.invert(fire))
-        # unclaimed matched pairs: copy target row unchanged
-        rest = pairs.filter(unclaimed)
-        if rest.num_rows:
-            out_parts.append(rest.select(target_cols))
+        claimed_tbl = None
+        if dv_mode:
+            # claimed rows get marked deleted in-place; unclaimed matched
+            # pairs stay live in their files — nothing is copied
+            claimed_tbl = pairs.filter(pc.invert(unclaimed)).select(
+                [_FID, POSITION_COL]
+            )
+            n_rest = 0
+        else:
+            # unclaimed matched pairs: copy target row unchanged
+            rest = pairs.filter(unclaimed)
+            if rest.num_rows:
+                out_parts.append(rest.select(target_cols))
+            n_rest = rest.num_rows
         out = (
             pa.concat_tables(out_parts, promote_options="permissive")
             if out_parts
             else None
         )
-        return out, n_updated, n_deleted, rest.num_rows
+        return out, n_updated, n_deleted, n_rest, claimed_tbl
 
     def _resolve_in_pairs(self, e: ir.Expression, pairs: pa.Table) -> ir.Expression:
         src_cols = [c[len(_SRC):] for c in pairs.column_names if c.startswith(_SRC)]

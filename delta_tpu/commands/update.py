@@ -14,7 +14,14 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from delta_tpu.commands import operations as ops
-from delta_tpu.commands.dml_common import Timer, candidate_files, read_candidates
+from delta_tpu.commands.dml_common import (
+    POSITION_COL,
+    Timer,
+    candidate_files,
+    dv_enabled,
+    dv_mark_from_mask,
+    read_candidates,
+)
 from delta_tpu.exec import write as write_exec
 from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_expression, parse_predicate
@@ -57,9 +64,11 @@ class UpdateCommand:
                 raise DeltaAnalysisError(f"Column {col!r} not found in table schema")
 
         timer = Timer()
+        use_dv = dv_enabled(metadata)
         candidates = candidate_files(txn, self.condition)
         touched = read_candidates(
-            self.delta_log.data_path, candidates, metadata, self.condition
+            self.delta_log.data_path, candidates, metadata, self.condition,
+            with_positions=use_dv,
         )
         scan_ms = timer.lap_ms()
 
@@ -71,8 +80,23 @@ class UpdateCommand:
             if not n_match:
                 continue
             updated_rows += n_match
-            removes.append(tf.add.remove())
-            rewritten = self._apply_updates(tf.table, tf.mask, metadata)
+            if use_dv:
+                # old versions of the matched rows get DV-marked; only the
+                # NEW versions are written — untouched rows stay in place
+                rm, re_add = dv_mark_from_mask(
+                    self.delta_log.data_path, tf.add, tf.table, tf.mask
+                )
+                removes.append(rm)
+                if re_add is not None:
+                    adds.append(re_add)
+                matched = tf.table.filter(tf.mask).drop_columns([POSITION_COL])
+                all_true = pa.chunked_array(
+                    [pa.array([True] * matched.num_rows)]
+                )
+                rewritten = self._apply_updates(matched, all_true, metadata)
+            else:
+                removes.append(tf.add.remove())
+                rewritten = self._apply_updates(tf.table, tf.mask, metadata)
             adds.extend(
                 write_exec.write_files(
                     self.delta_log.data_path, rewritten, metadata, data_change=True

@@ -78,10 +78,23 @@ class VacuumCommand:
         # valid set: live files + tombstones younger than THIS vacuum's
         # horizon (snapshot.tombstones caches against an older clock reading)
         valid: Set[str] = set()
+
+        def _dv_sidecar(action) -> Optional[str]:
+            dv = getattr(action, "deletion_vector", None)
+            if dv and dv.get("storageType") == "u":
+                return dv.get("pathOrInlineDv")
+            return None
+
         for f in snapshot.all_files:
             valid.add(urllib.parse.unquote(f.path))
+            side = _dv_sidecar(f)
+            if side:
+                valid.add(side)
         for r in snapshot.tombstones_newer_than(cutoff):
             valid.add(urllib.parse.unquote(r.path))
+            side = _dv_sidecar(r)
+            if side:
+                valid.add(side)
 
         data_path = log.data_path
         all_files: List[str] = []

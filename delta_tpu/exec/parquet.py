@@ -168,5 +168,7 @@ def read_parquet_files(
     before concatenation)."""
     out = []
     for p in abs_paths:
-        out.append(pq.read_table(p, columns=list(columns) if columns else None))
+        out.append(pq.read_table(
+            p, columns=list(columns) if columns else None, memory_map=True,
+        ))
     return out

@@ -40,6 +40,7 @@ def read_files_as_table(
     metadata,
     columns: Optional[Sequence[str]] = None,
     per_file: bool = False,
+    position_column: Optional[str] = None,
 ):
     """Decode AddFiles to one Arrow table, materializing partition columns.
 
@@ -47,6 +48,11 @@ def read_files_as_table(
     the GIL) — the host fan-out the reference gets from Spark executors
     (`files/TahoeFileIndex.scala:58-81`). ``per_file=True`` returns the list
     of per-file tables (same order as ``files``) instead of one concat.
+
+    Rows marked in a file's deletion vector are dropped. When
+    ``position_column`` is given, each row carries its PHYSICAL position in
+    the file as written (int64) — DML needs physical positions to extend a
+    file's deletion vector.
     """
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
@@ -67,7 +73,10 @@ def read_files_as_table(
 
     def read_one(add: AddFile) -> pa.Table:
         abs_path = _abs_data_path(data_path, add.path)
-        pf = pq.ParquetFile(abs_path)
+        # memory_map: decoded columns reference page-cache pages instead of
+        # round-tripping file bytes through the Arrow memory pool — on
+        # single-core hosts the pool churn costs more than the decode
+        pf = pq.ParquetFile(abs_path, memory_map=True)
         # project to the columns this file actually has (files written before
         # a schema evolution lack the newer columns — read fills them w/ null)
         present = set(pf.schema_arrow.names)
@@ -79,6 +88,24 @@ def read_files_as_table(
             # requested columns post-date this file): carry just the row
             # count — the dummy column is dropped by the final select
             t = pa.table({"__dummy": pa.nulls(pf.metadata.num_rows)})
+        import numpy as np
+
+        positions = None
+        if add.deletion_vector is not None:
+            from delta_tpu.protocol.deletion_vectors import (
+                DeletionVectorDescriptor,
+                read_deletion_vector,
+            )
+
+            dv_rows = read_deletion_vector(
+                DeletionVectorDescriptor.from_dict(add.deletion_vector), data_path
+            )
+            keep = np.ones(t.num_rows, dtype=bool)
+            keep[dv_rows] = False
+            t = t.filter(pa.array(keep))
+            positions = np.flatnonzero(keep)
+        elif position_column is not None:
+            positions = np.arange(t.num_rows, dtype=np.int64)
         for f in schema.fields:
             if f.name in data_cols and f.name not in t.column_names:
                 at = arrow_type_for(f.data_type)
@@ -107,6 +134,10 @@ def read_files_as_table(
             col = t.column(i)
             if want is not None and col.type != want:
                 t = t.set_column(i, pa.field(name, want, True), col.cast(want))
+        if position_column is not None:
+            t = t.append_column(
+                position_column, pa.array(positions, pa.int64())
+            )
         return t
 
     if len(files) == 1:

@@ -171,6 +171,11 @@ def write_files(
 
     table = generated_mod.compute_on_write(table, schema)
     table = normalize_data(table, schema)
+    # Defragment heavily-chunked inputs (join/filter outputs arrive as
+    # hundreds of small chunks): one contiguous copy is cheap next to the
+    # per-chunk costs the Parquet encoder pays on fragmented columns.
+    if table.num_columns and table.column(0).num_chunks > 4:
+        table = table.combine_chunks()
     if constraints is None:
         constraints = constraints_mod.from_metadata(metadata)
     constraints_mod.enforce(constraints, table)

@@ -163,6 +163,14 @@ def _arrow_checkpoint_schema():
     import pyarrow as pa
 
     str_map = pa.map_(pa.string(), pa.string())
+    dv_struct = pa.struct(
+        [
+            pa.field("storageType", pa.string()),
+            pa.field("pathOrInlineDv", pa.string()),
+            pa.field("sizeInBytes", pa.int64()),
+            pa.field("cardinality", pa.int64()),
+        ]
+    )
     return pa.schema(
         [
             pa.field(
@@ -186,6 +194,7 @@ def _arrow_checkpoint_schema():
                         pa.field("dataChange", pa.bool_()),
                         pa.field("stats", pa.string()),
                         pa.field("tags", str_map),
+                        pa.field("deletionVector", dv_struct),
                     ]
                 ),
             ),
@@ -200,6 +209,7 @@ def _arrow_checkpoint_schema():
                         pa.field("partitionValues", str_map),
                         pa.field("size", pa.int64()),
                         pa.field("tags", str_map),
+                        pa.field("deletionVector", dv_struct),
                     ]
                 ),
             ),
@@ -232,6 +242,8 @@ def _arrow_checkpoint_schema():
                     [
                         pa.field("minReaderVersion", pa.int32()),
                         pa.field("minWriterVersion", pa.int32()),
+                        pa.field("readerFeatures", pa.list_(pa.string())),
+                        pa.field("writerFeatures", pa.list_(pa.string())),
                     ]
                 ),
             ),
@@ -244,10 +256,12 @@ def _action_to_row(a: Action) -> Dict[str, Any]:
         d = a.to_dict()
         d.setdefault("stats", None)
         d.setdefault("tags", None)
+        d.setdefault("deletionVector", None)
         return {"add": d}
     if isinstance(a, RemoveFile):
         d = a.to_dict()
-        for k in ("deletionTimestamp", "extendedFileMetadata", "partitionValues", "size", "tags"):
+        for k in ("deletionTimestamp", "extendedFileMetadata", "partitionValues",
+                  "size", "tags", "deletionVector"):
             d.setdefault(k, None)
         return {"remove": d}
     if isinstance(a, Metadata):

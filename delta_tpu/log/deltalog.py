@@ -18,6 +18,10 @@ from delta_tpu.log.snapshot import InitialSnapshot, LogSegment, Snapshot
 from delta_tpu.protocol import filenames
 from delta_tpu.protocol.actions import (
     READER_VERSION,
+    SUPPORTED_READER_FEATURES,
+    SUPPORTED_READER_VERSION,
+    SUPPORTED_WRITER_FEATURES,
+    SUPPORTED_WRITER_VERSION,
     WRITER_VERSION,
     Action,
     Metadata,
@@ -210,17 +214,41 @@ class DeltaLog:
     # -- protocol gating (DeltaLog.scala:248-275) ------------------------
 
     def assert_protocol_read(self, protocol: Protocol) -> None:
-        if protocol is not None and READER_VERSION < protocol.min_reader_version:
+        """Reader gate, feature-aware: legacy versions we implement (1) pass;
+        version 2 (column mapping) is refused; version 3 (table features)
+        passes only when every listed readerFeature is supported — a missing
+        list at version 3 is spec-invalid and also refused."""
+        if protocol is None:
+            return
+        v = protocol.min_reader_version
+        ok = v <= READER_VERSION or (
+            v == SUPPORTED_READER_VERSION
+            and protocol.reader_features is not None
+            and set(protocol.reader_features) <= SUPPORTED_READER_FEATURES
+        )
+        if not ok:
             raise errors_mod.invalid_protocol_version(
-                READER_VERSION, WRITER_VERSION,
-                protocol.min_reader_version, protocol.min_writer_version or 0,
+                SUPPORTED_READER_VERSION, SUPPORTED_WRITER_VERSION,
+                v, protocol.min_writer_version or 0,
             )
 
     def assert_protocol_write(self, protocol: Protocol, log_upgrade_message: bool = True) -> None:
-        if protocol is not None and WRITER_VERSION < protocol.min_writer_version:
+        """Writer gate: legacy versions up to 4 (invariants/constraints/
+        generated columns — all implemented) pass; 5/6 (column mapping,
+        identity columns) are refused; 7 (table features) passes only when
+        every listed writerFeature is supported."""
+        if protocol is None:
+            return
+        v = protocol.min_writer_version
+        ok = v <= WRITER_VERSION or (
+            v == SUPPORTED_WRITER_VERSION
+            and protocol.writer_features is not None
+            and set(protocol.writer_features) <= SUPPORTED_WRITER_FEATURES
+        )
+        if not ok:
             raise errors_mod.invalid_protocol_version(
-                READER_VERSION, WRITER_VERSION,
-                protocol.min_reader_version or 0, protocol.min_writer_version,
+                SUPPORTED_READER_VERSION, SUPPORTED_WRITER_VERSION,
+                protocol.min_reader_version or 0, v,
             )
 
     def upgrade_protocol(self, new_protocol: Protocol) -> None:

@@ -154,6 +154,12 @@ def get_snapshot_at(delta_log: "DeltaLog", version: int) -> Snapshot:
     current = delta_log.unsafe_volatile_snapshot
     if current is not None and current.version == version:
         return current
+    if version < 0 or (current is not None and version > current.version):
+        # out-of-range asks get the user-facing time-travel error
+        # (``DeltaErrors.versionNotExistException``), not a contiguity error
+        latest = delta_log.update().version
+        if version < 0 or version > latest:
+            raise VersionNotFoundError(version, 0, latest)
     start_ckpt = None
     found = ckpt_mod.find_last_complete_checkpoint_before(
         delta_log.store, delta_log.log_path, version + 1

@@ -16,7 +16,7 @@ import json
 import uuid
 import time
 from dataclasses import dataclass, field, replace
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from delta_tpu.schema.types import StructType, schema_from_json
 
@@ -37,10 +37,26 @@ __all__ = [
     "actions_from_lines",
 ]
 
-# Protocol versions this implementation can read/write.
+# Default protocol versions for new tables.
 # Mirrors actions.scala:52-55 (readerVersion=1, writerVersion=4 in the reference).
 READER_VERSION = 1
 WRITER_VERSION = 4
+
+# Highest protocol versions this implementation can read/write. (3, 7) is the
+# table-features range: versions 3/7 carry explicit readerFeatures/
+# writerFeatures lists and a table is admitted only when every listed feature
+# is supported here (see SUPPORTED_*_FEATURES). Version 2 (column mapping)
+# and 5/6 are NOT supported and stay refused.
+SUPPORTED_READER_VERSION = 3
+SUPPORTED_WRITER_VERSION = 7
+
+# This engine's DV flavor uses its own bitmap encoding
+# (protocol/deletion_vectors.py), so it advertises a distinct feature name:
+# real-Delta DV tables (feature "deletionVectors", RoaringBitmap payloads)
+# are refused cleanly here, and vice versa.
+DV_FEATURE_NAME = "tpu.deletionVectors"
+SUPPORTED_READER_FEATURES = frozenset({DV_FEATURE_NAME})
+SUPPORTED_WRITER_FEATURES = frozenset({DV_FEATURE_NAME})
 
 
 def _drop_none(d: Dict[str, Any]) -> Dict[str, Any]:
@@ -70,22 +86,39 @@ class Action:
 @dataclass(frozen=True)
 class Protocol(Action):
     """Protocol version gate (PROTOCOL.md "Protocol Evolution";
-    actions.scala:84-193)."""
+    actions.scala:84-193). Reader 3 / writer 7 are the table-features
+    versions: they carry explicit feature-name lists, per the modern Delta
+    table-features spec — reader 3 REQUIRES readerFeatures, writer 7
+    REQUIRES writerFeatures."""
 
     min_reader_version: int = READER_VERSION
     min_writer_version: int = WRITER_VERSION
+    reader_features: Optional[Tuple[str, ...]] = None
+    writer_features: Optional[Tuple[str, ...]] = None
 
     wrap_key = "protocol"
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "minReaderVersion": self.min_reader_version,
             "minWriterVersion": self.min_writer_version,
         }
+        if self.min_reader_version >= 3:
+            d["readerFeatures"] = sorted(self.reader_features or ())
+        if self.min_writer_version >= 7:
+            d["writerFeatures"] = sorted(self.writer_features or ())
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Protocol":
-        return Protocol(int(d["minReaderVersion"]), int(d["minWriterVersion"]))
+        rf = d.get("readerFeatures")
+        wf = d.get("writerFeatures")
+        return Protocol(
+            int(d["minReaderVersion"]),
+            int(d["minWriterVersion"]),
+            tuple(rf) if rf is not None else None,
+            tuple(wf) if wf is not None else None,
+        )
 
 
 @dataclass(frozen=True)
@@ -126,6 +159,9 @@ class AddFile(FileAction):
     data_change: bool = True
     stats: Optional[str] = None  # raw JSON string, parsed lazily
     tags: Optional[Dict[str, str]] = None
+    # deletion-vector descriptor dict (protocol/deletion_vectors.py); rows
+    # listed there are logically deleted from this file
+    deletion_vector: Optional[Dict[str, Any]] = None
 
     wrap_key = "add"
 
@@ -141,6 +177,8 @@ class AddFile(FileAction):
             d["stats"] = self.stats
         if self.tags is not None:
             d["tags"] = self.tags
+        if self.deletion_vector is not None:
+            d["deletionVector"] = self.deletion_vector
         return d
 
     @staticmethod
@@ -153,10 +191,13 @@ class AddFile(FileAction):
             data_change=bool(d.get("dataChange", True)),
             stats=d.get("stats"),
             tags=d.get("tags"),
+            deletion_vector=d.get("deletionVector"),
         )
 
     def remove(self, deletion_timestamp: Optional[int] = None, data_change: bool = True) -> "RemoveFile":
-        """Tombstone for this file (actions.scala:245-252)."""
+        """Tombstone for this file (actions.scala:245-252). Carries the
+        add's deletion vector so vacuum keeps/expires the DV sidecar with
+        the data file."""
         ts = deletion_timestamp if deletion_timestamp is not None else int(time.time() * 1000)
         return RemoveFile(
             path=self.path,
@@ -166,6 +207,7 @@ class AddFile(FileAction):
             partition_values=self.partition_values,
             size=self.size,
             tags=self.tags,
+            deletion_vector=self.deletion_vector,
         )
 
     def with_data_change(self, data_change: bool) -> "AddFile":
@@ -199,6 +241,7 @@ class RemoveFile(FileAction):
     partition_values: Optional[Dict[str, Optional[str]]] = None
     size: Optional[int] = None
     tags: Optional[Dict[str, str]] = None
+    deletion_vector: Optional[Dict[str, Any]] = None
 
     wrap_key = "remove"
 
@@ -212,6 +255,7 @@ class RemoveFile(FileAction):
                 "partitionValues": self.partition_values,
                 "size": self.size,
                 "tags": self.tags,
+                "deletionVector": self.deletion_vector,
             }
         )
 
@@ -225,6 +269,7 @@ class RemoveFile(FileAction):
             partition_values=d.get("partitionValues"),
             size=d.get("size"),
             tags=d.get("tags"),
+            deletion_vector=d.get("deletionVector"),
         )
 
     @property

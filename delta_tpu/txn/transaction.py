@@ -18,6 +18,7 @@ from delta_tpu.expr import partition as part
 from delta_tpu.expr.parser import parse_expression
 from delta_tpu.protocol import filenames
 from delta_tpu.protocol.actions import (
+    DV_FEATURE_NAME,
     Action,
     AddCDCFile,
     AddFile,
@@ -162,19 +163,42 @@ class OptimisticTransaction:
             required_writer = 4
         elif uses_constraints:
             required_writer = max(required_writer, 3)
+        required_reader = 1
+        feature_names: set = set()
+        if props.get("delta.tpu.enableDeletionVectors", "false").lower() == "true":
+            # DV-bearing files change read semantics: table-features (3, 7)
+            # with the engine's DV feature listed, so pre-DV engines refuse
+            # the table instead of resurrecting deleted rows
+            required_reader, required_writer = 3, 7
+            feature_names.add(DV_FEATURE_NAME)
         pinned_reader = props.get("delta.minReaderVersion")
         pinned_writer = props.get("delta.minWriterVersion")
         cur = self.protocol
-        new_reader = max(cur.min_reader_version, int(pinned_reader) if pinned_reader else 1)
+        new_reader = max(cur.min_reader_version, required_reader,
+                         int(pinned_reader) if pinned_reader else 1)
         new_writer = max(cur.min_writer_version, required_writer if required_writer > 2 else cur.min_writer_version,
                          int(pinned_writer) if pinned_writer else 1)
+
+        def _features(versions):
+            # versions 3/7 REQUIRE the feature lists (table-features spec);
+            # preserve any features the table already declares
+            r, w = versions
+            names = set(feature_names)
+            names.update(cur.reader_features or ())
+            names.update(cur.writer_features or ())
+            rf = tuple(sorted(names)) if r >= 3 else None
+            wf = tuple(sorted(names)) if w >= 7 else None
+            return rf, wf
+
         if self.read_version == -1:
             # new table: start at spec default unless features demand more
             new_writer = max(2, required_writer, int(pinned_writer) if pinned_writer else 0)
-            new_reader = max(1, int(pinned_reader) if pinned_reader else 0)
-            return Protocol(new_reader, new_writer)
+            new_reader = max(1, required_reader, int(pinned_reader) if pinned_reader else 0)
+            rf, wf = _features((new_reader, new_writer))
+            return Protocol(new_reader, new_writer, rf, wf)
         if (new_reader, new_writer) != (cur.min_reader_version, cur.min_writer_version):
-            return Protocol(new_reader, new_writer)
+            rf, wf = _features((new_reader, new_writer))
+            return Protocol(new_reader, new_writer, rf, wf)
         return self.new_protocol
 
     # -- reads -----------------------------------------------------------

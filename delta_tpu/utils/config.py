@@ -244,6 +244,12 @@ class DeltaConfigs:
         "delta.appendOnly", "false", _bool,
         help="When true, deletes/updates are rejected (protocol writer v2 feature).",
     )
+    ENABLE_DELETION_VECTORS = DeltaConfig(
+        "delta.tpu.enableDeletionVectors", "false", _bool,
+        help="DML marks deleted rows in per-file deletion vectors instead of "
+             "rewriting whole files (beyond-reference feature; bumps the "
+             "table protocol to (3, 7)).",
+    )
     CHECKPOINT_WRITE_STATS_AS_JSON = DeltaConfig(
         "delta.checkpoint.writeStatsAsJson", "true", _bool,
     )

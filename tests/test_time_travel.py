@@ -1,0 +1,302 @@
+"""Time travel + history manager semantics.
+
+Ports the high-value slices of the reference's ``DeltaTimeTravelSuite``
+(726 LoC) and ``DeltaHistoryManagerSuite`` (163 LoC): version reads,
+timestamp→version resolution with monotonized commit timestamps, the
+out-of-range error contract, reproducibility after log cleanup, and the
+API-level time-travel options. Commit timestamps are file mtimes (as in the
+reference, which sets mtimes directly via ``ManualClock`` tests).
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from tests.conftest import commit_manually, init_metadata
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import AddFile, Protocol
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    TemporallyUnstableInputError,
+    TimestampEarlierThanCommitRetentionError,
+    VersionNotFoundError,
+)
+
+HOUR_MS = 3_600_000
+
+
+def add(path, size=1):
+    return AddFile(path, {}, size, 0, True)
+
+
+def set_commit_time(log, version, ts_ms):
+    """Pin a commit file's mtime (the reference's ManualClock trick)."""
+    p = f"{log.log_path}/{filenames.delta_file(version)}"
+    os.utime(p, (ts_ms / 1000, ts_ms / 1000))
+
+
+def bootstrap(tmp_table, n_commits=5, base_ts=10 * HOUR_MS):
+    """n commits, one AddFile each, timestamps one hour apart."""
+    log = DeltaLog.for_table(tmp_table)
+    commit_manually(log, 0, [Protocol(1, 2), init_metadata(), add("f-0")])
+    for v in range(1, n_commits):
+        commit_manually(log, v, [add(f"f-{v}")])
+    for v in range(n_commits):
+        set_commit_time(log, v, base_ts + v * HOUR_MS)
+    return log
+
+
+# -- version time travel -----------------------------------------------------
+
+
+def test_snapshot_at_each_version(tmp_table):
+    log = bootstrap(tmp_table)
+    for v in range(5):
+        snap = log.get_snapshot_at(v)
+        assert snap.version == v
+        assert len(snap.all_files) == v + 1
+
+
+def test_version_negative_rejected(tmp_table):
+    log = bootstrap(tmp_table)
+    with pytest.raises((VersionNotFoundError, DeltaAnalysisError)):
+        log.get_snapshot_at(-3)
+
+
+def test_version_beyond_latest_rejected(tmp_table):
+    log = bootstrap(tmp_table)
+    with pytest.raises((VersionNotFoundError, DeltaAnalysisError)):
+        log.get_snapshot_at(99)
+
+
+def test_version_travel_is_stable_under_new_commits(tmp_table):
+    log = bootstrap(tmp_table)
+    old = log.get_snapshot_at(2)
+    commit_manually(log, 5, [add("f-5")])
+    log.update()
+    assert len(old.all_files) == 3  # pinned snapshot unaffected
+    assert len(log.get_snapshot_at(2).all_files) == 3
+
+
+# -- timestamp → version resolution ------------------------------------------
+
+
+def test_timestamp_exactly_on_commit(tmp_table):
+    log = bootstrap(tmp_table)
+    c = log.history.get_active_commit_at_time(10 * HOUR_MS + 2 * HOUR_MS)
+    assert c.version == 2
+
+
+def test_timestamp_between_commits_resolves_to_earlier(tmp_table):
+    log = bootstrap(tmp_table)
+    c = log.history.get_active_commit_at_time(10 * HOUR_MS + 2 * HOUR_MS + 1)
+    assert c.version == 2
+    c = log.history.get_active_commit_at_time(10 * HOUR_MS + 3 * HOUR_MS - 1)
+    assert c.version == 2
+
+
+def test_timestamp_before_earliest_raises(tmp_table):
+    log = bootstrap(tmp_table)
+    with pytest.raises(TimestampEarlierThanCommitRetentionError):
+        log.history.get_active_commit_at_time(HOUR_MS)
+
+
+def test_timestamp_before_earliest_can_return_earliest(tmp_table):
+    log = bootstrap(tmp_table)
+    c = log.history.get_active_commit_at_time(
+        HOUR_MS, can_return_earliest_commit=True
+    )
+    assert c.version == 0
+
+
+def test_timestamp_after_latest_raises_unstable(tmp_table):
+    log = bootstrap(tmp_table)
+    with pytest.raises(TemporallyUnstableInputError):
+        log.history.get_active_commit_at_time(10 * HOUR_MS + 100 * HOUR_MS)
+
+
+def test_timestamp_after_latest_can_return_last(tmp_table):
+    log = bootstrap(tmp_table)
+    c = log.history.get_active_commit_at_time(
+        10 * HOUR_MS + 100 * HOUR_MS, can_return_last_commit=True
+    )
+    assert c.version == 4
+
+
+# -- timestamp monotonization ------------------------------------------------
+
+
+def test_regressing_mtimes_are_monotonized(tmp_table):
+    """File mtimes can regress (clock skew, copies); resolution must treat
+    the sequence as monotone: a later version never maps to an earlier
+    adjusted timestamp (``DeltaHistoryManager`` monotonization)."""
+    log = bootstrap(tmp_table)
+    # regress version 3's mtime to BEFORE version 2's
+    set_commit_time(log, 3, 10 * HOUR_MS + HOUR_MS // 2)
+    commits = log.history.get_commits(0, 4)
+    ts = [c.timestamp for c in commits]
+    assert ts == sorted(ts), "timestamps must be non-decreasing after adjustment"
+    assert [c.version for c in commits] == [0, 1, 2, 3, 4]
+    # v3's adjusted timestamp nudges just past v2's
+    assert commits[3].timestamp > commits[2].timestamp
+
+
+def test_resolution_with_regressed_mtime(tmp_table):
+    log = bootstrap(tmp_table)
+    set_commit_time(log, 3, 10 * HOUR_MS)  # same as v0
+    # a timestamp just after v2's commit still resolves to v2 (not v3,
+    # whose raw mtime regressed below it)
+    c = log.history.get_active_commit_at_time(10 * HOUR_MS + 2 * HOUR_MS + 60_000)
+    assert c.version in (2, 3)
+    commits = log.history.get_commits(0, 4)
+    assert [c.version for c in commits] == sorted(c.version for c in commits)
+
+
+# -- reproducibility after cleanup -------------------------------------------
+
+
+def checkpointed_log_with_cleaned_head(tmp_table):
+    """10 commits, checkpoint at 6, versions 0-3 deleted from the log.
+
+    Commit mtimes sit within LOG_RETENTION of now — the checkpoint's
+    automatic metadata cleanup must NOT delete them; the head deletion
+    below is the manual 'someone cleaned the log' scenario.
+    """
+    import time as _time
+
+    now = int(_time.time() * 1000)
+    log = bootstrap(tmp_table, n_commits=10, base_ts=now - 10 * HOUR_MS)
+    log.update()
+    log.checkpoint(log.get_snapshot_at(6))
+    assert os.path.exists(f"{log.log_path}/{filenames.delta_file(0)}"), (
+        "retention cleanup must not touch commits younger than LOG_RETENTION"
+    )
+    for v in range(0, 4):
+        os.remove(f"{log.log_path}/{filenames.delta_file(v)}")
+    DeltaLog.clear_cache()
+    return DeltaLog.for_table(tmp_table)
+
+
+def test_earliest_reproducible_commit_after_cleanup(tmp_table):
+    log = checkpointed_log_with_cleaned_head(tmp_table)
+    # versions 0-3 are gone; earliest rebuildable state is the checkpoint
+    assert log.history.get_earliest_reproducible_commit() == 6
+    assert log.history.get_earliest_delta_file() == 4
+
+
+def test_travel_to_cleaned_version_fails(tmp_table):
+    log = checkpointed_log_with_cleaned_head(tmp_table)
+    with pytest.raises((VersionNotFoundError, DeltaAnalysisError)):
+        log.history.check_version_exists(2)
+
+
+def test_travel_to_checkpoint_covered_version(tmp_table):
+    log = checkpointed_log_with_cleaned_head(tmp_table)
+    snap = log.get_snapshot_at(7)
+    assert snap.version == 7
+    assert len(snap.all_files) == 8
+
+
+def test_full_history_intact_log(tmp_table):
+    log = bootstrap(tmp_table, n_commits=3)
+    hist = log.history.get_history()
+    assert [h.version for h in hist] == [2, 1, 0]
+
+
+def test_history_limit(tmp_table):
+    log = bootstrap(tmp_table, n_commits=5)
+    hist = log.history.get_history(limit=2)
+    assert [h.version for h in hist] == [4, 3]
+
+
+def test_history_stops_at_cleaned_versions(tmp_table):
+    log = checkpointed_log_with_cleaned_head(tmp_table)
+    hist = log.history.get_history()
+    # newest-first, stops where the log was cleaned (v3 and below gone)
+    assert [h.version for h in hist] == [9, 8, 7, 6, 5, 4]
+
+
+# -- API-level time travel ---------------------------------------------------
+
+
+def api_table(tmp_table):
+    data = pa.table({"id": [1, 2], "value": ["a", "b"]})
+    t = DeltaTable.create(tmp_table, data=data)
+    t.delta_log.store.write  # touch
+    import pyarrow as _pa
+
+    for i in range(2):
+        from delta_tpu.commands.write import WriteIntoDelta
+
+        WriteIntoDelta(
+            t.delta_log, "append",
+            _pa.table({"id": [10 + i], "value": [f"v{i}"]}),
+        ).run()
+    return t
+
+
+def test_to_arrow_version_as_of(tmp_table):
+    t = api_table(tmp_table)
+    assert t.version == 2
+    assert t.to_arrow(version=0).num_rows == 2
+    assert t.to_arrow(version=1).num_rows == 3
+    assert t.to_arrow().num_rows == 4
+
+
+def test_to_arrow_timestamp_as_of(tmp_table):
+    t = api_table(tmp_table)
+    log = t.delta_log
+    for v in range(3):
+        set_commit_time(log, v, (10 + v) * HOUR_MS)
+    DeltaLog.clear_cache()
+    t = DeltaTable.for_path(tmp_table)
+    got = t.to_arrow(timestamp=11 * HOUR_MS + 1)
+    assert got.num_rows == 3  # version 1
+
+
+def test_to_arrow_timestamp_string_form(tmp_table):
+    t = api_table(tmp_table)
+    log = t.delta_log
+    import datetime as dt
+
+    base = dt.datetime(2024, 5, 1, tzinfo=dt.timezone.utc)
+    for v in range(3):
+        set_commit_time(log, v, int(base.timestamp() * 1000) + v * HOUR_MS)
+    DeltaLog.clear_cache()
+    t = DeltaTable.for_path(tmp_table)
+    got = t.to_arrow(timestamp="2024-05-01 01:30:00")
+    assert got.num_rows == 3
+
+
+def test_version_and_timestamp_both_rejected(tmp_table):
+    t = api_table(tmp_table)
+    with pytest.raises(DeltaAnalysisError):
+        t.to_arrow(version=1, timestamp=10 * HOUR_MS)
+
+
+def test_time_travel_sees_old_schema(tmp_table):
+    """Schema is part of the snapshot: travel before an ADD COLUMNS must
+    yield the old schema (reference: time travel reads the pinned
+    snapshot's metadata, not the latest)."""
+    t = api_table(tmp_table)
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.schema.types import LongType, StructField
+
+    add_columns(t.delta_log, [StructField("extra", LongType())])
+    old = t.to_arrow(version=2)
+    new = t.to_arrow()
+    assert "extra" not in old.column_names
+    assert "extra" in new.column_names
+
+
+def test_get_changes_tailing(tmp_table):
+    log = bootstrap(tmp_table, n_commits=4)
+    changes = list(log.get_changes(2))
+    assert [v for v, _ in changes] == [2, 3]
+    # each change carries that commit's actions
+    assert any(
+        getattr(a, "path", None) == "f-3" for _, acts in changes for a in acts
+    )
