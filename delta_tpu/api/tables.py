@@ -183,6 +183,19 @@ class DeltaTable:
     def history(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
         return describe_history(self.delta_log, limit)
 
+    def table_changes(self, starting_version: int,
+                      ending_version: Optional[int] = None) -> pa.Table:
+        """Change Data Feed between two versions (inclusive): rows with
+        ``_change_type`` / ``_commit_version`` / ``_commit_timestamp``.
+        Requires ``delta.enableChangeDataFeed=true`` for row-accurate
+        UPDATE/MERGE capture; append/delete-only commits reconstruct from
+        file actions either way."""
+        from delta_tpu.exec import cdf as cdf_exec
+
+        return cdf_exec.read_changes(
+            self.delta_log, starting_version, ending_version
+        )
+
     def detail(self) -> Dict[str, Any]:
         return describe_detail(self.delta_log)
 

@@ -23,6 +23,7 @@ from delta_tpu.commands.dml_common import (
     dv_mark_from_mask,
     read_candidates,
 )
+from delta_tpu.exec import cdf
 from delta_tpu.exec import write as write_exec
 from delta_tpu.expr import ir
 from delta_tpu.expr import partition as partition_expr
@@ -79,6 +80,7 @@ class DeleteCommand:
 
         # case 3: scan + rewrite (or DV-mark when deletion vectors are on)
         use_dv = dv_enabled(metadata)
+        use_cdf = cdf.cdf_enabled(metadata)
         candidates = candidate_files(txn, self.condition)
         touched = read_candidates(
             self.delta_log.data_path, candidates, metadata, self.condition,
@@ -88,12 +90,15 @@ class DeleteCommand:
 
         removes: List[Action] = []
         adds: List[Action] = []
+        cdf_blocks = []
         deleted_rows = 0
         for tf in touched:
             matches = pc.sum(tf.mask).as_py() or 0
             if not matches:
                 continue  # file untouched
             deleted_rows += matches
+            if use_cdf:
+                cdf_blocks.append(("delete", tf.table.filter(tf.mask)))
             if use_dv:
                 rm, re_add = dv_mark_from_mask(
                     self.delta_log.data_path, tf.add, tf.table, tf.mask
@@ -110,6 +115,13 @@ class DeleteCommand:
                         self.delta_log.data_path, survivors, metadata, data_change=True
                     )
                 )
+        cdc_actions: List[Action] = []
+        if cdf_blocks:
+            cdc_actions = list(
+                cdf.write_change_data(
+                    self.delta_log.data_path, cdf_blocks, metadata
+                )
+            )
         self.metrics.update(
             numRemovedFiles=len(removes),
             numAddedFiles=len(adds),
@@ -117,4 +129,4 @@ class DeleteCommand:
             scanTimeMs=scan_ms,
             rewriteTimeMs=timer.lap_ms(),
         )
-        return removes + adds
+        return removes + adds + cdc_actions

@@ -34,6 +34,7 @@ import pyarrow.compute as pc
 from delta_tpu.commands import operations as ops
 from delta_tpu.commands import dml_common as dv_common
 from delta_tpu.commands.dml_common import POSITION_COL, Timer, candidate_files
+from delta_tpu.exec import cdf as cdf_exec
 from delta_tpu.exec import write as write_exec
 from delta_tpu.exec.scan import read_files_as_table
 from delta_tpu.expr import ir
@@ -284,6 +285,8 @@ class MergeIntoCommand:
         # reset per-execution state: a re-run that takes the host or empty
         # path must not consume a previous run's device-join flags
         self._device_join = None
+        self._cdf_blocks = []
+        self._use_cdf = cdf_exec.cdf_enabled(txn.metadata)
         self.phase_ms.clear()
         timer = Timer()
         metadata = self._migrate_schema(txn)
@@ -393,9 +396,16 @@ class MergeIntoCommand:
         )
         if inserts is not None and inserts.num_rows:
             out_blocks.append(inserts)
+            if self._use_cdf:
+                self._cdf_blocks.append(("insert", inserts))
 
         self.phase_ms["apply_ms"] = timer.peek_ms()
         adds: List[Action] = list(dv_adds)
+        cdc_actions: List[Action] = []
+        if self._cdf_blocks:
+            cdc_actions = list(cdf_exec.write_change_data(
+                self.delta_log.data_path, self._cdf_blocks, metadata
+            ))
         if out_blocks:
             out = pa.concat_tables(out_blocks, promote_options="permissive")
             if out.column_names != target_cols:
@@ -433,7 +443,7 @@ class MergeIntoCommand:
             deletes=[_clause_info(c) for c in self.matched_clauses if c.kind == "delete"],
             inserts=[_clause_info(c) for c in self.not_matched_clauses],
         )
-        return txn.commit(removes + adds, op)
+        return txn.commit(removes + adds + cdc_actions, op)
 
     # -- join -------------------------------------------------------------
 
@@ -767,11 +777,28 @@ class MergeIntoCommand:
             if count:
                 block = pairs.filter(fire)
                 if clause.kind == "update":
-                    out_parts.append(
-                        self._project_update(block, clause, target_cols, metadata)
+                    projected = self._project_update(
+                        block, clause, target_cols, metadata
                     )
+                    out_parts.append(projected)
+                    if self._use_cdf:
+                        self._cdf_blocks.append(
+                            ("update_preimage", block.select(target_cols))
+                        )
+                        self._cdf_blocks.append(("update_postimage", projected))
                     n_updated += count
                 else:
+                    if self._use_cdf:
+                        # distinct target rows (a legal multi-match would
+                        # otherwise emit duplicate delete rows in the feed)
+                        import numpy as np
+
+                        tids = block.column(_TID).to_numpy(zero_copy_only=False)
+                        _, first = np.unique(tids, return_index=True)
+                        self._cdf_blocks.append((
+                            "delete",
+                            block.take(pa.array(np.sort(first))).select(target_cols),
+                        ))
                     # count distinct target ROWS, not pairs: a single
                     # unconditional DELETE may legally multi-match, and the
                     # reference's numTargetRowsDeleted is rows deleted

@@ -22,6 +22,7 @@ from delta_tpu.commands.dml_common import (
     dv_mark_from_mask,
     read_candidates,
 )
+from delta_tpu.exec import cdf
 from delta_tpu.exec import write as write_exec
 from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_expression, parse_predicate
@@ -65,6 +66,8 @@ class UpdateCommand:
 
         timer = Timer()
         use_dv = dv_enabled(metadata)
+        use_cdf = cdf.cdf_enabled(metadata)
+        cdf_blocks = []
         candidates = candidate_files(txn, self.condition)
         touched = read_candidates(
             self.delta_log.data_path, candidates, metadata, self.condition,
@@ -94,12 +97,29 @@ class UpdateCommand:
                     [pa.array([True] * matched.num_rows)]
                 )
                 rewritten = self._apply_updates(matched, all_true, metadata)
+                if use_cdf:
+                    cdf_blocks.append(("update_preimage", matched))
+                    cdf_blocks.append(("update_postimage", rewritten))
             else:
                 removes.append(tf.add.remove())
                 rewritten = self._apply_updates(tf.table, tf.mask, metadata)
+                if use_cdf:
+                    cdf_blocks.append(
+                        ("update_preimage", tf.table.filter(tf.mask))
+                    )
+                    cdf_blocks.append(
+                        ("update_postimage", rewritten.filter(tf.mask))
+                    )
             adds.extend(
                 write_exec.write_files(
                     self.delta_log.data_path, rewritten, metadata, data_change=True
+                )
+            )
+        cdc_actions: List[Action] = []
+        if cdf_blocks:
+            cdc_actions = list(
+                cdf.write_change_data(
+                    self.delta_log.data_path, cdf_blocks, metadata
                 )
             )
         self.metrics.update(
@@ -111,7 +131,7 @@ class UpdateCommand:
         )
         txn.report_metrics(**self.metrics)
         op = ops.Update(predicate=self.condition.sql() if self.condition else None)
-        return txn.commit(removes + adds, op)
+        return txn.commit(removes + adds + cdc_actions, op)
 
     def _apply_updates(self, table: pa.Table, mask, metadata) -> pa.Table:
         cols = []

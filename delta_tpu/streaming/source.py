@@ -22,11 +22,18 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
-from delta_tpu.protocol.actions import Action, AddFile, Metadata, Protocol, RemoveFile
+from delta_tpu.protocol.actions import (
+    Action,
+    AddCDCFile,
+    AddFile,
+    Metadata,
+    Protocol,
+    RemoveFile,
+)
 from delta_tpu.streaming.offset import DeltaSourceOffset
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalStateError
 
-__all__ = ["IndexedFile", "AdmissionLimits", "DeltaSource"]
+__all__ = ["IndexedFile", "AdmissionLimits", "DeltaSource", "DeltaCDFSource"]
 
 BASE_INDEX = -1  # offset index meaning "before any file of this version"
 # index marking "this version fully consumed" — used when transitioning from
@@ -137,11 +144,11 @@ class DeltaSource:
             )
         return out
 
-    def _verify_hygiene(self, version: int, actions: Sequence[Action]) -> None:
-        """`verifyStreamHygieneAndFilterAddFiles` (`DeltaSource.scala:312-355`)."""
-        seen_file_action = False
-        removes = []
-        adds_with_change = []
+    def _verify_schema_and_protocol(
+        self, version: int, actions: Sequence[Action]
+    ) -> None:
+        """Schema-change + protocol checks — apply to EVERY streaming source
+        (the CDF source waives the change/delete errors, never these)."""
         for a in actions:
             if isinstance(a, Metadata):
                 if a.schema_string != self._initial_schema:
@@ -151,7 +158,14 @@ class DeltaSource:
                     )
             elif isinstance(a, Protocol):
                 self.delta_log.assert_protocol_read(a)
-            elif isinstance(a, RemoveFile) and a.data_change:
+
+    def _verify_hygiene(self, version: int, actions: Sequence[Action]) -> None:
+        """`verifyStreamHygieneAndFilterAddFiles` (`DeltaSource.scala:312-355`)."""
+        self._verify_schema_and_protocol(version, actions)
+        removes = []
+        adds_with_change = []
+        for a in actions:
+            if isinstance(a, RemoveFile) and a.data_change:
                 removes.append(a)
             elif isinstance(a, AddFile) and a.data_change:
                 adds_with_change.append(a)
@@ -273,3 +287,107 @@ class DeltaSource:
         return read_files_as_table(
             self.delta_log.data_path, files, snap.metadata
         )
+
+
+class DeltaCDFSource(DeltaSource):
+    """Streaming source over the Change Data Feed.
+
+    Batches carry change rows (``_change_type`` / ``_commit_version`` /
+    ``_commit_timestamp``) instead of table rows — the streaming face of
+    ``exec/cdf.py``. The initial snapshot is served as ``insert`` rows at
+    the snapshot version; the tail is one unit per commit (``read_changes``
+    resolves each commit's CDC files or reconstructs from file actions).
+    Updates/deletes are the *point* of this source, so the base class's
+    hygiene errors (`ignoreChanges`/`ignoreDeletes`) do not apply.
+    """
+
+    def _verify_hygiene(self, version: int, actions: Sequence[Action]) -> None:
+        # changes are data here — but schema drift / protocol upgrades are
+        # still fatal, exactly as on the row source
+        self._verify_schema_and_protocol(version, actions)
+
+    def _changes_from(self, version: int, start_index: int) -> Iterator[IndexedFile]:
+        # one indexed unit per commit: index 0 carries the whole version.
+        # The synthetic AddFile sizes the unit for admission control
+        # (maxFilesPerTrigger = commits/trigger, maxBytesPerTrigger
+        # approximated by the commit's changed bytes).
+        for v, actions in self.delta_log.get_changes(
+            version, fail_on_data_loss=self.fail_on_data_loss
+        ):
+            self._verify_hygiene(v, actions)
+            if v == version and start_index >= 0:
+                continue  # already consumed
+            changed = sum(
+                (a.size or 0) for a in actions
+                if isinstance(a, (AddFile, AddCDCFile))
+            )
+            yield IndexedFile(
+                v, 0, AddFile(path=f"__commit-{v}__", size=changed),
+                is_last=True,
+            )
+
+    def get_batch(
+        self, start: Optional[DeltaSourceOffset], end: DeltaSourceOffset
+    ) -> pa.Table:
+        from delta_tpu.exec import cdf as cdf_exec
+        from delta_tpu.exec.scan import read_files_as_table
+
+        if start is None:
+            if end.is_starting_version:
+                start = DeltaSourceOffset(
+                    end.reservoir_version, BASE_INDEX, True, self.table_id
+                )
+            else:
+                sv = self._resolve_starting_version()
+                if sv is not None:
+                    start = DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
+                else:
+                    return self.get_batch(end, end)
+        snap = self.delta_log.update()
+        parts: List[pa.Table] = []
+        if start.is_starting_version:
+            files = [
+                f.add
+                for f in self._initial_snapshot_files(start.reservoir_version)
+                if f.index > start.index
+                and (f.version, f.index) <= (end.reservoir_version, end.index)
+                and f.add is not None
+            ]
+            if files:
+                t = read_files_as_table(self.delta_log.data_path, files, snap.metadata)
+                t = t.append_column(
+                    cdf_exec.CHANGE_TYPE_COL,
+                    pa.array(["insert"] * t.num_rows, pa.string()),
+                )
+                t = t.append_column(
+                    cdf_exec.COMMIT_VERSION_COL,
+                    pa.array([start.reservoir_version] * t.num_rows, pa.int64()),
+                )
+                sv = start.reservoir_version
+                snap_commits = self.delta_log.history.get_commits(sv, sv)
+                snap_ts = snap_commits[0].timestamp if snap_commits else 0
+                t = t.append_column(
+                    cdf_exec.COMMIT_TIMESTAMP_COL,
+                    pa.array([snap_ts] * t.num_rows, pa.int64()),
+                )
+                parts.append(t)
+        # tail versions fully contained in (start, end]
+        tail_first = (
+            start.reservoir_version + 1
+            if start.is_starting_version
+            else (start.reservoir_version if start.index < 0
+                  else start.reservoir_version + 1)
+        )
+        if not end.is_starting_version and end.reservoir_version >= tail_first:
+            parts.append(
+                cdf_exec.read_changes(
+                    self.delta_log, tail_first, end.reservoir_version
+                )
+            )
+        if not parts:
+            return pa.schema(
+                [pa.field(cdf_exec.CHANGE_TYPE_COL, pa.string()),
+                 pa.field(cdf_exec.COMMIT_VERSION_COL, pa.int64()),
+                 pa.field(cdf_exec.COMMIT_TIMESTAMP_COL, pa.int64())]
+            ).empty_table()
+        return pa.concat_tables(parts, promote_options="permissive")
