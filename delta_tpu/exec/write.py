@@ -165,6 +165,16 @@ def write_files(
 
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
+    # ambiguous (case-insensitively duplicated) batch columns would silently
+    # drop data during cast/resolution — reject at ANY nesting level, and
+    # before generated-column computation whose lookups would KeyError on
+    # them (`SchemaUtils.checkColumnNameDuplication`)
+    from delta_tpu.schema.arrow_interop import schema_from_arrow
+    from delta_tpu.schema.schema_utils import check_column_name_duplication
+
+    check_column_name_duplication(
+        schema_from_arrow(table.schema), "in the data to save"
+    )
     # generated columns: compute the missing, verify the provided — must see
     # the batch before normalize_data turns missing columns into nulls
     from delta_tpu.schema import generated as generated_mod

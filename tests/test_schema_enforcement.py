@@ -1,0 +1,311 @@
+"""Write-time schema enforcement matrix (≈ ``SchemaEnforcementSuite``, 897
+LoC in the reference): what a batch may look like relative to the table
+schema on append/overwrite, and exactly how it fails when it may not.
+"""
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    InvariantViolationError,
+    SchemaMismatchError,
+)
+
+
+def base_table(tmp_table, **create_kwargs):
+    data = pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "value": pa.array(["a", "b"]),
+    })
+    return DeltaTable.create(tmp_table, data=data, **create_kwargs)
+
+
+def append(t, data, **kw):
+    WriteIntoDelta(t.delta_log, "append", data, **kw).run()
+
+
+# -- column presence ----------------------------------------------------------
+
+
+def test_missing_column_null_filled(tmp_table):
+    t = base_table(tmp_table)
+    append(t, pa.table({"id": pa.array([3], pa.int64())}))
+    got = t.to_arrow(filters=["id = 3"])
+    assert got.column("value").to_pylist() == [None]
+
+
+def test_extra_column_rejected_with_name_in_error(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises(SchemaMismatchError, match="surprise"):
+        append(t, pa.table({
+            "id": pa.array([3], pa.int64()),
+            "surprise": pa.array([1.0]),
+        }))
+
+
+def test_extra_column_added_with_merge_schema(tmp_table):
+    t = base_table(tmp_table)
+    append(t, pa.table({
+        "id": pa.array([3], pa.int64()),
+        "surprise": pa.array([1.5]),
+    }), merge_schema=True)
+    got = t.to_arrow()
+    assert "surprise" in got.column_names
+    # old rows read null for the new column; schema order: new col appended
+    vals = dict(zip(got.column("id").to_pylist(), got.column("surprise").to_pylist()))
+    assert vals[1] is None and vals[3] == 1.5
+    assert t.schema().field_names[-1] == "surprise"
+
+
+def test_reordered_columns_normalized(tmp_table):
+    t = base_table(tmp_table)
+    append(t, pa.table({
+        "value": pa.array(["z"]),
+        "id": pa.array([9], pa.int64()),
+    }))
+    got = t.to_arrow(filters=["id = 9"])
+    assert got.column_names == ["id", "value"]
+    assert got.column("value").to_pylist() == ["z"]
+
+
+def test_empty_batch_still_schema_checked(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises(SchemaMismatchError):
+        append(t, pa.table({"nope": pa.array([], pa.int64())}))
+
+
+# -- case handling ------------------------------------------------------------
+
+
+def test_case_insensitive_column_match(tmp_table):
+    t = base_table(tmp_table)
+    append(t, pa.table({
+        "ID": pa.array([5], pa.int64()),
+        "VALUE": pa.array(["c"]),
+    }))
+    got = t.to_arrow(filters=["id = 5"])
+    # stored under the TABLE's canonical casing
+    assert got.column_names == ["id", "value"]
+    assert got.column("value").to_pylist() == ["c"]
+
+
+def test_case_differing_duplicates_rejected(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        append(t, pa.table([
+            pa.array([1], pa.int64()),
+            pa.array([2], pa.int64()),
+            pa.array(["x"]),
+        ], names=["id", "ID", "value"]))
+
+
+# -- type compatibility -------------------------------------------------------
+
+
+def test_narrower_int_upcast_on_write(tmp_table):
+    t = base_table(tmp_table)
+    append(t, pa.table({
+        "id": pa.array([7], pa.int32()),
+        "value": pa.array(["w"]),
+    }))
+    got = t.to_arrow(filters=["id = 7"])
+    assert got.column("id").type == pa.int64()
+
+
+def test_incompatible_type_rejected(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises(SchemaMismatchError, match="id"):
+        append(t, pa.table({
+            "id": pa.array(["not-a-number"]),
+            "value": pa.array(["x"]),
+        }))
+
+
+def test_float_to_long_lossy_rejected(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises(SchemaMismatchError):
+        append(t, pa.table({
+            "id": pa.array([1.5]),
+            "value": pa.array(["x"]),
+        }))
+
+
+def test_merge_schema_cannot_widen_existing_column(tmp_table):
+    """mergeSchema adds NEW columns; changing an existing column's type is
+    ALTER territory (`SchemaUtils.mergeSchemas` fails on int vs long)."""
+    data = pa.table({"id": pa.array([1], pa.int32())})
+    t = DeltaTable.create(tmp_table, data=data)
+    with pytest.raises(SchemaMismatchError, match="merge"):
+        append(t, pa.table({"id": pa.array([2**40], pa.int64())}),
+               merge_schema=True)
+
+
+def test_alter_widen_then_append_long(tmp_table):
+    from delta_tpu.commands.alter import change_column
+    from delta_tpu.schema.types import LongType
+
+    data = pa.table({"id": pa.array([1], pa.int32())})
+    t = DeltaTable.create(tmp_table, data=data)
+    change_column(t.delta_log, "id", new_type=LongType())
+    append(t, pa.table({"id": pa.array([2**40], pa.int64())}))
+    assert t.to_arrow().column("id").type == pa.int64()
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2**40]
+
+
+def test_merge_schema_conflicting_types_rejected(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        append(t, pa.table({
+            "id": pa.array([1], pa.int64()),
+            "value": pa.array([3.14]),  # string column fed doubles
+        }), merge_schema=True)
+
+
+# -- overwrite semantics ------------------------------------------------------
+
+
+def test_overwrite_keeps_schema_checks(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises(SchemaMismatchError):
+        WriteIntoDelta(
+            t.delta_log, "overwrite",
+            pa.table({"other": pa.array([1], pa.int64())}),
+        ).run()
+
+
+def test_overwrite_schema_replaces_schema(tmp_table):
+    t = base_table(tmp_table)
+    WriteIntoDelta(
+        t.delta_log, "overwrite",
+        pa.table({"other": pa.array([1], pa.int64())}),
+        overwrite_schema=True,
+    ).run()
+    assert t.schema().field_names == ["other"]
+    assert t.to_arrow().num_rows == 1
+
+
+def test_overwrite_schema_requires_overwrite_mode(tmp_table):
+    t = base_table(tmp_table)
+    with pytest.raises((DeltaAnalysisError, Exception)):
+        append(t, pa.table({"other": pa.array([1], pa.int64())}),
+               overwrite_schema=True)
+
+
+# -- nested structs -----------------------------------------------------------
+
+
+def nested_table(tmp_table):
+    data = pa.table({
+        "id": pa.array([1], pa.int64()),
+        "s": pa.array([{"x": 1, "y": "a"}],
+                      pa.struct([("x", pa.int64()), ("y", pa.string())])),
+    })
+    return DeltaTable.create(tmp_table, data=data)
+
+
+def test_nested_missing_inner_field_null_filled(tmp_table):
+    t = nested_table(tmp_table)
+    append(t, pa.table({
+        "id": pa.array([2], pa.int64()),
+        "s": pa.array([{"x": 5}], pa.struct([("x", pa.int64())])),
+    }))
+    got = t.to_arrow(filters=["id = 2"])
+    assert got.column("s").to_pylist() == [{"x": 5, "y": None}]
+
+
+def test_nested_extra_inner_field_rejected_without_merge(tmp_table):
+    t = nested_table(tmp_table)
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        append(t, pa.table({
+            "id": pa.array([2], pa.int64()),
+            "s": pa.array(
+                [{"x": 5, "y": "b", "z": 1.0}],
+                pa.struct([("x", pa.int64()), ("y", pa.string()),
+                           ("z", pa.float64())]),
+            ),
+        }))
+
+
+def test_nested_extra_inner_field_added_with_merge(tmp_table):
+    t = nested_table(tmp_table)
+    append(t, pa.table({
+        "id": pa.array([2], pa.int64()),
+        "s": pa.array(
+            [{"x": 5, "y": "b", "z": 1.0}],
+            pa.struct([("x", pa.int64()), ("y", pa.string()),
+                       ("z", pa.float64())]),
+        ),
+    }), merge_schema=True)
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got[0]["s"] == {"x": 1, "y": "a", "z": None}
+    assert got[1]["s"] == {"x": 5, "y": "b", "z": 1.0}
+
+
+# -- constraints interplay ----------------------------------------------------
+
+
+def test_not_null_constraint_on_missing_column(tmp_table):
+    from delta_tpu.schema.types import LongType, StringType, StructType
+
+    schema = (StructType()
+              .add("id", LongType(), nullable=False)
+              .add("value", StringType()))
+    t = DeltaTable.create(tmp_table, schema=schema)
+    with pytest.raises(InvariantViolationError):
+        append(t, pa.table({"value": pa.array(["x"])}))
+
+
+def test_not_null_constraint_with_nulls_in_batch(tmp_table):
+    from delta_tpu.schema.types import LongType, StringType, StructType
+
+    schema = (StructType()
+              .add("id", LongType(), nullable=False)
+              .add("value", StringType()))
+    t = DeltaTable.create(tmp_table, schema=schema)
+    with pytest.raises(InvariantViolationError, match="id"):
+        append(t, pa.table({
+            "id": pa.array([1, None], pa.int64()),
+            "value": pa.array(["x", "y"]),
+        }))
+
+
+def test_partition_column_cannot_be_dropped_by_batch(tmp_table):
+    data = pa.table({
+        "id": pa.array([1], pa.int64()),
+        "part": pa.array(["p1"]),
+    })
+    t = DeltaTable.create(tmp_table, data=data, partition_columns=["part"])
+    append(t, pa.table({"id": pa.array([2], pa.int64())}))
+    got = t.to_arrow(filters=["id = 2"])
+    assert got.column("part").to_pylist() == [None]  # null partition
+
+
+def test_nested_case_duplicates_rejected(tmp_table):
+    """Duplicate field names inside a struct are just as ambiguous as at
+    top level — must raise, not silently drop one."""
+    t = nested_table(tmp_table)
+    dup_struct = pa.struct([("x", pa.int64()), ("X", pa.int64()),
+                            ("y", pa.string())])
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        append(t, pa.table({
+            "id": pa.array([2], pa.int64()),
+            "s": pa.array([{"x": 10, "X": 20, "y": "b"}], dup_struct),
+        }))
+
+
+def test_duplicates_with_generated_columns_clean_error(tmp_table):
+    """The duplicate check must fire BEFORE generated-column computation
+    (whose lookups KeyError on duplicate names)."""
+    from delta_tpu.schema.types import LongType, StructType
+
+    schema = StructType().add("id", LongType()).add(
+        "twice", LongType(),
+        metadata={"delta.generationExpression": "id * 2"},
+    )
+    t = DeltaTable.create(tmp_table, schema=schema)
+    with pytest.raises((SchemaMismatchError, DeltaAnalysisError)):
+        append(t, pa.table([
+            pa.array([1], pa.int64()), pa.array([2], pa.int64()),
+        ], names=["id", "ID"]))
