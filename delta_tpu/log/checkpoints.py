@@ -278,6 +278,128 @@ def _action_to_row(a: Action) -> Dict[str, Any]:
     raise ValueError(f"Action not checkpointable: {a!r}")
 
 
+def _v2_schema_and_rows(actions: Sequence[Action]):
+    """CheckpointV2 columns (``Checkpoints.scala:340-389``): typed
+    ``add.partitionValues_parsed`` and ``add.stats_parsed`` structs, built
+    from the state's own Metadata action. Returns (extra add fields,
+    row-builder) or (None, None) when the table opts out
+    (``delta.checkpoint.writeStatsAsStruct``, default false)."""
+    import pyarrow as pa
+
+    from delta_tpu.expr.partition import typed_partition_row
+    from delta_tpu.expr.vectorized import arrow_type_for
+    from delta_tpu.utils.config import DeltaConfigs
+
+    meta = next((a for a in actions if isinstance(a, Metadata)), None)
+    if meta is None or not DeltaConfigs.CHECKPOINT_WRITE_STATS_AS_STRUCT.from_metadata(meta):
+        return None, None
+    schema = meta.schema
+    pcols = list(meta.partition_columns)
+    part_schema = meta.partition_schema
+    data_fields = [f for f in schema.fields if f.name not in pcols]
+
+    extra_fields = []
+    if pcols:
+        extra_fields.append(pa.field(
+            "partitionValues_parsed",
+            pa.struct([
+                pa.field(c, arrow_type_for(part_schema[c].data_type))
+                for c in pcols
+            ]),
+        ))
+    from delta_tpu.schema.types import (
+        DateType,
+        DecimalType,
+        StructType,
+        TimestampType,
+    )
+
+    def _null_count_type(dt):
+        # protocol: nullCount nests per struct field (int64 at the leaves)
+        if isinstance(dt, StructType):
+            return pa.struct(
+                [pa.field(f.name, _null_count_type(f.data_type)) for f in dt.fields]
+            )
+        return pa.int64()
+
+    def _coerce_stat(v, dt):
+        """Stats JSON carries dates/timestamps as ISO strings and nests per
+        struct field — convert to the typed Arrow representation."""
+        if v is None:
+            return None
+        if isinstance(dt, StructType):
+            if not isinstance(v, dict):
+                return None
+            return {f.name: _coerce_stat(v.get(f.name), f.data_type)
+                    for f in dt.fields}
+        if isinstance(dt, DateType):
+            import datetime as _dt
+
+            return _dt.date.fromisoformat(str(v))
+        if isinstance(dt, TimestampType):
+            import datetime as _dt
+
+            sv = str(v).replace("Z", "+00:00").replace(" ", "T")
+            out = _dt.datetime.fromisoformat(sv)
+            return out.replace(tzinfo=None) if out.tzinfo else out
+        if isinstance(dt, DecimalType):
+            from decimal import Decimal
+
+            return Decimal(str(v))
+        return v
+
+    val_struct = pa.struct(
+        [pa.field(f.name, arrow_type_for(f.data_type)) for f in data_fields]
+    )
+    null_struct = pa.struct(
+        [pa.field(f.name, _null_count_type(f.data_type)) for f in data_fields]
+    )
+    extra_fields.append(pa.field(
+        "stats_parsed",
+        pa.struct([
+            pa.field("numRecords", pa.int64()),
+            pa.field("minValues", val_struct),
+            pa.field("maxValues", val_struct),
+            pa.field("nullCount", null_struct),
+        ]),
+    ))
+
+    def _null_count_value(v, dt):
+        if isinstance(dt, StructType):
+            v = v if isinstance(v, dict) else {}
+            return {f.name: _null_count_value(v.get(f.name), f.data_type)
+                    for f in dt.fields}
+        return int(v) if isinstance(v, (int, float)) else None
+
+    def build(add: AddFile) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if pcols:
+            out["partitionValues_parsed"] = typed_partition_row(add, part_schema)
+        s = add.stats_dict() or {}
+        out["stats_parsed"] = {
+            "numRecords": s.get("numRecords"),
+            "minValues": {
+                f.name: _coerce_stat((s.get("minValues") or {}).get(f.name),
+                                     f.data_type)
+                for f in data_fields
+            },
+            "maxValues": {
+                f.name: _coerce_stat((s.get("maxValues") or {}).get(f.name),
+                                     f.data_type)
+                for f in data_fields
+            },
+            "nullCount": {
+                f.name: _null_count_value(
+                    (s.get("nullCount") or {}).get(f.name), f.data_type
+                )
+                for f in data_fields
+            },
+        }
+        return out
+
+    return extra_fields, build
+
+
 def write_checkpoint(
     store: LogStore,
     log_path: str,
@@ -294,7 +416,9 @@ def write_checkpoint(
     reference's multi-part support is read-only in this version — its writer
     is a single-task ``repartition(1)``; we go wider). Files are staged and
     atomically renamed when the store shows partial writes
-    (``Checkpoints.scala:271-303``)."""
+    (``Checkpoints.scala:271-303``). Tables with
+    ``delta.checkpoint.writeStatsAsStruct=true`` additionally get the V2
+    ``partitionValues_parsed``/``stats_parsed`` typed columns."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -303,9 +427,19 @@ def write_checkpoint(
         parts = 1 if n <= part_size else math.ceil(n / part_size)
 
     schema = _arrow_checkpoint_schema()
+    v2_fields, v2_build = _v2_schema_and_rows(actions)
+    if v2_fields:
+        add_idx = schema.get_field_index("add")
+        add_type = schema.field(add_idx).type
+        new_add = pa.struct(list(add_type) + v2_fields)
+        schema = schema.set(add_idx, pa.field("add", new_add))
 
     def _write_one(path: str, acts: Sequence[Action]) -> None:
         rows = [_action_to_row(a) for a in acts]
+        if v2_build is not None:
+            for a, r in zip(acts, rows):
+                if isinstance(a, AddFile):
+                    r["add"].update(v2_build(a))
         cols = {}
         for field_ in schema:
             cols[field_.name] = [r.get(field_.name) for r in rows]

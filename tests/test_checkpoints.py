@@ -84,3 +84,96 @@ def test_find_last_complete_checkpoint_before():
     found = ck.find_last_complete_checkpoint_before(store, LOG, 25)
     assert found == CheckpointInstance(20, 2)
     assert ck.find_last_complete_checkpoint_before(store, LOG, 10) is None
+
+
+def test_v2_checkpoint_struct_columns(tmp_path):
+    """`delta.checkpoint.writeStatsAsStruct=true` adds the CheckpointV2
+    typed columns (`Checkpoints.scala:340-389`): partitionValues_parsed and
+    stats_parsed; the checkpoint stays readable by the normal path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.log.deltalog import DeltaLog
+    from delta_tpu.protocol import filenames
+
+    path = str(tmp_path / "t")
+    data = pa.table({
+        "part": pa.array(["a", "a", "b"]),
+        "x": pa.array([1, 2, 30], pa.int64()),
+    })
+    t = DeltaTable.create(
+        path, data=data, partition_columns=["part"],
+        configuration={"delta.checkpoint.writeStatsAsStruct": "true"},
+    )
+    md = t.delta_log.checkpoint()
+    ckpt = f"{t.delta_log.log_path}/{filenames.checkpoint_file_single(md.version)}"
+    table = pq.read_table(ckpt)
+    add_type = table.schema.field("add").type
+    names = [add_type.field(i).name for i in range(add_type.num_fields)]
+    assert "partitionValues_parsed" in names
+    assert "stats_parsed" in names
+    adds = [r for r in table.column("add").to_pylist() if r is not None]
+    by_part = {r["partitionValues_parsed"]["part"]: r for r in adds}
+    assert by_part["b"]["stats_parsed"]["minValues"]["x"] == 30
+    assert by_part["a"]["stats_parsed"]["numRecords"] == 2
+    assert by_part["a"]["stats_parsed"]["nullCount"]["x"] == 0
+
+    # normal read path unaffected
+    DeltaLog.clear_cache()
+    t2 = DeltaTable.for_path(path)
+    assert t2.to_arrow().num_rows == 3
+    assert t2.to_arrow(filters=["part = 'b'"]).column("x").to_pylist() == [30]
+
+
+def test_default_checkpoint_has_no_v2_columns(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.protocol import filenames
+
+    path = str(tmp_path / "t")
+    t = DeltaTable.create(
+        path, data=pa.table({"x": pa.array([1], pa.int64())})
+    )
+    md = t.delta_log.checkpoint()
+    ckpt = f"{t.delta_log.log_path}/{filenames.checkpoint_file_single(md.version)}"
+    add_type = pq.read_table(ckpt).schema.field("add").type
+    names = [add_type.field(i).name for i in range(add_type.num_fields)]
+    assert "stats_parsed" not in names and "partitionValues_parsed" not in names
+
+
+def test_v2_checkpoint_typed_and_nested_stats(tmp_path):
+    """Date/timestamp stats arrive as ISO strings in the stats JSON and
+    struct columns nest their nullCount — the V2 writer must coerce both
+    instead of crashing the checkpoint."""
+    import datetime
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.protocol import filenames
+
+    path = str(tmp_path / "t")
+    data = pa.table({
+        "d": pa.array([datetime.date(2024, 1, 2), datetime.date(2024, 3, 4)]),
+        "ts": pa.array([datetime.datetime(2024, 1, 2, 3, 4, 5),
+                        datetime.datetime(2024, 6, 7, 8, 9, 10)],
+                       pa.timestamp("us")),
+        "s": pa.array([{"a": 1, "b": None}, {"a": 2, "b": "x"}],
+                      pa.struct([("a", pa.int64()), ("b", pa.string())])),
+    })
+    t = DeltaTable.create(
+        path, data=data,
+        configuration={"delta.checkpoint.writeStatsAsStruct": "true"},
+    )
+    md = t.delta_log.checkpoint()  # must not raise
+    ckpt = f"{t.delta_log.log_path}/{filenames.checkpoint_file_single(md.version)}"
+    [add] = [r for r in pq.read_table(ckpt).column("add").to_pylist() if r]
+    sp = add["stats_parsed"]
+    assert sp["minValues"]["d"] == datetime.date(2024, 1, 2)
+    assert sp["maxValues"]["ts"].year == 2024
+    if sp["nullCount"]["s"] is not None:
+        assert isinstance(sp["nullCount"]["s"], dict)

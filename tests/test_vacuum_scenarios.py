@@ -1,0 +1,208 @@
+"""VACUUM scenario matrix under a controlled clock (≈ ``DeltaVacuumSuite``,
+611 LoC, which drives ManualClock + a CheckFiles scenario DSL). The engine's
+clock is injectable per DeltaLog; file mtimes are pinned with os.utime.
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.vacuum import VacuumCommand
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.utils.errors import DeltaIllegalArgumentError
+
+HOUR = 3_600_000
+WEEK = 7 * 24 * HOUR
+
+
+class ManualClock:
+    """Starts at REAL now: action timestamps (RemoveFile.deletion_timestamp,
+    file mtimes) are wall-clock, so a manual clock must begin aligned with
+    them and only ever advance."""
+
+    def __init__(self, now_ms=None):
+        import time
+
+        self.now = int(time.time() * 1000) if now_ms is None else now_ms
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, ms):
+        self.now += ms
+
+
+def make(tmp_table, clock, partitioned=False):
+    data = pa.table({
+        "part": pa.array(["a", "a", "b"]),
+        "x": pa.array([1, 2, 3], pa.int64()),
+    })
+    DeltaLog.clear_cache()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    t = DeltaTable.create(
+        tmp_table, data=data,
+        partition_columns=["part"] if partitioned else (),
+    )
+    assert t.delta_log is log
+    return t
+
+
+def data_file_paths(t):
+    import urllib.parse
+
+    return [urllib.parse.unquote(f.path) for f in t.delta_log.update().all_files]
+
+
+def pin_mtime(root, rel, ts_ms):
+    os.utime(os.path.join(root, rel), (ts_ms / 1000, ts_ms / 1000))
+
+
+def test_live_files_never_deleted(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    before = set(data_file_paths(t))
+    clock.advance(52 * WEEK)
+    r = t.vacuum()
+    assert r.files_deleted == 0
+    assert set(data_file_paths(t)) == before
+
+
+def test_removed_file_kept_within_retention_deleted_after(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    [old] = data_file_paths(t)
+    t.delete()  # tombstones the file at clock.now
+    # within the default 1-week tombstone retention: kept
+    clock.advance(2 * HOUR)
+    assert t.vacuum().files_deleted == 0
+    assert os.path.exists(os.path.join(tmp_table, old))
+    # beyond retention: deleted (mtime is real wall time, well before the
+    # advanced clock's cutoff)
+    clock.advance(2 * WEEK)
+    r = t.vacuum()
+    assert r.files_deleted == 1
+    assert not os.path.exists(os.path.join(tmp_table, old))
+
+
+def test_dry_run_reports_without_deleting(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    [old] = data_file_paths(t)
+    t.delete()
+    clock.advance(2 * WEEK)
+    r = t.vacuum(dry_run=True)
+    assert r.files_deleted == 1 and r.deleted_paths == [old]
+    assert os.path.exists(os.path.join(tmp_table, old))
+
+
+def test_untracked_junk_deleted_after_retention(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    junk = os.path.join(tmp_table, "junk.parquet")
+    with open(junk, "wb") as f:
+        f.write(b"zz")
+    # fresh junk (uncommitted in-flight write): kept
+    assert t.vacuum().files_deleted == 0
+    clock.advance(2 * WEEK)
+    r = t.vacuum()
+    assert r.files_deleted == 1
+    assert not os.path.exists(junk)
+
+
+def test_hidden_dirs_untouched(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    hidden = os.path.join(tmp_table, "_internal", "x.bin")
+    os.makedirs(os.path.dirname(hidden))
+    with open(hidden, "wb") as f:
+        f.write(b"zz")
+    pin_mtime(tmp_table, "_internal/x.bin", 0)
+    clock.advance(2 * WEEK)
+    t.vacuum()
+    assert os.path.exists(hidden), "underscore-dirs are invisible to vacuum"
+    assert os.path.exists(os.path.join(tmp_table, "_delta_log"))
+
+
+def test_empty_partition_dirs_removed(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock, partitioned=True)
+    t.delete("part = 'a'")
+    clock.advance(2 * WEEK)
+    r = t.vacuum()
+    assert r.files_deleted == 1
+    assert r.dirs_deleted >= 1
+    assert not os.path.exists(os.path.join(tmp_table, "part=a"))
+    assert os.path.exists(os.path.join(tmp_table, "part=b"))
+
+
+def test_retention_shorter_than_tombstone_retention_rejected(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    with pytest.raises(DeltaIllegalArgumentError):
+        t.vacuum(retention_hours=1)
+    # explicit opt-out works (the reference's retentionDurationCheck)
+    t.vacuum(retention_hours=1, retention_check_enabled=False)
+
+
+def test_custom_tombstone_retention_property(tmp_table):
+    clock = ManualClock()
+    DeltaLog.clear_cache()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    t = DeltaTable.create(
+        tmp_table,
+        data=pa.table({"x": pa.array([1], pa.int64())}),
+        configuration={"delta.deletedFileRetentionDuration": "interval 1 hour"},
+    )
+    [old] = data_file_paths(t)
+    t.delete()
+    clock.advance(2 * HOUR)  # past the 1-hour property, within default week
+    r = t.vacuum()
+    assert r.files_deleted == 1
+    assert not os.path.exists(os.path.join(tmp_table, old))
+
+
+def test_vacuum_breaks_time_travel_to_removed_files(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    v0 = t.version
+    t.delete()
+    clock.advance(2 * WEEK)
+    t.vacuum()
+    with pytest.raises(FileNotFoundError):
+        t.to_arrow(version=v0)
+
+
+def test_vacuum_metrics_and_result_shape(tmp_table):
+    clock = ManualClock()
+    t = make(tmp_table, clock)
+    r = t.vacuum(dry_run=True)
+    assert r.path == tmp_table
+    assert r.retention_ms == WEEK
+    assert r.dry_run is True
+
+
+def test_expired_dv_sidecar_deleted_with_its_file(tmp_table, monkeypatch):
+    from delta_tpu.protocol import deletion_vectors as dv_mod
+
+    monkeypatch.setattr(dv_mod, "INLINE_THRESHOLD_BYTES", 0)
+    clock = ManualClock()
+    DeltaLog.clear_cache()
+    log = DeltaLog.for_table(tmp_table, clock=clock)
+    t = DeltaTable.create(
+        tmp_table,
+        data=pa.table({"x": pa.array(range(100), pa.int64())}),
+        configuration={"delta.tpu.enableDeletionVectors": "true"},
+    )
+    t.delete("x % 2 = 0")  # DV sidecar
+    side = [f for f in os.listdir(tmp_table) if f.startswith("deletion_vector_")]
+    assert len(side) == 1
+    # live DV: protected even past retention
+    clock.advance(2 * WEEK)
+    t.vacuum()
+    assert os.path.exists(os.path.join(tmp_table, side[0]))
+    # whole-file delete tombstones the add (and its DV); after retention both go
+    t.delete()
+    clock.advance(2 * WEEK)
+    r = t.vacuum()
+    assert not os.path.exists(os.path.join(tmp_table, side[0]))
