@@ -124,16 +124,10 @@ class DeltaTable:
         if version is not None:
             return self.delta_log.get_snapshot_at(version)
         if timestamp is not None:
-            ts = timestamp
-            if isinstance(ts, str):
-                import datetime as _dt
+            from delta_tpu.utils.timeparse import timestamp_option_to_ms
 
-                ts = int(
-                    _dt.datetime.fromisoformat(ts.replace(" ", "T"))
-                    .replace(tzinfo=_dt.timezone.utc).timestamp() * 1000
-                )
             commit = self.delta_log.history.get_active_commit_at_time(
-                ts, can_return_last_commit=True
+                timestamp_option_to_ms(timestamp), can_return_last_commit=True
             )
             return self.delta_log.get_snapshot_at(commit.version)
         return self.delta_log.update()
@@ -198,6 +192,22 @@ class DeltaTable:
 
     def detail(self) -> Dict[str, Any]:
         return describe_detail(self.delta_log)
+
+    def restore_to_version(self, version: int) -> Dict[str, int]:
+        """Roll the table back to ``version`` as a NEW commit (history is
+        preserved). Beyond the reference — modern Delta's RESTORE TABLE."""
+        from delta_tpu.commands.restore import RestoreCommand
+
+        cmd = RestoreCommand(self.delta_log, version=version)
+        cmd.run()
+        return cmd.metrics
+
+    def restore_to_timestamp(self, timestamp: Union[str, int]) -> Dict[str, int]:
+        from delta_tpu.commands.restore import RestoreCommand
+
+        cmd = RestoreCommand(self.delta_log, timestamp=timestamp)
+        cmd.run()
+        return cmd.metrics
 
     def generate(self, mode: str = "symlink_format_manifest") -> None:
         if mode != "symlink_format_manifest":

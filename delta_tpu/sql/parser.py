@@ -327,6 +327,8 @@ def parse_statement(sql: str):
         return _create(p)
     if head == "ALTER":
         return _alter(p)
+    if head == "RESTORE":
+        return _restore(p)
     raise DeltaAnalysisError(f"Unsupported SQL statement: {sql.strip()[:80]!r}")
 
 
@@ -355,6 +357,33 @@ def _vacuum(p: _Parser):
         from delta_tpu.commands.vacuum import VacuumCommand
 
         return VacuumCommand(_log_for(path), hours, dry_run=dry).run()
+
+    return run
+
+
+def _restore(p: _Parser):
+    """``RESTORE TABLE t TO VERSION AS OF n`` /
+    ``RESTORE TABLE t TO TIMESTAMP AS OF 'ts'`` (beyond the reference
+    grammar; modern Delta's restore statement)."""
+    p.expect_word("RESTORE")
+    p.accept_word("TABLE")
+    path = p.table_path()
+    p.expect_word("TO")
+    which = p.expect_word("VERSION", "TIMESTAMP").value.upper()
+    p.expect_word("AS")
+    p.expect_word("OF")
+    if which == "VERSION":
+        version, timestamp = p.number(as_int=True), None
+    else:
+        version, timestamp = None, p.string_or_number()
+    p.expect_end()
+
+    def run():
+        from delta_tpu.commands.restore import RestoreCommand
+
+        cmd = RestoreCommand(_log_for(path), version=version, timestamp=timestamp)
+        cmd.run()
+        return cmd.metrics
 
     return run
 

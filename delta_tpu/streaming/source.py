@@ -110,17 +110,11 @@ class DeltaSource:
                 return self.delta_log.update().version + 1
             return int(self.starting_version)
         if self.starting_timestamp is not None:
-            ts = self.starting_timestamp
-            if isinstance(ts, str):
-                import datetime as _dt
+            from delta_tpu.utils.timeparse import timestamp_option_to_ms
 
-                ts = int(
-                    _dt.datetime.fromisoformat(ts.replace(" ", "T"))
-                    .replace(tzinfo=_dt.timezone.utc)
-                    .timestamp() * 1000
-                )
             return self.delta_log.history.get_active_commit_at_time(
-                ts, can_return_last_commit=True, can_return_earliest_commit=True
+                timestamp_option_to_ms(self.starting_timestamp),
+                can_return_last_commit=True, can_return_earliest_commit=True,
             ).version
         return None
 

@@ -1,0 +1,31 @@
+"""User-facing timestamp-option parsing, shared by every surface that takes
+a point in time (time-travel reads, streaming ``startingTimestamp``,
+RESTORE ... TO TIMESTAMP AS OF): epoch milliseconds (int/float/numeric
+string) or ISO-8601 ('2024-05-01 12:00:00', naive = UTC)."""
+from __future__ import annotations
+
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+__all__ = ["timestamp_option_to_ms"]
+
+
+def timestamp_option_to_ms(ts) -> int:
+    if isinstance(ts, bool):
+        raise DeltaAnalysisError(f"Invalid timestamp {ts!r}")
+    if isinstance(ts, (int, float)):
+        return int(ts)
+    s = str(ts).strip()
+    if s.lstrip("-").isdigit():
+        return int(s)
+    import datetime as _dt
+
+    try:
+        out = _dt.datetime.fromisoformat(s.replace(" ", "T"))
+    except ValueError as e:
+        raise DeltaAnalysisError(
+            f"Invalid timestamp {ts!r}: expected epoch milliseconds or "
+            f"ISO-8601 (e.g. '2024-05-01 12:00:00'): {e}"
+        )
+    if out.tzinfo is None:
+        out = out.replace(tzinfo=_dt.timezone.utc)
+    return int(out.timestamp() * 1000)

@@ -1,0 +1,190 @@
+"""RESTORE TABLE semantics (beyond-reference; modern Delta's RESTORE):
+state rollback as a new commit, schema restore, DV awareness, VACUUM
+interaction, and timestamp form.
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    DeltaIllegalStateError,
+    VersionNotFoundError,
+)
+
+
+def make(tmp_table, **kw):
+    return DeltaTable.create(
+        tmp_table,
+        data=pa.table({"id": pa.array([1, 2], pa.int64()),
+                       "v": pa.array(["a", "b"])}),
+        **kw,
+    )
+
+
+def append(t, ids):
+    WriteIntoDelta(t.delta_log, "append", pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "v": pa.array([f"x{i}" for i in ids]),
+    })).run()
+
+
+def test_restore_undoes_appends(tmp_table):
+    t = make(tmp_table)
+    append(t, [10])
+    append(t, [20])
+    assert t.to_arrow().num_rows == 4
+    m = t.restore_to_version(0)
+    assert m["numRemovedFiles"] == 2 and m["numRestoredFiles"] == 0
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2]
+    # restore is a commit, not history rewrite
+    assert t.version == 3
+    assert t.history()[0]["operation"] == "RESTORE"
+
+
+def test_restore_undoes_delete(tmp_table):
+    t = make(tmp_table)
+    t.delete("id = 1")
+    assert t.to_arrow().num_rows == 1
+    m = t.restore_to_version(0)
+    assert m["numRestoredFiles"] == 1
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2]
+
+
+def test_restore_forward_again(tmp_table):
+    """Restore can itself be undone by restoring to the pre-restore version."""
+    t = make(tmp_table)
+    append(t, [10])          # v1
+    t.restore_to_version(0)  # v2
+    t.restore_to_version(1)  # v3
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2, 10]
+
+
+def test_restore_restores_schema(tmp_table):
+    from delta_tpu.commands.alter import add_columns
+    from delta_tpu.schema.types import LongType, StructField
+
+    t = make(tmp_table)
+    add_columns(t.delta_log, [StructField("extra", LongType())])
+    assert "extra" in t.schema().field_names
+    t.restore_to_version(0)
+    assert "extra" not in t.schema().field_names
+
+
+def test_restore_dv_state(tmp_table):
+    t = make(tmp_table, configuration={"delta.tpu.enableDeletionVectors": "true"})
+    t.delete("id = 1")  # v1: DV on the file
+    assert t.to_arrow().num_rows == 1
+    t.restore_to_version(0)
+    assert t.to_arrow().num_rows == 2, "restore must drop the DV'd entry"
+    t.restore_to_version(1)
+    assert t.to_arrow().num_rows == 1, "restore forward re-applies the DV"
+
+
+def test_restore_to_missing_version_rejected(tmp_table):
+    t = make(tmp_table)
+    with pytest.raises((VersionNotFoundError, DeltaAnalysisError)):
+        t.restore_to_version(99)
+
+
+def test_restore_requires_exactly_one_selector(tmp_table):
+    t = make(tmp_table)
+    from delta_tpu.commands.restore import RestoreCommand
+
+    with pytest.raises(DeltaAnalysisError):
+        RestoreCommand(t.delta_log)
+    with pytest.raises(DeltaAnalysisError):
+        RestoreCommand(t.delta_log, version=0, timestamp="2024-01-01")
+
+
+def test_restore_past_vacuum_fails_cleanly(tmp_table):
+    clock_now = [None]
+    import time as _time
+
+    clock_now[0] = int(_time.time() * 1000)
+    DeltaLog.clear_cache()
+    log = DeltaLog.for_table(tmp_table, clock=lambda: clock_now[0])
+    t = make(tmp_table)
+    t.delete()  # v1 removes the file
+    clock_now[0] += 14 * 24 * 3_600_000
+    t.vacuum()  # physically deletes it
+    with pytest.raises(DeltaIllegalStateError, match="no longer exists"):
+        t.restore_to_version(0)
+    # and the failed restore committed nothing
+    assert t.version == 1
+
+
+def test_restore_by_timestamp(tmp_table):
+    from delta_tpu.protocol import filenames
+
+    t = make(tmp_table)
+    append(t, [10])
+    HOUR = 3_600_000
+    base = 1_700_000_000_000
+    for v in (0, 1):
+        p = f"{t.delta_log.log_path}/{filenames.delta_file(v)}"
+        os.utime(p, ((base + v * HOUR) / 1000,) * 2)
+    DeltaLog.clear_cache()
+    t = DeltaTable.for_path(tmp_table)
+    t.restore_to_timestamp(base + HOUR // 2)  # between v0 and v1 -> v0
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 2]
+
+
+def test_restore_noop_when_already_there(tmp_table):
+    t = make(tmp_table)
+    m = t.restore_to_version(0)
+    assert m["numRestoredFiles"] == 0 and m["numRemovedFiles"] == 0
+    assert t.to_arrow().num_rows == 2
+
+
+def test_restore_sql_statement(tmp_table):
+    from delta_tpu.sql.parser import execute_sql
+
+    t = make(tmp_table)
+    append(t, [10])
+    DeltaLog.clear_cache()
+    m = execute_sql(f"RESTORE TABLE delta.`{tmp_table}` TO VERSION AS OF 0")
+    assert m["numRemovedFiles"] == 1
+    assert sorted(DeltaTable.for_path(tmp_table).to_arrow()
+                  .column("id").to_pylist()) == [1, 2]
+
+
+def test_restore_sql_bad_forms(tmp_table):
+    from delta_tpu.sql.parser import parse_statement
+    from delta_tpu.utils.errors import DeltaParseError
+
+    make(tmp_table)
+    with pytest.raises(DeltaParseError):
+        parse_statement(f"RESTORE TABLE delta.`{tmp_table}` TO VERSION 0")
+    with pytest.raises(DeltaParseError):
+        parse_statement(f"RESTORE TABLE delta.`{tmp_table}` VERSION AS OF 0")
+
+
+def test_restore_sql_epoch_millis_timestamp(tmp_table):
+    from delta_tpu.protocol import filenames
+    from delta_tpu.sql.parser import execute_sql
+
+    t = make(tmp_table)
+    append(t, [10])
+    base = 1_700_000_000_000
+    for v in (0, 1):
+        p = f"{t.delta_log.log_path}/{filenames.delta_file(v)}"
+        os.utime(p, ((base + v * 3_600_000) / 1000,) * 2)
+    DeltaLog.clear_cache()
+    execute_sql(
+        f"RESTORE TABLE delta.`{tmp_table}` TO TIMESTAMP AS OF {base + 60_000}"
+    )
+    assert sorted(DeltaTable.for_path(tmp_table).to_arrow()
+                  .column("id").to_pylist()) == [1, 2]
+
+
+def test_restore_malformed_timestamp_clean_error(tmp_table):
+    t = make(tmp_table)
+    with pytest.raises(DeltaAnalysisError, match="Invalid timestamp"):
+        t.restore_to_timestamp("not-a-time")
+    with pytest.raises(DeltaAnalysisError, match="Invalid timestamp"):
+        t.to_arrow(timestamp="also/not/a/time")
