@@ -119,18 +119,7 @@ class DeltaTable:
 
     def _snapshot(self, version: Optional[int] = None,
                   timestamp: Optional[Union[str, int]] = None):
-        if version is not None and timestamp is not None:
-            raise DeltaAnalysisError("Cannot specify both version and timestamp")
-        if version is not None:
-            return self.delta_log.get_snapshot_at(version)
-        if timestamp is not None:
-            from delta_tpu.utils.timeparse import timestamp_option_to_ms
-
-            commit = self.delta_log.history.get_active_commit_at_time(
-                timestamp_option_to_ms(timestamp), can_return_last_commit=True
-            )
-            return self.delta_log.get_snapshot_at(commit.version)
-        return self.delta_log.update()
+        return self.delta_log.snapshot_for(version, timestamp)
 
     @property
     def version(self) -> int:
@@ -208,6 +197,17 @@ class DeltaTable:
         cmd = RestoreCommand(self.delta_log, timestamp=timestamp)
         cmd.run()
         return cmd.metrics
+
+    def clone(self, target_path: str, version: Optional[int] = None,
+              timestamp: Optional[Union[str, int]] = None) -> "DeltaTable":
+        """Shallow-clone this table (optionally at a past version) into
+        ``target_path``: the clone references this table's data files in
+        place. Beyond the reference — modern Delta's SHALLOW CLONE."""
+        from delta_tpu.commands.clone import CloneCommand
+
+        CloneCommand(self.delta_log, target_path,
+                     version=version, timestamp=timestamp).run()
+        return DeltaTable.for_path(target_path)
 
     def generate(self, mode: str = "symlink_format_manifest") -> None:
         if mode != "symlink_format_manifest":

@@ -1,0 +1,127 @@
+"""SHALLOW CLONE — a new table whose log references the source's data files.
+
+Beyond-reference command (the 0.9 reference has none; modern Delta ships
+``CREATE TABLE t SHALLOW CLONE s [VERSION AS OF v]``). The clone commits the
+source snapshot's Protocol + Metadata (fresh table id) and one ``AddFile``
+per live source file with the path made ABSOLUTE, so the clone reads the
+source's Parquet in place; writes to the clone produce new files under the
+clone's own directory, and the source is never modified. Deletion-vector
+sidecars are absolutized the same way. Vacuum on the clone only walks the
+clone's directory, so referenced source files are never collected by it
+(vacuuming the SOURCE can break clones — the same caveat real shallow
+clones carry).
+"""
+from __future__ import annotations
+
+import os
+import urllib.parse
+from dataclasses import replace
+from typing import Dict, Optional, Union
+
+from delta_tpu.commands import operations as ops
+from delta_tpu.protocol.actions import Metadata, Protocol
+from delta_tpu.utils import errors
+
+__all__ = ["CloneCommand"]
+
+
+def Clone(source_path: str, source_version: int) -> ops.Operation:
+    return ops.Operation(
+        "CLONE",
+        {"source": source_path, "sourceVersion": source_version,
+         "isShallow": True},
+        ["sourceTableSize", "sourceNumOfFiles", "numClonedFiles"],
+    )
+
+
+class CloneCommand:
+    def __init__(self, source_log, target_path: str,
+                 version: Optional[int] = None,
+                 timestamp: Optional[Union[str, int]] = None):
+        self.source_log = source_log
+        self.target_path = target_path
+        self.version = version
+        self.timestamp = timestamp
+        self.metrics: Dict[str, int] = {}
+
+    def run(self) -> int:
+        from delta_tpu.log.deltalog import DeltaLog
+
+        src = self.source_log
+        snapshot = src.snapshot_for(self.version, self.timestamp)
+        if snapshot.version < 0:
+            raise errors.not_a_delta_table(src.data_path, "CLONE")
+
+        target = DeltaLog.for_table(self.target_path)
+        if target.update().version >= 0:
+            raise errors.DeltaAnalysisError(
+                f"Cannot clone into {self.target_path}: a Delta table "
+                "already exists there"
+            )
+
+        src_root = os.path.abspath(src.data_path)
+
+        def absolutize(rel: str) -> str:
+            if "://" in rel or os.path.isabs(rel):
+                return rel
+            return urllib.parse.quote(
+                os.path.join(src_root, urllib.parse.unquote(rel)),
+                safe="/:@!$&'()*+,;=-._~",
+            )
+
+        def body(txn) -> int:
+            import uuid
+
+            if txn.read_version != -1:
+                # a table appeared at the target between the pre-check and
+                # this transaction: never merge two tables silently
+                raise errors.DeltaAnalysisError(
+                    f"Cannot clone into {self.target_path}: a Delta table "
+                    "already exists there"
+                )
+            meta: Metadata = replace(snapshot.metadata, id=str(uuid.uuid4()))
+            txn.update_metadata(meta)
+            # the clone must carry at least the SOURCE's protocol: config
+            # alone under-derives it (e.g. DV files outliving an unset DV
+            # property, or an explicit upgrade_protocol on the source)
+            src_p = snapshot.protocol
+            derived = txn.new_protocol
+            reader = max(src_p.min_reader_version,
+                         derived.min_reader_version if derived else 0)
+            writer = max(src_p.min_writer_version,
+                         derived.min_writer_version if derived else 0)
+            feats = set(src_p.reader_features or ()) | set(
+                src_p.writer_features or ()
+            )
+            if derived is not None:
+                feats |= set(derived.reader_features or ())
+                feats |= set(derived.writer_features or ())
+            txn.new_protocol = Protocol(
+                reader, writer,
+                tuple(sorted(feats)) if reader >= 3 else None,
+                tuple(sorted(feats)) if writer >= 7 else None,
+            )
+            actions = []
+            total_size = 0
+            for f in snapshot.all_files:
+                dv = f.deletion_vector
+                if dv and dv.get("storageType") == "u":
+                    dv = dict(dv, pathOrInlineDv=os.path.join(
+                        src_root, dv["pathOrInlineDv"]
+                    ))
+                actions.append(replace(
+                    f, path=absolutize(f.path), data_change=True,
+                    deletion_vector=dv,
+                ))
+                total_size += f.size or 0
+            self.metrics.update(
+                sourceTableSize=total_size,
+                sourceNumOfFiles=len(actions),
+                numClonedFiles=len(actions),
+            )
+            txn.report_metrics(**self.metrics)
+            return txn.commit(
+                actions, Clone(src.data_path, snapshot.version)
+            )
+
+        return target.with_new_transaction(body)

@@ -162,6 +162,26 @@ class DeltaLog:
     def get_snapshot_at(self, version: int) -> Snapshot:
         return sm.get_snapshot_at(self, version)
 
+    def snapshot_for(self, version: Optional[int] = None,
+                     timestamp=None) -> Snapshot:
+        """One shared time-travel resolution for every surface that takes
+        version/timestamp options (reads, RESTORE, CLONE): at most one
+        selector; timestamp = epoch ms or ISO-8601; none = latest."""
+        if version is not None and timestamp is not None:
+            raise errors_mod.DeltaAnalysisError(
+                "Cannot specify both version and timestamp"
+            )
+        if version is not None:
+            return self.get_snapshot_at(int(version))
+        if timestamp is not None:
+            from delta_tpu.utils.timeparse import timestamp_option_to_ms
+
+            commit = self.history.get_active_commit_at_time(
+                timestamp_option_to_ms(timestamp), can_return_last_commit=True
+            )
+            return self.get_snapshot_at(commit.version)
+        return self.update()
+
     @property
     def table_exists(self) -> bool:
         return self.snapshot.version >= 0

@@ -1,0 +1,182 @@
+"""SHALLOW CLONE semantics (beyond-reference; modern Delta's clone):
+zero-copy table creation by absolute-path reference, divergence after
+writes, time-traveled clones, DV carrying, and isolation of the source.
+"""
+import os
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.utils.errors import DeltaAnalysisError
+
+
+def make(tmp_path, name="src", **kw):
+    return DeltaTable.create(
+        str(tmp_path / name),
+        data=pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                       "v": pa.array(["a", "b", "c"])}),
+        **kw,
+    )
+
+
+def test_clone_reads_source_data_without_copying(tmp_path):
+    src = make(tmp_path)
+    clone = src.clone(str(tmp_path / "c"))
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+    # no parquet copied into the clone dir
+    data_files = [f for f in os.listdir(str(tmp_path / "c"))
+                  if f.endswith(".parquet")]
+    assert data_files == []
+    assert clone.history()[0]["operation"] == "CLONE"
+
+
+def test_clone_gets_fresh_table_id(tmp_path):
+    src = make(tmp_path)
+    clone = src.clone(str(tmp_path / "c"))
+    assert clone.delta_log.update().metadata.id != src.delta_log.update().metadata.id
+
+
+def test_writes_to_clone_do_not_touch_source(tmp_path):
+    src = make(tmp_path)
+    clone = src.clone(str(tmp_path / "c"))
+    WriteIntoDelta(clone.delta_log, "append", pa.table({
+        "id": pa.array([99], pa.int64()), "v": pa.array(["z"]),
+    })).run()
+    clone.delete("id = 1")
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [2, 3, 99]
+    assert sorted(src.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+    # the clone's new file lives under the clone's directory
+    new_files = [f for f in os.listdir(str(tmp_path / "c"))
+                 if f.endswith(".parquet")]
+    assert len(new_files) >= 1
+
+
+def test_writes_to_source_do_not_affect_clone(tmp_path):
+    src = make(tmp_path)
+    clone = src.clone(str(tmp_path / "c"))
+    WriteIntoDelta(src.delta_log, "append", pa.table({
+        "id": pa.array([50], pa.int64()), "v": pa.array(["s"]),
+    })).run()
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_clone_at_version(tmp_path):
+    src = make(tmp_path)
+    WriteIntoDelta(src.delta_log, "append", pa.table({
+        "id": pa.array([4], pa.int64()), "v": pa.array(["d"]),
+    })).run()
+    clone = src.clone(str(tmp_path / "c"), version=0)
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_clone_into_existing_table_rejected(tmp_path):
+    src = make(tmp_path)
+    make(tmp_path, name="other")
+    with pytest.raises(DeltaAnalysisError):
+        src.clone(str(tmp_path / "other"))
+
+
+def test_clone_version_and_timestamp_rejected(tmp_path):
+    src = make(tmp_path)
+    with pytest.raises(DeltaAnalysisError):
+        src.clone(str(tmp_path / "c"), version=0, timestamp="2024-01-01")
+
+
+def test_clone_carries_dv_state(tmp_path):
+    src = make(tmp_path, configuration={"delta.tpu.enableDeletionVectors": "true"})
+    src.delete("id = 2")
+    clone = src.clone(str(tmp_path / "c"))
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 3]
+    p = clone.delta_log.update().protocol
+    assert (p.min_reader_version, p.min_writer_version) == (3, 7)
+
+
+def test_clone_carries_schema_and_properties(tmp_path):
+    src = DeltaTable.create(
+        str(tmp_path / "src"),
+        data=pa.table({"part": ["x", "y"], "n": pa.array([1, 2], pa.int64())}),
+        partition_columns=["part"],
+        configuration={"delta.appendOnly": "false", "custom.tag": "hello"},
+    )
+    clone = src.clone(str(tmp_path / "c"))
+    meta = clone.delta_log.update().metadata
+    assert meta.partition_columns == ["part"]
+    assert meta.configuration.get("custom.tag") == "hello"
+    assert clone.to_arrow(filters=["part = 'x'"]).num_rows == 1
+
+
+def test_clone_vacuum_does_not_touch_source_files(tmp_path):
+    import time as _time
+
+    from delta_tpu.log.deltalog import DeltaLog
+
+    src = make(tmp_path)
+    clone_path = str(tmp_path / "c")
+    now = [int(_time.time() * 1000)]
+    src.clone(clone_path)
+    DeltaLog.clear_cache()
+    log = DeltaLog.for_table(clone_path, clock=lambda: now[0])
+    clone = DeltaTable.for_path(clone_path)
+    now[0] += 14 * 24 * 3_600_000
+    r = clone.vacuum()
+    assert r.files_deleted == 0
+    assert sorted(src.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_clone_carries_source_protocol_beyond_config(tmp_path):
+    """Config under-derives protocol when DV files outlive an unset DV
+    property — the clone must inherit the SOURCE protocol, not re-derive."""
+    from delta_tpu.commands.alter import unset_table_properties
+
+    src = make(tmp_path, configuration={"delta.tpu.enableDeletionVectors": "true"})
+    src.delete("id = 2")  # AddFile now carries a DV
+    unset_table_properties(src.delta_log, ["delta.tpu.enableDeletionVectors"])
+    sp = src.delta_log.update().protocol
+    assert (sp.min_reader_version, sp.min_writer_version) == (3, 7)
+    clone = src.clone(str(tmp_path / "c"))
+    cp = clone.delta_log.update().protocol
+    assert (cp.min_reader_version, cp.min_writer_version) == (3, 7)
+    assert "tpu.deletionVectors" in (cp.reader_features or ())
+    assert sorted(clone.to_arrow().column("id").to_pylist()) == [1, 3]
+
+
+def test_clone_into_existing_rejected_by_outer_check(tmp_path):
+    from delta_tpu.commands.clone import CloneCommand
+
+    src = make(tmp_path)
+    make(tmp_path, name="raced")
+    with pytest.raises(DeltaAnalysisError):
+        CloneCommand(src.delta_log, str(tmp_path / "raced")).run()
+
+
+def test_clone_race_window_rejected_in_txn(tmp_path, monkeypatch):
+    """A table created at the target BETWEEN the pre-check and the commit
+    must fail the clone, never merge two tables: make the pre-check see an
+    empty table once, with the real table appearing when the transaction
+    pins its snapshot."""
+    from types import SimpleNamespace
+
+    from delta_tpu.commands.clone import CloneCommand
+    from delta_tpu.log.deltalog import DeltaLog
+
+    src = make(tmp_path)
+    target = str(tmp_path / "raced")
+    make(tmp_path, name="raced")  # the racing creator already committed
+    target_log = DeltaLog.for_table(target)
+    real_update = target_log.update
+    lied = []
+
+    def update_lying_once(stale_ok=False):
+        if not lied:
+            lied.append(1)
+            return SimpleNamespace(version=-1)  # pre-check sees "no table"
+        return real_update(stale_ok=stale_ok)
+
+    monkeypatch.setattr(target_log, "update", update_lying_once)
+    with pytest.raises(DeltaAnalysisError, match="already exists"):
+        CloneCommand(src.delta_log, target).run()
+    # and nothing was appended to the raced table
+    assert DeltaLog.for_table(target).update().version == 0
