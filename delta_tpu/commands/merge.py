@@ -475,6 +475,12 @@ class MergeIntoCommand:
             }
             cols = [c for c in target_cols if c.lower() in need]
             read_cols = cols or None
+        else:
+            read_cols = self._referenced_target_columns(
+                metadata, target_cols, [c for c in src.column_names
+                                        if c.startswith(_SRC)],
+                key_need, residual,
+            )
 
         mode = str(conf.get("delta.tpu.merge.devicePath.mode", "auto"))
         device_eligible = (
@@ -505,6 +511,13 @@ class MergeIntoCommand:
                 if est.device_s > rows * link.HOST_JOIN_S_PER_ROW:
                     device_eligible = False
 
+        # DV-mode matched clauses mark physical rows deleted — every scan
+        # that can end up as the phase-2 tables must carry positions
+        pos_col = (
+            POSITION_COL
+            if (not insert_only and dv_common.dv_enabled(metadata))
+            else None
+        )
         decode_t = Timer()
         pending = None
         key_pieces: Optional[List[pa.Table]] = None
@@ -513,6 +526,7 @@ class MergeIntoCommand:
             key_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
                 columns=key_cols or None, per_file=True,
+                position_column=pos_col,
             )
             key_tab = pa.concat_tables(key_pieces, promote_options="permissive")
             if key_tab.num_rows:
@@ -528,14 +542,7 @@ class MergeIntoCommand:
         else:
             raw_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
-                columns=read_cols, per_file=True,
-                # DV-mode matched clauses mark physical rows deleted — the
-                # scan must carry each row's physical file position
-                position_column=(
-                    POSITION_COL
-                    if (not insert_only and dv_common.dv_enabled(metadata))
-                    else None
-                ),
+                columns=read_cols, per_file=True, position_column=pos_col,
             )
         tgt_tables: Dict[int, pa.Table] = {}
         pieces: List[pa.Table] = []
@@ -634,6 +641,52 @@ class MergeIntoCommand:
             joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
         self.phase_ms["join_ms"] = join_t.lap_ms()
         return joined, tgt_tables
+
+    def _referenced_target_columns(
+        self, metadata, target_cols, src_prefixed, key_need, residual,
+    ) -> Optional[List[str]]:
+        """Project the candidate scan to the target columns phase 2 can
+        touch — or None when every column is needed.
+
+        Valid only when nothing re-materializes whole target rows: deletion
+        vectors on (no copy block — unclaimed/unmatched rows stay in their
+        files), CDC off (no preimages), no generated columns (recompute
+        reads arbitrary base columns), and every update clause a star
+        (explicit assignments keep unassigned target columns, i.e. all of
+        them). For a star upsert this collapses the scan to the join keys —
+        the dominant cost of the DV merge path."""
+        from delta_tpu.schema.generated import generated_column_names
+
+        if not dv_common.dv_enabled(metadata) or self._use_cdf:
+            return None
+        if generated_column_names(metadata.schema):
+            return None
+        source_bare = [c[len(_SRC):] for c in src_prefixed]
+        src_lower = {c.lower() for c in source_bare}
+        need = set(key_need)
+        for c in residual:
+            need |= {r.lower() for r in ir.references(c)
+                     if not r.startswith(_SRC)}
+        try:
+            for clause in self.matched_clauses + self.not_matched_clauses:
+                if clause.condition is not None:
+                    resolved = self._resolve(
+                        clause.condition, target_cols, source_bare
+                    )
+                    need |= {r.lower() for r in ir.references(resolved)
+                             if not r.startswith(_SRC)}
+                if clause.kind == "update":
+                    if not clause.is_star:
+                        return None
+                    # star update: target-only columns copy from the target
+                    need |= {c.lower() for c in target_cols
+                             if c.lower() not in src_lower}
+        except DeltaAnalysisError:
+            return None  # let the normal path raise the real resolution error
+        cols = [c for c in target_cols if c.lower() in need]
+        if len(cols) == len(target_cols):
+            return None
+        return cols or None
 
     def _launch_device_join(self, key_tab: pa.Table, src: pa.Table, equi):
         """Evaluate + coerce the join keys and launch the device membership
@@ -849,6 +902,10 @@ class MergeIntoCommand:
             for col, e in clause.assignments.items():
                 name = col.split(".")[-1]  # strip target alias qualifier
                 assignments[name] = self._resolve_in_pairs(e, block)
+        from delta_tpu.expr.vectorized import arrow_type_for
+
+        declared = {f.name: arrow_type_for(f.data_type)
+                    for f in metadata.schema.fields}
         cols = []
         for c in target_cols:
             e = None
@@ -860,7 +917,9 @@ class MergeIntoCommand:
                 cols.append(block.column(c))
             else:
                 new = evaluate(e, block)
-                cols.append(pc.cast(new, block.column(c).type, safe=False))
+                # cast to the SCHEMA's declared type — with projection
+                # pushdown the assigned target column isn't decoded at all
+                cols.append(pc.cast(new, declared[c], safe=False))
         out = pa.table(cols, names=target_cols)
         # recompute generated columns whose referenced base columns were
         # assigned (stale copies fail write-time checks); uses the txn's

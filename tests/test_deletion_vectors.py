@@ -397,3 +397,21 @@ def test_json_action_round_trip_with_dv(tmp_table):
     back = action_from_json(a.json())
     assert back.deletion_vector == desc.to_dict()
     assert back.remove().deletion_vector == desc.to_dict()
+
+
+def test_merge_device_path_with_dv(tmp_table):
+    """Forced device join on a DV table: the key-projection reuse path must
+    still carry physical positions for DV marking (bench-caught KeyError)."""
+    from delta_tpu.utils.config import conf
+
+    t = make_table(tmp_table, n=50)
+    src = pa.table({"id": pa.array([5, 6, 999], pa.int64()),
+                    "value": pa.array(["U5", "U6", "N"])})
+    with conf.set_temporarily(**{"delta.tpu.merge.devicePath.mode": "force"}):
+        m = (t.alias("t").merge(src, "t.id = s.id", source_alias="s")
+             .when_matched_update_all().when_not_matched_insert_all().execute())
+    assert m["numTargetRowsUpdated"] == 2 and m["numTargetRowsInserted"] == 1
+    got = t.to_arrow()
+    vals = dict(zip(got.column("id").to_pylist(), got.column("value").to_pylist()))
+    assert vals[5] == "U5" and vals[999] == "N" and vals[7] == "v7"
+    assert got.num_rows == 51
