@@ -222,3 +222,90 @@ def test_incremental_manifest_hook(tmp_table):
     # a delete that empties the partition removes its manifest
     DeleteCommand(log, "c = 'a'").run()
     assert not os.path.exists(mpath)
+
+
+def test_convert_with_stats_enables_skipping(tmp_table):
+    os.makedirs(tmp_table)
+    pq.write_table(pa.table({"id": [1, 2]}), os.path.join(tmp_table, "a.parquet"))
+    pq.write_table(pa.table({"id": [100, 200]}), os.path.join(tmp_table, "b.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    ConvertToDeltaCommand(log, collect_stats=True).run()
+    snap = log.update()
+    stats = [f.stats_dict() for f in snap.all_files]
+    assert all(s and "numRecords" in s and "minValues" in s for s in stats)
+    from delta_tpu.expr.parser import parse_predicate
+    from delta_tpu.ops import pruning
+
+    scan = pruning.files_for_scan(snap, [parse_predicate("id > 50")])
+    assert len(scan.files) == 1, "min/max stats from convert must prune"
+
+
+def test_convert_null_partition_token(tmp_table):
+    os.makedirs(os.path.join(tmp_table, "c=__HIVE_DEFAULT_PARTITION__"))
+    os.makedirs(os.path.join(tmp_table, "c=x"))
+    pq.write_table(pa.table({"id": [1]}),
+                   os.path.join(tmp_table, "c=__HIVE_DEFAULT_PARTITION__", "a.parquet"))
+    pq.write_table(pa.table({"id": [2]}), os.path.join(tmp_table, "c=x", "b.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    part_schema = StructType([StructField("c", StringType())])
+    ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+    t = scan_to_table(log.update())
+    by_id = dict(zip(t.column("id").to_pylist(), t.column("c").to_pylist()))
+    assert by_id[1] is None and by_id[2] == "x"
+
+
+def test_convert_escaped_partition_values(tmp_table):
+    # hive-escaped special chars in dir names round-trip through convert
+    os.makedirs(os.path.join(tmp_table, "c=a%3Db"))  # value "a=b"
+    pq.write_table(pa.table({"id": [1]}),
+                   os.path.join(tmp_table, "c=a%3Db", "a.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    part_schema = StructType([StructField("c", StringType())])
+    ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+    t = scan_to_table(log.update())
+    assert t.column("c").to_pylist() == ["a=b"]
+
+
+def test_convert_ignores_hidden_files_and_dirs(tmp_table):
+    os.makedirs(os.path.join(tmp_table, "_staging"))
+    pq.write_table(pa.table({"id": [9]}), os.path.join(tmp_table, "_staging", "x.parquet"))
+    pq.write_table(pa.table({"id": [1]}), os.path.join(tmp_table, "a.parquet"))
+    with open(os.path.join(tmp_table, ".hidden.parquet"), "wb") as f:
+        f.write(b"junk")
+    log = DeltaLog.for_table(tmp_table)
+    ConvertToDeltaCommand(log).run()
+    t = scan_to_table(log.update())
+    assert t.column("id").to_pylist() == [1]
+
+
+def test_convert_empty_dir_errors(tmp_table):
+    os.makedirs(tmp_table)
+    log = DeltaLog.for_table(tmp_table)
+    from delta_tpu.utils.errors import DeltaFileNotFoundError
+
+    with pytest.raises(DeltaFileNotFoundError):
+        ConvertToDeltaCommand(log).run()
+
+
+def test_convert_mixed_depth_partitions_rejected(tmp_table):
+    os.makedirs(os.path.join(tmp_table, "c=x"))
+    pq.write_table(pa.table({"id": [1]}), os.path.join(tmp_table, "c=x", "a.parquet"))
+    pq.write_table(pa.table({"id": [2]}), os.path.join(tmp_table, "b.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    part_schema = StructType([StructField("c", StringType())])
+    with pytest.raises(DeltaAnalysisError):
+        ConvertToDeltaCommand(log, partition_schema=part_schema).run()
+
+
+def test_post_convert_dml_works(tmp_table):
+    os.makedirs(tmp_table)
+    pq.write_table(pa.table({"id": [1, 2, 3]}), os.path.join(tmp_table, "a.parquet"))
+    log = DeltaLog.for_table(tmp_table)
+    ConvertToDeltaCommand(log).run()
+    from delta_tpu.api.tables import DeltaTable
+
+    t = DeltaTable.for_path(tmp_table)
+    t.delete("id = 2")
+    t.update({"id": "id * 10"}, "id = 3")
+    assert sorted(t.to_arrow().column("id").to_pylist()) == [1, 30]
+    assert len(t.history()) == 3
