@@ -132,3 +132,119 @@ def test_write_omitting_referenced_nullable_base_column(gtable):
     gtable.write({"id": [7]})
     r = rows(gtable.delta_log)[0]
     assert r == {"id": 7, "name": None, "id2": 14, "uname": None}
+
+
+# -- depth: partitions, DML interplay, evolution (GeneratedColumnSuite tail) --
+
+
+def test_generated_partition_column(tmp_table):
+    """Generated columns can partition the table — writers compute the
+    partition value from the base column (the reference's headline use:
+    date-derived partitions)."""
+    schema = (
+        StructType()
+        .add("id", LongType())
+        .add_field(generated_field("bucket", LongType(), "id % 3"))
+    )
+    t = DeltaTable.create(tmp_table, schema, partition_columns=["bucket"])
+    t.write({"id": [0, 1, 2, 3, 4, 5]})
+    snap = t.delta_log.update()
+    assert snap.metadata.partition_columns == ["bucket"]
+    got = t.to_arrow(filters=["bucket = 1"])
+    assert sorted(got.column("id").to_pylist()) == [1, 4]
+    # partition pruning actually prunes
+    from delta_tpu.expr.parser import parse_predicate
+    from delta_tpu.ops import pruning
+
+    scan = pruning.files_for_scan(snap, [parse_predicate("bucket = 1")])
+    assert len(scan.files) < len(snap.all_files)
+
+
+def test_delete_on_generated_table_keeps_values(gtable):
+    gtable.write({"id": [1, 2, 3], "name": ["a", "b", "c"]})
+    gtable.delete("id2 = 4")  # predicate on the GENERATED column
+    got = rows(gtable.delta_log)
+    assert [r["id"] for r in got] == [1, 3]
+    assert [r["id2"] for r in got] == [2, 6]
+
+
+def test_generated_with_dv_table(tmp_table):
+    schema = (
+        StructType()
+        .add("id", LongType())
+        .add_field(generated_field("id2", LongType(), "id * 2"))
+    )
+    t = DeltaTable.create(
+        tmp_table, schema,
+        configuration={"delta.tpu.enableDeletionVectors": "true"},
+    )
+    t.write({"id": [1, 2, 3]})
+    t.update({"id": "id + 10"}, "id = 2")
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert [(r["id"], r["id2"]) for r in got] == [(1, 2), (3, 6), (12, 24)]
+
+
+def test_merge_star_with_generated_uses_full_decode(tmp_table):
+    """Projection pushdown must bail on generated columns (recompute needs
+    base columns) — values stay correct under a DV-enabled star merge,
+    which is exactly the configuration where pushdown would engage."""
+    t = DeltaTable.create(
+        tmp_table, gen_schema(),
+        configuration={"delta.tpu.enableDeletionVectors": "true"},
+    )
+    t.write({"id": [1, 2], "name": ["a", "b"]})
+    src = pa.table({"id": pa.array([1, 9], pa.int64()),
+                    "name": pa.array(["A", "n"])})
+    (t.alias("t").merge(src, "t.id = s.id", source_alias="s")
+     .when_matched_update_all().when_not_matched_insert_all().execute())
+    got = rows(t.delta_log)
+    assert [(r["id"], r["uname"]) for r in got] == [(1, "A"), (2, "B"), (9, "N")]
+
+
+def test_alter_add_generated_column_nulls_old_rows_computes_new(gtable):
+    """Adding a generated column to a table with existing rows: old rows
+    read NULL (no stale/wrong values), and the NEXT write computes it."""
+    from delta_tpu.commands.alter import add_columns
+
+    gtable.write({"id": [1], "name": ["a"]})
+    add_columns(gtable.delta_log, [generated_field("id3", LongType(), "id * 3")])
+    got = rows(gtable.delta_log)
+    assert got[0].get("id3") is None
+    gtable.write({"id": [2], "name": ["b"]})
+    got = rows(gtable.delta_log)
+    assert [(r["id"], r["id3"]) for r in got] == [(1, None), (2, 6)]
+
+
+def test_generated_column_in_constraint(gtable):
+    from delta_tpu.commands.alter import add_constraint
+
+    gtable.write({"id": [1, 2], "name": ["a", "b"]})
+    add_constraint(gtable.delta_log, "small", "id2 < 100")
+    gtable.write({"id": [5], "name": ["e"]})  # id2=10, passes
+    assert len(rows(gtable.delta_log)) == 3
+    with pytest.raises(InvariantViolationError):
+        gtable.write({"id": [500], "name": ["big"]})  # id2=1000 violates
+
+
+def test_timestamp_date_generation(tmp_table):
+    """The reference's canonical use: date partitions derived from a
+    timestamp column."""
+    import datetime
+
+    from delta_tpu.schema.types import DateType, TimestampType
+
+    schema = (
+        StructType()
+        .add("ts", TimestampType())
+        .add_field(generated_field("d", DateType(), "cast(ts as date)"))
+    )
+    try:
+        t = DeltaTable.create(tmp_table, schema)
+    except DeltaAnalysisError:
+        pytest.skip("cast-to-date not in the generation whitelist")
+    t.write({"ts": [datetime.datetime(2024, 5, 1, 12, 30),
+                    datetime.datetime(2024, 5, 2, 1, 0)]})
+    got = t.to_arrow()
+    assert got.column("d").to_pylist() == [
+        datetime.date(2024, 5, 1), datetime.date(2024, 5, 2)
+    ]
