@@ -22,7 +22,7 @@ from delta_tpu.commands.optimize import OptimizeCommand
 from delta_tpu.commands.update import UpdateCommand
 from delta_tpu.commands.vacuum import VacuumCommand
 from delta_tpu.commands.write import WriteIntoDelta
-from delta_tpu.exec.scan import scan_files, scan_to_table
+from delta_tpu.exec.scan import scan_to_table
 from delta_tpu.expr import ir
 from delta_tpu.log.deltalog import DeltaLog
 from delta_tpu.protocol.actions import Protocol

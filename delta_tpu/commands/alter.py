@@ -8,7 +8,7 @@ transaction.
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from delta_tpu.commands import operations as ops
 from delta_tpu.expr.parser import parse_predicate

@@ -13,15 +13,15 @@ from __future__ import annotations
 
 import os
 import urllib.parse
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow.parquet as pq
 
 from delta_tpu.commands import operations as ops
 from delta_tpu.exec.write import unescape_partition_value
-from delta_tpu.protocol.actions import Action, AddFile, Metadata, Protocol
+from delta_tpu.protocol.actions import Action, AddFile, Metadata
 from delta_tpu.schema.arrow_interop import schema_from_arrow
-from delta_tpu.schema.types import StructField, StructType
+from delta_tpu.schema.types import StructType
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaFileNotFoundError
 
 __all__ = ["ConvertToDeltaCommand"]

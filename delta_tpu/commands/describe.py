@@ -8,7 +8,6 @@ first, via the history manager).
 from __future__ import annotations
 
 import datetime as _dt
-import os
 from typing import Any, Dict, List, Optional
 
 __all__ = ["describe_detail", "describe_history"]

@@ -15,9 +15,8 @@ import os
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import List, Optional, Set
 
-from delta_tpu.commands import operations as ops
 from delta_tpu.utils.config import DeltaConfigs, conf
 from delta_tpu.utils import errors
 

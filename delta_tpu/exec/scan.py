@@ -11,12 +11,10 @@ from __future__ import annotations
 
 import os
 import urllib.parse
-from typing import List, Optional, Sequence, Union
+from typing import Optional, Sequence, Union
 
 import pyarrow as pa
-import pyarrow.compute as pc
 
-from delta_tpu.exec import parquet as pq_exec
 from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_predicate
 from delta_tpu.expr.partition import typed_partition_row

@@ -16,7 +16,7 @@ from __future__ import annotations
 import os
 import urllib.parse
 import uuid
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.compute as pc
@@ -27,7 +27,7 @@ from delta_tpu.protocol.actions import AddFile, Metadata
 from delta_tpu.schema import constraints as constraints_mod
 from delta_tpu.schema.types import StructType
 from delta_tpu.utils.config import DeltaConfigs
-from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+from delta_tpu.utils.errors import SchemaMismatchError
 
 __all__ = ["normalize_data", "write_files", "escape_partition_value", "partition_path"]
 

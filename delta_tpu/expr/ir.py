@@ -27,11 +27,8 @@ from delta_tpu.schema.types import (
     DateType,
     DecimalType,
     DoubleType,
-    FloatType,
-    IntegerType,
     LongType,
     StringType,
-    StructType,
     TimestampType,
 )
 from delta_tpu.utils.errors import DeltaAnalysisError

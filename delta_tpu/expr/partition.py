@@ -16,7 +16,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_expression
 from delta_tpu.protocol.actions import AddFile, Metadata
-from delta_tpu.schema.types import DataType, StringType, StructType
+from delta_tpu.schema.types import StringType, StructType
 
 __all__ = [
     "typed_partition_row",

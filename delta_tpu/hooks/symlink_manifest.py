@@ -16,7 +16,7 @@ import os
 import shutil
 import urllib.parse
 from collections import defaultdict
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set
 
 from delta_tpu.exec.write import partition_path
 from delta_tpu.protocol.actions import Action, AddFile, RemoveFile

@@ -25,7 +25,6 @@ from delta_tpu.protocol.actions import (
     Action,
     AddFile,
     Metadata,
-    Format,
     Protocol,
     RemoveFile,
     SetTransaction,

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -33,10 +33,8 @@ import pyarrow.compute as pc
 
 from delta_tpu.protocol.actions import (
     Action,
-    AddFile,
     Metadata,
     Protocol,
-    RemoveFile,
     SetTransaction,
     action_from_json,
 )

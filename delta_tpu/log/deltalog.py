@@ -14,7 +14,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from delta_tpu.log import checkpoints as ckpt_mod
 from delta_tpu.log import checksum as crc_mod
 from delta_tpu.log import snapshot_management as sm
-from delta_tpu.log.snapshot import InitialSnapshot, LogSegment, Snapshot
+from delta_tpu.log.snapshot import InitialSnapshot, Snapshot
 from delta_tpu.protocol import filenames
 from delta_tpu.protocol.actions import (
     READER_VERSION,
@@ -24,7 +24,6 @@ from delta_tpu.protocol.actions import (
     SUPPORTED_WRITER_VERSION,
     WRITER_VERSION,
     Action,
-    Metadata,
     Protocol,
     actions_from_lines,
 )

@@ -16,7 +16,6 @@ from delta_tpu.log.snapshot import LogSegment, Snapshot
 from delta_tpu.protocol import filenames
 from delta_tpu.storage.logstore import FileStatus, LogStore
 from delta_tpu.utils.errors import (
-    DeltaFileNotFoundError,
     DeltaIllegalStateError,
     VersionNotFoundError,
     versions_not_contiguous,

@@ -18,7 +18,6 @@ import pyarrow as pa
 
 from delta_tpu.protocol.actions import Action, AddFile, Metadata, RemoveFile
 from delta_tpu.schema.types import (
-    BooleanType,
     ByteType,
     DataType,
     DateType,
@@ -27,7 +26,6 @@ from delta_tpu.schema.types import (
     IntegerType,
     LongType,
     ShortType,
-    StringType,
     StructType,
     TimestampType,
 )

@@ -11,7 +11,7 @@ the skipping predicate (`ops/pruning.py`) exploits.
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Sequence
 
 import numpy as np
 

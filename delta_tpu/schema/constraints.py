@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List
 
 import pyarrow as pa
 import pyarrow.compute as pc

@@ -18,7 +18,7 @@ themselves (no chains, no self-reference).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import pyarrow as pa
 import pyarrow.compute as pc

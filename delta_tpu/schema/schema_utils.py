@@ -19,7 +19,6 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 from delta_tpu.schema.types import (
     ArrayType,
-    AtomicType,
     ByteType,
     DataType,
     DoubleType,

@@ -36,7 +36,7 @@ import json
 import socket
 import time
 import urllib.parse
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Iterable, Iterator, Optional, Tuple
 
 from delta_tpu.storage.logstore import FileStatus, LogStore
 from delta_tpu.utils.errors import DeltaIOError

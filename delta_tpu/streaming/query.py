@@ -12,9 +12,8 @@ from __future__ import annotations
 import json
 import os
 import uuid
-from typing import Any, Callable, Optional
+from typing import Optional
 
-import pyarrow as pa
 
 from delta_tpu.streaming.offset import DeltaSourceOffset
 from delta_tpu.streaming.sink import DeltaSink

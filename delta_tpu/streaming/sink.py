@@ -9,7 +9,7 @@ output mode removes all existing files first (`:93-98`).
 from __future__ import annotations
 
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Sequence
 
 from delta_tpu.commands import operations as ops
 from delta_tpu.commands.write import coerce_to_table, update_metadata_on_write

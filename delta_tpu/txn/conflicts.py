@@ -21,9 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set
 
-from delta_tpu.expr import ir
 from delta_tpu.expr import partition as part
-from delta_tpu.protocol import filenames
 from delta_tpu.protocol.actions import (
     Action,
     AddFile,

@@ -11,7 +11,7 @@ from __future__ import annotations
 import contextvars
 import logging
 from dataclasses import dataclass, replace
-from typing import Any, Dict, List, Optional, Sequence, Set
+from typing import Any, Dict, List, Optional, Sequence
 
 from delta_tpu.expr import ir
 from delta_tpu.expr import partition as part
@@ -26,7 +26,6 @@ from delta_tpu.protocol.actions import (
     Metadata,
     Protocol,
     RemoveFile,
-    SetTransaction,
     actions_from_lines,
 )
 from delta_tpu.schema import schema_utils
