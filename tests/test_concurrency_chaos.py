@@ -10,7 +10,6 @@ table state must equal a serial execution of the successful operations.
 import threading
 
 import pyarrow as pa
-import pytest
 
 from delta_tpu.api.tables import DeltaTable
 from delta_tpu.commands.write import WriteIntoDelta
@@ -75,9 +74,6 @@ def test_concurrent_disjoint_partition_deletes(tmp_table):
     # surface retry-exhaustion only as a TYPED concurrency error
     assert all(isinstance(e, DeltaConcurrentModificationException) for e in errs)
     remaining = sorted(t.to_arrow().column("p").to_pylist())
-    deleted = {p for p in parts[:4]} - {
-        p for e in errs for p in parts if f"'{p}'" in str(e)
-    }
     assert set(remaining) >= set(parts[4:])
     assert len(remaining) == 6 - 4 + len(errs)
 
