@@ -284,6 +284,59 @@ class _Vectorizer:
     }
 
     def _v_Func(self, e: ir.Func):
+        # concat / round / substring need special argument handling; the
+        # rest map 1:1 onto an Arrow kernel. Anything else (or non-literal
+        # substring/round arguments) keeps the exact row-eval semantics.
+        if e.name == "concat":
+            args = [self.visit(a) for a in e.children]
+            types = [getattr(a, "type", None) for a in args]
+            # stringified-operand semantics match Arrow's cast only for
+            # strings and integers (floats/bools render differently than
+            # str()) — anything else keeps the exact row semantics
+            if all(t is not None and (pa.types.is_string(t) or pa.types.is_integer(t))
+                   for t in types):
+                args = [
+                    a if pa.types.is_string(a.type) else pc.cast(a, pa.string())
+                    for a in args
+                ]
+                # any NULL argument → NULL (binary_join's default emit_null)
+                return pc.binary_join_element_wise(*args, "")
+            return self._fallback(e)
+        if e.name == "hour":
+            arg = self.visit(e.children[0])
+            t = getattr(arg, "type", None)
+            if t is not None and pa.types.is_timestamp(t):
+                return pc.hour(arg)
+            return self._fallback(e)  # int-µs inputs keep row semantics
+        def _int_literals(args):
+            return all(
+                isinstance(a, ir.Literal) and isinstance(a.value, int)
+                and not isinstance(a.value, bool)
+                for a in args
+            )
+
+        if e.name == "round" and (
+            len(e.children) == 1
+            or (_int_literals(e.children[1:])
+                and e.children[1].value == 0)
+        ):
+            # only ndigits=0 vectorizes: integer boundaries are binary-exact
+            # so Arrow's half_to_even agrees with Python's round(); for
+            # ndigits>0 Arrow rounds the binary-scaled value (round(2.675,2)
+            # → 2.68) while Python is correctly rounded (→ 2.67) — keep the
+            # exact row semantics there
+            return pc.round(
+                self.visit(e.children[0]), ndigits=0,
+                round_mode="half_to_even",
+            )
+        if e.name == "substring" and _int_literals(e.children[1:]):
+            s = self.visit(e.children[0])
+            pos = int(e.children[1].value)
+            start = max(pos - 1, 0)
+            if len(e.children) > 2:
+                stop = start + int(e.children[2].value)
+                return pc.utf8_slice_codeunits(s, start=start, stop=stop)
+            return pc.utf8_slice_codeunits(s, start=start)
         fn = self._ARROW_FUNCS.get(e.name)
         if fn is None:
             return self._fallback(e)

@@ -159,3 +159,53 @@ def test_jaxeval_under_jit():
         for v, ok in zip(np.asarray(out.values, bool), np.asarray(out.valid, bool))
     ]
     assert got == expected
+
+
+# -- vectorized Func parity with the row evaluator ---------------------------
+
+
+def _func_parity(expr_sql, table):
+    from delta_tpu.expr.parser import parse_expression
+    from delta_tpu.expr.vectorized import evaluate
+
+    e = parse_expression(expr_sql)
+    vec = evaluate(e, table).to_pylist()
+    rows = [e.eval(r) for r in table.to_pylist()]
+    assert vec == rows, (expr_sql, vec, rows)
+
+
+def test_vectorized_concat_parity():
+    import pyarrow as pa
+
+    t = pa.table({
+        "a": pa.array(["x", None, "z"]),
+        "b": pa.array([1, 2, None], pa.int64()),
+    })
+    _func_parity("concat(a, 'mid', b)", t)
+
+
+def test_vectorized_substring_parity():
+    import pyarrow as pa
+
+    t = pa.table({"s": pa.array(["hello", "ab", None, ""])})
+    _func_parity("substring(s, 2, 3)", t)
+    _func_parity("substring(s, 1)", t)
+    _func_parity("substring(s, 2, NULL)", t)  # NULL length: row semantics
+
+
+def test_vectorized_round_parity():
+    import pyarrow as pa
+
+    # decimal ndigits MUST keep exact row semantics (Arrow rounds the
+    # binary-scaled value: round(2.675, 2) -> 2.68 vs Python's 2.67)
+    t = pa.table({"x": pa.array([1.25, 2.5, None, -0.5, 2.675, 0.15])})
+    _func_parity("round(x, 2)", t)
+    _func_parity("round(x, 1)", t)
+    _func_parity("round(x)", t)
+
+
+def test_vectorized_hour_parity_on_int_micros():
+    import pyarrow as pa
+
+    t = pa.table({"t": pa.array([0, 3_600_000_000 * 5 + 17, None], pa.int64())})
+    _func_parity("hour(t)", t)
