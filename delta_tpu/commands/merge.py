@@ -600,26 +600,37 @@ class MergeIntoCommand:
                 return joined, tgt_tables
 
         if equi:
+            # Join INDEX tables (keys + row positions), then take the full
+            # rows: Arrow's hash join refuses nested (struct/list/map)
+            # non-key payload columns, and carrying 2 int columns through
+            # the join beats carrying every column anyway.
             key_cols = []
             for t_e, s_e in equi:
                 t_vals = evaluate(t_e, target)
                 s_vals = evaluate(s_e, src)
                 key_cols.append(_coerce_join_keys(t_vals, s_vals))
+            t_idx_cols = {"__trow__": pa.array(np.arange(target.num_rows), pa.int64())}
+            s_idx_cols = {"__srow__": pa.array(np.arange(src.num_rows), pa.int64())}
             tkeys, skeys = [], []
-            t_aug, s_aug = target, src
             for i, (t_vals, s_vals) in enumerate(key_cols):
                 k = f"__k{i}__"
-                t_aug = t_aug.append_column(k, t_vals)
-                s_aug = s_aug.append_column(k, s_vals)
+                t_idx_cols[k] = t_vals
+                s_idx_cols[k] = s_vals
                 tkeys.append(k)
                 skeys.append(k)
-            joined = t_aug.join(
-                s_aug, keys=tkeys, right_keys=skeys, join_type="inner",
-                use_threads=False,
+            pairs_idx = pa.table(t_idx_cols).join(
+                pa.table(s_idx_cols), keys=tkeys, right_keys=skeys,
+                join_type="inner", use_threads=False,
             )
-            # the hash join emits one chunk per batch: defragment once here
+            t_take = pairs_idx.column("__trow__")
+            s_take = pairs_idx.column("__srow__")
+            joined = target.take(t_take)
+            s_taken = src.take(s_take)
+            for name in s_taken.column_names:
+                joined = joined.append_column(name, s_taken.column(name))
+            # take() emits one chunk per input chunk: defragment once here
             # or every downstream mask/projection/encode pays per-chunk costs
-            joined = joined.drop_columns(tkeys).combine_chunks()
+            joined = joined.combine_chunks()
         else:
             # general condition: cartesian pairing (small sources only)
             if target.num_rows * src.num_rows > 50_000_000:

@@ -11,6 +11,7 @@ per-column ``minValues``/``maxValues``/``nullCount`` + ``numRecords`` schema
 from __future__ import annotations
 
 import datetime as _dt
+import decimal as _decimal
 import json
 import math
 import os
@@ -37,6 +38,12 @@ def _stat_value(scalar: pa.Scalar, round_up: bool = False) -> Any:
         return None
     if isinstance(v, bytes):
         return None  # binary stats not representable in JSON stats
+    if isinstance(v, _decimal.Decimal):
+        # JSON can't carry exact decimals as numbers; a float conversion can
+        # shift the bound inward (wrongly pruning matching files) and an
+        # outward nudge breaks the column's scale for the V2 stats_parsed
+        # struct — absent bounds are the only always-safe encoding
+        return None
     return v
 
 

@@ -275,12 +275,39 @@ class Not(Expression):
         return f"(NOT {self.child.sql()})"
 
 
+def _parse_temporal_str(s: str, like: Any):
+    import datetime as _dt
+
+    from delta_tpu.utils.timeparse import iso_to_date, iso_to_naive_utc
+
+    if isinstance(like, _dt.datetime):
+        out = iso_to_naive_utc(s)
+        if like.tzinfo is not None:
+            out = out.replace(tzinfo=_dt.timezone.utc)  # compare as aware
+        return out
+    return iso_to_date(s)
+
+
 def _coerce_pair(l: Any, r: Any) -> Tuple[Any, Any]:
-    """Numeric cross-type comparisons; strings compare as strings."""
+    """Numeric cross-type comparisons; strings compare as strings — except
+    against dates/timestamps, where the string side parses as ISO-8601
+    (Spark's implicit cast of temporal literals)."""
+    import datetime as _dt
+
     if isinstance(l, bool) or isinstance(r, bool):
         return l, r
     if isinstance(l, (int, float)) and isinstance(r, (int, float)):
         return l, r
+    if isinstance(l, str) and isinstance(r, (_dt.datetime, _dt.date)):
+        try:
+            return _parse_temporal_str(l, r), r
+        except ValueError:
+            return l, r
+    if isinstance(r, str) and isinstance(l, (_dt.datetime, _dt.date)):
+        try:
+            return l, _parse_temporal_str(r, l)
+        except ValueError:
+            return l, r
     return l, r
 
 

@@ -16,7 +16,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 from delta_tpu.expr import ir
 from delta_tpu.expr.parser import parse_expression
 from delta_tpu.protocol.actions import AddFile, Metadata
-from delta_tpu.schema.types import StringType, StructType
+from delta_tpu.schema.types import DateType, StringType, StructType, TimestampType
 
 __all__ = [
     "typed_partition_row",
@@ -42,6 +42,19 @@ def typed_partition_row(add: AddFile, partition_schema: StructType) -> Dict[str,
             row[f.name] = None
         elif isinstance(f.data_type, StringType):
             row[f.name] = raw
+        elif isinstance(f.data_type, (DateType, TimestampType)):
+            # natural temporal objects, NOT the device epoch-int encoding —
+            # these rows feed Arrow columns (date32/timestamp) and the row
+            # evaluator, where '2024-05-01'-style literals coerce correctly
+            from delta_tpu.utils.timeparse import iso_to_date, iso_to_naive_utc
+
+            try:
+                if isinstance(f.data_type, DateType):
+                    row[f.name] = iso_to_date(raw)
+                else:
+                    row[f.name] = iso_to_naive_utc(raw)
+            except ValueError:
+                row[f.name] = None  # cast failure → NULL (Spark semantics)
         else:
             row[f.name] = ir.cast_value(raw, f.data_type)
     return row

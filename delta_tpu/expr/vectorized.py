@@ -20,6 +20,8 @@ import pyarrow.compute as pc
 
 from delta_tpu.expr import ir
 from delta_tpu.schema.types import (
+    ArrayType,
+    BinaryType,
     BooleanType,
     ByteType,
     DataType,
@@ -28,6 +30,7 @@ from delta_tpu.schema.types import (
     DoubleType,
     FloatType,
     IntegerType,
+    MapType,
     LongType,
     ShortType,
     StringType,
@@ -65,11 +68,11 @@ def arrow_type_for(dt: DataType) -> pa.DataType:
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, StructType):
         return pa.struct([pa.field(f.name, arrow_type_for(f.data_type), f.nullable) for f in dt.fields])
-    if dt.name == "binary":
+    if isinstance(dt, BinaryType):
         return pa.binary()
-    if dt.name == "array":
+    if isinstance(dt, ArrayType):
         return pa.list_(arrow_type_for(dt.element_type))
-    if dt.name == "map":
+    if isinstance(dt, MapType):
         return pa.map_(arrow_type_for(dt.key_type), arrow_type_for(dt.value_type))
     raise DeltaAnalysisError(f"No Arrow mapping for type {dt.simple_string()}")
 
@@ -106,14 +109,20 @@ def _row_fallback(expr: ir.Expression, table: pa.Table, rows=None) -> pa.Chunked
 
 
 def _numeric_coerce(l: Any, r: Any):
-    """Arrow's kernels refuse string-vs-number; mimic Spark's implicit cast."""
-    lt = l.type if isinstance(l, (pa.ChunkedArray, pa.Array)) else None
-    rt = r.type if isinstance(r, (pa.ChunkedArray, pa.Array)) else None
+    """Arrow's kernels refuse string-vs-number and string-vs-temporal;
+    mimic Spark's implicit cast of the string side."""
+    lt = getattr(l, "type", None)
+    rt = getattr(r, "type", None)
     if lt is not None and rt is not None:
         if pa.types.is_string(lt) and (pa.types.is_integer(rt) or pa.types.is_floating(rt)):
             return pc.cast(l, pa.float64(), safe=False), pc.cast(r, pa.float64(), safe=False)
         if pa.types.is_string(rt) and (pa.types.is_integer(lt) or pa.types.is_floating(lt)):
             return pc.cast(l, pa.float64(), safe=False), pc.cast(r, pa.float64(), safe=False)
+        # ISO string literals against date/timestamp columns
+        if pa.types.is_string(lt) and (pa.types.is_date(rt) or pa.types.is_timestamp(rt)):
+            return pc.cast(l, rt), r
+        if pa.types.is_string(rt) and (pa.types.is_date(lt) or pa.types.is_timestamp(lt)):
+            return l, pc.cast(r, lt)
     return l, r
 
 

@@ -336,11 +336,9 @@ def _v2_schema_and_rows(actions: Sequence[Action]):
 
             return _dt.date.fromisoformat(str(v))
         if isinstance(dt, TimestampType):
-            import datetime as _dt
+            from delta_tpu.utils.timeparse import iso_to_naive_utc
 
-            sv = str(v).replace("Z", "+00:00").replace(" ", "T")
-            out = _dt.datetime.fromisoformat(sv)
-            return out.replace(tzinfo=None) if out.tzinfo else out
+            return iso_to_naive_utc(str(v))
         if isinstance(dt, DecimalType):
             from decimal import Decimal
 

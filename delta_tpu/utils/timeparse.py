@@ -6,7 +6,27 @@ from __future__ import annotations
 
 from delta_tpu.utils.errors import DeltaAnalysisError
 
-__all__ = ["timestamp_option_to_ms"]
+__all__ = ["timestamp_option_to_ms", "iso_to_naive_utc", "iso_to_date"]
+
+
+def iso_to_naive_utc(s: str):
+    """ISO-8601 → naive datetime in UTC (the engine's timestamp convention:
+    naive values ARE UTC). 'Z' and explicit offsets convert to UTC before
+    the tzinfo is dropped — one parser for every call site."""
+    import datetime as _dt
+
+    out = _dt.datetime.fromisoformat(
+        str(s).strip().replace(" ", "T").replace("Z", "+00:00")
+    )
+    if out.tzinfo is not None:
+        out = out.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+    return out
+
+
+def iso_to_date(s: str):
+    import datetime as _dt
+
+    return _dt.date.fromisoformat(str(s).strip()[:10])
 
 
 def timestamp_option_to_ms(ts) -> int:
