@@ -301,3 +301,12 @@ class DeltaOptimizeBuilder:
         )
         cmd.run()
         return cmd.metrics
+
+    def execute_purge(self) -> Dict[str, int]:
+        """Rewrite exactly the files carrying deletion vectors, materializing
+        their deletes (modern Delta's ``REORG TABLE ... APPLY (PURGE)``)."""
+        cmd = OptimizeCommand(
+            self._target.delta_log, self._predicate, purge=True,
+        )
+        cmd.run()
+        return cmd.metrics

@@ -174,6 +174,17 @@ def Optimize(predicate: Optional[List[str]] = None, z_order_by: Optional[List[st
     return op
 
 
+def Reorg(predicate: Optional[List[str]] = None) -> Operation:
+    """REORG TABLE ... APPLY (PURGE) — distinct from OPTIMIZE in history so
+    DV-materializing rewrites are auditable."""
+    return Operation(
+        "REORG",
+        {"predicate": json.dumps(predicate or [], separators=(",", ":")),
+         "applyPurge": True},
+        OPTIMIZE_METRICS,
+    )
+
+
 def Vacuum(retention_hours: Optional[float] = None, retention_check_enabled: bool = True) -> Operation:
     return Operation(
         "VACUUM",

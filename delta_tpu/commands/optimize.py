@@ -46,6 +46,7 @@ class OptimizeCommand:
         z_order_by: Sequence[str] = (),
         min_file_size: int = DEFAULT_MIN_FILE_SIZE,
         target_rows: int = DEFAULT_TARGET_ROWS,
+        purge: bool = False,
     ):
         self.delta_log = delta_log
         self.predicate = (
@@ -54,6 +55,10 @@ class OptimizeCommand:
         self.z_order_by = list(z_order_by)
         self.min_file_size = min_file_size
         self.target_rows = target_rows
+        # purge mode (modern Delta's REORG TABLE ... APPLY (PURGE)): rewrite
+        # exactly the files carrying deletion vectors, materializing the
+        # deletes and dropping the DVs — size-based selection is bypassed
+        self.purge = purge
         self.metrics: Dict[str, int] = {}
 
     def run(self) -> int:
@@ -95,6 +100,10 @@ class OptimizeCommand:
         ):
             if self.z_order_by:
                 group = files  # Z-order rewrites every selected file
+            elif self.purge:
+                group = [f for f in files if f.deletion_vector is not None]
+                if not group:
+                    continue
             else:
                 group = [f for f in files if (f.size or 0) < self.min_file_size]
                 if len(group) < 2:
@@ -125,10 +134,13 @@ class OptimizeCommand:
             timeMs=timer.lap_ms(),
         )
         txn.report_metrics(**self.metrics)
-        op = ops.Optimize(
-            predicate=[self.predicate.sql()] if self.predicate is not None else [],
-            z_order_by=self.z_order_by or None,
-        )
+        pred_sql = [self.predicate.sql()] if self.predicate is not None else []
+        if self.purge:
+            op = ops.Reorg(predicate=pred_sql)
+        else:
+            op = ops.Optimize(
+                predicate=pred_sql, z_order_by=self.z_order_by or None,
+            )
         return txn.commit(removes + adds, op)
 
 
