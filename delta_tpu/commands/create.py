@@ -142,10 +142,14 @@ class CreateDeltaTableCommand:
             if replacing:
                 actions.extend(f.remove() for f in txn.filter_files())
             if self.data is not None and self.data.num_rows:
-                actions.extend(
-                    write_exec.write_files(
-                        log.data_path, self.data, txn.metadata, data_change=True
-                    )
+                adds = write_exec.write_files(
+                    log.data_path, self.data, txn.metadata, data_change=True
+                )
+                actions.extend(adds)
+                txn.report_metrics(
+                    numFiles=len(adds),
+                    numOutputBytes=sum(a.size or 0 for a in adds),
+                    numOutputRows=self.data.num_rows,
                 )
             if replacing:
                 op = ops.ReplaceTable(

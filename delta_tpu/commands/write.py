@@ -140,6 +140,12 @@ class WriteIntoDelta:
 
         def body(txn):
             actions = self.write(txn)
+            adds = [a for a in actions if isinstance(a, AddFile)]
+            txn.report_metrics(
+                numFiles=len(adds),
+                numOutputBytes=sum(a.size or 0 for a in adds),
+                numOutputRows=self.table.num_rows,
+            )
             op = ops.Write(
                 mode=self.mode,
                 partition_by=self.partition_columns or None,
