@@ -346,12 +346,34 @@ def bench_streaming_tail(workdir):
 
     naive_s, naive_rows = _timed(naive)
     assert naive_rows == rows_read
+
+    # CDC-tailing leg (the BASELINE config names it): the change feed of the
+    # same 1k-commit log streamed through DeltaCDFSource
+    def tail_cdf():
+        from delta_tpu.streaming.source import DeltaCDFSource
+
+        DeltaLog.clear_cache()
+        src = DeltaCDFSource(DeltaLog.for_table(path),
+                             max_files_per_trigger=100, starting_version=0)
+        off = src.initial_offset()
+        total = 0
+        while True:
+            end = src.latest_offset(off)
+            if end is None:
+                break
+            total += src.get_batch(off, end).num_rows
+            off = end
+        return total
+
+    cdf_s, cdf_rows = _timed(tail_cdf)
+    assert cdf_rows == rows_read  # append-only log: every row is an insert
     return {
         "metric": "streaming_tail_1k_commit_log",
         "value": round(n_commits / tail_s, 1),
         "unit": "commits/s",
         "vs_baseline": round(naive_s / tail_s, 2),
         "baseline": "snapshot rebuild + full rescan per micro-batch",
+        "cdf_commits_per_s": round(n_commits / cdf_s, 1),
     }
 
 

@@ -36,3 +36,4 @@ Serializable = _Serializable()
 WriteSerializable = _WriteSerializable()
 SnapshotIsolation = _SnapshotIsolation()
 ALL_LEVELS = {l.name: l for l in (Serializable, WriteSerializable, SnapshotIsolation)}
+

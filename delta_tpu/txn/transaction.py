@@ -233,14 +233,21 @@ class OptimisticTransaction:
 
                 self.register_post_commit_hook(SymlinkManifestHook())
 
-            # Isolation pick (scala:432-440): data-changing commits use
-            # WriteSerializable; rearrange-only commits can use SnapshotIsolation.
+            # Isolation pick (scala:432-440): rearrange-only commits can use
+            # SnapshotIsolation; data-changing commits use the TABLE's level
+            # (`delta.isolationLevel`, default WriteSerializable —
+            # isolationLevels.scala:75), resolved through the config registry
+            # so session-level defaults apply and only data-changing commits
+            # ever consult (and validate) the stored value.
             no_data_changed = all(
                 not a.data_change for a in actions if isinstance(a, (AddFile, RemoveFile))
             )
-            self.commit_isolation_level = (
-                isolation.SnapshotIsolation if no_data_changed else isolation.WriteSerializable
-            )
+            if no_data_changed:
+                self.commit_isolation_level = isolation.SnapshotIsolation
+            else:
+                self.commit_isolation_level = isolation.ALL_LEVELS[
+                    DeltaConfigs.ISOLATION_LEVEL.from_metadata(self.metadata)
+                ]
 
             # Blind-append detection (scala:442-447)
             only_add_files = all(
@@ -337,10 +344,19 @@ class OptimisticTransaction:
                         f"table's partitioning schema: {sorted(a.partition_values)} vs {sorted(pcols)}"
                     )
 
-        # Append-only enforcement (scala:575-576)
+        # Append-only enforcement (scala:575-576). A deletion-vector re-add
+        # logically deletes rows too — refuse it like a remove (first commit
+        # exempt: a table may be CREATED/CLONED with pre-existing DVs).
         if DeltaConfigs.IS_APPEND_ONLY.from_metadata(current_metadata):
             for a in actions:
                 if isinstance(a, RemoveFile) and a.data_change:
+                    raise errors.modify_append_only_table()
+                if (
+                    self.read_version >= 0
+                    and isinstance(a, AddFile)
+                    and a.data_change
+                    and a.deletion_vector is not None
+                ):
                     raise errors.modify_append_only_table()
 
         # Protocol write gate for the (possibly updated) protocol

@@ -244,6 +244,12 @@ class DeltaConfigs:
         "delta.appendOnly", "false", _bool,
         help="When true, deletes/updates are rejected (protocol writer v2 feature).",
     )
+    ISOLATION_LEVEL = DeltaConfig(
+        "delta.isolationLevel", "WriteSerializable", str,
+        lambda v: v in ("Serializable", "WriteSerializable"),
+        help="Write isolation for data-changing commits "
+             "(isolationLevels.scala:27-91).",
+    )
     ENABLE_DELETION_VECTORS = DeltaConfig(
         "delta.tpu.enableDeletionVectors", "false", _bool,
         help="DML marks deleted rows in per-file deletion vectors instead of "

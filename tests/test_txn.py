@@ -285,3 +285,119 @@ class TestConcurrentThreads:
             t.join()
         assert sorted(results) == list(range(1, 9))
         assert len(log.update().all_files) == 8
+
+
+class TestConflictMatrixDepth:
+    """Further block/allow cases toward OptimisticTransactionSuite's ~25."""
+
+    def test_serializable_table_blocks_even_blind_append_vs_read(self, tmp_table):
+        """delta.isolationLevel=Serializable: blind appends DO conflict with
+        reads (vs WriteSerializable's exemption, isolationLevels.scala)."""
+        log = create_table(
+            tmp_table, configuration={"delta.isolationLevel": "Serializable"}
+        )
+        a = log.start_transaction()
+        a.filter_files()
+        log.start_transaction().commit([add("b1")], ops.Write("Append"))  # blind
+        with pytest.raises(errors.ConcurrentAppendException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_invalid_isolation_level_property_rejected(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        with pytest.raises(errors.DeltaIllegalArgumentError):
+            a.update_metadata(init_metadata(
+                configuration={"delta.isolationLevel": "ReadCommitted"}
+            ))
+
+    def test_unread_set_transaction_no_conflict(self, tmp_table):
+        from delta_tpu.protocol.actions import SetTransaction
+
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        a.txn_version("app-A")  # reads only app-A
+        log.start_transaction().commit(
+            [SetTransaction("app-B", 7)], ops.StreamingUpdate("Append", "app-B", 7)
+        )
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 2
+
+    def test_winner_removes_unread_file_no_conflict(self, tmp_table):
+        log = create_table(tmp_table, partitioned=True)
+        log.start_transaction().commit([add("fx", part="x")], ops.Write("Append"))
+        log.start_transaction().commit([add("fy", part="y")], ops.Write("Append"))
+        a = log.start_transaction()
+        a.filter_files(["part = 'y'"])  # reads only partition y
+        # winner deletes the x file A never read
+        b = log.start_transaction()
+        b.commit([AddFile("fx", {"part": "x"}, 1, 1, True).remove()],
+                 ops.Delete(["part = 'x'"]))
+        v = a.commit([add("a1", part="y")], ops.Write("Append"))
+        assert v == 4
+
+    def test_commit_info_only_winner_no_conflict(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        a.filter_files()
+        log.start_transaction().commit([], ops.ManualUpdate())  # empty commit
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 2
+
+    def test_dv_readds_of_same_file_conflict(self, tmp_table):
+        """Two transactions DV-marking the same file: both stage remove+
+        re-add of one path — delete/delete conflict, never a lost update."""
+        log = create_table(tmp_table)
+        f = add("shared")
+        log.start_transaction().commit([f], ops.Write("Append"))
+        dv1 = {"storageType": "i", "pathOrInlineDv": "p1", "sizeInBytes": 1,
+               "cardinality": 1}
+        dv2 = {"storageType": "i", "pathOrInlineDv": "p2", "sizeInBytes": 1,
+               "cardinality": 2}
+        from dataclasses import replace as _replace
+
+        a = log.start_transaction()
+        a.filter_files()
+        b = log.start_transaction()
+        b.filter_files()
+        b.commit([f.remove(), _replace(f, deletion_vector=dv2)],
+                 ops.Delete([]))
+        with pytest.raises(errors.DeltaConcurrentModificationException):
+            a.commit([f.remove(), _replace(f, deletion_vector=dv1)],
+                     ops.Delete([]))
+
+    def test_losing_txn_retries_past_multiple_winners(self, tmp_table):
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        for i in range(3):
+            log.start_transaction().commit([add(f"w{i}")], ops.Write("Append"))
+        v = a.commit([add("a1")], ops.Write("Append"))
+        assert v == 4
+        assert len(log.update().all_files) == 4
+
+    def test_protocol_upgrade_winner_blocks_everyone(self, tmp_table):
+        from delta_tpu.protocol.actions import Protocol
+
+        log = create_table(tmp_table)
+        a = log.start_transaction()
+        log.start_transaction().commit(
+            [Protocol(1, 3)], ops.UpgradeProtocol(Protocol(1, 3))
+        )
+        with pytest.raises(errors.ProtocolChangedException):
+            a.commit([add("a1")], ops.Write("Append"))
+
+    def test_append_only_table_rejects_dv_readd_as_delete(self, tmp_table):
+        """A DV re-add logically deletes rows — appendOnly must refuse it
+        even WITHOUT a staged remove (the remove-based check alone would
+        miss a bare add-with-DV)."""
+        log = create_table(
+            tmp_table, configuration={"delta.appendOnly": "true"}
+        )
+        f = add("f1")
+        log.start_transaction().commit([f], ops.Write("Append"))
+        from dataclasses import replace as _replace
+
+        dv = {"storageType": "i", "pathOrInlineDv": "p", "sizeInBytes": 1,
+              "cardinality": 1}
+        a = log.start_transaction()
+        with pytest.raises(errors.DeltaUnsupportedOperationError):
+            a.commit([_replace(f, deletion_vector=dv)], ops.Delete([]))
