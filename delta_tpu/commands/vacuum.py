@@ -162,9 +162,14 @@ class VacuumCommand:
             except FileNotFoundError:
                 pass
 
-        if to_delete:
+        # multi-host fan-out (§2.8 distributed GC): each process deletes
+        # its strided slice of the candidates; single-host = identity
+        from delta_tpu.parallel.distributed import host_partition
+
+        my_deletes = host_partition(sorted(to_delete))
+        if my_deletes:
             with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
-                list(pool.map(rm, to_delete))
+                list(pool.map(rm, my_deletes))
 
         # drop now-empty partition dirs (deepest first)
         dirs_deleted = 0

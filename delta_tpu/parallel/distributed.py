@@ -1,0 +1,99 @@
+"""Multi-host coordination: DCN-level fan-out around the ICI mesh.
+
+SURVEY §2.8's distribution model, made explicit. The reference's data plane
+fans out over Spark executors with driver⇄executor RPC; here the equivalent
+split is:
+
+* **intra-slice (ICI)** — `jax.lax` collectives under `shard_map` over the
+  device mesh (`parallel/mesh.py`): the replay, join, and skipping kernels.
+* **inter-host (DCN)** — `jax.distributed` + the deterministic per-host
+  work partitioner below: every host computes the same strided assignment
+  with no RPC. Wired today into VACUUM's delete fan-out
+  (`commands/vacuum.py` — each host removes its slice, the reference's
+  distributed GC); other host-IO loops can adopt :func:`host_partition`
+  the same way when launched multi-process.
+* **control plane** — unchanged from single-host: commits still serialize
+  through the LogStore's atomic create, which is host-agnostic. There is
+  deliberately no lock service (the reference's stance,
+  `storage/LogStore.scala:30-43`).
+
+On a single host every function degrades to a no-op/identity, so the same
+program runs unchanged from a laptop to a multi-host slice.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = [
+    "initialize",
+    "process_info",
+    "host_partition",
+    "host_shard_indices",
+]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Join the multi-host runtime; returns (process_id, num_processes).
+
+    With explicit arguments they are passed through. With none,
+    `jax.distributed.initialize()` is attempted bare so its cluster
+    AUTO-DETECTION (Cloud TPU metadata, SLURM, GKE) still applies; when no
+    cluster environment is detected this degrades to single-host (0, 1)
+    instead of raising — safe to call unconditionally at engine startup.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        if coordinator_address is not None or num_processes not in (None, 1):
+            raise  # explicitly-requested cluster must not silently degrade
+        return 0, 1
+    return jax.process_index(), jax.process_count()
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count) of the current runtime — (0, 1) when
+    no multi-host runtime was initialized."""
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:  # backend not initialized yet
+        return 0, 1
+
+
+def host_shard_indices(n_items: int, index: Optional[int] = None,
+                       count: Optional[int] = None) -> List[int]:
+    """This host's item positions in a global work list.
+
+    Deterministic strided partition: host i takes items i, i+n, i+2n, … —
+    every host computes the same assignment with no RPC, the DCN-free
+    analogue of the reference's driver→executor task scheduling. Striding
+    (rather than contiguous blocks) balances size-skewed file lists.
+
+    ``index``/``count`` must be given together (or neither, to use the
+    runtime's process info).
+    """
+    if (index is None) != (count is None):
+        raise ValueError("host partitioning needs both index and count (or neither)")
+    if index is None:
+        index, count = process_info()
+    if count <= 1:
+        return list(range(n_items))
+    return list(range(index, n_items, count))
+
+
+def host_partition(items: Sequence, index: Optional[int] = None,
+                   count: Optional[int] = None) -> List:
+    """This host's slice of a global work list (see
+    :func:`host_shard_indices` for the assignment rule)."""
+    return [items[j] for j in host_shard_indices(len(items), index, count)]

@@ -131,3 +131,75 @@ def test_host_join_fallback_when_no_sentinel_room():
     # with a budget the caller's fallback is preferred
     with _with_link(6.0, 4.0):
         assert join_kernel.inner_join_async(t, t_ok, s, s_ok, budget_s=100.0) is None
+
+
+# -- multi-host fan-out helpers (parallel/distributed.py) --------------------
+
+
+def test_distributed_single_host_noop():
+    from delta_tpu.parallel import distributed
+
+    pid, n = distributed.initialize()
+    assert (pid, n) == (0, 1)
+    assert distributed.process_info()[1] >= 1
+
+
+def test_host_partition_strided_and_complete():
+    from delta_tpu.parallel.distributed import host_partition, host_shard_indices
+
+    items = [f"f{i}" for i in range(10)]
+    parts = [host_partition(items, index=i, count=3) for i in range(3)]
+    # disjoint and complete
+    flat = [x for p in parts for x in p]
+    assert sorted(flat) == sorted(items)
+    assert len(set(flat)) == len(items)
+    # strided: host 0 gets 0,3,6,9
+    assert parts[0] == ["f0", "f3", "f6", "f9"]
+    # indices line up with the selection
+    assert [items[j] for j in host_shard_indices(10, index=1, count=3)] == parts[1]
+
+
+def test_host_partition_single_host_identity():
+    from delta_tpu.parallel.distributed import host_partition
+
+    items = list(range(5))
+    assert host_partition(items, index=0, count=1) == items
+
+
+def test_host_partition_rejects_half_specified_args():
+    import pytest
+
+    from delta_tpu.parallel.distributed import host_partition
+
+    with pytest.raises(ValueError):
+        host_partition([1, 2, 3], count=4)
+    with pytest.raises(ValueError):
+        host_partition([1, 2, 3], index=1)
+
+
+def test_vacuum_deletes_only_this_hosts_slice(tmp_table, monkeypatch):
+    """Vacuum's delete fan-out partitions candidates per process: a
+    simulated 2-process runtime deletes only the strided half."""
+    import os as _os
+    import time as _time
+
+    import pyarrow as pa
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.log.deltalog import DeltaLog
+    from delta_tpu.parallel import distributed
+
+    now = [int(_time.time() * 1000)]
+    DeltaLog.clear_cache()
+    DeltaLog.for_table(tmp_table, clock=lambda: now[0])
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"x": pa.array([1], pa.int64())})
+    )
+    for i in range(4):
+        with open(_os.path.join(tmp_table, f"junk{i}.parquet"), "wb") as f:
+            f.write(b"z")
+    now[0] += 14 * 24 * 3_600_000
+    monkeypatch.setattr(distributed, "process_info", lambda: (0, 2))
+    r = t.vacuum()
+    remaining = [f for f in _os.listdir(tmp_table) if f.startswith("junk")]
+    assert len(remaining) == 2, "host 0 of 2 must delete exactly its half"
