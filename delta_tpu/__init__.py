@@ -14,4 +14,25 @@ __version__ = "0.1.0"
 from delta_tpu.log.deltalog import DeltaLog  # noqa: F401
 from delta_tpu.utils.config import conf  # noqa: F401
 
-__all__ = ["DeltaLog", "conf", "__version__"]
+
+def __getattr__(name):
+    # Lazy top-level conveniences: `from delta_tpu import DeltaTable`
+    # without paying the command/executor module imports at package-import
+    # time. (The log kernel itself — and its pyarrow dependency — loads
+    # eagerly via DeltaLog above; this defers only the data-plane glue.)
+    if name == "DeltaTable":
+        from delta_tpu.api.tables import DeltaTable
+
+        return DeltaTable
+    if name == "execute_sql":
+        from delta_tpu.sql.parser import execute_sql
+
+        return execute_sql
+    raise AttributeError(f"module 'delta_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))
+
+
+__all__ = ["DeltaLog", "DeltaTable", "conf", "execute_sql", "__version__"]
