@@ -669,3 +669,39 @@ def test_merge_device_multimatch_delete_metrics_parity(tmp_path):
     assert dev_rows == host_rows == [{"id": 2, "v": 20}]
     assert dev_m == host_m
     assert dev_m["numTargetRowsDeleted"] == 1
+
+
+def test_merge_device_composite_key_parity(tmp_path):
+    """Two-column equi-key: the device kernel packs both int32-fitting
+    components into one int64 lane (hi<<32 | lo) — results must match the
+    host hash join exactly, including negative components."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    n_t = 300
+    k1 = rng.randint(-50, 50, n_t)
+    k2 = rng.randint(0, 40, n_t)
+    target = {
+        "a": k1.tolist(),
+        "b": k2.tolist(),
+        "v": rng.randint(0, 1000, n_t).tolist(),
+    }
+    # source: unique composite keys, half overlapping the target domain
+    pairs = {(int(a), int(b)) for a, b in zip(k1[:60], k2[:60])}
+    pairs |= {(999 + i, 999 + i) for i in range(40)}
+    src_a, src_b = zip(*sorted(pairs))
+    source = pa.table({
+        "a": pa.array(src_a, pa.int64()),
+        "b": pa.array(src_b, pa.int64()),
+        "v": pa.array([5000 + i for i in range(len(src_a))], pa.int64()),
+    })
+    (dev_rows, dev_m), (host_rows, host_m) = _run_merge_both_paths(
+        tmp_path, "composite", target, source,
+        "t.a = s.a AND t.b = s.b",
+        matched=[MergeClause("update", assignments=None)],
+        not_matched=[MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    assert dev_rows == host_rows
+    assert dev_m == host_m
+    assert dev_m["numTargetRowsInserted"] >= 40
