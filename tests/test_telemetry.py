@@ -1,0 +1,71 @@
+"""Usage-logging telemetry (SURVEY §5; ``metering/DeltaLogging.scala:50-109``):
+hierarchical opTypes, the real ring-buffer backend, duration/error capture,
+and the engine wiring (commits emit ``delta.commit`` events).
+"""
+import json
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_buffer():
+    telemetry.clear_events()
+    yield
+    telemetry.clear_events()
+
+
+def test_record_event_and_query_by_prefix():
+    telemetry.record_event("delta.test.alpha", {"n": 1}, path="/t")
+    telemetry.record_event("delta.test.beta", {"n": 2})
+    telemetry.record_event("other.op")
+    got = telemetry.recent_events("delta.test")
+    assert [e.op_type for e in got] == ["delta.test.alpha", "delta.test.beta"]
+    assert got[0].tags == {"path": "/t"}
+    assert got[0].data == {"n": 1}
+
+
+def test_record_operation_captures_duration():
+    with telemetry.record_operation("delta.test.op") as ev:
+        pass
+    [got] = telemetry.recent_events("delta.test.op")
+    assert got is ev
+    assert got.duration_ms is not None and got.duration_ms >= 0
+    assert got.error is None
+
+
+def test_record_operation_captures_error_and_reraises():
+    with pytest.raises(ValueError):
+        with telemetry.record_operation("delta.test.boom"):
+            raise ValueError("kapow")
+    [got] = telemetry.recent_events("delta.test.boom")
+    assert got.error and "kapow" in got.error
+
+
+def test_event_json_round_trips():
+    telemetry.record_event("delta.test.json", {"k": [1, 2]}, table="x")
+    [ev] = telemetry.recent_events("delta.test.json")
+    d = json.loads(ev.to_json())
+    assert d["opType"] == "delta.test.json"
+    assert d["data"] == {"k": [1, 2]}
+
+
+def test_commits_emit_usage_events(tmp_table):
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([1], pa.int64())})
+    )
+    t.delete("id = 1")
+    commits = telemetry.recent_events("delta.commit")
+    assert len(commits) >= 2  # create + delete
+    assert all(e.duration_ms is not None for e in commits)
+    assert all(e.tags.get("path") == tmp_table for e in commits)
+
+
+def test_ring_buffer_bounded():
+    for i in range(5000):
+        telemetry.record_event("delta.test.flood")
+    # deque(maxlen=4096): exactly full — also catches silent non-recording
+    assert len(telemetry.recent_events()) == 4096
