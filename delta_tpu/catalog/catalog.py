@@ -110,30 +110,55 @@ class Catalog:
         from delta_tpu.api.tables import DeltaTable
 
         key = _normalize(name)
-        # Pre-check under the lock, run the (possibly long) CTAS/create
-        # outside it so unrelated catalog operations aren't serialized behind
-        # data writes, then re-check + register in a second critical section.
+        abs_path = os.path.abspath(path)
+        # Claim the name inside the first critical section, then run the
+        # (possibly long) CTAS/create outside the lock so unrelated catalog
+        # operations aren't serialized behind data writes. A concurrent
+        # creator of the same name now fails BEFORE materializing any data
+        # (no orphan table directory); if our create fails, roll the claim
+        # back so the name isn't left dangling.
+        from delta_tpu.api.tables import DeltaTable as _DT
+
         with self._lock, self._file_lock():
             if self._store_path:
                 self._load()
-            if self._tables.get(key) is not None and mode == "create":
-                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
-        table = DeltaTable.create(
-            path, schema, partition_columns, configuration, data, mode=mode
-        )
-        with self._lock, self._file_lock():
-            if self._store_path:
-                self._load()
-            existing = self._tables.get(key)
-            if (existing is not None and mode == "create"
-                    and existing != os.path.abspath(path)):
-                raise DeltaAnalysisError(
-                    f"Table {name!r} was registered concurrently (at "
-                    f"{existing}). The table data created at {path} was NOT "
-                    "registered; remove it or register it under another name."
-                )
-            self._tables[key] = os.path.abspath(path)
-            self._save()
+            prior = self._tables.get(key)
+            if prior is not None and mode == "create":
+                # a claim whose creator crashed mid-create (no table behind
+                # the registered path) is stale — reclaimable, not an error
+                if _DT.is_delta_table(prior):
+                    raise DeltaAnalysisError(
+                        f"Table {name!r} already exists in catalog"
+                    )
+                prior = None
+            claimed = prior is None
+            if claimed:
+                # claim an unregistered name now, so a losing concurrent
+                # creator fails before materializing data; until the create
+                # commits, readers of this name see a claim, not a table. A
+                # replace of an EXISTING registration keeps pointing at the
+                # old location until the create succeeds.
+                self._tables[key] = abs_path
+                self._save()
+        try:
+            table = DeltaTable.create(
+                path, schema, partition_columns, configuration, data, mode=mode
+            )
+        except BaseException:
+            if claimed:
+                with self._lock, self._file_lock():
+                    if self._store_path:
+                        self._load()
+                    if self._tables.get(key) == abs_path:
+                        self._tables.pop(key, None)
+                        self._save()
+            raise
+        if not claimed:
+            with self._lock, self._file_lock():
+                if self._store_path:
+                    self._load()
+                self._tables[key] = abs_path
+                self._save()
         return table
 
     def drop_table(self, name: str) -> None:

@@ -327,10 +327,6 @@ class MergeIntoCommand:
             # matches are harmless (reference fast path, `:397-450`)
             self._check_multi_match(matched_pairs)
 
-        touched_ids = set()
-        if matched_pairs.num_rows:
-            touched_ids = set(pc.unique(matched_pairs.column(_FID)).to_pylist())
-
         removes: List[Action] = []
         dv_adds: List[Action] = []
         out_blocks: List[pa.Table] = []
@@ -339,7 +335,7 @@ class MergeIntoCommand:
 
         if not insert_only:
             # matched block → per-clause masks
-            upd, n_updated, n_deleted, n_pair_copied, claimed_tbl = (
+            upd, n_updated, n_deleted, n_pair_copied, claimed_tbl, fired_fids = (
                 self._apply_matched(
                     matched_pairs, target_cols, metadata, dv_mode=use_dv
                 )
@@ -366,7 +362,7 @@ class MergeIntoCommand:
                         if re_add is not None:
                             dv_adds.append(re_add)
             else:
-                for fid in sorted(touched_ids):
+                for fid in sorted(fired_fids):
                     removes.append(candidates[fid].remove())
                 # unmatched target rows inside touched files → copy. _TID is
                 # the global row index over the candidate concat, so one
@@ -379,7 +375,7 @@ class MergeIntoCommand:
                 for fid in sorted(tgt_tables):
                     starts[fid] = row_start
                     row_start += tgt_tables[fid].num_rows
-                for fid in sorted(touched_ids):
+                for fid in sorted(fired_fids):
                     t = tgt_tables[fid]
                     keep = ~claimed[starts[fid]: starts[fid] + t.num_rows]
                     if not keep.all():
@@ -826,7 +822,7 @@ class MergeIntoCommand:
         the 5th return value is a (file id, physical position) table of the
         claimed rows for deletion-vector marking."""
         if pairs.num_rows == 0 or not self.matched_clauses:
-            return None, 0, 0, 0, None
+            return None, 0, 0, 0, None, set()
         n = pairs.num_rows
         unclaimed = pa.chunked_array([pa.array([True] * n)])
         out_parts: List[pa.Table] = []
@@ -868,17 +864,35 @@ class MergeIntoCommand:
                     # reference's numTargetRowsDeleted is rows deleted
                     n_deleted += pc.count_distinct(block.column(_TID)).as_py()
             unclaimed = pc.and_(unclaimed, pc.invert(fire))
+        claimed_pairs = pairs.filter(pc.invert(unclaimed))
+        # files with at least one FIRED row: only these are rewritten. A file
+        # whose matches all fall through every clause condition stays in place
+        # untouched — rewriting it would commit a remove+add with
+        # dataChange=true and make CDF reconstruct delete+insert change rows
+        # for rows that never logically changed.
+        fired_fids: set = (
+            set(pc.unique(claimed_pairs.column(_FID)).to_pylist())
+            if claimed_pairs.num_rows else set()
+        )
         claimed_tbl = None
         if dv_mode:
             # claimed rows get marked deleted in-place; unclaimed matched
             # pairs stay live in their files — nothing is copied
-            claimed_tbl = pairs.filter(pc.invert(unclaimed)).select(
-                [_FID, POSITION_COL]
-            )
+            claimed_tbl = claimed_pairs.select([_FID, POSITION_COL])
             n_rest = 0
         else:
-            # unclaimed matched pairs: copy target row unchanged
+            # unclaimed matched pairs: copy target row unchanged — but only
+            # out of files actually being rewritten (fired_fids)
             rest = pairs.filter(unclaimed)
+            if rest.num_rows:
+                if fired_fids:
+                    keep = pc.is_in(
+                        rest.column(_FID),
+                        value_set=pa.array(sorted(fired_fids), pa.int64()),
+                    )
+                    rest = rest.filter(keep)
+                else:
+                    rest = rest.slice(0, 0)
             if rest.num_rows:
                 out_parts.append(rest.select(target_cols))
             n_rest = rest.num_rows
@@ -887,7 +901,7 @@ class MergeIntoCommand:
             if out_parts
             else None
         )
-        return out, n_updated, n_deleted, n_rest, claimed_tbl
+        return out, n_updated, n_deleted, n_rest, claimed_tbl, fired_fids
 
     def _resolve_in_pairs(self, e: ir.Expression, pairs: pa.Table) -> ir.Expression:
         src_cols = [c[len(_SRC):] for c in pairs.column_names if c.startswith(_SRC)]

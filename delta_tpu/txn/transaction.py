@@ -198,6 +198,15 @@ class OptimisticTransaction:
         if (new_reader, new_writer) != (cur.min_reader_version, cur.min_writer_version):
             rf, wf = _features((new_reader, new_writer))
             return Protocol(new_reader, new_writer, rf, wf)
+        # Versions unchanged (e.g. table already pinned at (3,7)) but the
+        # required feature set adds names the table doesn't declare yet:
+        # still emit a Protocol action, or DV files would be committed with
+        # the feature undeclared and foreign engines wouldn't refuse cleanly.
+        if feature_names:
+            rf, wf = _features((new_reader, new_writer))
+            if (set(rf or ()) - set(cur.reader_features or ())
+                    or set(wf or ()) - set(cur.writer_features or ())):
+                return Protocol(new_reader, new_writer, rf, wf)
         return self.new_protocol
 
     # -- reads -----------------------------------------------------------

@@ -40,12 +40,10 @@ def timestamp_option_to_ms(ts) -> int:
     import datetime as _dt
 
     try:
-        out = _dt.datetime.fromisoformat(s.replace(" ", "T"))
+        out = iso_to_naive_utc(s)
     except ValueError as e:
         raise DeltaAnalysisError(
             f"Invalid timestamp {ts!r}: expected epoch milliseconds or "
             f"ISO-8601 (e.g. '2024-05-01 12:00:00'): {e}"
         )
-    if out.tzinfo is None:
-        out = out.replace(tzinfo=_dt.timezone.utc)
-    return int(out.timestamp() * 1000)
+    return int(out.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)

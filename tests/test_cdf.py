@@ -94,6 +94,39 @@ def test_merge_captures_all_change_kinds(tmp_table):
     assert [r["value"] for r in rows["update_postimage"]] == ["U2"]
 
 
+def test_merge_skips_files_with_no_fired_clause(tmp_table):
+    """A file whose matched rows all fall through every clause condition is
+    left in place: no remove+add rewrite, and no spurious delete+insert
+    change rows for rows that never logically changed."""
+    t = make_table(tmp_table, n=5)
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    WriteIntoDelta(t.delta_log, "append", pa.table({
+        "id": pa.array(range(1000, 1005), pa.int64()),
+        "value": pa.array([f"w{i}" for i in range(5)]),
+    })).run()
+    files_before = {f.path for f in t.delta_log.update().all_files}
+    # id=2 (first file): update fires; id=1000 (second file): matched but
+    # the clause condition is false — second file must stay untouched
+    src = pa.table({"id": pa.array([2, 1000], pa.int64()),
+                    "value": pa.array(["U2", "NOOP"])})
+    (t.alias("t").merge(src, "t.id = s.id", source_alias="s")
+       .when_matched_update_all("s.value != 'NOOP'")
+       .execute())
+    files_after = {f.path for f in t.delta_log.update().all_files}
+    # the second file survives the merge verbatim
+    second = [p for p in files_before if p in files_after]
+    assert len(second) == 1
+    rows = by_type(changes(t, 2))
+    assert [r["id"] for r in rows["update_preimage"]] == [2]
+    assert [r["id"] for r in rows["update_postimage"]] == [2]
+    assert "insert" not in rows and "delete" not in rows
+    # table contents intact
+    got = t.to_arrow()
+    vals = dict(zip(got.column("id").to_pylist(), got.column("value").to_pylist()))
+    assert vals[2] == "U2" and vals[1000] == "w0" and got.num_rows == 10
+
+
 def test_append_reconstructed_without_cdc_files(tmp_table):
     t = make_table(tmp_table, n=2)
     WriteIntoDelta(t.delta_log, "append",

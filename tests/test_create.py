@@ -134,6 +134,38 @@ def test_catalog_duplicate_name_errors(tmp_path):
         cat.create_table("t", str(tmp_path / "b"), SCHEMA)
 
 
+def test_catalog_losing_creator_fails_before_writing_data(tmp_path):
+    """The name is claimed in the first critical section, so a concurrent
+    create of the same name fails BEFORE materializing any table data —
+    no orphan directory is left behind (DeltaCatalog's staged-create
+    atomicity, `DeltaCatalog.scala:329-403`)."""
+    import os
+
+    from delta_tpu.catalog.catalog import Catalog
+
+    cat = Catalog()
+    cat.create_table("t", str(tmp_path / "a"), SCHEMA)
+    loser = str(tmp_path / "b")
+    with pytest.raises(DeltaAnalysisError, match="already exists"):
+        cat.create_table("t", loser, SCHEMA)
+    assert not os.path.exists(loser), "losing creator must not write data"
+    assert cat.table_path("t") == str(tmp_path / "a")
+
+
+def test_catalog_failed_create_rolls_back_claim(tmp_path):
+    """If the create itself fails after the name was claimed, the claim is
+    rolled back so the name isn't left dangling at a nonexistent table."""
+    from delta_tpu.catalog.catalog import Catalog
+
+    cat = Catalog()
+    with pytest.raises(Exception):
+        cat.create_table("bad", str(tmp_path / "bad"), schema=None, data=None)
+    assert not cat.table_exists("bad")
+    # the name is reusable afterwards
+    cat.create_table("bad", str(tmp_path / "ok"), SCHEMA)
+    assert cat.table_exists("bad")
+
+
 def test_catalog_persistence(tmp_path):
     from delta_tpu.catalog.catalog import Catalog
 

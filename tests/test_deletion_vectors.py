@@ -314,6 +314,30 @@ def test_non_dv_table_keeps_default_protocol(tmp_table):
     assert p.min_reader_version == 1
 
 
+def test_enabling_dv_on_pinned_3_7_declares_feature(tmp_table):
+    """A table already AT (3,7) (pinned versions, no DV) must still get a
+    Protocol action declaring tpu.deletionVectors when DVs are enabled —
+    version comparison alone would skip it and commit undeclared DV files."""
+    data = pa.table({"id": pa.array(range(10), pa.int64()),
+                     "value": pa.array([f"v{i}" for i in range(10)])})
+    t = DeltaTable.create(tmp_table, data=data, configuration={
+        "delta.minReaderVersion": "3", "delta.minWriterVersion": "7",
+    })
+    p0 = t.delta_log.update().protocol
+    assert (p0.min_reader_version, p0.min_writer_version) == (3, 7)
+    assert "tpu.deletionVectors" not in (p0.reader_features or ())
+
+    from delta_tpu.commands.alter import set_table_properties
+
+    set_table_properties(t.delta_log, DV_PROPS)
+    p = t.delta_log.update().protocol
+    assert (p.min_reader_version, p.min_writer_version) == (3, 7)
+    assert "tpu.deletionVectors" in (p.reader_features or ())
+    assert "tpu.deletionVectors" in (p.writer_features or ())
+    t.delete("id < 3")
+    assert any(x.deletion_vector for x in t.delta_log.update().all_files)
+
+
 def test_enabling_dv_later_bumps_protocol(tmp_table):
     t = make_table(tmp_table, dv=False)
     from delta_tpu.commands.alter import set_table_properties

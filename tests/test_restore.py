@@ -118,6 +118,34 @@ def test_restore_past_vacuum_fails_cleanly(tmp_table):
     assert t.version == 1
 
 
+def test_restore_with_missing_dv_sidecar_fails_cleanly(tmp_table, monkeypatch):
+    """The target version's AddFile can reference a deletion-vector sidecar
+    ('u' storage) that cleanup already deleted even though the data file
+    survives; the restore pre-check must catch the missing sidecar, not
+    commit a state whose scans crash with a raw FileNotFoundError."""
+    import glob
+
+    from delta_tpu.protocol import deletion_vectors as dv_mod
+
+    monkeypatch.setattr(dv_mod, "INLINE_THRESHOLD_BYTES", -1)  # force sidecar
+    t = DeltaTable.create(
+        tmp_table,
+        data=pa.table({"id": pa.array(range(100), pa.int64()),
+                       "v": pa.array([f"a{i}" for i in range(100)])}),
+        configuration={"delta.tpu.enableDeletionVectors": "true"},
+    )
+    t.delete("id < 10")          # v1: DV sidecar on the file
+    target = t.delta_log.update()
+    dv_files = [f for f in target.all_files if f.deletion_vector]
+    assert dv_files and dv_files[0].deletion_vector["storageType"] == "u"
+    t.optimize().execute_purge()  # v2: rewrites, drops the DV reference
+    for p in glob.glob(os.path.join(tmp_table, "deletion_vector*")):
+        os.remove(p)             # the sidecar is gone, the data file is not
+    with pytest.raises(DeltaIllegalStateError, match="deletion-vector"):
+        t.restore_to_version(1)
+    assert t.version == 2
+
+
 def test_restore_by_timestamp(tmp_table):
     from delta_tpu.protocol import filenames
 

@@ -300,3 +300,25 @@ def test_get_changes_tailing(tmp_table):
     assert any(
         getattr(a, "path", None) == "f-3" for _, acts in changes for a in acts
     )
+
+
+def test_timestamp_option_parsing_forms():
+    """One parser for every timestamp option surface: epoch ms, ISO-8601
+    naive (= UTC), explicit offsets, and the 'Z' suffix (normalized before
+    fromisoformat, which only accepts 'Z' natively from Python 3.11)."""
+    from delta_tpu.utils.timeparse import timestamp_option_to_ms
+
+    base = 1_714_564_800_000  # 2024-05-01T12:00:00Z
+    assert timestamp_option_to_ms(base) == base
+    assert timestamp_option_to_ms(str(base)) == base
+    assert timestamp_option_to_ms("2024-05-01 12:00:00") == base
+    assert timestamp_option_to_ms("2024-05-01T12:00:00Z") == base
+    assert timestamp_option_to_ms("2024-05-01T14:00:00+02:00") == base
+    import pytest
+
+    from delta_tpu.utils.errors import DeltaAnalysisError
+
+    with pytest.raises(DeltaAnalysisError):
+        timestamp_option_to_ms("not-a-time")
+    with pytest.raises(DeltaAnalysisError):
+        timestamp_option_to_ms(True)
