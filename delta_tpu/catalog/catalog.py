@@ -41,6 +41,9 @@ class Catalog:
     def __init__(self, store_path: Optional[str] = None):
         self._store_path = store_path
         self._tables: Dict[str, str] = {}
+        # in-flight CREATE claims: name → {path, pid, host, ts_ms}. Kept out
+        # of ``_tables`` so lookups never resolve a half-created table.
+        self._claims: Dict[str, Dict] = {}
         self._lock = threading.RLock()
         if store_path and os.path.exists(store_path):
             self._load()
@@ -77,8 +80,10 @@ class Catalog:
             with open(self._store_path) as f:
                 data = json.load(f)
             self._tables = dict(data.get("tables", {}))
+            self._claims = dict(data.get("claims", {}))
         except (OSError, json.JSONDecodeError):
             self._tables = {}
+            self._claims = {}
 
     def _save(self) -> None:
         if not self._store_path:
@@ -86,8 +91,38 @@ class Catalog:
         os.makedirs(os.path.dirname(self._store_path) or ".", exist_ok=True)
         tmp = self._store_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"tables": self._tables}, f, indent=1, sort_keys=True)
+            json.dump({"tables": self._tables, "claims": self._claims},
+                      f, indent=1, sort_keys=True)
         os.replace(tmp, self._store_path)
+
+    def _claim_is_live(self, claim: Dict) -> bool:
+        """Is an in-flight CREATE claim still owned by a live creator?
+
+        Same-host claims are checked by pid liveness; foreign-host claims (a
+        shared store on network storage) fall back to an age bound
+        (``delta.tpu.catalog.claimTimeoutMs``) — a creator that takes longer
+        forfeits the name."""
+        import socket
+        import time
+
+        timeout_ms = int(conf.get("delta.tpu.catalog.claimTimeoutMs", 600_000))
+        if claim.get("host") == socket.gethostname():
+            pid = claim.get("pid")
+            if pid == os.getpid():
+                return True
+            try:
+                os.kill(int(pid), 0)
+                return True
+            except (OSError, TypeError, ValueError):
+                return False
+        return (time.time() * 1000 - claim.get("ts_ms", 0)) < timeout_ms
+
+    def _new_claim(self, path: str) -> Dict:
+        import socket
+        import time
+
+        return {"path": path, "pid": os.getpid(),
+                "host": socket.gethostname(), "ts_ms": int(time.time() * 1000)}
 
     # -- registry ---------------------------------------------------------
 
@@ -99,6 +134,12 @@ class Catalog:
                 self._load()
             if key in self._tables:
                 raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
+            claim = self._claims.get(key)
+            if claim is not None and self._claim_is_live(claim):
+                raise DeltaAnalysisError(
+                    f"Table {name!r} is being created concurrently"
+                )
+            self._claims.pop(key, None)
             self._tables[key] = os.path.abspath(path)
             self._save()
 
@@ -114,51 +155,47 @@ class Catalog:
         # Claim the name inside the first critical section, then run the
         # (possibly long) CTAS/create outside the lock so unrelated catalog
         # operations aren't serialized behind data writes. A concurrent
-        # creator of the same name now fails BEFORE materializing any data
-        # (no orphan table directory); if our create fails, roll the claim
-        # back so the name isn't left dangling.
-        from delta_tpu.api.tables import DeltaTable as _DT
-
+        # creator of the same name fails BEFORE materializing any data (no
+        # orphan table directory). Claims live in a separate map carrying
+        # owner liveness (pid/host/ts), so a crashed creator's claim is
+        # reclaimable while a live in-progress one blocks the race — and
+        # lookups never resolve a name whose table hasn't committed yet.
         with self._lock, self._file_lock():
             if self._store_path:
                 self._load()
-            prior = self._tables.get(key)
-            if prior is not None and mode == "create":
-                # a claim whose creator crashed mid-create (no table behind
-                # the registered path) is stale — reclaimable, not an error
-                if _DT.is_delta_table(prior):
+            if mode == "create":
+                if key in self._tables:
                     raise DeltaAnalysisError(
                         f"Table {name!r} already exists in catalog"
                     )
-                prior = None
-            claimed = prior is None
-            if claimed:
-                # claim an unregistered name now, so a losing concurrent
-                # creator fails before materializing data; until the create
-                # commits, readers of this name see a claim, not a table. A
-                # replace of an EXISTING registration keeps pointing at the
-                # old location until the create succeeds.
-                self._tables[key] = abs_path
+                claim = self._claims.get(key)
+                if claim is not None and self._claim_is_live(claim):
+                    raise DeltaAnalysisError(
+                        f"Table {name!r} is being created concurrently"
+                    )
+            my_claim = self._new_claim(abs_path)
+            self._claims[key] = my_claim
+            self._save()
+
+        def _release(register_table: bool):
+            with self._lock, self._file_lock():
+                if self._store_path:
+                    self._load()
+                cur = self._claims.get(key)
+                if cur and cur.get("pid") == my_claim["pid"] and cur.get("ts_ms") == my_claim["ts_ms"]:
+                    self._claims.pop(key, None)
+                if register_table:
+                    self._tables[key] = abs_path
                 self._save()
+
         try:
             table = DeltaTable.create(
                 path, schema, partition_columns, configuration, data, mode=mode
             )
         except BaseException:
-            if claimed:
-                with self._lock, self._file_lock():
-                    if self._store_path:
-                        self._load()
-                    if self._tables.get(key) == abs_path:
-                        self._tables.pop(key, None)
-                        self._save()
+            _release(register_table=False)
             raise
-        if not claimed:
-            with self._lock, self._file_lock():
-                if self._store_path:
-                    self._load()
-                self._tables[key] = abs_path
-                self._save()
+        _release(register_table=True)
         return table
 
     def drop_table(self, name: str) -> None:

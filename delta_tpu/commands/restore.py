@@ -93,19 +93,19 @@ class RestoreCommand:
                     )
                 # a sidecar deletion vector ('u' storage) is as load-bearing
                 # as the data file: scans of the restored state read it
-                dv = f.deletion_vector or {}
-                sidecar = (dv.get("pathOrInlineDv")
-                           if dv.get("storageType") == "u" else None)
-                if sidecar is not None:
-                    # resolve exactly the way read_deletion_vector does
-                    # (plain join, no unquote — sidecar paths are stored raw)
-                    dv_abs = os.path.join(self.delta_log.data_path, sidecar)
-                    if not os.path.exists(dv_abs):
-                        raise errors.DeltaIllegalStateError(
-                            f"Cannot restore to version {target_version}: "
-                            f"deletion-vector file {sidecar} for data file "
-                            f"{path} no longer exists (removed by VACUUM?)"
-                        )
+                from delta_tpu.protocol.deletion_vectors import dv_sidecar_path
+
+                dv_abs = dv_sidecar_path(
+                    f.deletion_vector or {}, self.delta_log.data_path
+                )
+                if dv_abs is not None and not os.path.exists(dv_abs):
+                    raise errors.DeltaIllegalStateError(
+                        f"Cannot restore to version {target_version}: "
+                        f"deletion-vector file "
+                        f"{(f.deletion_vector or {}).get('pathOrInlineDv')} "
+                        f"for data file {path} no longer exists "
+                        f"(removed by VACUUM?)"
+                    )
                 actions.append(replace(f, data_change=True))
                 restored += 1
                 restored_size += f.size or 0

@@ -306,8 +306,14 @@ class Snapshot:
         """Export AddFile metadata as numpy columns for the device scan planner
         (path dictionary stays on host; hashes/sizes/stats go to HBM).
         See ``delta_tpu.ops.pruning``."""
-        from delta_tpu.ops.state_export import files_to_arrays
+        from delta_tpu.ops.state_export import arrays_from_columns, files_to_arrays
 
+        arr = arrays_from_columns(
+            self._columnar, self._alive_mask, self.metadata, stats_columns,
+            sort_by_path=True,
+        )
+        if arr is not None:
+            return arr
         return files_to_arrays(self.all_files, self.metadata, stats_columns)
 
     def __repr__(self) -> str:

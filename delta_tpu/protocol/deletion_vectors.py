@@ -129,6 +129,16 @@ def write_deletion_vector(
     )
 
 
+def dv_sidecar_path(dv: dict, data_path: str):
+    """Absolute sidecar path for a ``deletionVector`` JSON dict, or None for
+    inline/absent payloads. The single resolution rule (plain join, no
+    unquote — sidecar paths are stored raw) shared by the read path below
+    and pre-checks like RESTORE's vacuumed-sidecar guard."""
+    if not dv or dv.get("storageType") != STORAGE_FILE:
+        return None
+    return os.path.join(data_path, dv["pathOrInlineDv"])
+
+
 def read_deletion_vector(
     descriptor: DeletionVectorDescriptor, data_path: str
 ) -> np.ndarray:
@@ -136,7 +146,12 @@ def read_deletion_vector(
     if descriptor.storage_type == STORAGE_INLINE:
         payload = base64.b85decode(descriptor.path_or_inline_dv)
     elif descriptor.storage_type == STORAGE_FILE:
-        with open(os.path.join(data_path, descriptor.path_or_inline_dv), "rb") as f:
+        sidecar = dv_sidecar_path(
+            {"storageType": descriptor.storage_type,
+             "pathOrInlineDv": descriptor.path_or_inline_dv},
+            data_path,
+        )
+        with open(sidecar, "rb") as f:
             payload = f.read()
     else:
         raise ValueError(f"Unknown deletion-vector storage type: {descriptor.storage_type!r}")

@@ -1,5 +1,8 @@
 """CREATE / REPLACE / CTAS command (reference spec:
 ``DeltaTableCreationTests``, 1,923 LoC core cases) and the name catalog."""
+import os
+import unittest.mock
+
 import pyarrow as pa
 import pytest
 
@@ -204,3 +207,81 @@ def test_register_external_table(tmp_path):
     cat = Catalog()
     cat.register("ext", path)
     assert cat.load_table("ext").to_arrow().column("id").to_pylist() == [9]
+
+
+def test_catalog_live_inflight_create_blocks_concurrent(tmp_path):
+    """A live in-progress creator's claim must NOT be reclaimable: the
+    concurrent creator errors instead of hijacking the name (round-4 review:
+    the stale-claim reclaim must distinguish crashed from live)."""
+    import threading
+
+    from delta_tpu.catalog.catalog import Catalog
+
+    cat = Catalog(str(tmp_path / "cat.json"))
+    gate = threading.Event()
+    release = threading.Event()
+    errors_b = []
+
+    orig_create = DeltaTable.create.__func__
+
+    def slow_create(cls, *a, **kw):
+        gate.set()
+        release.wait(timeout=10)
+        return orig_create(cls, *a, **kw)
+
+    a_path, b_path = str(tmp_path / "a"), str(tmp_path / "b")
+
+    def creator_a():
+        with unittest.mock.patch.object(
+            DeltaTable, "create", classmethod(slow_create)
+        ):
+            cat.create_table("t", a_path, SCHEMA)
+
+    ta = threading.Thread(target=creator_a)
+    ta.start()
+    assert gate.wait(timeout=10)
+    # B races while A is mid-create: must fail, must not write data
+    try:
+        cat.create_table("t", b_path, SCHEMA)
+    except DeltaAnalysisError as e:
+        errors_b.append(str(e))
+    release.set()
+    ta.join(timeout=10)
+    assert errors_b and "concurrently" in errors_b[0]
+    assert not os.path.exists(b_path)
+    assert cat.table_path("t") == a_path
+    assert DeltaTable.is_delta_table(a_path)
+
+
+def test_catalog_crashed_claim_is_reclaimable(tmp_path):
+    """A claim whose owner pid is dead (crashed creator) is stale: a new
+    creator takes the name over cleanly."""
+    import json as _json
+
+    from delta_tpu.catalog.catalog import Catalog
+
+    store = str(tmp_path / "cat.json")
+    dead = {"path": str(tmp_path / "ghost"), "pid": 2**22 + 12345,
+            "host": __import__("socket").gethostname(), "ts_ms": 0}
+    with open(store, "w") as f:
+        _json.dump({"tables": {}, "claims": {"default.t": dead}}, f)
+    cat = Catalog(store)
+    cat.create_table("t", str(tmp_path / "real"), SCHEMA)
+    assert cat.table_path("t") == str(tmp_path / "real")
+
+
+def test_catalog_register_refuses_live_claim(tmp_path):
+    import socket
+    import time as _time
+    import json as _json
+
+    from delta_tpu.catalog.catalog import Catalog
+
+    store = str(tmp_path / "cat.json")
+    live = {"path": str(tmp_path / "x"), "pid": os.getpid(),
+            "host": socket.gethostname(), "ts_ms": int(_time.time() * 1000)}
+    with open(store, "w") as f:
+        _json.dump({"tables": {}, "claims": {"default.t": live}}, f)
+    cat = Catalog(store)
+    with pytest.raises(DeltaAnalysisError, match="concurrently"):
+        cat.register("t", str(tmp_path / "y"))
