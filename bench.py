@@ -477,6 +477,153 @@ def bench_checkpoint_replay(workdir):
     }
 
 
+# -- config 6: hot-table batched scan planning (device-resident state) -------
+
+
+def bench_hot_plan(workdir):
+    """The query-server shape: a 1M-file table's scan lanes resident in HBM
+    (`ops/state_cache`), serving batches of 256 point-range plans. Baseline =
+    the strongest host implementation (vectorized numpy over the same float64
+    mirrors, batched); the reference-shaped per-query path (materialize
+    AddFiles + re-evaluate stats per query, `DataSkippingReader`'s shape) is
+    also sampled for scale. The win condition VERDICT r3 set: the device
+    engages under AUTO routing and beats the host."""
+    import json as _json
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.exec.scan import plan_scans
+    from delta_tpu.log import checkpoints as ckpt_mod
+    from delta_tpu.ops.state_cache import DeviceStateCache
+    from delta_tpu.protocol import filenames
+    from delta_tpu.protocol.actions import AddFile, Metadata, Protocol
+    from delta_tpu.schema.types import DoubleType, LongType, StructType
+    from delta_tpu.storage.logstore import get_log_store
+    from delta_tpu.utils.config import conf
+
+    n_files = max(int(1_000_000 * SCALE), 20_000)
+    n_queries = 256
+    rng = np.random.RandomState(13)
+    table_path = os.path.join(workdir, "c6")
+    log_path = os.path.join(table_path, "_delta_log")
+    store = get_log_store(log_path)
+
+    schema = StructType()
+    for c in range(4):
+        schema = schema.add(f"c{c}", DoubleType() if c % 2 else LongType())
+    meta = Metadata(schema_string=schema.to_json())
+    proto = Protocol(1, 2)
+    store.write(f"{log_path}/{filenames.delta_file(0)}",
+                [proto.json(), meta.json()])
+
+    # 1M files, each covering a narrow range per column (a well-clustered
+    # table: point queries match a handful of files)
+    base = {f"c{c}": np.sort(rng.rand(n_files) * 1e6) if c % 2 else
+            np.sort(rng.randint(0, 1 << 40, n_files).astype(np.int64))
+            for c in range(4)}
+    width = {f"c{c}": 1e6 / n_files * 8 if c % 2 else max((1 << 40) // n_files * 8, 1)
+             for c in range(4)}
+    adds = []
+    for i in range(n_files):
+        mins = {c: (float(v[i]) if c in ("c1", "c3") else int(v[i])) for c, v in base.items()}
+        maxs = {c: (float(v[i] + width[c]) if c in ("c1", "c3") else int(v[i] + width[c]))
+                for c, v in base.items()}
+        stats = _json.dumps({"numRecords": 10000, "minValues": mins,
+                             "maxValues": maxs,
+                             "nullCount": {c: 0 for c in base}})
+        adds.append(AddFile(path=f"part-{i:07d}.parquet", size=1 << 20,
+                            modification_time=0, data_change=False, stats=stats))
+    ckpt_mod.write_checkpoint(store, log_path, 0, [proto, meta] + adds)
+
+    DeltaLog.clear_cache()
+    DeviceStateCache.reset()
+    log = DeltaLog.for_table(table_path)
+    t0 = time.perf_counter()
+    snap = log.update()
+    snap.num_of_files  # force state reconstruction
+    decode_s = time.perf_counter() - t0
+
+    # queries: point ranges on 2 columns (a dashboard's WHERE shapes)
+    qs = []
+    for _ in range(n_queries):
+        i = rng.randint(n_files)
+        lo0 = int(base["c0"][i])
+        lo1 = float(base["c1"][i])
+        qs.append([f"c0 >= {lo0} AND c0 <= {lo0 + int(width['c0'])} "
+                   f"AND c1 >= {lo1:.6f} AND c1 <= {lo1 + width['c1']:.6f}"])
+
+    t0 = time.perf_counter()
+    entry = DeviceStateCache.instance().get(snap)
+    assert entry is not None
+    entry.ensure_resident()
+    build_s = time.perf_counter() - t0
+
+    def run(mode):
+        with conf.set_temporarily(**{"delta.tpu.stateCache.devicePlan.mode": mode}):
+            return plan_scans(snap, qs, k=256)
+
+    from delta_tpu.parallel import link
+
+    link.profile()  # process-wide calibration, not a per-batch cost
+    run("force")  # warm the plan-kernel compile
+    dev_s = min(_timed(lambda: run("force"))[0] for _ in range(3))
+    host_s = min(_timed(lambda: run("off"))[0] for _ in range(3))
+    auto_s, auto_plans = min(
+        (_timed(lambda: run("auto")) for _ in range(2)), key=lambda x: x[0])
+    auto_via = auto_plans[0].via
+
+    # parity spot-check: the device's f32 verdict may keep an extra boundary
+    # file (conservative rounding) but never drop one the host keeps
+    dev_plans, host_plans = run("force"), run("off")
+    for d, h in zip(dev_plans[:16], host_plans[:16]):
+        assert set(h.paths) <= set(d.paths)
+        assert d.count <= h.count + 4, (d.count, h.count)
+
+    # reference-shaped per-query sample: files_for_scan on materialized
+    # AddFiles (the all_files dataclass path), 2 queries, extrapolated
+    from delta_tpu.exec.scan import scan_files
+
+    sample_n = 2
+    ref_s, _ = _timed(lambda: [scan_files(snap, q) for q in qs[:sample_n]])
+    ref_extrapolated_s = ref_s / sample_n * n_queries
+
+    # steady-state: a new commit tails in incrementally (no rebuild)
+    new_add = AddFile(path="part-new.parquet", size=1 << 20, modification_time=1,
+                      data_change=True,
+                      stats=_json.dumps({"numRecords": 1, "minValues": {"c0": 1},
+                                         "maxValues": {"c0": 2},
+                                         "nullCount": {c: 0 for c in base}}))
+    store.write(f"{log_path}/{filenames.delta_file(1)}", [new_add.json()])
+    DeviceStateCache.instance().get(log.update())  # first apply warms the jits
+    from dataclasses import replace as _dc_replace
+
+    new_add2 = _dc_replace(new_add, path="part-new2.parquet")
+    store.write(f"{log_path}/{filenames.delta_file(2)}", [new_add2.json()])
+    snap2 = log.update()
+    tail_s, entry2 = _timed(lambda: DeviceStateCache.instance().get(snap2))
+    assert entry2 is entry and entry2.version == 2, "tail must apply incrementally"
+
+    per_q_device_ms = dev_s / n_queries * 1000
+    return {
+        "metric": "hot_table_batched_scan_planning_1M_files_256_queries",
+        "value": round(dev_s * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": round(host_s / dev_s, 2),
+        "baseline": "strongest host path on the same machine: batched "
+                    "vectorized numpy over resident float64 mirrors",
+        "auto_used_device": auto_via == "device",
+        "auto_ms": round(auto_s * 1000, 1),
+        "host_resident_ms": round(host_s * 1000, 1),
+        "device_ms": round(dev_s * 1000, 1),
+        "per_query_device_ms": round(per_q_device_ms, 3),
+        "reference_shaped_extrapolated_s": round(ref_extrapolated_s, 1),
+        "vs_reference_shaped": round(ref_extrapolated_s / dev_s, 1),
+        "state_decode_s": round(decode_s, 2),
+        "cache_build_s": round(build_s, 2),
+        "incremental_tail_apply_ms": round(tail_s * 1000, 1),
+        "n_files": n_files,
+    }
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else None
     workdir = tempfile.mkdtemp(prefix="delta_tpu_bench_")
@@ -486,6 +633,7 @@ def main():
         "3": lambda: bench_zorder_point_query(workdir),
         "4": lambda: bench_streaming_tail(workdir),
         "5": lambda: bench_checkpoint_replay(workdir),
+        "6": lambda: bench_hot_plan(workdir),
     }
     try:
         if only:

@@ -106,16 +106,24 @@ class Catalog:
         import time
 
         timeout_ms = int(conf.get("delta.tpu.catalog.claimTimeoutMs", 600_000))
+        within_age = (time.time() * 1000 - claim.get("ts_ms", 0)) < timeout_ms
         if claim.get("host") == socket.gethostname():
             pid = claim.get("pid")
             if pid == os.getpid():
                 return True
             try:
                 os.kill(int(pid), 0)
-                return True
+                alive = True
+            except ProcessLookupError:
+                alive = False  # definitely gone
+            except PermissionError:
+                alive = True  # exists, owned by another user
             except (OSError, TypeError, ValueError):
-                return False
-        return (time.time() * 1000 - claim.get("ts_ms", 0)) < timeout_ms
+                alive = True  # unknown: never hijack on doubt
+            # age bound also applies same-host: a recycled pid would
+            # otherwise block the name forever
+            return alive and within_age
+        return within_age
 
     def _new_claim(self, path: str) -> Dict:
         import socket

@@ -23,7 +23,7 @@ from delta_tpu.ops import pruning
 from delta_tpu.protocol.actions import AddFile
 from delta_tpu.schema.types import StructType
 
-__all__ = ["scan_files", "read_files_as_table", "scan_to_table"]
+__all__ = ["scan_files", "read_files_as_table", "scan_to_table", "plan_scans", "QueryPlan"]
 
 
 def _abs_data_path(data_path: str, file_path: str) -> str:
@@ -154,6 +154,75 @@ def read_files_as_table(
 def scan_files(snapshot, filters: Sequence[Union[str, ir.Expression]] = ()) -> pruning.DeltaScan:
     exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
     return pruning.files_for_scan(snapshot, exprs)
+
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass
+class QueryPlan:
+    """One query's pruned file list from :func:`plan_scans`. ``overflow``
+    marks a query whose match set exceeded K (``paths`` holds the first K;
+    ``count`` stays exact); ``via`` records which engine produced it
+    ('device', 'host-resident', or 'scan' for the per-query fallback)."""
+
+    paths: List[str]
+    count: int
+    overflow: bool = False
+    via: str = "scan"
+
+
+def plan_scans(
+    snapshot,
+    queries: Sequence[Sequence[Union[str, ir.Expression]]],
+    k: int = 256,
+) -> List[QueryPlan]:
+    """Plan a *batch* of queries against one snapshot — the serving shape of
+    a query router / BI dashboard (N concurrent point lookups) or MERGE's
+    per-partition file probing.
+
+    With the table's scan lanes HBM-resident (`ops/state_cache`), the whole
+    batch is ONE device dispatch and one (N, K) download; the link cost model
+    (`parallel/link`) decides device vs the host float64 mirrors per batch.
+    Queries whose predicates don't lower to per-column ranges (ORs, null
+    tests, strings) fall back to :func:`scan_files` individually."""
+    from delta_tpu.ops.state_cache import DeviceStateCache, extract_ranges
+
+    parsed = [
+        [parse_predicate(f) if isinstance(f, str) else f for f in q]
+        for q in queries
+    ]
+    out: List[Optional[QueryPlan]] = [None] * len(queries)
+    entry = DeviceStateCache.instance().get(snapshot)
+    range_ix, ranges = [], []
+    if entry is not None:
+        pcols = frozenset(c.lower() for c in snapshot.metadata.partition_columns)
+        for i, exprs in enumerate(parsed):
+            if not exprs:
+                continue
+            rewritten = pruning.skipping_predicate(ir.and_all(list(exprs)), pcols)
+            r = extract_ranges(rewritten, entry.columns)
+            if r is not None:
+                range_ix.append(i)
+                ranges.append(r)
+    if ranges:
+        plans = entry.plan_ranges(
+            ranges, k=k, expected_version=snapshot.version
+        )
+        if plans is not None:  # None: entry advanced past our snapshot
+            for i, p in zip(range_ix, plans):
+                out[i] = QueryPlan(
+                    paths=[entry.paths[r] for r in p.rows],
+                    count=p.count, overflow=p.overflow, via=p.via,
+                )
+    for i, exprs in enumerate(parsed):
+        if out[i] is None:
+            scan = pruning.files_for_scan(snapshot, exprs)
+            out[i] = QueryPlan(
+                paths=[f.path for f in scan.files], count=len(scan.files)
+            )
+    return out  # type: ignore[return-value]
 
 
 def scan_to_table(

@@ -1,0 +1,648 @@
+"""Device-resident snapshot state: table metadata cached in HBM.
+
+The reference caches reconstructed state as a Spark-memory Dataset
+(`util/StateCache.scala:34-110` backing `Snapshot.scala:88-111`), so repeat
+queries replay nothing. The TPU-native equivalent keeps the *scan-planning
+lanes* of the reconciled state — per-file min/max/nullCount stats, sizes,
+aliveness — resident in HBM, keyed by table, and updates them incrementally
+as the log tails forward: each new commit appends a handful of rows
+device-side (one small upload + one scatter/slice kernel), so steady-state
+queries pay **zero bulk upload**.
+
+Why this is the piece that makes the chip win: on any link (PCIe or
+tunneled), re-uploading O(files) state per query prices the device out of
+interactive planning; from residency, a *batch* of N predicates over F files
+and C stat columns is one dispatch reading N·F·C lanes from HBM (~800 GB/s)
+against a host evaluator bound by DRAM (~10 GB/s single-core), and one
+small packed block-bitmap download finished exactly on the host mirrors
+(coarse-fine; see ``_plan_device``).
+
+Precision: stats lanes are stored as float32 with **conservative rounding**
+— min lanes round toward -inf, max lanes toward +inf, and query bounds round
+outward the same way (`_f32_down`/`_f32_up`) — so a float32 verdict can only
+*keep* extra files, never drop a matching one. NaN = missing stat = keep.
+The skipping rewrite only ever tests ``min.c`` against upper bounds and
+``max.c`` against lower bounds (`ops/pruning.skipping_predicate`), which is
+what makes one rounding direction per lane sufficient.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from delta_tpu.expr import ir
+from delta_tpu.utils.config import conf
+
+__all__ = [
+    "ResidentState", "DeviceStateCache", "PlanResult", "extract_ranges",
+    "RangeSet",
+]
+
+
+def _f32_down(x: np.ndarray) -> np.ndarray:
+    """float64 → float32 rounded toward -inf (result <= x). NaN passes."""
+    with np.errstate(invalid="ignore", over="ignore"):
+        f = x.astype(np.float32)
+        bump = f.astype(np.float64) > x
+    if bump.any():
+        f = f.copy()
+        f[bump] = np.nextafter(f[bump], np.float32(-np.inf))
+    return f
+
+
+def _f32_up(x: np.ndarray) -> np.ndarray:
+    """float64 → float32 rounded toward +inf (result >= x). NaN passes."""
+    with np.errstate(invalid="ignore", over="ignore"):
+        f = x.astype(np.float32)
+        bump = f.astype(np.float64) < x
+    if bump.any():
+        f = f.copy()
+        f[bump] = np.nextafter(f[bump], np.float32(np.inf))
+    return f
+
+
+def _next_pow2(n: int, floor: int = 1024) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+# -- range extraction from skipping predicates ------------------------------
+
+
+@dataclass
+class RangeSet:
+    """One query as per-column bounds: keep file iff for every column c,
+    ``max.c >= lo[c] AND min.c <= hi[c]`` (NaN bound = unconstrained).
+    ``verdict`` short-circuits structural cases: 'empty' (matches nothing),
+    'all' (prunes nothing)."""
+
+    lo: np.ndarray  # float64, len C, NaN = -inf
+    hi: np.ndarray  # float64, len C, NaN = +inf
+    verdict: Optional[str] = None  # None | 'empty' | 'all'
+
+
+def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[RangeSet]:
+    """Lower a *rewritten* skipping predicate (over ``min.c``/``max.c`` lanes)
+    to per-column range bounds, or None when the shape doesn't fit (ORs,
+    null-count tests, unknown columns → caller routes that query to the
+    generic path). Strict comparisons are relaxed to non-strict — pruning may
+    keep a boundary file it could have dropped, never the reverse."""
+    col_ix = {c: i for i, c in enumerate(columns)}
+    lo = np.full(len(columns), np.nan)
+    hi = np.full(len(columns), np.nan)
+    empty = False
+
+    def walk(e: ir.Expression) -> bool:
+        nonlocal empty
+        t = type(e)
+        if t is ir.And:
+            return walk(e.left) and walk(e.right)
+        if t is ir.Literal:
+            if e.value is None or e.value is True:
+                return True  # unknown/true conjunct prunes nothing
+            if e.value is False:
+                empty = True
+                return True
+            return False
+        if t in (ir.Le, ir.Lt, ir.Ge, ir.Gt):
+            l, r = e.left, e.right
+            if not (isinstance(l, ir.Column) and isinstance(r, ir.Literal)):
+                return False
+            if not isinstance(r.value, (int, float)) or isinstance(r.value, bool):
+                return False
+            v = float(r.value)
+            name = l.name
+            if name.startswith("min.") and t in (ir.Le, ir.Lt):
+                i = col_ix.get(name[4:])
+                if i is None:
+                    return False
+                hi[i] = v if np.isnan(hi[i]) else min(hi[i], v)
+                return True
+            if name.startswith("max.") and t in (ir.Ge, ir.Gt):
+                i = col_ix.get(name[4:])
+                if i is None:
+                    return False
+                lo[i] = v if np.isnan(lo[i]) else max(lo[i], v)
+                return True
+            return False
+        return False
+
+    if not walk(pred):
+        return None
+    if empty:
+        return RangeSet(lo, hi, verdict="empty")
+    if np.isnan(lo).all() and np.isnan(hi).all():
+        return RangeSet(lo, hi, verdict="all")
+    return RangeSet(lo, hi)
+
+
+# -- the resident entry ------------------------------------------------------
+
+
+@dataclass
+class PlanResult:
+    """One query's plan from the resident state. ``rows`` are row indices
+    into the entry's layout (map to paths via ``ResidentState.paths``);
+    ``overflow`` means more than K files survived and the caller must
+    fall back for this query (counts stay exact)."""
+
+    count: int
+    rows: np.ndarray
+    overflow: bool = False
+    via: str = "host-resident"  # 'device' | 'host-resident' | 'verdict'
+
+
+class ResidentState:
+    """One table's scan-planning lanes in HBM + exact host mirrors.
+
+    Rows are append-only (a re-added path gets a fresh row; the old one's
+    alive bit drops); device arrays are padded to a power-of-two capacity so
+    tail appends hit a handful of compiled kernel shapes.
+    """
+
+    def __init__(self, log_path: str, metadata_id: str, version: int,
+                 columns: List[str], paths: List[str],
+                 lanes: Dict[str, np.ndarray]):
+        self.log_path = log_path
+        self.metadata_id = metadata_id
+        self.version = version
+        self.columns = columns
+        self.paths = list(paths)
+        self.path_to_row: Dict[str, int] = {p: i for i, p in enumerate(paths)}
+        n = len(paths)
+        self.num_rows = n
+        self.capacity = _next_pow2(max(n, 1))
+        # exact host mirrors (float64 bounds; the device carries f32)
+        self.h_alive = np.ones(n, bool)
+        self.h_lo = lanes["min"]  # (C, n) float64
+        self.h_hi = lanes["max"]
+        self.h_size = lanes["size"]  # (n,) int64
+        self._dead = 0
+        self._dev = None  # lazily-built device arrays
+        self._lock = threading.RLock()
+        self.last_used = 0.0
+
+    # -- device residency -------------------------------------------------
+
+    def _pad2(self, a: np.ndarray, fill) -> np.ndarray:
+        out = np.full((a.shape[0], self.capacity), fill, np.float32)
+        out[:, : a.shape[1]] = a
+        return out
+
+    def _build_device(self) -> None:
+        import jax.numpy as jnp
+
+        mins = self._pad2(_f32_down(self.h_lo), np.nan)
+        maxs = self._pad2(_f32_up(self.h_hi), np.nan)
+        alive = np.zeros(self.capacity, bool)
+        alive[: self.num_rows] = self.h_alive[: self.num_rows]
+        self._dev = {
+            "mins": jnp.asarray(mins),
+            "maxs": jnp.asarray(maxs),
+            "alive": jnp.asarray(alive),
+        }
+
+    @property
+    def device_bytes(self) -> int:
+        c = len(self.columns)
+        return self.capacity * (2 * c * 4 + 1)
+
+    def ensure_resident(self) -> None:
+        with self._lock:
+            if self._dev is None:
+                self._build_device()
+
+    @property
+    def is_resident(self) -> bool:
+        return self._dev is not None
+
+    def drop_device(self) -> None:
+        with self._lock:
+            self._dev = None
+
+    # -- incremental tail apply ------------------------------------------
+
+    def apply_tail(self, version: int, removed_paths: Sequence[str],
+                   added: Tuple[List[str], np.ndarray, np.ndarray, np.ndarray]) -> bool:
+        """Advance to ``version``: drop removed paths, append added rows
+        (paths, lo(C,k), hi(C,k), size(k)). Returns False when the entry
+        must be rebuilt instead (capacity overflow / too much garbage)."""
+        add_paths, add_lo, add_hi, add_size = added
+        k = len(add_paths)
+        with self._lock:
+            dead_rows = []
+            for p in removed_paths:
+                r = self.path_to_row.pop(p, None)
+                if r is not None and self.h_alive[r]:
+                    self.h_alive[r] = False
+                    dead_rows.append(r)
+            for p in add_paths:
+                r = self.path_to_row.get(p)
+                if r is not None and self.h_alive[r]:
+                    # re-add supersedes the old row's stats
+                    self.h_alive[r] = False
+                    dead_rows.append(r)
+            self._dead += len(dead_rows)
+            start = self.num_rows
+            if start + k > self.capacity or self._dead > max(1024, self.num_rows // 2):
+                return False
+            if k:
+                self.h_alive = np.concatenate([self.h_alive, np.ones(k, bool)])
+                self.h_lo = np.concatenate([self.h_lo, add_lo], axis=1)
+                self.h_hi = np.concatenate([self.h_hi, add_hi], axis=1)
+                self.h_size = np.concatenate([self.h_size, add_size])
+                for i, p in enumerate(add_paths):
+                    self.paths.append(p)
+                    self.path_to_row[p] = start + i
+                self.num_rows = start + k
+            if self._dev is not None:
+                self._apply_tail_device(dead_rows, start, k, add_lo, add_hi)
+            self.version = version
+            return True
+
+    def _apply_tail_device(self, dead_rows, start, k, add_lo, add_hi) -> None:
+        """One small upload + one jitted scatter/slice update in HBM.
+
+        Shapes are bucketed (pow2 pads; out-of-range scatter indices use
+        XLA drop semantics) so a steady commit stream reuses a handful of
+        compiled executables."""
+        import jax.numpy as jnp
+
+        dev = self._dev
+        cap = self.capacity
+        d = _next_pow2(max(len(dead_rows), 1), floor=8)
+        dead = np.full(d, cap, np.int32)  # cap = out of bounds -> dropped
+        dead[: len(dead_rows)] = dead_rows
+        a = _next_pow2(max(k, 1), floor=8)
+        rows = np.full(a, cap, np.int32)
+        rows[:k] = np.arange(start, start + k, dtype=np.int32)
+        lo32 = np.full((self.h_lo.shape[0], a), np.nan, np.float32)
+        hi32 = np.full((self.h_hi.shape[0], a), np.nan, np.float32)
+        lo32[:, :k] = _f32_down(add_lo)
+        hi32[:, :k] = _f32_up(add_hi)
+        dev["alive"] = _scatter_bool(dev["alive"], jnp.asarray(dead), False)
+        dev["alive"] = _scatter_bool(dev["alive"], jnp.asarray(rows), True)
+        dev["mins"] = _scatter_cols(dev["mins"], jnp.asarray(rows), jnp.asarray(lo32))
+        dev["maxs"] = _scatter_cols(dev["maxs"], jnp.asarray(rows), jnp.asarray(hi32))
+
+    # -- serving ----------------------------------------------------------
+
+    def plan_ranges(self, ranges: Sequence[RangeSet], k: int = 256,
+                    use_device: Optional[bool] = None,
+                    expected_version: Optional[int] = None) -> Optional[List[PlanResult]]:
+        """Evaluate a batch of range queries against the resident lanes:
+        one dispatch, one packed-bitmap download. Structural verdicts
+        short-circuit; device/host routing follows the link cost model unless
+        pinned (each PlanResult records the route in ``via``).
+
+        Runs under the entry lock so a concurrent ``apply_tail`` cannot
+        mutate the mirrors mid-plan; ``expected_version`` guards the other
+        race — the entry advancing *past* the caller's snapshot between
+        lookup and plan — by returning None (caller re-plans or falls back).
+        """
+        with self._lock:
+            if expected_version is not None and self.version != expected_version:
+                return None
+            n = len(ranges)
+            real_ix = [i for i, r in enumerate(ranges) if r.verdict is None]
+            out: List[Optional[PlanResult]] = [None] * n
+            alive_rows = np.nonzero(self.h_alive[: self.num_rows])[0]
+            for i, r in enumerate(ranges):
+                if r.verdict == "empty":
+                    out[i] = PlanResult(0, np.empty(0, np.int64), via="verdict")
+                elif r.verdict == "all":
+                    out[i] = PlanResult(len(alive_rows), alive_rows[:k],
+                                        overflow=len(alive_rows) > k, via="verdict")
+            if not real_ix:
+                return out  # type: ignore[return-value]
+            lo = np.stack([ranges[i].lo for i in real_ix])  # (M, C)
+            hi = np.stack([ranges[i].hi for i in real_ix])
+            if use_device is None:
+                use_device = self._device_profitable(len(real_ix), k)
+            results = (self._plan_device(lo, hi, k) if use_device
+                       else self._plan_host(lo, hi, k))
+            via = "device" if use_device else "host-resident"
+            for j, i in enumerate(real_ix):
+                results[j].via = via
+                out[i] = results[j]
+            return out  # type: ignore[return-value]
+
+    def _device_profitable(self, m: int, k: int) -> bool:
+        if not conf.get_bool("delta.tpu.stateCache.devicePlan.enabled", True):
+            return False
+        mode = conf.get("delta.tpu.stateCache.devicePlan.mode", "auto")
+        if mode == "force":
+            return True
+        if mode == "off":
+            return False
+        from delta_tpu.parallel import link
+
+        cells = m * self.num_rows * max(len(self.columns), 1)
+        host_s = cells * link.HOST_PRUNE_S_PER_CELL
+        p = link.profile()
+        down_bytes = m * max(self.capacity // BLOCK // 8, 1)
+        device_s = (2 * p.latency_s + p.download_s(down_bytes)
+                    + cells * link.DEVICE_PRUNE_S_PER_CELL)
+        if self._dev is None:
+            # cold build ships the full lanes once; amortized over later
+            # queries, but charge it to this call for honest routing
+            device_s += p.upload_s(self.device_bytes)
+        return device_s < host_s
+
+    def _plan_host(self, lo: np.ndarray, hi: np.ndarray, k: int) -> List[PlanResult]:
+        n = self.num_rows
+        mins, maxs = self.h_lo[:, :n], self.h_hi[:, :n]
+        alive = self.h_alive[:n]
+        out = []
+        for q in range(lo.shape[0]):
+            keep = alive.copy()
+            for c in range(lo.shape[1]):
+                if not np.isnan(lo[q, c]):
+                    keep &= ~(maxs[c] < lo[q, c])  # NaN stat keeps
+                if not np.isnan(hi[q, c]):
+                    keep &= ~(mins[c] > hi[q, c])
+            rows = np.nonzero(keep)[0]
+            out.append(PlanResult(len(rows), rows[:k], overflow=len(rows) > k))
+        return out
+
+    def _plan_device(self, lo: np.ndarray, hi: np.ndarray, k: int) -> List[PlanResult]:
+        """Coarse-fine plan: the device culls 1024-file BLOCKS (one dispatch
+        over the resident f32 lanes, one tiny packed-bitmap download); the
+        host then evaluates exactly (float64 mirrors) inside the surviving
+        blocks only. Index extraction never runs on device — measured on a
+        v5e, a vmapped ``nonzero``/``top_k`` over (256, 1M) costs 0.7-2.4 s
+        where the block-bitmap reduction costs ~0.1 s — and the fine pass
+        erases the f32 slop, so device results equal host results exactly."""
+        import jax.numpy as jnp
+
+        self.ensure_resident()
+        m = lo.shape[0]
+        mb = _next_pow2(m, floor=8)  # bucket the query-batch dim too
+        lo_p = np.full((mb, lo.shape[1]), np.nan, np.float32)
+        hi_p = np.full((mb, hi.shape[1]), np.nan, np.float32)
+        lo_p[:m] = _f32_down(lo)
+        hi_p[:m] = _f32_up(hi)
+        bits = _block_kernel(
+            self._dev["mins"], self._dev["maxs"], self._dev["alive"],
+            jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
+        )
+        n_blocks = self.capacity // BLOCK
+        blocks = np.unpackbits(np.asarray(bits)[:m], axis=1, count=n_blocks)
+        n = self.num_rows
+        mins, maxs, alive = self.h_lo[:, :n], self.h_hi[:, :n], self.h_alive[:n]
+        out = []
+        for q in range(m):
+            hit = np.nonzero(blocks[q])[0]
+            if not len(hit):
+                out.append(PlanResult(0, np.empty(0, np.int64)))
+                continue
+            cand = np.concatenate([
+                np.arange(b * BLOCK, min((b + 1) * BLOCK, n)) for b in hit
+            ])
+            keep = alive[cand].copy()
+            for c in range(lo.shape[1]):
+                if not np.isnan(lo[q, c]):
+                    keep &= ~(maxs[c][cand] < lo[q, c])
+                if not np.isnan(hi[q, c]):
+                    keep &= ~(mins[c][cand] > hi[q, c])
+            rows = cand[keep]
+            out.append(PlanResult(len(rows), rows[:k], overflow=len(rows) > k))
+        return out
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_bool_fn(value: bool):
+    import jax
+
+    return jax.jit(lambda a, r: a.at[r].set(value, mode="drop"))
+
+
+def _scatter_bool(arr, rows, value: bool):
+    return _scatter_bool_fn(value)(arr, rows)
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_cols_fn():
+    import jax
+
+    return jax.jit(lambda a, r, v: a.at[:, r].set(v, mode="drop"))
+
+
+def _scatter_cols(arr, rows, vals):
+    return _scatter_cols_fn()(arr, rows, vals)
+
+
+# device block-cull granularity: pow2 ≤ the capacity floor in _next_pow2, so
+# the padded capacity always divides evenly
+BLOCK = 1024
+
+
+@functools.lru_cache(maxsize=None)
+def _block_kernel_fn(block: int):
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(mins, maxs, alive, lo, hi):
+        # mins/maxs: (C, cap) f32; alive: (cap,) bool; lo/hi: (M, C) f32.
+        # keep[m, f] = alive[f] AND over columns: the file's [min,max] range
+        # can intersect the query's [lo,hi]; NaN (either side) = no bound.
+        keep = jnp.broadcast_to(alive[None, :], (lo.shape[0], alive.shape[0]))
+        for c in range(lo.shape[1]):  # static unroll: C is a lane count
+            mn, mx = mins[c][None, :], maxs[c][None, :]
+            lo_c, hi_c = lo[:, c:c + 1], hi[:, c:c + 1]
+            keep = keep & (jnp.isnan(mx) | jnp.isnan(lo_c) | (mx >= lo_c))
+            keep = keep & (jnp.isnan(mn) | jnp.isnan(hi_c) | (mn <= hi_c))
+        blocks = keep.reshape(keep.shape[0], keep.shape[1] // block, block).any(axis=2)
+        return jnp.packbits(blocks, axis=1)
+
+    return jax.jit(kernel)
+
+
+def _block_kernel(mins, maxs, alive, lo, hi, block: int):
+    return _block_kernel_fn(block)(mins, maxs, alive, lo, hi)
+
+
+# -- building entries from snapshots ----------------------------------------
+
+
+def _lanes_from_arrays(arr, columns: Sequence[str]):
+    lo = np.stack([arr.stats_min[c] for c in columns]) if columns else np.empty((0, arr.num_files))
+    hi = np.stack([arr.stats_max[c] for c in columns]) if columns else np.empty((0, arr.num_files))
+    return {"min": lo, "max": hi, "size": arr.size.astype(np.int64)}
+
+
+def build_entry(snapshot) -> Optional[ResidentState]:
+    """Full build of a resident entry from a snapshot's columnar state.
+    None when the table shape is unsupported (partitioned / odd stats)."""
+    from delta_tpu.ops.state_export import arrays_from_columns
+
+    arr = arrays_from_columns(
+        snapshot._columnar, snapshot._alive_mask, snapshot.metadata
+    )
+    if arr is None:
+        return None
+    columns = sorted(arr.stats_min.keys())
+    return ResidentState(
+        log_path=snapshot.delta_log.log_path,
+        metadata_id=snapshot.metadata.id,
+        version=snapshot.version,
+        columns=columns,
+        paths=list(arr.paths),
+        lanes=_lanes_from_arrays(arr, columns),
+    )
+
+
+def _decode_tail(snapshot, from_version: int):
+    """Decode commits (from_version, snapshot.version] to (removed_paths,
+    (add_paths, lo, hi, size)) or None when incremental apply isn't safe
+    (metadata change in the tail, missing commit files, partitioned...)."""
+    from delta_tpu.log.columnar import decode_segment
+    from delta_tpu.ops.state_export import arrays_from_columns
+    from delta_tpu.protocol import filenames
+    from delta_tpu.protocol.actions import Metadata
+
+    log = snapshot.delta_log
+    paths = [
+        f"{log.log_path}/{filenames.delta_file(v)}"
+        for v in range(from_version + 1, snapshot.version + 1)
+    ]
+    try:
+        cols = decode_segment(log.store, [], paths)
+    except Exception:
+        return None
+    if any(isinstance(a, Metadata) for a in cols.other_actions):
+        return None  # schema/config may have changed -> rebuild
+    w = cols.winner_mask()
+    alive, _ = cols.replay(winner=w)
+    dead_winner = w & ~alive
+    removed = cols.paths_for(np.nonzero(dead_winner)[0])
+    arr = arrays_from_columns(cols, alive, snapshot.metadata)
+    if arr is None:
+        return None
+    columns = sorted(arr.stats_min.keys())
+    lanes = _lanes_from_arrays(arr, columns)
+    return removed, (list(arr.paths), lanes["min"], lanes["max"], lanes["size"]), columns
+
+
+class DeviceStateCache:
+    """Process-wide registry of :class:`ResidentState` entries with an HBM
+    byte budget (`delta.tpu.stateCache.maxBytes`) and LRU eviction — the
+    TPU analogue of the reference's `StateCache` Spark-memory cache."""
+
+    _instance: Optional["DeviceStateCache"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._entries: Dict[str, ResidentState] = {}
+        self._lock = threading.RLock()
+        self._build_locks: Dict[str, threading.Lock] = {}
+        self._tick = 0
+
+    @classmethod
+    def instance(cls) -> "DeviceStateCache":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = DeviceStateCache()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = None
+
+    def invalidate(self, log_path: str) -> None:
+        with self._lock:
+            self._entries.pop(log_path, None)
+            self._build_locks.pop(log_path, None)
+
+    def _lookup(self, key: str, snapshot):
+        """Registry-lock lookup. Returns (entry_or_None, verdict): 'hit',
+        'older' (serve from host), or 'advance' (tail apply / rebuild)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.metadata_id != snapshot.metadata.id:
+                e = None  # table replaced in place
+            if e is None:
+                return None, "advance"
+            if e.version > snapshot.version:
+                return None, "older"  # time travel below residency
+            return e, ("hit" if e.version == snapshot.version else "advance")
+
+    def get(self, snapshot) -> Optional[ResidentState]:
+        """Entry current at the snapshot's version: cache hit, incremental
+        tail apply, or full rebuild. None when unsupported or disabled.
+
+        The registry lock covers only lookups/inserts; the seconds-long
+        decode/build work runs under a per-table build lock so a cold build
+        for one table never stalls cache hits for another."""
+        if not conf.get_bool("delta.tpu.stateCache.enabled", True):
+            return None
+        key = snapshot.delta_log.log_path
+        with self._lock:
+            self._tick += 1
+            tick = self._tick
+            build_lock = self._build_locks.setdefault(key, threading.Lock())
+        e, verdict = self._lookup(key, snapshot)
+        if verdict == "older":
+            return None
+        if verdict == "hit":
+            e.last_used = tick
+            return e
+        with build_lock:
+            # re-check: another thread may have advanced/built meanwhile
+            e, verdict = self._lookup(key, snapshot)
+            if verdict == "older":
+                return None
+            if verdict == "hit":
+                e.last_used = tick
+                return e
+            if e is not None:  # behind: try the incremental tail
+                tail = _decode_tail(snapshot, e.version)
+                ok = False
+                if tail is not None:
+                    removed, added, columns = tail
+                    if columns == e.columns or not added[0]:
+                        ok = e.apply_tail(snapshot.version, removed, added)
+                if not ok:
+                    e = None
+            if e is None:
+                e = build_entry(snapshot)
+                if e is None:
+                    return None
+                with self._lock:
+                    self._entries[key] = e
+            e.last_used = tick
+            with self._lock:
+                self._evict_over_budget(keep=key)
+            return e
+
+    def _evict_over_budget(self, keep: str) -> None:
+        # HBM budget: drop device arrays LRU (host mirrors keep serving)
+        budget = int(conf.get("delta.tpu.stateCache.maxBytes", 2 << 30))
+        resident = [(p, e) for p, e in self._entries.items() if e.is_resident]
+        total = sum(e.device_bytes for _, e in resident)
+        for p, e in sorted(resident, key=lambda kv: kv[1].last_used):
+            if total <= budget:
+                break
+            if p == keep:
+                continue
+            e.drop_device()
+            total -= e.device_bytes
+        # host budget: entries (mirrors + path dictionaries) are themselves
+        # sizable — drop whole tables LRU beyond maxEntries
+        max_entries = int(conf.get("delta.tpu.stateCache.maxEntries", 16))
+        if len(self._entries) > max_entries:
+            for p, _e in sorted(self._entries.items(),
+                                key=lambda kv: kv[1].last_used):
+                if p == keep:
+                    continue
+                self._entries.pop(p, None)
+                self._build_locks.pop(p, None)
+                if len(self._entries) <= max_entries:
+                    break

@@ -32,6 +32,7 @@ from typing import Optional
 __all__ = [
     "LinkProfile", "Estimate", "profile", "estimate_device_s", "reset",
     "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW",
+    "HOST_PRUNE_S_PER_CELL", "DEVICE_PRUNE_S_PER_CELL",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -42,6 +43,12 @@ _PROBE_BYTES = 1 << 20  # 1 MB
 KERNEL_S_PER_ROW = 1.1e-7
 # Arrow hash join, one host core, measured: ~1.1s for 11M rows
 HOST_JOIN_S_PER_ROW = 1.0e-7
+# batched min/max pruning, host numpy: ~0.6s for 100 preds x 1M files x 4
+# stat columns (DRAM-bound boolean reductions)
+HOST_PRUNE_S_PER_CELL = 1.5e-9
+# the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
+# ~2 f32 reads/cell at HBM bandwidth, fused compares
+DEVICE_PRUNE_S_PER_CELL = 2.0e-11
 
 
 @dataclass(frozen=True)

@@ -136,7 +136,10 @@ def dv_sidecar_path(dv: dict, data_path: str):
     and pre-checks like RESTORE's vacuumed-sidecar guard."""
     if not dv or dv.get("storageType") != STORAGE_FILE:
         return None
-    return os.path.join(data_path, dv["pathOrInlineDv"])
+    rel = dv.get("pathOrInlineDv")
+    if rel is None:
+        return None  # malformed descriptor: tolerated, the read path errors
+    return os.path.join(data_path, rel)
 
 
 def read_deletion_vector(

@@ -108,6 +108,14 @@ class SqlConf:
             return self._DEFAULTS[key]
         return default
 
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        """Boolean conf with string coercion: "false"/"0"/"off" (any case)
+        are False — a raw ``bool(conf.get(...))`` treats "false" as truthy."""
+        v = self.get(key, default)
+        if isinstance(v, str):
+            return v.strip().lower() not in ("false", "0", "off", "no", "")
+        return bool(v)
+
     def set(self, key: str, value: Any) -> None:
         with self._lock:
             self._values[key] = value

@@ -285,3 +285,23 @@ def test_catalog_register_refuses_live_claim(tmp_path):
     cat = Catalog(store)
     with pytest.raises(DeltaAnalysisError, match="concurrently"):
         cat.register("t", str(tmp_path / "y"))
+
+
+def test_catalog_same_host_claim_expires(tmp_path):
+    """A same-host claim whose pid is (or appears) alive still expires past
+    claimTimeoutMs — a recycled pid must not block the name forever."""
+    import socket
+    import json as _json
+
+    from delta_tpu.catalog.catalog import Catalog
+    from delta_tpu.utils.config import conf
+
+    store = str(tmp_path / "cat.json")
+    stale = {"path": str(tmp_path / "x"), "pid": 1,  # alive (init), not ours
+             "host": socket.gethostname(), "ts_ms": 0}  # ancient
+    with open(store, "w") as f:
+        _json.dump({"tables": {}, "claims": {"default.t": stale}}, f)
+    cat = Catalog(store)
+    with conf.set_temporarily(**{"delta.tpu.catalog.claimTimeoutMs": 1}):
+        cat.create_table("t", str(tmp_path / "real"), SCHEMA)
+    assert cat.table_path("t") == str(tmp_path / "real")

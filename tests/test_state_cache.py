@@ -1,0 +1,338 @@
+"""Device-resident snapshot state (`ops/state_cache.py`): correctness of the
+f32 conservative rounding, range extraction, batched planning parity
+(device vs host mirrors), incremental tail application, invalidation, and
+byte-budget eviction. Runs on the virtual CPU mesh like every device test."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.expr import ir
+from delta_tpu.expr.parser import parse_expression
+from delta_tpu.ops import pruning, state_cache
+from delta_tpu.ops.state_cache import (
+    DeviceStateCache, RangeSet, _f32_down, _f32_up, extract_ranges,
+)
+from delta_tpu.utils.config import conf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    DeviceStateCache.reset()
+    yield
+    DeviceStateCache.reset()
+
+
+def _mk_table(path, n_files=6, rows=40, start=0):
+    log = DeltaLog.for_table(path)
+    rng = np.random.RandomState(1)
+    for i in range(start, start + n_files):
+        WriteIntoDelta(log, "append", pa.table({
+            "a": np.arange(i * rows, (i + 1) * rows, dtype=np.int64),
+            "b": rng.rand(rows),
+        })).run()
+    return log
+
+
+def _ranges_for(snap, exprs):
+    entry = DeviceStateCache.instance().get(snap)
+    assert entry is not None
+    pcols = frozenset()
+    rs = []
+    for e in exprs:
+        pred = pruning.skipping_predicate(parse_expression(e), pcols)
+        r = extract_ranges(pred, entry.columns)
+        assert r is not None, e
+        rs.append(r)
+    return entry, rs
+
+
+# -- rounding ---------------------------------------------------------------
+
+
+def test_f32_rounding_directions():
+    xs = np.array([0.1, -0.1, 1e300, -1e300, 1.0, np.nan])
+    lo = _f32_down(xs)
+    hi = _f32_up(xs)
+    for i, x in enumerate(xs):
+        if np.isnan(x):
+            assert np.isnan(lo[i]) and np.isnan(hi[i])
+        else:
+            assert float(lo[i]) <= x <= float(hi[i])
+    # exact f32 values stay exact
+    assert float(lo[4]) == 1.0 == float(hi[4])
+    # 1e300 overflows f32: down must stay finite-below, up goes +inf
+    assert float(lo[2]) < np.inf and float(hi[2]) == np.inf
+
+
+# -- range extraction -------------------------------------------------------
+
+
+def test_extract_ranges_shapes():
+    cols = ["a", "b"]
+    p = lambda s: pruning.skipping_predicate(parse_expression(s), frozenset())
+    r = extract_ranges(p("a = 5"), cols)
+    assert r.lo[0] == 5 and r.hi[0] == 5 and np.isnan(r.lo[1])
+    r = extract_ranges(p("a > 3 AND a < 10 AND b >= 0.5"), cols)
+    assert r.lo[0] == 3 and r.hi[0] == 10 and r.lo[1] == 0.5
+    # OR does not lower; null tests do not lower
+    assert extract_ranges(p("a = 1 OR a = 2"), cols) is None
+    assert extract_ranges(p("a IS NULL"), cols) is None
+    # unknown column in the predicate -> not extractable
+    assert extract_ranges(p("zzz = 1"), cols) is None
+    # contradiction -> empty verdict
+    r = extract_ranges(ir.Literal(False), cols)
+    assert r.verdict == "empty"
+    # unconstrained -> all verdict
+    r = extract_ranges(ir.Literal(None), cols)
+    assert r.verdict == "all"
+
+
+# -- end-to-end parity ------------------------------------------------------
+
+
+def test_plan_matches_files_for_scan(tmp_table):
+    log = _mk_table(tmp_table)
+    snap = log.update()
+    queries = ["a = 25", "a >= 100 AND a <= 139", "a <= -1", "b <= 2.0"]
+    entry, rs = _ranges_for(snap, queries)
+    for use_device in (False, True):
+        plans = entry.plan_ranges(rs, k=16, use_device=use_device)
+        for q, plan in zip(queries, plans):
+            scan = pruning.files_for_scan(snap, [parse_expression(q)])
+            expect = sorted(f.path for f in scan.files)
+            got = sorted(entry.paths[r] for r in plan.rows)
+            assert got == expect, (q, use_device)
+            assert plan.count == len(expect)
+
+
+def test_plan_strict_bounds_keep_superset(tmp_table):
+    """Strict comparisons relax to non-strict in the range lowering: the plan
+    may keep a boundary file the exact evaluator drops, never the reverse,
+    and device and host mirrors agree exactly with each other."""
+    log = _mk_table(tmp_table)
+    snap = log.update()
+    queries = ["a < 40", "a > 199", "a < 0"]
+    entry, rs = _ranges_for(snap, queries)
+    host = entry.plan_ranges(rs, k=16, use_device=False)
+    dev = entry.plan_ranges(rs, k=16, use_device=True)
+    for q, h, d in zip(queries, host, dev):
+        assert sorted(h.rows) == sorted(d.rows), q
+        scan = pruning.files_for_scan(snap, [parse_expression(q)])
+        expect = {f.path for f in scan.files}
+        got = {entry.paths[r] for r in h.rows}
+        assert expect <= got, q
+
+
+def test_plan_overflow_falls_back_exact(tmp_table):
+    log = _mk_table(tmp_table, n_files=8)
+    snap = log.update()
+    entry, rs = _ranges_for(snap, ["a >= 0"])  # matches all 8 files
+    plans = entry.plan_ranges(rs, k=3, use_device=True)
+    assert plans[0].count == 8
+    assert plans[0].overflow and len(plans[0].rows) == 3
+
+
+def test_f32_boundary_keeps_file(tmp_table):
+    """A bound that f32 rounds past must keep the boundary file, not drop it:
+    the file [lo, hi] with a query literal between f32 grid points."""
+    log = DeltaLog.for_table(tmp_table)
+    # 16777217 = 2^24 + 1 is not representable in f32 (rounds to 2^24)
+    v = 2**24 + 1
+    WriteIntoDelta(log, "append", pa.table({"a": np.array([v], np.int64)})).run()
+    snap = log.update()
+    entry, rs = _ranges_for(snap, [f"a = {v}"])
+    for use_device in (False, True):
+        plans = entry.plan_ranges(rs, k=4, use_device=use_device)
+        assert plans[0].count == 1, use_device
+
+
+# -- incremental tail -------------------------------------------------------
+
+
+def test_incremental_tail_append(tmp_table):
+    log = _mk_table(tmp_table, n_files=3)
+    entry1 = DeviceStateCache.instance().get(log.update())
+    entry1.ensure_resident()
+    v1 = entry1.version
+    _mk_table(tmp_table, n_files=2, start=3)  # two more commits
+    snap2 = log.update()
+    entry2 = DeviceStateCache.instance().get(snap2)
+    assert entry2 is entry1, "tail must apply incrementally, not rebuild"
+    assert entry2.version == snap2.version > v1
+    assert entry2.num_rows == 5
+    # parity after the incremental device update
+    entry, rs = _ranges_for(snap2, ["a >= 120"])
+    for use_device in (False, True):
+        plans = entry.plan_ranges(rs, k=8, use_device=use_device)
+        scan = pruning.files_for_scan(snap2, [parse_expression("a >= 120")])
+        assert sorted(entry.paths[r] for r in plans[0].rows) == sorted(
+            f.path for f in scan.files)
+
+
+def test_incremental_tail_remove_and_readd(tmp_table):
+    from delta_tpu.commands.delete import DeleteCommand
+
+    log = _mk_table(tmp_table, n_files=4)
+    cache = DeviceStateCache.instance()
+    e1 = cache.get(log.update())
+    e1.ensure_resident()
+    # delete one whole file's rows -> that file is removed
+    DeleteCommand(log, "a < 40").run()
+    snap = log.update()
+    e2 = cache.get(snap)
+    assert e2 is e1
+    entry, rs = _ranges_for(snap, ["a >= 0"])
+    plans = entry.plan_ranges(rs, k=16, use_device=True)
+    scan = pruning.files_for_scan(snap, [parse_expression("a >= 0")])
+    assert sorted(entry.paths[r] for r in plans[0].rows) == sorted(
+        f.path for f in scan.files)
+    assert plans[0].count == len(scan.files)
+
+
+def test_metadata_change_rebuilds(tmp_table):
+    from delta_tpu.commands.alter import set_table_properties
+
+    log = _mk_table(tmp_table, n_files=2)
+    cache = DeviceStateCache.instance()
+    e1 = cache.get(log.update())
+    set_table_properties(log, {"delta.logRetentionDuration": "interval 30 days"})
+    snap = log.update()
+    e2 = cache.get(snap)
+    assert e2 is not None and e2.version == snap.version
+    assert e2 is not e1, "a Metadata action in the tail must force a rebuild"
+
+
+def test_table_replaced_invalidates(tmp_table):
+    import shutil
+
+    log = _mk_table(tmp_table, n_files=2)
+    cache = DeviceStateCache.instance()
+    e1 = cache.get(log.update())
+    assert e1 is not None
+    shutil.rmtree(tmp_table)
+    DeltaLog.clear_cache()
+    log2 = _mk_table(tmp_table, n_files=1)
+    e2 = cache.get(log2.update())
+    assert e2 is not e1 and e2.num_rows == 1
+
+
+def test_time_travel_below_residency_serves_host(tmp_table):
+    log = _mk_table(tmp_table, n_files=3)
+    cache = DeviceStateCache.instance()
+    cache.get(log.update())
+    old = log.get_snapshot_at(0)
+    assert cache.get(old) is None  # residency never serves an older version
+
+
+def test_partitioned_table_unsupported(tmp_table):
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.schema.types import IntegerType, StringType, StructType
+
+    schema = StructType().add("p", StringType()).add("a", IntegerType())
+    DeltaTable.create(tmp_table, schema, partition_columns=["p"])
+    snap = DeltaLog.for_table(tmp_table).update()
+    assert DeviceStateCache.instance().get(snap) is None
+
+
+def test_budget_eviction(tmp_path):
+    cache = DeviceStateCache.instance()
+    entries = []
+    for i in range(3):
+        log = _mk_table(str(tmp_path / f"t{i}"), n_files=2)
+        e = cache.get(log.update())
+        e.ensure_resident()
+        entries.append(e)
+    with conf.set_temporarily(**{"delta.tpu.stateCache.maxBytes": "1"}):
+        log = _mk_table(str(tmp_path / "t3"), n_files=2)
+        e3 = cache.get(log.update())
+        e3.ensure_resident()
+        cache._evict_over_budget(keep=e3.log_path)
+    assert e3.is_resident  # the active entry is never evicted
+    assert not any(e.is_resident for e in entries)
+    # evicted entries still serve from host mirrors and can re-warm
+    _, rs = _ranges_for(DeltaLog.for_table(str(tmp_path / "t0")).update(), ["a >= 0"])
+    assert entries[0].plan_ranges(rs, k=8, use_device=False)[0].count == 2
+
+
+def test_disabled_by_conf(tmp_table):
+    log = _mk_table(tmp_table, n_files=1)
+    with conf.set_temporarily(**{"delta.tpu.stateCache.enabled": "false"}):
+        assert DeviceStateCache.instance().get(log.update()) is None
+
+
+# -- batched planning API (exec/scan.plan_scans) ---------------------------
+
+
+def test_plan_scans_batch(tmp_table):
+    from delta_tpu.exec.scan import plan_scans, scan_files
+
+    log = _mk_table(tmp_table, n_files=5)
+    snap = log.update()
+    queries = [
+        ["a = 25"],                       # range -> resident path
+        ["a >= 0 AND a <= 79"],           # range, 2 files
+        ["a = 1 OR a = 190"],             # OR -> per-query fallback
+        ["b IS NULL"],                    # null test -> fallback
+    ]
+    plans = plan_scans(snap, queries, k=8)
+    assert plans[0].via in ("device", "host-resident")
+    assert plans[2].via == "scan" and plans[3].via == "scan"
+    for q, plan in zip(queries, plans):
+        expect = {f.path for f in scan_files(snap, q).files}
+        assert expect <= set(plan.paths), q
+        assert plan.count == len(plan.paths)
+
+
+def test_plan_scans_forced_device_matches_host(tmp_table):
+    from delta_tpu.exec.scan import plan_scans
+
+    log = _mk_table(tmp_table, n_files=4)
+    snap = log.update()
+    queries = [[f"a = {i * 40 + 7}"] for i in range(4)]
+    with conf.set_temporarily(**{"delta.tpu.stateCache.devicePlan.mode": "force"}):
+        dev = plan_scans(snap, queries, k=8)
+    with conf.set_temporarily(**{"delta.tpu.stateCache.devicePlan.mode": "off"}):
+        host = plan_scans(snap, queries, k=8)
+    assert [sorted(p.paths) for p in dev] == [sorted(p.paths) for p in host]
+    assert dev[0].via == "device" and host[0].via == "host-resident"
+
+
+def test_plan_ranges_stale_version_returns_none(tmp_table):
+    """A caller planning for snapshot v must not be served by an entry that
+    advanced to v+1 (the apply_tail race): expected_version guards it."""
+    log = _mk_table(tmp_table, n_files=2)
+    snap1 = log.update()
+    cache = DeviceStateCache.instance()
+    cache.get(snap1)
+    _mk_table(tmp_table, n_files=1, start=2)
+    snap2 = log.update()
+    entry = cache.get(snap2)  # entry advances to v2
+    _, rs = _ranges_for(snap2, ["a >= 0"])
+    assert entry.plan_ranges(rs, expected_version=snap1.version) is None
+    assert entry.plan_ranges(rs, expected_version=snap2.version) is not None
+
+
+def test_max_entries_evicts_whole_tables(tmp_path):
+    cache = DeviceStateCache.instance()
+    logs = [_mk_table(str(tmp_path / f"m{i}"), n_files=1) for i in range(4)]
+    with conf.set_temporarily(**{"delta.tpu.stateCache.maxEntries": "2"}):
+        for lg in logs:
+            cache.get(lg.update())
+    assert len(cache._entries) <= 3  # keep + at most maxEntries
+
+
+def test_plan_scans_stale_entry_falls_back(tmp_table):
+    """plan_scans against an older snapshot than residency: per-query scan."""
+    from delta_tpu.exec.scan import plan_scans, scan_files
+
+    log = _mk_table(tmp_table, n_files=3)
+    old = log.update()
+    cache = DeviceStateCache.instance()
+    cache.get(old)
+    _mk_table(tmp_table, n_files=1, start=3)
+    cache.get(log.update())  # advance residency past `old`
+    plans = plan_scans(old, [["a >= 0"]], k=16)
+    assert plans[0].via == "scan"
+    assert set(plans[0].paths) == {f.path for f in scan_files(old, ["a >= 0"]).files}
