@@ -169,7 +169,7 @@ def bench_merge_upsert(workdir):
         shutil.copytree(path, p, copy_function=os.link)
     gb = (_dir_bytes(path) + source.nbytes) / 1e9
 
-    def run_merge(table_path, mode):
+    def run_merge(table_path, mode, src_tab=None, resident=False):
         from delta_tpu import DeltaLog as DL
 
         DL.clear_cache()
@@ -180,9 +180,13 @@ def bench_merge_upsert(workdir):
         with conf.set_temporarily(**{
             "delta.tpu.merge.devicePath.mode": mode,
             "delta.tpu.deletionVectors.enabled": mode != "off",
+            # the resident-key lane is exercised by its own legs below; the
+            # cold trials stay cold (no background build skewing them)
+            "delta.tpu.merge.residentKeys.enabled": resident,
         }):
             cmd = MergeIntoCommand(
-                lg, source, "t.ss_item_sk = s.ss_item_sk",
+                lg, source if src_tab is None else src_tab,
+                "t.ss_item_sk = s.ss_item_sk",
                 [MergeClause("update", assignments=None)],
                 [MergeClause("insert", assignments=None)],
                 source_alias="s", target_alias="t",
@@ -212,6 +216,54 @@ def bench_merge_upsert(workdir):
     host_s, host_cmd = min(host_trials, key=lambda x: x[0])
     assert forced_cmd._device_join is not None, "forced device join did not run"
 
+    # resident-key steady state (the CDC loop): the warm copy was merged
+    # once already; build its key lane (reported separately — in production
+    # it builds in the background after the first eligible merge), then a
+    # second merge probes from HBM, shipping only source keys
+    from delta_tpu import DeltaLog as DL
+    from delta_tpu.commands.merge import MergeIntoCommand as MIC
+    from delta_tpu.expr import ir as _ir
+    from delta_tpu.ops.key_cache import KeyCache
+
+    DL.clear_cache()
+    lg = DL.for_table(copies["warm"])
+    snapw = lg.update()
+    t_exprs = [_ir.Column("ss_item_sk")]
+    sig = MIC._key_signature(t_exprs)
+    build_s, entry = _timed(lambda: KeyCache.instance().get(
+        snapw, sig, ["ss_item_sk"], t_exprs))
+    assert entry is not None
+    up_s, _ = _timed(entry.ensure_resident)
+    build_s += up_s
+    # per-round sources against the evolving table: updates hit original
+    # keys (always present), inserts use disjoint fresh ranges per round
+    def mk_source(round_i):
+        ex = np.asarray(target.column("ss_item_sk"))[
+            np.random.RandomState(17 + round_i).choice(
+                n_target, n_source // 2, replace=False)]
+        fr = np.arange(n_target * (3 + round_i),
+                       n_target * (3 + round_i) + (n_source - n_source // 2),
+                       dtype=np.int64)
+        keys = np.concatenate([ex, fr])
+        np.random.RandomState(23 + round_i).shuffle(keys)
+        s = _store_sales(n_source, np.random.RandomState(29 + round_i))
+        return s.set_column(0, "ss_item_sk", pa.array(keys))
+
+    # rounds 1-2 warm the kernel compiles for this shape bucket (probe +
+    # tail-advance scatters; first machine contact — the persistent XLA
+    # cache makes later processes skip them); rounds 3-4 are the steady
+    # state being measured
+    run_merge(copies["warm"], "force", src_tab=mk_source(0), resident=True)
+    run_merge(copies["warm"], "force", src_tab=mk_source(1), resident=True)
+    drain()
+    resident_s, res_cmd = _timed(lambda: run_merge(
+        copies["warm"], "force", src_tab=mk_source(2), resident=True))
+    assert res_cmd._join_path == "resident", res_cmd._join_path
+    # what auto picks with the lane resident (honest link-model verdict)
+    drain()
+    res_auto_s, res_auto_cmd = _timed(lambda: run_merge(
+        copies["warm"], "auto", src_tab=mk_source(3), resident=True))
+
     from delta_tpu.parallel import link
 
     lp = link.profile()
@@ -234,6 +286,13 @@ def bench_merge_upsert(workdir):
         # auto router engages the same kernel
         "device_forced_s": round(forced_s, 2),
         "device_forced_phases": dict(forced_cmd.phase_ms),
+        # steady-state CDC legs: target key lane HBM-resident, probe ships
+        # only source keys (ops/key_cache)
+        "device_resident_s": round(resident_s, 2),
+        "device_resident_phases": dict(res_cmd.phase_ms),
+        "resident_build_s": round(build_s, 2),
+        "resident_auto_s": round(res_auto_s, 2),
+        "resident_auto_path": res_auto_cmd._join_path,
         "link_MBps": {"up": round(lp.up_mbps, 1), "down": round(lp.down_mbps, 1),
                       "latency_ms": round(lp.latency_s * 1000, 1)},
     }

@@ -27,8 +27,7 @@ from delta_tpu.expr import ir
 from delta_tpu.log.deltalog import DeltaLog
 from delta_tpu.protocol.actions import Protocol
 from delta_tpu.schema.types import StructType
-from delta_tpu.utils import errors as errors_mod
-from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["DeltaTable", "DeltaMergeBuilder", "DeltaOptimizeBuilder"]
 
@@ -46,7 +45,7 @@ class DeltaTable:
     def for_path(cls, path: str, store=None, clock=None) -> "DeltaTable":
         log = DeltaLog.for_table(path, store=store, clock=clock)
         if not log.table_exists:
-            raise errors_mod.not_a_delta_table(path)
+            raise errors.not_a_delta_table(path)
         return cls(log)
 
     @classmethod
@@ -119,7 +118,9 @@ class DeltaTable:
 
     def _snapshot(self, version: Optional[int] = None,
                   timestamp: Optional[Union[str, int]] = None):
-        return self.delta_log.snapshot_for(version, timestamp)
+        # reads may serve within the staleness window (background refresh);
+        # copy-like surfaces resolve their own snapshots synchronously
+        return self.delta_log.snapshot_for(version, timestamp, stale_ok=True)
 
     @property
     def version(self) -> int:
@@ -211,10 +212,7 @@ class DeltaTable:
 
     def generate(self, mode: str = "symlink_format_manifest") -> None:
         if mode != "symlink_format_manifest":
-            raise DeltaAnalysisError(
-                f"Specified mode {mode!r} is not supported; only "
-                "'symlink_format_manifest' is"
-            )
+            raise errors.unsupported_generate_mode(mode)
         from delta_tpu.hooks.symlink_manifest import generate_full_manifest
 
         generate_full_manifest(self.delta_log)

@@ -21,6 +21,7 @@ from typing import Dict, Optional, Sequence
 
 from delta_tpu.utils.config import conf
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["Catalog", "default_catalog", "resolve_identifier"]
 
@@ -30,7 +31,7 @@ def _normalize(name: str) -> str:
     if len(parts) == 1:
         parts = ["default"] + parts
     if len(parts) != 2 or not all(parts):
-        raise DeltaAnalysisError(f"Invalid table identifier: {name!r}")
+        raise errors.invalid_table_identifier(name)
     return ".".join(p.lower() for p in parts)
 
 
@@ -141,12 +142,10 @@ class Catalog:
             if self._store_path:
                 self._load()
             if key in self._tables:
-                raise DeltaAnalysisError(f"Table {name!r} already exists in catalog")
+                raise errors.table_already_exists_in_catalog(name)
             claim = self._claims.get(key)
             if claim is not None and self._claim_is_live(claim):
-                raise DeltaAnalysisError(
-                    f"Table {name!r} is being created concurrently"
-                )
+                raise errors.table_being_created_concurrently(name)
             self._claims.pop(key, None)
             self._tables[key] = os.path.abspath(path)
             self._save()
@@ -173,14 +172,10 @@ class Catalog:
                 self._load()
             if mode == "create":
                 if key in self._tables:
-                    raise DeltaAnalysisError(
-                        f"Table {name!r} already exists in catalog"
-                    )
+                    raise errors.table_already_exists_in_catalog(name)
                 claim = self._claims.get(key)
                 if claim is not None and self._claim_is_live(claim):
-                    raise DeltaAnalysisError(
-                        f"Table {name!r} is being created concurrently"
-                    )
+                    raise errors.table_being_created_concurrently(name)
             my_claim = self._new_claim(abs_path)
             self._claims[key] = my_claim
             self._save()
@@ -214,7 +209,7 @@ class Catalog:
             if self._store_path:
                 self._load()
             if key not in self._tables:
-                raise DeltaAnalysisError(f"Table {name!r} not found in catalog")
+                raise errors.table_not_found_in_catalog(name)
             del self._tables[key]
             self._save()
 
@@ -225,7 +220,7 @@ class Catalog:
                 self._load()
             path = self._tables.get(key)
         if path is None:
-            raise DeltaAnalysisError(f"Table {name!r} not found in catalog")
+            raise errors.table_not_found_in_catalog(name)
         return path
 
     def table_exists(self, name: str) -> bool:

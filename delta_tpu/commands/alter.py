@@ -16,8 +16,8 @@ from delta_tpu.expr.vectorized import boolean_mask
 from delta_tpu.schema import schema_utils
 from delta_tpu.schema.constraints import CONSTRAINT_PROP_PREFIX
 from delta_tpu.schema.types import StructField, StructType
-from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = [
     "set_table_properties",
@@ -49,7 +49,7 @@ def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = Fal
             actual = norm.get(k.lower())
             if actual is None:
                 if not if_exists:
-                    raise errors_mod.unset_nonexistent_property(
+                    raise errors.unset_nonexistent_property(
                         k, delta_log.data_path
                     )
                 continue
@@ -81,13 +81,9 @@ def _position_spec(schema: StructType, parent_parts, leaf_spec):
                     else parent.value_type
                 )
             else:
-                raise DeltaAnalysisError(
-                    f"Parent {'.'.join(parent_parts)} is not a struct"
-                )
+                raise errors.parent_is_not_struct('.'.join(parent_parts))
         if not isinstance(parent, StructType):
-            raise DeltaAnalysisError(
-                f"Parent {'.'.join(parent_parts)} is not a struct"
-            )
+            raise errors.parent_is_not_struct('.'.join(parent_parts))
     else:
         parent_pos = []
         parent = schema
@@ -101,12 +97,10 @@ def _position_spec(schema: StructType, parent_parts, leaf_spec):
             (i for i, f in enumerate(parent.fields) if f.name.lower() == sib), None
         )
         if match is None:
-            raise DeltaAnalysisError(
-                f"Couldn't find column {leaf_spec[1]} to position AFTER"
-            )
+            raise errors.position_after_column_not_found(leaf_spec[1])
         idx = match + 1
     else:
-        raise DeltaAnalysisError(f"Invalid column position spec {leaf_spec!r}")
+        raise errors.invalid_column_position_spec(leaf_spec)
     return list(parent_pos) + [idx]
 
 
@@ -127,9 +121,7 @@ def add_columns(
         schema = meta.schema
         for f in new_fields:
             if not f.nullable:
-                raise DeltaAnalysisError(
-                    f"ADD COLUMNS requires nullable columns, {f.name} is NOT NULL"
-                )
+                raise errors.add_columns_must_be_nullable(f.name)
             parts = f.name.split(".")
             leaf = replace(f, name=parts[-1])
             pos = _position_spec(schema, parts[:-1], positions.get(f.name))
@@ -163,20 +155,17 @@ def change_column(
         pos = schema_utils.find_column_position(parts, schema)
         field = schema_utils.find_field(schema, name)
         if field is None:
-            raise DeltaAnalysisError(f"Column {name!r} not found")
+            raise errors.column_not_in_schema(name)
         new_field = field
         if new_type is not None and new_type != field.data_type:
             if not schema_utils.can_change_data_type(field.data_type, new_type):
-                raise DeltaAnalysisError(
-                    f"Cannot change column {name} from "
-                    f"{field.data_type.simple_string()} to {new_type.simple_string()}"
-                )
+                raise errors.cannot_change_column_type(
+                    name, field.data_type.simple_string(),
+                    new_type.simple_string())
             new_field = replace(new_field, data_type=new_type)
         if nullable is not None:
             if not nullable and field.nullable:
-                raise DeltaAnalysisError(
-                    f"Cannot change nullable column {name} to NOT NULL"
-                )
+                raise errors.cannot_change_nullable_to_not_null(name)
             new_field = replace(new_field, nullable=nullable)
         if comment is not None:
             md = dict(new_field.metadata or {})
@@ -208,14 +197,14 @@ def add_constraint(delta_log, name: str, expr_sql: str) -> int:
         meta = txn.metadata
         cfg = dict(meta.configuration or {})
         if any(k.lower() == key for k in cfg):
-            raise DeltaAnalysisError(f"Constraint '{name}' already exists")
+            raise errors.constraint_already_exists(name)
         expr = parse_predicate(expr_sql)
         existing = scan_to_table(txn.snapshot)
         if existing.num_rows:
             ok = boolean_mask(expr, existing)
             bad = (pc.sum(pc.invert(ok)).as_py() or 0)
             if bad:
-                raise errors_mod.new_check_constraint_violated(
+                raise errors.new_check_constraint_violated(
                     bad, delta_log.data_path, expr_sql
                 )
         txn.read_whole_table()
@@ -236,7 +225,7 @@ def drop_constraint(delta_log, name: str, if_exists: bool = True) -> int:
         if actual is None:
             if if_exists:
                 return txn.commit([], ops.DropConstraint(name, None))
-            raise DeltaAnalysisError(f"Constraint '{name}' does not exist")
+            raise errors.constraint_does_not_exist(name)
         expr = cfg.pop(actual)
         txn.update_metadata(replace(meta, configuration=cfg))
         return txn.commit([], ops.DropConstraint(name, expr))

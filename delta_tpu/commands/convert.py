@@ -23,6 +23,7 @@ from delta_tpu.protocol.actions import Action, AddFile, Metadata
 from delta_tpu.schema.arrow_interop import schema_from_arrow
 from delta_tpu.schema.types import StructType
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaFileNotFoundError
+from delta_tpu.utils import errors
 
 __all__ = ["ConvertToDeltaCommand"]
 
@@ -64,18 +65,12 @@ class ConvertToDeltaCommand:
         values: Dict[str, Optional[str]] = {}
         for seg in parts:
             if "=" not in seg:
-                raise DeltaAnalysisError(
-                    f"Expecting partition column in path segment {seg!r} of {rel!r}"
-                )
+                raise errors.partition_path_segment_invalid(seg, rel)
             k, _, v = seg.partition("=")
             values[k] = unescape_partition_value(v)
         expected = [f.name for f in (self.partition_schema.fields if self.partition_schema else [])]
         if sorted(values) != sorted(expected):
-            raise DeltaAnalysisError(
-                f"Partition columns in path {rel!r} ({sorted(values)}) don't match "
-                f"the declared partition schema ({sorted(expected)}). "
-                "CONVERT TO DELTA requires PARTITIONED BY matching the layout."
-            )
+            raise errors.partition_path_mismatch(rel, values, expected)
         return values
 
     def run(self) -> int:

@@ -28,6 +28,7 @@ from delta_tpu.utils.errors import (
     DeltaAnalysisError,
     DeltaIllegalArgumentError,
 )
+from delta_tpu.utils import errors
 
 __all__ = ["CreateDeltaTableCommand"]
 
@@ -112,20 +113,17 @@ class CreateDeltaTableCommand:
         exists = log.update().version >= 0
         if exists:
             if self.mode == "create":
-                raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
+                raise errors.table_already_exists(log.data_path)
             if self.mode == "create_if_not_exists":
                 self._reconcile_existing(log.snapshot.metadata)
                 return log.snapshot.version
         elif self.mode == "replace":
-            raise DeltaAnalysisError(
-                f"Table not found: {log.data_path} (REPLACE requires an "
-                "existing table; use CREATE OR REPLACE)"
-            )
+            raise errors.replace_requires_existing_table(log.data_path)
 
         def body(txn) -> int:
             exists_now = txn.snapshot.version >= 0
             if exists_now and self.mode == "create":
-                raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
+                raise errors.table_already_exists(log.data_path)
             if exists_now and self.mode == "create_if_not_exists":
                 self._reconcile_existing(txn.snapshot.metadata)
                 return txn.snapshot.version

@@ -43,6 +43,7 @@ from delta_tpu.expr.vectorized import boolean_mask, evaluate
 from delta_tpu.protocol.actions import Action, AddFile
 from delta_tpu.utils.config import conf
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaUnsupportedOperationError
+from delta_tpu.utils import errors as errors_mod
 
 __all__ = ["MergeIntoCommand", "MergeClause"]
 
@@ -167,10 +168,10 @@ class MergeIntoCommand:
     def _validate_clauses(self) -> None:
         for c in self.matched_clauses:
             if c.kind not in ("update", "delete"):
-                raise DeltaAnalysisError(f"Invalid matched clause: {c.kind}")
+                raise errors_mod.invalid_merge_clause(c.kind, matched=True)
         for c in self.not_matched_clauses:
             if c.kind != "insert":
-                raise DeltaAnalysisError(f"Invalid not-matched clause: {c.kind}")
+                raise errors_mod.invalid_merge_clause(c.kind, matched=False)
         # only the last clause of each group may lack a condition
         for group in (self.matched_clauses, self.not_matched_clauses):
             for c in group[:-1]:
@@ -237,19 +238,13 @@ class MergeIntoCommand:
                 # an unknown qualifier must NOT fall back to bare resolution:
                 # 't.id = s.id' without aliases would resolve both sides to
                 # the target and turn the condition into a tautology
-                raise DeltaAnalysisError(
-                    f"Cannot resolve {name!r} in MERGE: qualifier {qual!r} matches "
-                    f"neither target alias {self.target_alias!r} nor source alias "
-                    f"{self.source_alias!r}"
-                )
+                raise errors_mod.merge_unresolvable_qualifier(
+                    name, qual, self.target_alias, self.source_alias)
             if low in t_low:
                 return ir.Column(t_low[low])
             if low in s_low:
                 return ir.Column(_SRC + s_low[low])
-            raise DeltaAnalysisError(
-                f"Cannot resolve {name!r} in MERGE (target={list(target_cols)}, "
-                f"source={list(source_cols)})"
-            )
+            raise errors_mod.merge_unresolvable_column(name, target_cols, source_cols)
 
         return e.transform(rewrite)
 
@@ -285,6 +280,8 @@ class MergeIntoCommand:
         # reset per-execution state: a re-run that takes the host or empty
         # path must not consume a previous run's device-join flags
         self._device_join = None
+        self._resident_candidate = None
+        self._join_path = "host"  # 'resident' | 'device-upload' | 'host'
         self._cdf_blocks = []
         self._use_cdf = cdf_exec.cdf_enabled(txn.metadata)
         self.phase_ms.clear()
@@ -439,7 +436,9 @@ class MergeIntoCommand:
             deletes=[_clause_info(c) for c in self.matched_clauses if c.kind == "delete"],
             inserts=[_clause_info(c) for c in self.not_matched_clauses],
         )
-        return txn.commit(removes + adds + cdc_actions, op)
+        version = txn.commit(removes + adds + cdc_actions, op)
+        self._maybe_build_resident_keys()
+        return version
 
     # -- join -------------------------------------------------------------
 
@@ -479,7 +478,7 @@ class MergeIntoCommand:
             )
 
         mode = str(conf.get("delta.tpu.merge.devicePath.mode", "auto"))
-        device_eligible = (
+        base_eligible = (
             bool(conf.get("delta.tpu.merge.devicePath.enabled", True))
             and mode != "off"
             and 1 <= len(equi) <= 2
@@ -487,6 +486,7 @@ class MergeIntoCommand:
             and candidates
             and src.num_rows > 0
         )
+        device_eligible = base_eligible
         if device_eligible and mode == "auto":
             # pre-decode routing check from AddFile stats row counts: on a
             # slow link even the *optimistic* plan (int32 keys) loses to the
@@ -516,8 +516,18 @@ class MergeIntoCommand:
         )
         decode_t = Timer()
         pending = None
+        resident = None
         key_pieces: Optional[List[pa.Table]] = None
-        if device_eligible:
+        if base_eligible:
+            # resident-operand path first: the target key lane already lives
+            # in HBM (ops/key_cache), so the probe ships only source keys —
+            # different economics from the cold upload path, hence evaluated
+            # before (and independent of) the upload-cost gate above
+            resident = self._launch_resident_probe(
+                txn, candidates, src, equi, target_cols, key_need,
+                pos_col, insert_only,
+            )
+        if resident is None and device_eligible:
             key_cols = [c for c in target_cols if c.lower() in key_need]
             key_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
@@ -576,10 +586,19 @@ class MergeIntoCommand:
             return empty_pairs(), tgt_tables
 
         join_t = Timer()
+        if resident is not None and pending is None:
+            pending = self._finalize_resident(
+                resident, candidates, tgt_tables, target, src, equi,
+                pos_col, insert_only,
+            )
+            via = "resident"
+        else:
+            via = "device-upload"
         if pending is not None:
             res = pending.result()
             if res is not None:
                 self._device_join = res
+                self._join_path = via
                 # insert-only never consumes the pair rows (the not-matched
                 # block comes from s_matched): skip materializing them
                 if insert_only:
@@ -695,6 +714,164 @@ class MergeIntoCommand:
             return None
         return cols or None
 
+    # -- resident-key device path (ops/key_cache) -------------------------
+
+    @staticmethod
+    def _key_signature(t_exprs) -> str:
+        return repr([repr(e) for e in t_exprs])
+
+    def _launch_resident_probe(self, txn, candidates, src, equi, target_cols,
+                               key_need, pos_col, insert_only):
+        """Probe the HBM-resident target key lane (if one is current for this
+        table + key signature): ships only the source keys. Returns
+        (entry, PendingProbe, s_keys, s_ok) or None — and when the lane
+        doesn't exist yet, records the signature so a background build can
+        start after this merge commits (the CDC steady-state warmup)."""
+        import numpy as np
+
+        from delta_tpu.expr.vectorized import evaluate
+        from delta_tpu.ops import key_cache as kc_mod
+        from delta_tpu.parallel import link
+
+        if not conf.get_bool("delta.tpu.merge.residentKeys.enabled", True):
+            return None
+        # bit mapping back to the DV-filtered decode needs physical
+        # positions; without them only DV-free candidates are alignable
+        # (insert-only merges never consume per-target bits)
+        if (pos_col is None and not insert_only
+                and any(f.deletion_vector is not None for f in candidates)):
+            return None
+        t_exprs = [t for t, _ in equi]
+        s_exprs = [s for _, s in equi]
+        sig = self._key_signature(t_exprs)
+        key_cols = [c for c in target_cols if c.lower() in key_need]
+        entry = kc_mod.KeyCache.instance().get(
+            txn.snapshot, sig, key_cols, t_exprs, build_if_missing=False
+        )
+        if entry is None:
+            self._resident_candidate = (sig, key_cols, t_exprs)
+            return None
+        packed = kc_mod._pack_lanes(src, s_exprs, evaluate)
+        if packed is None:
+            return None
+        s_keys, s_ok = packed
+        if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "auto":
+            m = len(s_keys)
+            n = entry.num_rows
+            p = link.profile()
+            # optimistic int32 narrowing (like the upload path's pre-gate);
+            # the kernel constant is the calibrated resident-probe cost
+            device_s = (
+                p.upload_s(m * 4)
+                + p.download_s(n // 8 + m // 8)
+                + (n + m) * link.RESIDENT_PROBE_S_PER_ROW
+                + 3 * p.latency_s
+            )
+            if not entry.is_resident:
+                # the device copy was evicted / regrown: the probe would
+                # synchronously re-ship the whole slab first — charge it
+                device_s += p.upload_s(entry.capacity * 9)
+            host_s = ((n + m) * link.HOST_JOIN_S_PER_ROW
+                      + n * link.HOST_KEY_DECODE_S_PER_ROW)
+            if device_s > host_s:
+                return None
+        probe = entry.probe_async(s_keys, s_ok)
+        if probe is None:
+            return None
+        return entry, probe, s_keys, s_ok
+
+    def _finalize_resident(self, resident, candidates, tgt_tables, target,
+                           src, equi, pos_col, insert_only):
+        """Map the physical-space probe bits onto the DV-filtered decode and
+        recover the matched pairing from the already-decoded target keys.
+        Returns a PendingJoin whose result is a JoinResult (or None → the
+        caller falls back to the host hash join)."""
+        import numpy as np
+
+        from delta_tpu.expr.vectorized import evaluate
+        from delta_tpu.ops import join_kernel, key_cache as kc_mod
+
+        entry, probe, s_keys, s_ok = resident
+
+        def finalize():
+            try:
+                res_p = probe.result()
+            except Exception:
+                return None
+            n_target = target.num_rows
+            t_first_s = np.full(n_target, -1, np.int64)
+            if insert_only:
+                # only s_matched / any_multi are consumed downstream
+                return join_kernel.JoinResult(
+                    t_first_s, res_p.s_matched, res_p.any_multi
+                )
+            t_matched = np.zeros(n_target, bool)
+            row_base = 0
+            for fid in sorted(tgt_tables):
+                t = tgt_tables[fid]
+                add = candidates[fid]
+                if pos_col is not None:
+                    positions = t.column(pos_col).to_numpy(zero_copy_only=False)
+                else:
+                    positions = None
+                bits = res_p.bits_for_file(add.path, positions, t.num_rows)
+                if bits is None:
+                    return None  # slab/decode disagree: host fallback
+                t_matched[row_base:row_base + t.num_rows] = bits
+                row_base += t.num_rows
+            idx = np.flatnonzero(t_matched)
+            if idx.size:
+                sub = target.take(pa.array(idx, pa.int64()))
+                packed = kc_mod._pack_lanes(
+                    sub, [t for t, _ in equi], evaluate
+                )
+                if packed is None:
+                    return None
+                tk, _tok = packed
+                t_first_s[idx] = join_kernel._first_match_recovery(
+                    tk, np.arange(len(tk)), s_keys, s_ok
+                )
+            return join_kernel.JoinResult(t_first_s, res_p.s_matched,
+                                          res_p.any_multi)
+
+        return join_kernel.PendingJoin(finalize)
+
+    def _maybe_build_resident_keys(self) -> None:
+        """Post-commit: start the background build of the resident key lane
+        recorded by `_launch_resident_probe`, so the NEXT merge into this
+        table probes from HBM. Never blocks the committing merge."""
+        cand = getattr(self, "_resident_candidate", None)
+        if cand is None:
+            return
+        self._resident_candidate = None
+        if not conf.get_bool("delta.tpu.merge.residentKeys.enabled", True):
+            return
+        if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "off":
+            return
+        sig, key_cols, t_exprs = cand
+        log = self.delta_log
+
+        def build():
+            try:
+                from delta_tpu.ops.key_cache import KeyCache
+
+                snap = log.update()
+                min_rows = int(conf.get(
+                    "delta.tpu.merge.residentKeys.minRows", 1 << 20))
+                est = sum(f.num_logical_records or 0 for f in snap.all_files)
+                if est < min_rows:
+                    return
+                e = KeyCache.instance().get(
+                    snap, sig, key_cols, t_exprs, build_if_missing=True)
+                if e is not None:
+                    e.ensure_resident()
+            except Exception:
+                pass  # best-effort warmup; the next merge just stays cold
+
+        import threading
+
+        threading.Thread(target=build, daemon=True, name="resident-keys-build").start()
+
     def _launch_device_join(self, key_tab: pa.Table, src: pa.Table, equi):
         """Evaluate + coerce the join keys and launch the device membership
         probe asynchronously (`ops/join_kernel.py`). Composite integer keys
@@ -777,11 +954,7 @@ class MergeIntoCommand:
             if c.lower() not in src_low and c.lower() not in gen
         ]
         if missing:
-            raise DeltaAnalysisError(
-                f"cannot resolve {missing[0]} in {typ} clause given columns "
-                f"{list(src_cols)} (enable delta.tpu.schema.autoMerge.enabled "
-                f"to evolve the target schema instead)"
-            )
+            raise errors_mod.merge_clause_unresolvable(missing[0], typ, src_cols)
 
     def _check_multi_match(self, pairs: pa.Table) -> None:
         """Error when a target row matches multiple source rows, unless the

@@ -31,6 +31,7 @@ from delta_tpu.expr.parser import parse_predicate
 from delta_tpu.ops.zorder import morton_order
 from delta_tpu.protocol.actions import Action, AddFile
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["OptimizeCommand"]
 
@@ -76,9 +77,9 @@ class OptimizeCommand:
         for c in self.z_order_by:
             names = [f.name.lower() for f in metadata.schema.fields]
             if c.lower() not in names:
-                raise DeltaAnalysisError(f"Z-order column {c!r} not in table schema")
+                raise errors.zorder_column_not_in_schema(c)
             if c.lower() in [p.lower() for p in pcols]:
-                raise DeltaAnalysisError(f"Cannot Z-order by partition column {c!r}")
+                raise errors.zorder_on_partition_column(c)
 
         timer = Timer()
         # filter_files evaluates the partition predicate exactly

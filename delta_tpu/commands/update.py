@@ -29,6 +29,7 @@ from delta_tpu.expr.parser import parse_expression, parse_predicate
 from delta_tpu.expr.vectorized import evaluate
 from delta_tpu.protocol.actions import Action
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["UpdateCommand"]
 
@@ -62,7 +63,7 @@ class UpdateCommand:
         # aware, so rewritten rows land in their new partition directories
         for col in self.set_exprs:
             if col.lower() not in schema_cols:
-                raise DeltaAnalysisError(f"Column {col!r} not found in table schema")
+                raise errors.update_column_not_found(col)
 
         timer = Timer()
         use_dv = dv_enabled(metadata)
@@ -147,10 +148,7 @@ class UpdateCommand:
                 try:
                     new = pc.cast(new, old.type, safe=False)
                 except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-                    raise DeltaAnalysisError(
-                        f"UPDATE expression for {name} has incompatible type "
-                        f"{new.type} (column is {old.type})"
-                    )
+                    raise errors.update_expression_type_mismatch(name, new.type, old.type)
                 cols.append(pc.if_else(mask, new, old))
             names.append(name)
         out = pa.table(cols, names=names)

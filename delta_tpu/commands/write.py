@@ -24,8 +24,8 @@ from delta_tpu.protocol.actions import Action, AddFile, Metadata
 from delta_tpu.schema import schema_utils
 from delta_tpu.schema.arrow_interop import schema_from_arrow
 from delta_tpu.schema.types import StructType
-from delta_tpu.utils import errors as errors_mod
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaIllegalArgumentError
+from delta_tpu.utils import errors
 
 __all__ = ["WriteIntoDelta", "update_metadata_on_write", "coerce_to_table"]
 
@@ -77,10 +77,8 @@ def update_metadata_on_write(
     if partition_columns and [c.lower() for c in partition_columns] != [
         c.lower() for c in current.partition_columns
     ]:
-        raise DeltaAnalysisError(
-            f"Partition columns {list(partition_columns)} don't match the table's "
-            f"{current.partition_columns}"
-        )
+        raise errors.partition_columns_mismatch(
+            partition_columns, current.partition_columns)
     if overwrite_schema:
         new_meta = replace(
             current,
@@ -136,7 +134,7 @@ class WriteIntoDelta:
             if self.mode == "ignore":
                 return log.snapshot.version
             if self.mode in ("error", "errorifexists"):
-                raise DeltaAnalysisError(f"Table already exists: {log.data_path}")
+                raise errors.table_already_exists(log.data_path)
 
         def body(txn):
             actions = self.write(txn)
@@ -196,13 +194,10 @@ class WriteIntoDelta:
         pcols = metadata.partition_columns
         conjuncts = ir.split_conjuncts(pred)
         if not all(partition_expr.is_partition_predicate(c, pcols) for c in conjuncts):
-            raise DeltaAnalysisError(
-                f"replaceWhere {pred.sql()!r} must reference only partition columns "
-                f"{pcols}"
-            )
+            raise errors.replace_where_needs_partition_columns(pred.sql(), pcols)
         for add in written:
             if not partition_expr.matches(pred, add, part_schema):
-                raise errors_mod.replace_where_mismatch(
+                raise errors.replace_where_mismatch(
                     pred.sql(), f"partitions {add.partition_values}"
                 )
         matched = txn.filter_files([pred])

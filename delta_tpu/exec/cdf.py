@@ -28,6 +28,7 @@ import numpy as np
 import pyarrow as pa
 
 from delta_tpu.protocol.actions import AddCDCFile, AddFile, RemoveFile
+from delta_tpu.utils import errors
 
 __all__ = [
     "CHANGE_TYPE_COL",
@@ -115,30 +116,19 @@ def read_changes(
     """The table's change feed for versions [starting, ending] (inclusive)."""
     import pyarrow.parquet as pq
 
-    from delta_tpu.utils.errors import DeltaAnalysisError
 
     snapshot = delta_log.update()
     if ending_version is None:
         ending_version = snapshot.version
     if starting_version > snapshot.version:
-        raise DeltaAnalysisError(
-            f"CDF start version {starting_version} is after the latest "
-            f"table version {snapshot.version}"
-        )
+        raise errors.cdf_start_after_latest(starting_version, snapshot.version)
     if starting_version > ending_version:
-        raise DeltaAnalysisError(
-            f"CDF start version {starting_version} is after end version "
-            f"{ending_version}"
-        )
+        raise errors.cdf_start_after_end(starting_version, ending_version)
     # data-loss guard: silently skipping retention-cleaned commits would
     # hide their deletes/updates from the consumer
     earliest = delta_log.history.get_earliest_delta_file()
     if starting_version < earliest:
-        raise DeltaAnalysisError(
-            f"CDF start version {starting_version} is no longer available "
-            f"(earliest retained commit is {earliest}); the change feed for "
-            "cleaned-up versions is lost"
-        )
+        raise errors.cdf_start_unavailable(starting_version, earliest)
     metadata = snapshot.metadata
     target_cols = [f.name for f in metadata.schema.fields]
     commits = {

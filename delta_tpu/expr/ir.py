@@ -32,6 +32,7 @@ from delta_tpu.schema.types import (
     TimestampType,
 )
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = [
     "Expression",
@@ -146,7 +147,7 @@ class Column(Expression):
         for k, v in row.items():
             if k.lower() == lname:
                 return v
-        raise DeltaAnalysisError(f"Column not found: {self.name} in {list(row)}")
+        raise errors.column_not_found_in_row(self.name, row)
 
     def sql(self) -> str:
         if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", self.name):
@@ -323,9 +324,8 @@ class _Comparison(_Binary):
         try:
             return self.py(l, r)
         except TypeError:
-            raise DeltaAnalysisError(
-                f"Cannot compare {type(l).__name__} with {type(r).__name__} in {self.sql()}"
-            )
+            raise errors.cannot_compare_types(
+                type(l).__name__, type(r).__name__, self.sql())
 
 
 class Eq(_Comparison):
@@ -438,9 +438,8 @@ class _Arith(_Binary):
         try:
             return self.py(l, r)
         except TypeError:
-            raise DeltaAnalysisError(
-                f"Cannot apply {self.op!r} to {type(l).__name__} and {type(r).__name__} in {self.sql()}"
-            )
+            raise errors.cannot_apply_operator(
+                self.op, type(l).__name__, type(r).__name__, self.sql())
 
 
 class Add(_Arith):
@@ -568,9 +567,7 @@ class Like(_Binary):
         if v is None or p is None:
             return None
         if not isinstance(v, str) or not isinstance(p, str):
-            raise DeltaAnalysisError(
-                f"LIKE requires string operands, got {type(v).__name__} in {self.sql()}"
-            )
+            raise errors.like_requires_strings(type(v).__name__, self.sql())
         cached = self._rx_cache
         if cached is None or cached[0] != p:
             rx = re.compile(
@@ -662,7 +659,7 @@ class Func(Expression):
     def __init__(self, name: str, args: Sequence[Expression]):
         self.name = name.lower()
         if self.name not in self.FUNCS:
-            raise DeltaAnalysisError(f"Unsupported function: {name}")
+            raise errors.unsupported_function(name)
         self.children = tuple(args)
 
     def eval(self, row):

@@ -17,6 +17,7 @@ from typing import List, Optional
 from delta_tpu.expr import ir
 from delta_tpu.schema.types import parse_data_type
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["parse_expression", "parse_predicate"]
 
@@ -53,7 +54,7 @@ def _tokenize(s: str) -> List[_Tok]:
     while pos < len(s):
         m = _TOKEN_RE.match(s, pos)
         if not m:
-            raise DeltaAnalysisError(f"Cannot tokenize predicate at {s[pos:pos+20]!r}")
+            raise errors.cannot_tokenize_predicate(s[pos:pos+20])
         pos = m.end()
         if m.lastgroup == "ws":
             continue
@@ -79,7 +80,7 @@ class _Parser:
     def next(self) -> _Tok:
         t = self.peek()
         if t is None:
-            raise DeltaAnalysisError(f"Unexpected end of expression: {self.source!r}")
+            raise errors.unexpected_end_of_expression(self.source)
         self.i += 1
         return t
 
@@ -93,9 +94,7 @@ class _Parser:
     def expect(self, kind: str, text: Optional[str] = None) -> _Tok:
         t = self.accept(kind, text)
         if t is None:
-            raise DeltaAnalysisError(
-                f"Expected {text or kind} at token {self.peek()} in {self.source!r}"
-            )
+            raise errors.parse_expected(text or kind, self.peek(), self.source)
         return t
 
     # precedence climbing ------------------------------------------------
@@ -103,7 +102,7 @@ class _Parser:
     def parse(self) -> ir.Expression:
         e = self.parse_or()
         if self.peek() is not None:
-            raise DeltaAnalysisError(f"Trailing tokens at {self.peek()} in {self.source!r}")
+            raise errors.trailing_tokens(self.peek(), self.source)
         return e
 
     def parse_or(self) -> ir.Expression:
@@ -239,7 +238,7 @@ class _Parser:
                 return ir.CaseWhen(branches, default)
             if t.text == "NOT":
                 return ir.Not(self.parse_not())
-            raise DeltaAnalysisError(f"Unexpected keyword {t.text} in {self.source!r}")
+            raise errors.unexpected_keyword(t.text, self.source)
         if t.kind == "op" and t.text == "(":
             e = self.parse_or()
             self.expect("op", ")")
@@ -267,15 +266,15 @@ class _Parser:
                 self.next()
                 nxt = self.next()
                 if nxt.kind not in ("id", "bq"):
-                    raise DeltaAnalysisError(f"Bad column path after '.' in {self.source!r}")
+                    raise errors.bad_column_path(self.source)
                 parts.append(nxt.text[1:-1].replace("``", "`") if nxt.kind == "bq" else nxt.text)
             return ir.Column(".".join(parts))
-        raise DeltaAnalysisError(f"Unexpected token {t} in {self.source!r}")
+        raise errors.unexpected_token(t, self.source)
 
     def _parse_type_name(self) -> str:
         tok = self.next()
         if tok.kind not in ("id", "kw"):
-            raise DeltaAnalysisError(f"Expected type name, got {tok}")
+            raise errors.expected_type_name(tok)
         name = tok.text.lower()
         if name == "decimal" and self.accept("op", "("):
             p = self.next().text

@@ -38,6 +38,7 @@ from delta_tpu.schema.types import (
     TimestampType,
 )
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["evaluate", "filter_table", "boolean_mask", "project", "arrow_type_for"]
 
@@ -74,7 +75,7 @@ def arrow_type_for(dt: DataType) -> pa.DataType:
         return pa.list_(arrow_type_for(dt.element_type))
     if isinstance(dt, MapType):
         return pa.map_(arrow_type_for(dt.key_type), arrow_type_for(dt.value_type))
-    raise DeltaAnalysisError(f"No Arrow mapping for type {dt.simple_string()}")
+    raise errors.arrow_mapping_missing(dt.simple_string())
 
 
 def _resolve_column(table: pa.Table, name: str) -> pa.ChunkedArray:
@@ -84,7 +85,7 @@ def _resolve_column(table: pa.Table, name: str) -> pa.ChunkedArray:
     for c in table.column_names:
         if c.lower() == lowered:
             return table.column(c)
-    raise DeltaAnalysisError(f"Column {name!r} not found among {table.column_names}")
+    raise errors.column_not_found_in_table(name, table.column_names)
 
 
 def _as_array(v: Any, n: int) -> pa.ChunkedArray:

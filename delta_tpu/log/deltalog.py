@@ -56,6 +56,8 @@ class DeltaLog:
         self._snapshot: Optional[Snapshot] = None
         self._last_update_ms: int = 0
         self._update_lock = threading.Lock()
+        self._refresh_future = None  # in-flight async stale-ok refresh
+        self._refresh_lock = threading.Lock()
         # checkpoint versions that failed to decode (Snapshot._columnar
         # recovery): listings skip them so update()'s early-exit holds
         self._corrupt_checkpoints: frozenset = frozenset()
@@ -108,19 +110,53 @@ class DeltaLog:
             s = self.update()
         return s
 
+    def _trigger_async_refresh(self) -> None:
+        """Kick one background re-list+install for this log (at most one in
+        flight); readers keep serving the stale snapshot meanwhile. Daemon
+        threads (not an executor pool): a refresh hung on an unreachable
+        store must never block interpreter exit — the analogue of the
+        reference's snapshot-update pool (``SnapshotManagement.scala:251-263``)."""
+        import concurrent.futures
+
+        with self._refresh_lock:
+            f = self._refresh_future
+            if f is not None and not f.done():
+                return
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            self._refresh_future = fut
+
+            def work():
+                try:
+                    fut.set_result(self._do_update())
+                except BaseException as e:
+                    logger.warning("async snapshot refresh failed for %s",
+                                   self.data_path, exc_info=True)
+                    fut.set_exception(e)
+
+            threading.Thread(
+                target=work, daemon=True, name="delta-state-update"
+            ).start()
+
     def update(self, stale_ok: bool = False) -> Snapshot:
         """Re-list the log and install a new Snapshot if the segment changed
-        (``SnapshotManagement.scala:244-330``). With ``stale_ok`` and a fresh
-        enough snapshot, return immediately (the reference's async stale-ok
-        path; we keep it synchronous but honor the staleness limit)."""
+        (``SnapshotManagement.scala:244-330``). With ``stale_ok`` and a
+        fresh-enough snapshot, return the current one immediately and refresh
+        in the background (the reference's async stale-ok path,
+        ``:251-263,375-380``); past the staleness bound the update is
+        synchronous again."""
         if stale_ok:
-            limit = conf.get("delta.tpu.stalenessLimitMs")
+            limit = (conf.get("delta.tpu.snapshot.stalenessLimitMs")
+                     or conf.get("delta.tpu.stalenessLimitMs"))
             if (
                 limit
                 and self._snapshot is not None
-                and self.clock() - self._last_update_ms < limit
+                and self.clock() - self._last_update_ms < int(limit)
             ):
+                self._trigger_async_refresh()
                 return self._snapshot
+        return self._do_update()
+
+    def _do_update(self) -> Snapshot:
         with self._update_lock:
             previous = self._snapshot
             start_ckpt = None
@@ -162,10 +198,14 @@ class DeltaLog:
         return sm.get_snapshot_at(self, version)
 
     def snapshot_for(self, version: Optional[int] = None,
-                     timestamp=None) -> Snapshot:
+                     timestamp=None, stale_ok: bool = False) -> Snapshot:
         """One shared time-travel resolution for every surface that takes
         version/timestamp options (reads, RESTORE, CLONE): at most one
-        selector; timestamp = epoch ms or ISO-8601; none = latest."""
+        selector; timestamp = epoch ms or ISO-8601; none = latest.
+
+        ``stale_ok`` (reads only): "latest" may be served from the staleness
+        window with a background refresh. Copy-like surfaces (CLONE,
+        RESTORE) must not pass it — they'd silently copy an old version."""
         if version is not None and timestamp is not None:
             raise errors_mod.DeltaAnalysisError(
                 "Cannot specify both version and timestamp"
@@ -179,7 +219,7 @@ class DeltaLog:
                 timestamp_option_to_ms(timestamp), can_return_last_commit=True
             )
             return self.get_snapshot_at(commit.version)
-        return self.update()
+        return self.update(stale_ok=stale_ok)
 
     @property
     def table_exists(self) -> bool:

@@ -108,6 +108,9 @@ def _probe_counts(jnp, base_sorted, probe_keys):
 
 @functools.lru_cache(maxsize=None)
 def _single_device_kernel_cached():
+    from delta_tpu.utils.jaxcache import ensure_compilation_cache
+
+    ensure_compilation_cache()
     import jax
 
     return _single_device_kernel(jax)

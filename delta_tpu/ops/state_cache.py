@@ -445,6 +445,9 @@ BLOCK = 1024
 
 @functools.lru_cache(maxsize=None)
 def _block_kernel_fn(block: int):
+    from delta_tpu.utils.jaxcache import ensure_compilation_cache
+
+    ensure_compilation_cache()
     import jax
     import jax.numpy as jnp
 

@@ -33,6 +33,7 @@ __all__ = [
     "LinkProfile", "Estimate", "profile", "estimate_device_s", "reset",
     "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW",
     "HOST_PRUNE_S_PER_CELL", "DEVICE_PRUNE_S_PER_CELL",
+    "HOST_KEY_DECODE_S_PER_ROW", "RESIDENT_PROBE_S_PER_ROW",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -46,6 +47,13 @@ HOST_JOIN_S_PER_ROW = 1.0e-7
 # batched min/max pruning, host numpy: ~0.6s for 100 preds x 1M files x 4
 # stat columns (DRAM-bound boolean reductions)
 HOST_PRUNE_S_PER_CELL = 1.5e-9
+# projected Parquet key-column decode, host Arrow: ~260ms for 10M rows —
+# the cost the resident-key probe avoids and the host join must pay
+HOST_KEY_DECODE_S_PER_ROW = 2.6e-8
+# resident-key membership probe kernel (ops/key_cache._probe_kernel):
+# ~0.35s for an 11M-row join on one v5e — sort-pair + one 'sort'-method
+# searchsorted + segment propagation, transfers excluded
+RESIDENT_PROBE_S_PER_ROW = 3.2e-8
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares
 DEVICE_PRUNE_S_PER_CELL = 2.0e-11
@@ -126,8 +134,13 @@ def _probe() -> LinkProfile:
         np.asarray(dev)
         down_best = min(down_best, time.perf_counter() - t0)
         del dev
-    up_mbps = (_PROBE_BYTES / 1e6) / max(up_best - latency, 1e-4)
-    down_mbps = (_PROBE_BYTES / 1e6) / max(down_best - latency, 1e-4)
+    # Subtracting a noisy latency sample from a fast transfer can go ~zero
+    # and report effectively infinite bandwidth (seen under host contention:
+    # 10 GB/s on a ~10 MB/s tunnel), which mis-routes every kernel. Floor
+    # the denominator at a quarter of the measured wall time so the derived
+    # bandwidth can never exceed 4x what was actually observed.
+    up_mbps = (_PROBE_BYTES / 1e6) / max(up_best - latency, up_best / 4, 1e-4)
+    down_mbps = (_PROBE_BYTES / 1e6) / max(down_best - latency, down_best / 4, 1e-4)
     return LinkProfile(up_mbps, down_mbps, max(latency, 1e-4), probed=True)
 
 

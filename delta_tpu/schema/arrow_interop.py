@@ -29,6 +29,7 @@ from delta_tpu.schema.types import (
     TimestampType,
 )
 from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+from delta_tpu.utils import errors
 
 __all__ = ["delta_type_from_arrow", "schema_from_arrow"]
 
@@ -78,7 +79,7 @@ def delta_type_from_arrow(t: pa.DataType) -> DataType:
         )
     if pa.types.is_null(t):
         return StringType()  # all-null columns default to string, like Spark
-    raise DeltaAnalysisError(f"Unsupported Arrow type for Delta schema: {t}")
+    raise errors.unsupported_arrow_type(t)
 
 
 def schema_from_arrow(schema: pa.Schema) -> StructType:

@@ -28,6 +28,7 @@ from delta_tpu.expr.parser import parse_expression
 from delta_tpu.expr.vectorized import arrow_type_for, evaluate
 from delta_tpu.schema.types import DataType, StructField, StructType
 from delta_tpu.utils.errors import DeltaAnalysisError, InvariantViolationError
+from delta_tpu.utils import errors
 
 __all__ = [
     "GENERATION_EXPRESSION_KEY",
@@ -63,9 +64,7 @@ def generation_expressions(schema: StructType) -> Dict[str, ir.Expression]:
             try:
                 out[f.name] = parse_expression(sql)
             except DeltaAnalysisError as e:
-                raise DeltaAnalysisError(
-                    f"Invalid generation expression for column {f.name!r}: {e}"
-                ) from e
+                raise errors.invalid_generation_expression(f.name, e) from e
     return out
 
 
@@ -104,15 +103,9 @@ def validate_generated_columns(schema: StructType) -> None:
         for r in ir.references(e):
             rl = r.lower()
             if rl not in names:
-                raise DeltaAnalysisError(
-                    f"Generation expression for {col!r} references unknown "
-                    f"column {r!r}"
-                )
+                raise errors.generation_expr_unknown_column(col, r)
             if rl in gen_names:
-                raise DeltaAnalysisError(
-                    f"Generation expression for {col!r} references generated "
-                    f"column {r!r}; generated columns cannot reference each other"
-                )
+                raise errors.generation_expr_references_generated(col, r)
 
 
 def _computed(col_name: str, e: ir.Expression, table: pa.Table,
@@ -123,10 +116,7 @@ def _computed(col_name: str, e: ir.Expression, table: pa.Table,
         try:
             vals = pc.cast(vals, at)
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as exc:
-            raise DeltaAnalysisError(
-                f"Generation expression for {col_name!r} produces type "
-                f"{vals.type}, which cannot become declared type {at}: {exc}"
-            )
+            raise errors.generation_expr_type_mismatch(col_name, vals.type, at, exc)
     return vals
 
 

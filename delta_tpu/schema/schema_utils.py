@@ -32,6 +32,7 @@ from delta_tpu.schema.types import (
     StructType,
 )
 from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+from delta_tpu.utils import errors
 
 __all__ = [
     "check_column_names",
@@ -69,10 +70,7 @@ def check_column_names(schema: StructType) -> None:
             for f in dt.fields:
                 bad = [c for c in f.name if c in _INVALID_CHARS]
                 if bad:
-                    raise DeltaAnalysisError(
-                        f"Attribute name \"{path + f.name}\" contains invalid character(s) "
-                        f"among \" ,;{{}}()\\n\\t=\". Please use alias to rename it."
-                    )
+                    raise errors.invalid_column_name(path + f.name)
                 walk(f.data_type, path + f.name + ".")
         elif isinstance(dt, ArrayType):
             walk(dt.element_type, path)
@@ -87,9 +85,7 @@ def check_partition_columns(partition_columns: Sequence[str], schema: StructType
     names = {f.name.lower() for f in schema.fields}
     for c in partition_columns:
         if c.lower() not in names:
-            raise DeltaAnalysisError(
-                f"Partition column `{c}` not found in schema {schema.simple_string()}"
-            )
+            raise errors.partition_column_not_found(c, schema.simple_string())
 
 
 def find_field(schema: StructType, name: str) -> Optional[StructField]:
@@ -164,10 +160,8 @@ def check_column_name_duplication(schema: StructType, context: str) -> None:
             for f in dt.fields:
                 low = f.name.lower()
                 if low in seen:
-                    raise DeltaAnalysisError(
-                        f"Found duplicate column(s) {context}: "
-                        f"{path}{seen[low]}, {path}{f.name}"
-                    )
+                    raise errors.duplicate_columns(
+                        context, f"{path}{seen[low]}", f"{path}{f.name}")
                 seen[low] = f.name
                 walk(f.data_type, path + f.name + ".")
         elif isinstance(dt, ArrayType):
@@ -211,11 +205,8 @@ def merge_schemas(
             and cur.name.lower() in fixed
             and cur.data_type != incoming.data_type
         ):
-            raise DeltaAnalysisError(
-                f"Column {cur.name} is a generated column or a column used by a "
-                f"generated column; its data type {cur.data_type.simple_string()} "
-                f"cannot be changed to {incoming.data_type.simple_string()}"
-            )
+            raise errors.generated_column_type_change(
+                cur.name, cur.data_type.simple_string())
         merged_type = _merge_types(
             cur.data_type, incoming.data_type, allow_implicit_conversions,
             keep_existing_type, path + cur.name,
@@ -407,25 +398,20 @@ def add_column(
         position = [min(position, len(schema.fields))]
     position = list(position)
     if not position:
-        raise DeltaAnalysisError(f"Don't know where to add the column {field.name}")
+        raise errors.add_column_anchor_not_found(field.name)
     slice_pos = position[0]
     if slice_pos < 0:
-        raise DeltaAnalysisError(
-            f"Index {slice_pos} to add column {field.name} is lower than 0"
-        )
+        raise errors.add_column_index_below_zero(slice_pos, field.name)
     length = len(schema.fields)
     if slice_pos > length:
-        raise DeltaAnalysisError(
-            f"Index {slice_pos} to add column {field.name} is larger than struct "
-            f"length: {length}"
-        )
+        raise errors.add_column_index_too_large(slice_pos, field.name, length)
     if len(position) == 1 and any(
         f.name.lower() == field.name.lower() for f in schema.fields
     ):
-        raise DeltaAnalysisError(f"Column {field.name} already exists")
+        raise errors.column_already_exists(field.name)
     if slice_pos == length:
         if len(position) > 1:
-            raise DeltaAnalysisError(f"Struct not found at position {slice_pos}")
+            raise errors.struct_not_found_at_position(slice_pos)
         return StructType(list(schema.fields) + [field])
     fields = list(schema.fields)
     if len(position) == 1:
@@ -464,14 +450,9 @@ def add_column(
                 dt.value_contains_null,
             )
         else:
-            raise DeltaAnalysisError(
-                f"Cannot add {field.name} because its parent is not a StructType."
-            )
+            raise errors.parent_not_struct(field.name)
     else:
-        raise DeltaAnalysisError(
-            f"Cannot add {field.name} because its parent is not a StructType. "
-            f"Found {dt.simple_string()}"
-        )
+        raise errors.parent_not_struct(field.name, dt.simple_string())
     fields[slice_pos] = StructField(
         parent.name, new_dt, parent.nullable, dict(parent.metadata)
     )
@@ -483,7 +464,7 @@ def drop_column(schema: StructType, name: str) -> StructType:
     ``drop_column_at``; ``dropColumn :663``)."""
     kept = [f for f in schema.fields if f.name.lower() != name.lower()]
     if len(kept) == len(schema.fields):
-        raise DeltaAnalysisError(f"Column {name} does not exist")
+        raise errors.column_not_in_schema(name)
     if not kept:
         raise DeltaAnalysisError("Cannot drop all columns from a table")
     return StructType(kept)
@@ -499,9 +480,7 @@ def replace_column_at(
         raise DeltaAnalysisError("Don't know which column to replace")
     slice_pos = position[0]
     if not 0 <= slice_pos < len(schema.fields):
-        raise DeltaAnalysisError(
-            f"Index {slice_pos} to replace column is out of bounds"
-        )
+        raise errors.replace_column_index_oob(slice_pos)
     fields = list(schema.fields)
     if len(position) == 1:
         fields[slice_pos] = new_field
@@ -529,10 +508,7 @@ def _descend_replace(dt: DataType, tail: Sequence[int], recurse, verb: str):
         return recurse(dt, tail)
     if isinstance(dt, ArrayType) and isinstance(dt.element_type, StructType):
         if tail[0] != ARRAY_ELEMENT_INDEX:
-            raise DeltaAnalysisError(
-                f"Incorrectly accessing an ArrayType during {verb}: use the "
-                f"element step"
-            )
+            raise errors.array_access_needs_element_step(verb)
         return ArrayType(recurse(dt.element_type, tail[1:]), dt.contains_null)
     if isinstance(dt, MapType):
         if tail[0] == MAP_KEY_INDEX and isinstance(dt.key_type, StructType):
@@ -545,10 +521,7 @@ def _descend_replace(dt: DataType, tail: Sequence[int], recurse, verb: str):
                 dt.key_type, recurse(dt.value_type, tail[1:]),
                 dt.value_contains_null,
             )
-    raise DeltaAnalysisError(
-        f"Can only {verb} nested columns inside StructType. Found: "
-        f"{dt.simple_string()}"
-    )
+    raise errors.nested_op_only_in_struct(verb, dt.simple_string())
 
 
 def drop_column_at(
@@ -561,13 +534,10 @@ def drop_column_at(
         raise DeltaAnalysisError("Don't know where to drop the column")
     slice_pos = position[0]
     if slice_pos < 0:
-        raise DeltaAnalysisError(f"Index {slice_pos} to drop column is lower than 0")
+        raise errors.drop_column_index_below_zero(slice_pos)
     length = len(schema.fields)
     if slice_pos >= length:
-        raise DeltaAnalysisError(
-            f"Index {slice_pos} to drop column equals to or is larger than struct "
-            f"length: {length}"
-        )
+        raise errors.drop_column_index_too_large(slice_pos, length)
     fields = list(schema.fields)
     if len(position) == 1:
         # an empty struct is legal here: CHANGE COLUMN moves are
@@ -604,11 +574,8 @@ def find_column_position(column: Sequence[str], schema: StructType) -> List[int]
         if not isinstance(current, StructType):
             if isinstance(current, ArrayType):
                 if name.lower() != "element":
-                    raise DeltaAnalysisError(
-                        f"An ArrayType was found. In order to access elements of an "
-                        f"ArrayType, specify "
-                        f"{'.'.join(parts[:i] + ['element'] + parts[i:])}"
-                    )
+                    raise errors.array_access_element_path_hint(
+                        '.'.join(parts[:i] + ['element'] + parts[i:]))
                 out.append(ARRAY_ELEMENT_INDEX)
                 current = current.element_type
                 i += 1
@@ -621,23 +588,17 @@ def find_column_position(column: Sequence[str], schema: StructType) -> List[int]
                     out.append(MAP_VALUE_INDEX)
                     current = current.value_type
                 else:
-                    raise DeltaAnalysisError(
-                        f"Cannot access {name} in a MapType: use key or value"
-                    )
+                    raise errors.map_access_needs_key_or_value(name)
                 i += 1
                 continue
-            raise DeltaAnalysisError(
-                f"Column path {'.'.join(parts)} descends into a non-nested type"
-            )
+            raise errors.column_path_not_nested('.'.join(parts))
         pos = next(
             (j for j, f in enumerate(current.fields) if f.name.lower() == name.lower()),
             -1,
         )
         if pos == -1:
-            raise DeltaAnalysisError(
-                f"Couldn't find column {'.'.join(parts[: i + 1])} in schema "
-                f"{schema.simple_string()}"
-            )
+            raise errors.column_path_not_found(
+                '.'.join(parts[: i + 1]), schema.simple_string())
         out.append(pos)
         current = current.fields[pos].data_type
         i += 1

@@ -12,6 +12,7 @@ from dataclasses import dataclass
 from typing import List
 
 from delta_tpu.utils.errors import DeltaParseError
+from delta_tpu.utils import errors
 
 __all__ = ["Token", "tokenize"]
 
@@ -95,6 +96,6 @@ def tokenize(sql: str) -> List[Token]:
             out.append(Token("PUNCT", c, i, i + 1))
             i += 1
             continue
-        raise DeltaParseError(f"Unexpected character {c!r} at offset {i}")
+        raise errors.sql_unexpected_character(c, i)
     out.append(Token("END", "", n, n))
     return out

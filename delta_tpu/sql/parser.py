@@ -27,6 +27,7 @@ from delta_tpu.log.deltalog import DeltaLog
 from delta_tpu.schema.types import StructField, StructType
 from delta_tpu.sql.lexer import Token, tokenize
 from delta_tpu.utils.errors import DeltaAnalysisError, DeltaParseError
+from delta_tpu.utils import errors
 
 __all__ = ["execute_sql", "parse_statement"]
 
@@ -50,11 +51,11 @@ def _make_type(name: str, args: List[str]):
             p = int(args[0]) if args else 10
             s = int(args[1]) if len(args) > 1 else 0
         except ValueError:
-            raise DeltaParseError(f"Invalid DECIMAL precision/scale: {args}")
+            raise errors.sql_invalid_decimal(args)
         return T.DecimalType(p, s)
     cls = _TYPES.get(low)
     if cls is None:
-        raise DeltaParseError(f"Unsupported SQL type: {name!r}")
+        raise errors.sql_unsupported_type(name)
     return getattr(T, cls)()
 
 
@@ -88,9 +89,7 @@ class _Parser:
     def expect_word(self, *words: str) -> Token:
         t = self.next()
         if not t.is_word(*words):
-            raise DeltaParseError(
-                f"Expected {' or '.join(words)} at offset {t.start}, got {t.value!r}"
-            )
+            raise errors.sql_expected(' or '.join(words), t.start, t.value)
         return t
 
     def accept_punct(self, p: str) -> bool:
@@ -103,16 +102,12 @@ class _Parser:
     def expect_punct(self, p: str) -> None:
         t = self.next()
         if not (t.kind == "PUNCT" and t.value == p):
-            raise DeltaParseError(
-                f"Expected {p!r} at offset {t.start}, got {t.value!r}"
-            )
+            raise errors.sql_expected(repr(p), t.start, t.value)
 
     def expect_end(self) -> None:
         if not self.at_end():
             t = self.peek()
-            raise DeltaParseError(
-                f"Unexpected trailing input at offset {t.start}: {t.value!r}"
-            )
+            raise errors.sql_trailing_input(t.start, t.value)
 
     # -- shared pieces -----------------------------------------------------
 
@@ -128,9 +123,7 @@ class _Parser:
             self.next()  # '.'
             ident = self.next()
             if ident.kind not in ("QUOTED_IDENT", "WORD", "STRING"):
-                raise DeltaParseError(
-                    f"Expected table identifier after {t.value}. at offset {ident.start}"
-                )
+                raise errors.sql_expected_table_identifier(t.value, ident.start)
             # delta.`/p` is a path; delta.name is a catalog name
             if ident.kind == "WORD":
                 return ("name", ident.value)
@@ -141,7 +134,7 @@ class _Parser:
             t.kind == "PUNCT" and t.value in "./"
         )
         if not path_start:
-            raise DeltaParseError(f"Expected table reference at offset {t.start}")
+            raise errors.sql_expected('table reference', t.start)
         # greedy run of ADJACENT tokens (no whitespace) forming a bare path
         # (/tmp/x, ./rel/x) or a dotted catalog name
         text = t.value
@@ -164,7 +157,7 @@ class _Parser:
         t = self.next()
         if t.kind in ("WORD", "QUOTED_IDENT"):
             return t.value
-        raise DeltaParseError(f"Expected identifier at offset {t.start}")
+        raise errors.sql_expected('identifier', t.start)
 
     def slice_expr(
         self, stop_words: Tuple[str, ...] = (), stop_comma: bool = False
@@ -206,20 +199,17 @@ class _Parser:
     def number(self, as_int: bool = False):
         t = self.next()
         if t.kind != "NUMBER":
-            raise DeltaParseError(f"Expected a number at offset {t.start}")
+            raise errors.sql_expected('a number', t.start)
         try:
             return int(t.value) if as_int else float(t.value)
         except ValueError:
-            raise DeltaParseError(
-                f"Invalid {'integer' if as_int else 'number'} {t.value!r} "
-                f"at offset {t.start}"
-            )
+            raise errors.sql_invalid_number(t.value, 'integer' if as_int else 'number', t.start)
 
     def string_or_number(self) -> str:
         t = self.next()
         if t.kind in ("STRING", "NUMBER", "WORD"):
             return t.value
-        raise DeltaParseError(f"Expected literal at offset {t.start}")
+        raise errors.sql_expected('literal', t.start)
 
     def properties(self) -> Dict[str, str]:
         """( 'k' = 'v' [, ...] )"""
@@ -245,9 +235,7 @@ class _Parser:
                 if t.kind == "NUMBER":
                     args.append(t.value)
                 elif not (t.kind == "PUNCT" and t.value == ","):
-                    raise DeltaParseError(
-                        f"Bad type argument at offset {t.start}: {t.value!r}"
-                    )
+                    raise errors.sql_bad_type_argument(t.start, t.value)
         return _make_type(name, args)
 
     def column_def(self) -> StructField:
@@ -266,7 +254,7 @@ class _Parser:
             elif self.accept_word("COMMENT"):
                 t = self.next()
                 if t.kind != "STRING":
-                    raise DeltaParseError(f"Expected comment string at {t.start}")
+                    raise errors.sql_expected('comment string', t.start)
                 metadata["comment"] = t.value
             elif self.accept_word("GENERATED"):
                 self.expect_word("ALWAYS")
@@ -307,7 +295,7 @@ def parse_statement(sql: str):
     p = _Parser(sql)
     t = p.peek()
     if t.kind != "WORD":
-        raise DeltaParseError(f"Expected a statement keyword, got {t.value!r}")
+        raise errors.sql_expected_statement(t.value)
     head = t.value.upper()
     if head == "VACUUM":
         return _vacuum(p)
@@ -329,7 +317,7 @@ def parse_statement(sql: str):
         return _alter(p)
     if head == "RESTORE":
         return _restore(p)
-    raise DeltaAnalysisError(f"Unsupported SQL statement: {sql.strip()[:80]!r}")
+    raise errors.unsupported_sql_statement(sql)
 
 
 def execute_sql(sql: str) -> Any:
@@ -413,7 +401,7 @@ def _generate(p: _Parser):
     t = p.next()
     mode = t.value if t.kind in ("WORD", "STRING") else None
     if mode is None or mode.lower() != "symlink_format_manifest":
-        raise DeltaAnalysisError(f"Unsupported GENERATE mode: {mode}")
+        raise errors.unsupported_generate_mode(mode)
     p.expect_word("FOR")
     p.expect_word("TABLE")
     path = p.table_path()
@@ -484,7 +472,7 @@ def _set_assignments(p: _Parser, stop_words: Tuple[str, ...]) -> Dict[str, str]:
         p.expect_punct("=")
         expr = p.slice_expr(stop_words, stop_comma=True)
         if expr is None:
-            raise DeltaParseError(f"Empty SET expression for column {col!r}")
+            raise errors.sql_empty_set_expression(col)
         sets[col] = expr
         if not p.accept_punct(","):
             return sets
@@ -568,9 +556,7 @@ def _merge(p: _Parser):
                         break
                     p.expect_punct(",")
                 if len(cols) != len(vals):
-                    raise DeltaParseError(
-                        f"INSERT columns ({len(cols)}) and VALUES ({len(vals)}) differ"
-                    )
+                    raise errors.sql_insert_arity_mismatch(len(cols), len(vals))
                 not_matched.append(
                     MergeClause(
                         "insert", condition=clause_cond,
@@ -631,7 +617,7 @@ def _create(p: _Parser):
     if p.accept_word("USING"):
         fmt = p.ident()
         if fmt.lower() != "delta":
-            raise DeltaAnalysisError(f"Unsupported table format: {fmt!r}")
+            raise errors.unsupported_table_format(fmt)
     part_cols: List[str] = []
     props: Dict[str, str] = {}
     comment = None
@@ -645,18 +631,16 @@ def _create(p: _Parser):
         elif p.accept_word("COMMENT"):
             t = p.next()
             if t.kind != "STRING":
-                raise DeltaParseError(f"Expected comment string at {t.start}")
+                raise errors.sql_expected('comment string', t.start)
             comment = t.value
         elif p.accept_word("LOCATION"):
             t = p.next()
             if t.kind != "STRING":
-                raise DeltaParseError(f"Expected location string at {t.start}")
+                raise errors.sql_expected('location string', t.start)
             location = t.value
         else:
             t = p.peek()
-            raise DeltaParseError(
-                f"Unexpected token at offset {t.start}: {t.value!r}"
-            )
+            raise errors.sql_unexpected_input(t.start, t.value)
     p.expect_end()
     if replace and if_not_exists:
         raise DeltaParseError("CREATE OR REPLACE cannot have IF NOT EXISTS")
@@ -676,10 +660,7 @@ def _create(p: _Parser):
             elif cat.table_exists(value):
                 target = cat.table_path(value)
             else:
-                raise DeltaAnalysisError(
-                    f"CREATE TABLE {value}: unregistered name needs LOCATION "
-                    f"(or use delta.`/path`)"
-                )
+                raise errors.create_table_needs_location(value)
         else:
             target = location or value
         mode = "create_or_replace" if replace else (
@@ -795,7 +776,7 @@ def _alter(p: _Parser):
             elif p.accept_word("COMMENT"):
                 t = p.next()
                 if t.kind != "STRING":
-                    raise DeltaParseError(f"Expected comment string at {t.start}")
+                    raise errors.sql_expected('comment string', t.start)
                 comment = t.value
             elif p.accept_word("FIRST"):
                 position = "first"
@@ -811,13 +792,11 @@ def _alter(p: _Parser):
                 nullable = False
             else:
                 t = p.peek()
-                raise DeltaParseError(
-                    f"Unexpected token at offset {t.start}: {t.value!r}"
-                )
+                raise errors.sql_unexpected_input(t.start, t.value)
         p.expect_end()
         return lambda: alter_mod.change_column(
             _log_for(path), name, new_type=new_type,
             nullable=nullable, comment=comment, position=position,
         )
     t = p.peek()
-    raise DeltaParseError(f"Unsupported ALTER TABLE action at offset {t.start}")
+    raise errors.sql_unsupported_alter_action(t.start)

@@ -326,3 +326,580 @@ def missing_part_files(version: int, cause: Exception) -> DeltaIllegalStateError
         f"Couldn't find all part files of the checkpoint version: {version} "
         f"({cause})"
     )
+
+
+# ---------------------------------------------------------------------------
+# Named factories for every analysis-time error path — no call site raises a
+# bare f-string DeltaAnalysisError (enforced by tests/test_errors.py); each
+# message carries what went wrong plus how to fix it, the DeltaErrors.scala
+# contract.
+# ---------------------------------------------------------------------------
+
+
+def invalid_table_identifier(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Invalid table identifier: {name!r}. Use 'table', 'db.table', or a "
+        "path identifier delta.`/path/to/table`."
+    )
+
+
+def table_already_exists_in_catalog(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Table {name!r} already exists in catalog. Use CREATE OR REPLACE to "
+        "overwrite it, or DROP TABLE first."
+    )
+
+
+def table_being_created_concurrently(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Table {name!r} is being created concurrently by another writer. "
+        "Wait for that create to finish, or retry the operation."
+    )
+
+
+def table_not_found_in_catalog(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Table {name!r} not found in catalog. Check the identifier, or use "
+        "a path identifier delta.`/path/to/table` for path-addressed tables."
+    )
+
+
+def table_already_exists(path: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Table already exists: {path}. Use mode='overwrite' / CREATE OR "
+        "REPLACE to replace it, or pick a different location."
+    )
+
+
+def unsupported_sql_statement(sql: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Unsupported SQL statement: {sql.strip()[:80]!r}. Supported "
+        "statements: SELECT, CREATE/REPLACE TABLE, ALTER TABLE, "
+        "INSERT/UPDATE/DELETE/MERGE, OPTIMIZE, VACUUM, DESCRIBE, RESTORE, "
+        "CONVERT TO DELTA, GENERATE, SHALLOW CLONE."
+    )
+
+
+def unsupported_generate_mode(mode: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Unsupported GENERATE mode: {mode!r}. The only supported mode is "
+        "'symlink_format_manifest'."
+    )
+
+
+def unsupported_table_format(fmt: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Unsupported table format: {fmt!r}. CREATE TABLE ... USING must be "
+        "'delta'; to import an existing parquet table, use CONVERT TO DELTA "
+        "parquet.`/path`."
+    )
+
+
+def unsupported_arrow_type(t) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Unsupported Arrow type for a Delta schema: {t}. Cast the column "
+        "to a supported primitive, struct, array, or map type before writing."
+    )
+
+
+def arrow_mapping_missing(type_name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"No Arrow mapping for Delta type {type_name}. This type cannot be "
+        "materialized by the vectorized reader."
+    )
+
+
+def add_column_anchor_not_found(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Couldn't resolve the position to add the column {column}: the "
+        "AFTER anchor column does not exist at that nesting level."
+    )
+
+
+def column_already_exists(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(f"Column {column} already exists.")
+
+
+def struct_not_found_at_position(position) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Struct not found at position {position}; the parent of a nested "
+        "column operation must be a struct column."
+    )
+
+
+def column_not_in_schema(column: str, schema_cols=None) -> DeltaAnalysisError:
+    detail = f" Available columns: {list(schema_cols)}." if schema_cols else ""
+    return DeltaAnalysisError(f"Column {column} does not exist.{detail}")
+
+
+def drop_column_index_below_zero(position: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Index {position} to drop column is lower than 0"
+    )
+
+
+def invalid_timestamp_format(ts, cause=None) -> DeltaAnalysisError:
+    tail = f": {cause}" if cause is not None else "."
+    return DeltaAnalysisError(
+        f"Invalid timestamp {ts!r}. Provide epoch milliseconds or an "
+        f"ISO-8601 string like '2024-05-01 12:00:00'{tail}"
+    )
+
+
+def column_not_found_in_table(column: str, available) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Column {column!r} not found among {list(available)}."
+    )
+
+
+def cannot_tokenize_predicate(fragment: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Cannot tokenize predicate at {fragment!r}. Check for unbalanced "
+        "quotes or unsupported characters."
+    )
+
+
+def unexpected_end_of_expression(source: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Unexpected end of expression: {source!r}. The predicate ends "
+        "mid-term — a operand or closing parenthesis is missing."
+    )
+
+
+def trailing_tokens(token, source: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Trailing tokens at {token} in {source!r}. Combine multiple "
+        "conditions with AND/OR."
+    )
+
+
+def unexpected_keyword(text: str, source: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Unexpected keyword {text} in {source!r}."
+    )
+
+
+def bad_column_path(source: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Bad column path after '.' in {source!r}. Nested fields are "
+        "addressed as parent.child (backquote names with special characters)."
+    )
+
+
+def unexpected_token(token, source: str) -> DeltaParseError:
+    return DeltaParseError(f"Unexpected token {token} in {source!r}.")
+
+
+def expected_type_name(token) -> DeltaParseError:
+    return DeltaParseError(
+        f"Expected type name, got {token}. Use a Delta type like INT, "
+        "BIGINT, DOUBLE, STRING, DATE, TIMESTAMP, or DECIMAL(p, s)."
+    )
+
+
+def column_not_found_in_row(column: str, available) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Column not found: {column} in {list(available)}"
+    )
+
+
+def unsupported_function(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Unsupported function: {name}. See delta_tpu.expr.ir.FUNCTION_NAMES "
+        "for the supported surface."
+    )
+
+
+def invalid_column_position_spec(spec: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Invalid column position spec {spec!r}. Use FIRST or AFTER "
+        "<existing column>."
+    )
+
+
+def constraint_already_exists(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Constraint '{name}' already exists. DROP CONSTRAINT first to "
+        "replace it."
+    )
+
+
+def constraint_does_not_exist(name: str, table: str = "") -> DeltaAnalysisError:
+    where = f" in table {table}" if table else ""
+    return DeltaAnalysisError(
+        f"Constraint '{name}' does not exist{where}. Nothing to drop."
+    )
+
+
+def zorder_column_not_in_schema(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Z-order column {column!r} not in table schema."
+    )
+
+
+def zorder_on_partition_column(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot Z-order by partition column {column!r}: partition values "
+        "are constant within a file, so they add no clustering. Z-order by "
+        "data columns instead."
+    )
+
+
+def invalid_merge_clause(kind: str, matched: bool) -> DeltaAnalysisError:
+    allowed = "UPDATE or DELETE" if matched else "INSERT"
+    block = "WHEN MATCHED" if matched else "WHEN NOT MATCHED"
+    return DeltaAnalysisError(
+        f"Invalid {block} clause: {kind}. Only {allowed} is allowed here."
+    )
+
+
+def update_column_not_found(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Column {column!r} not found in table schema. SET clauses may only "
+        "assign existing columns."
+    )
+
+
+# -- SQL parse family (DeltaSqlBase.g4 / ParseException analogues) ----------
+
+
+def sql_unexpected_character(c: str, offset: int) -> DeltaParseError:
+    return DeltaParseError(f"Unexpected character {c!r} at offset {offset}")
+
+
+def sql_expected(what: str, offset, got=None) -> DeltaParseError:
+    tail = f", got {got!r}" if got is not None else ""
+    return DeltaParseError(f"Expected {what} at offset {offset}{tail}")
+
+
+def sql_unexpected_input(offset, got) -> DeltaParseError:
+    return DeltaParseError(f"Unexpected token at offset {offset}: {got!r}")
+
+
+def sql_trailing_input(offset, got) -> DeltaParseError:
+    return DeltaParseError(
+        f"Unexpected trailing input at offset {offset}: {got!r}"
+    )
+
+
+def sql_invalid_decimal(args) -> DeltaParseError:
+    return DeltaParseError(
+        f"Invalid DECIMAL precision/scale: {args}. Use DECIMAL(precision, "
+        "scale) with 1 <= precision <= 38 and 0 <= scale <= precision."
+    )
+
+
+def sql_unsupported_type(name: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Unsupported SQL type: {name!r}. Use a Delta type like INT, BIGINT, "
+        "DOUBLE, STRING, DATE, TIMESTAMP, BOOLEAN, BINARY, or DECIMAL(p, s)."
+    )
+
+
+def sql_invalid_number(value, kind: str, offset) -> DeltaParseError:
+    return DeltaParseError(f"Invalid {kind} {value!r} at offset {offset}")
+
+
+def sql_bad_type_argument(offset, value) -> DeltaParseError:
+    return DeltaParseError(f"Bad type argument at offset {offset}: {value!r}")
+
+
+def sql_empty_set_expression(column: str) -> DeltaParseError:
+    return DeltaParseError(f"Empty SET expression for column {column!r}")
+
+
+def sql_insert_arity_mismatch(n_cols: int, n_vals: int) -> DeltaParseError:
+    return DeltaParseError(
+        f"INSERT columns ({n_cols}) and VALUES ({n_vals}) differ"
+    )
+
+
+def sql_unsupported_alter_action(offset) -> DeltaParseError:
+    return DeltaParseError(f"Unsupported ALTER TABLE action at offset {offset}")
+
+
+def sql_expected_statement(got) -> DeltaParseError:
+    return DeltaParseError(f"Expected a statement keyword, got {got!r}")
+
+
+def sql_expected_table_identifier(after: str, offset) -> DeltaParseError:
+    return DeltaParseError(
+        f"Expected table identifier after {after}. at offset {offset}"
+    )
+
+
+def create_table_needs_location(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"CREATE TABLE {name}: unregistered name needs LOCATION "
+        "(or use delta.`/path`)"
+    )
+
+
+def parse_expected(what, got, source: str) -> DeltaParseError:
+    return DeltaParseError(f"Expected {what} at token {got} in {source!r}")
+
+
+# -- expression typing ------------------------------------------------------
+
+
+def cannot_compare_types(left: str, right: str, sql: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(f"Cannot compare {left} with {right} in {sql}")
+
+
+def cannot_apply_operator(op: str, left: str, right: str, sql: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot apply {op!r} to {left} and {right} in {sql}"
+    )
+
+
+def like_requires_strings(got: str, sql: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"LIKE requires string operands, got {got} in {sql}"
+    )
+
+
+# -- schema machinery (SchemaUtils / DeltaErrors schema family) -------------
+
+
+def invalid_column_name(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f'Attribute name "{name}" contains invalid character(s) among '
+        '" ,;{}()\\n\\t=". Please use alias to rename it.'
+    )
+
+
+def partition_column_not_found(column: str, schema_str: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Partition column `{column}` not found in schema {schema_str}"
+    )
+
+
+def duplicate_columns(context: str, first: str, second: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Found duplicate column(s) {context}: {first}, {second}"
+    )
+
+
+def generated_column_type_change(name: str, data_type: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Column {name} is a generated column or a column used by a "
+        f"generated column; its data type {data_type} cannot be changed."
+    )
+
+
+def add_column_index_below_zero(position: int, name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Index {position} to add column {name} is lower than 0"
+    )
+
+
+def add_column_index_too_large(position: int, name: str, length: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Index {position} to add column {name} is larger than struct "
+        f"length: {length}"
+    )
+
+
+def parent_not_struct(name: str, found: Optional[str] = None) -> DeltaAnalysisError:
+    tail = f" Found {found}" if found else ""
+    return DeltaAnalysisError(
+        f"Cannot add {name} because its parent is not a StructType.{tail}"
+    )
+
+
+def replace_column_index_oob(position: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Index {position} to replace column is out of bounds"
+    )
+
+
+def array_access_needs_element_step(verb: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Incorrectly accessing an ArrayType during {verb}: use the element "
+        "step"
+    )
+
+
+def nested_op_only_in_struct(verb: str, found: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Can only {verb} nested columns inside StructType. Found: {found}"
+    )
+
+
+def drop_column_index_too_large(position: int, length: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Index {position} to drop column equals to or is larger than "
+        f"struct length: {length}"
+    )
+
+
+def array_access_element_path_hint(corrected_path: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        "An ArrayType was found. In order to access elements of an "
+        f"ArrayType, specify {corrected_path}"
+    )
+
+
+def map_access_needs_key_or_value(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot access {name} in a MapType: use key or value"
+    )
+
+
+def column_path_not_nested(path: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Column path {path} descends into a non-nested type"
+    )
+
+
+def column_path_not_found(path: str, schema_str: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Couldn't find column {path} in schema {schema_str}"
+    )
+
+
+def parent_is_not_struct(parent: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(f"Parent {parent} is not a struct")
+
+
+def position_after_column_not_found(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Couldn't find column {column} to position AFTER"
+    )
+
+
+def add_columns_must_be_nullable(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"ADD COLUMNS requires nullable columns, {name} is NOT NULL"
+    )
+
+
+def cannot_change_column_type(name: str, old: str, new: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot change column {name} from {old} to {new}"
+    )
+
+
+def cannot_change_nullable_to_not_null(name: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot change nullable column {name} to NOT NULL"
+    )
+
+
+# -- generated columns ------------------------------------------------------
+
+
+def invalid_generation_expression(column: str, cause) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Invalid generation expression for column {column!r}: {cause}"
+    )
+
+
+def generation_expr_unknown_column(column: str, ref: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Generation expression for {column!r} references unknown column "
+        f"{ref!r}"
+    )
+
+
+def generation_expr_references_generated(column: str, ref: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Generation expression for {column!r} references generated column "
+        f"{ref!r}; generated columns cannot reference each other"
+    )
+
+
+def generation_expr_type_mismatch(column: str, got, want, cause) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Generation expression for {column!r} produces type {got}, which "
+        f"cannot become declared type {want}: {cause}"
+    )
+
+
+# -- commands ---------------------------------------------------------------
+
+
+def partition_path_segment_invalid(segment: str, rel_path: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Expecting partition column in path segment {segment!r} of {rel_path!r}"
+    )
+
+
+def partition_path_mismatch(rel_path: str, found, expected) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Partition columns in path {rel_path!r} ({sorted(found)}) don't "
+        f"match the declared partition schema ({sorted(expected)}). "
+        "CONVERT TO DELTA requires PARTITIONED BY matching the layout."
+    )
+
+
+def replace_requires_existing_table(path: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Table not found: {path} (REPLACE requires an existing table; use "
+        "CREATE OR REPLACE)"
+    )
+
+
+def merge_unresolvable_qualifier(
+    name: str, qualifier: str, target_alias, source_alias
+) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot resolve {name!r} in MERGE: qualifier {qualifier!r} matches "
+        f"neither target alias {target_alias!r} nor source alias "
+        f"{source_alias!r}"
+    )
+
+
+def merge_unresolvable_column(name: str, target_cols, source_cols) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Cannot resolve {name!r} in MERGE (target={list(target_cols)}, "
+        f"source={list(source_cols)})"
+    )
+
+
+def merge_clause_unresolvable(column: str, clause: str, source_cols) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"cannot resolve {column} in {clause} clause given columns "
+        f"{list(source_cols)} (enable delta.tpu.schema.autoMerge.enabled to "
+        "evolve the target schema instead)"
+    )
+
+
+def update_expression_type_mismatch(name: str, new_type, old_type) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"UPDATE expression for {name} has incompatible type {new_type} "
+        f"(column is {old_type})"
+    )
+
+
+def partition_columns_mismatch(given, current) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"Partition columns {list(given)} don't match the table's {current}"
+    )
+
+
+def replace_where_needs_partition_columns(pred_sql: str, partition_cols) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"replaceWhere {pred_sql!r} must reference only partition columns "
+        f"{partition_cols}"
+    )
+
+
+def cdf_start_after_latest(start: int, latest: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"CDF start version {start} is after the latest table version {latest}"
+    )
+
+
+def cdf_start_after_end(start: int, end: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"CDF start version {start} is after end version {end}"
+    )
+
+
+def cdf_start_unavailable(start: int, earliest: int) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"CDF start version {start} is no longer available (earliest "
+        f"retained commit is {earliest}); the change feed for cleaned-up "
+        "versions is lost"
+    )

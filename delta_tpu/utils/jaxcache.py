@@ -1,0 +1,46 @@
+"""Persistent XLA compilation cache.
+
+Device kernels here compile against a handful of bucketed shapes
+(`join_kernel._bucket`, `state_cache._next_pow2`), but on a tunneled TPU a
+single cold compile costs tens of seconds — enough to wipe out a kernel's
+win the first time a process touches a new shape. JAX's persistent
+compilation cache amortizes that across processes: first contact per
+machine compiles, everything after loads from disk.
+
+Enabled lazily by the device-kernel modules; best-effort (an unwritable
+dir or an unsupported backend silently degrades to in-memory caching).
+``delta.tpu.xla.cacheDir`` overrides the location; empty string disables.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["ensure_compilation_cache"]
+
+_done = False
+_lock = threading.Lock()
+
+
+def ensure_compilation_cache() -> None:
+    global _done
+    with _lock:
+        if _done:
+            return
+        _done = True
+        try:
+            from delta_tpu.utils.config import conf
+
+            cache_dir = conf.get(
+                "delta.tpu.xla.cacheDir",
+                os.path.join(os.path.expanduser("~"), ".cache", "delta_tpu", "xla"),
+            )
+            if not cache_dir:
+                return
+            os.makedirs(cache_dir, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # in-memory compile cache only

@@ -5,6 +5,7 @@ string) or ISO-8601 ('2024-05-01 12:00:00', naive = UTC)."""
 from __future__ import annotations
 
 from delta_tpu.utils.errors import DeltaAnalysisError
+from delta_tpu.utils import errors
 
 __all__ = ["timestamp_option_to_ms", "iso_to_naive_utc", "iso_to_date"]
 
@@ -31,7 +32,7 @@ def iso_to_date(s: str):
 
 def timestamp_option_to_ms(ts) -> int:
     if isinstance(ts, bool):
-        raise DeltaAnalysisError(f"Invalid timestamp {ts!r}")
+        raise errors.invalid_timestamp_format(ts)
     if isinstance(ts, (int, float)):
         return int(ts)
     s = str(ts).strip()
@@ -42,8 +43,5 @@ def timestamp_option_to_ms(ts) -> int:
     try:
         out = iso_to_naive_utc(s)
     except ValueError as e:
-        raise DeltaAnalysisError(
-            f"Invalid timestamp {ts!r}: expected epoch milliseconds or "
-            f"ISO-8601 (e.g. '2024-05-01 12:00:00'): {e}"
-        )
+        raise errors.invalid_timestamp_format(ts, e)
     return int(out.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)

@@ -191,3 +191,83 @@ def test_deleted_checkpoint_recovers_from_listing(tmp_table):
     snap = DeltaLog.for_table(tmp_table).snapshot
     assert snap.version == 12
     assert len(snap.all_files) == 13
+
+
+# -- async stale-ok snapshot updates ---------------------------------------
+
+
+def test_stale_ok_serves_stale_and_converges(tmp_table):
+    """A read during a slow listing serves the stale snapshot immediately
+    and the background refresh converges (SnapshotManagement.scala:251-263)."""
+    import threading
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.utils.config import conf
+
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
+    v0 = log.update().version
+
+    # a second "process" advances the table (a fresh DeltaLog instance, so
+    # our reader's cached snapshot genuinely goes stale)
+    other = DeltaLog(tmp_table)
+    WriteIntoDelta(other, "append", pa.table({"a": np.arange(3)})).run()
+
+    # make listings slow: the stale-ok read must not wait on them
+    gate = threading.Event()
+    real_list = log.store.list_from
+
+    def slow_list(path):
+        gate.wait(timeout=10)
+        return real_list(path)
+
+    log.store.list_from = slow_list
+    try:
+        with conf.set_temporarily(**{"delta.tpu.snapshot.stalenessLimitMs": 60_000}):
+            t0 = time.monotonic()
+            snap = log.update(stale_ok=True)
+            served_in = time.monotonic() - t0
+            assert snap.version == v0, "must serve the stale snapshot"
+            assert served_in < 1.0, "stale-ok read must not block on listing"
+            gate.set()
+            f = log._refresh_future
+            assert f is not None
+            f.result(timeout=10)
+            assert log.update(stale_ok=True).version == v0 + 1
+    finally:
+        log.store.list_from = real_list
+        gate.set()
+
+
+def test_stale_ok_beyond_limit_is_synchronous(tmp_table):
+    import numpy as np
+    import pyarrow as pa
+
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.utils.config import conf
+
+    clock = {"now": 1_000_000}
+    log = DeltaLog.for_table(tmp_table, clock=lambda: clock["now"])
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
+    v1 = log.update().version
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
+    clock["now"] += 120_000  # older than the limit
+    with conf.set_temporarily(**{"delta.tpu.snapshot.stalenessLimitMs": 60_000}):
+        assert log.update(stale_ok=True).version == v1 + 1
+
+
+def test_stale_ok_without_limit_stays_synchronous(tmp_table):
+    import numpy as np
+    import pyarrow as pa
+
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
+    v = log.update().version
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
+    assert log.update(stale_ok=True).version == v + 1

@@ -106,3 +106,27 @@ def test_unset_nonexistent_property_text(tmp_path):
     with pytest.raises(errors.DeltaAnalysisError,
                        match="unset non-existent property"):
         alter.unset_table_properties(log, ["nope"])
+
+
+def test_no_bare_fstring_analysis_errors():
+    """Every analysis-error path goes through a named factory in
+    utils/errors.py (the DeltaErrors.scala contract): no call site may raise
+    a bare f-string DeltaAnalysisError/DeltaParseError (VERDICT r3 item 5)."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "delta_tpu"
+    pattern = re.compile(
+        r"raise\s+Delta(Analysis|Parse)Error\(\s*f[\"']", re.S
+    )
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        if path.name == "errors.py":
+            continue  # the factories themselves compose messages
+        for m in pattern.finditer(path.read_text()):
+            line = path.read_text()[: m.start()].count("\n") + 1
+            offenders.append(f"{path.relative_to(root)}:{line}")
+    assert not offenders, (
+        "bare f-string analysis errors (add a named factory to "
+        f"utils/errors.py instead): {offenders}"
+    )

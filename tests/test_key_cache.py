@@ -1,0 +1,293 @@
+"""HBM-resident MERGE join keys (`ops/key_cache.py`): build/advance
+lifecycle, deletion-vector validity (grow, shrink, re-add), probe parity
+with the host join, and the resident path wired through MergeIntoCommand
+(forced mode; parity against the host-pinned merge on a table copy)."""
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.expr import ir
+from delta_tpu.ops.key_cache import KeyCache, _pack_lanes
+from delta_tpu.utils.config import conf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    KeyCache.reset()
+    yield
+    KeyCache.reset()
+
+
+KEY_EXPRS = (ir.Column("k"),)
+SIG = "test-k"
+
+
+def _mk_table(path, lo=0, hi=200, files=4):
+    log = DeltaLog.for_table(path)
+    per = (hi - lo) // files
+    rng = np.random.RandomState(5)
+    for i in range(files):
+        keys = np.arange(lo + i * per, lo + (i + 1) * per, dtype=np.int64)
+        WriteIntoDelta(log, "append", pa.table({
+            "k": keys, "v": rng.rand(per),
+        })).run()
+    return log
+
+
+def _entry(log, **kw):
+    snap = log.update()
+    return KeyCache.instance().get(
+        snap, SIG, ["k"], list(KEY_EXPRS), **kw)
+
+
+def _source(keys, vals=None):
+    keys = np.asarray(keys, np.int64)
+    return pa.table({
+        "k": keys,
+        "v": np.asarray(vals if vals is not None else np.zeros(len(keys))),
+    })
+
+
+def _merge(log, source, mode="force"):
+    with conf.set_temporarily(**{
+        "delta.tpu.merge.devicePath.mode": mode,
+        "delta.tpu.deletionVectors.enabled": True,
+    }):
+        cmd = MergeIntoCommand(
+            log, source, "t.k = s.k",
+            [MergeClause("update", assignments=None)],
+            [MergeClause("insert", assignments=None)],
+            source_alias="s", target_alias="t",
+        )
+        cmd.run()
+    return cmd
+
+
+# -- entry lifecycle --------------------------------------------------------
+
+
+def test_build_and_probe_matches_membership(tmp_table):
+    log = _mk_table(tmp_table)
+    e = _entry(log)
+    assert e is not None and e.num_rows == 200
+    probe = e.probe_async(np.array([5, 150, 500], np.int64),
+                          np.array([True, True, True]))
+    res = probe.result()
+    assert res.s_matched.tolist() == [True, True, False]
+    assert res.t_bits.sum() == 2
+    assert not res.any_multi
+
+
+def test_probe_null_keys_never_match(tmp_table):
+    log = _mk_table(tmp_table)
+    e = _entry(log)
+    res = e.probe_async(np.array([5, 0], np.int64),
+                        np.array([True, False])).result()
+    assert res.s_matched.tolist() == [True, False]
+
+
+def test_tail_advance_append_and_remove(tmp_table):
+    from delta_tpu.commands.delete import DeleteCommand
+
+    log = _mk_table(tmp_table)
+    e1 = _entry(log)
+    v1 = e1.version
+    # append a new file
+    WriteIntoDelta(log, "append", pa.table({
+        "k": np.arange(500, 550, dtype=np.int64), "v": np.zeros(50)})).run()
+    # delete a whole file's rows (file removal, no DV since whole-file)
+    e2 = _entry(log)
+    assert e2 is e1 and e2.version > v1
+    res = e2.probe_async(np.array([510], np.int64), np.array([True])).result()
+    assert res.s_matched.tolist() == [True]
+
+
+def test_dv_deleted_rows_do_not_match(tmp_table):
+    """A row logically deleted via deletion vector must not count as a
+    match — else its key's NOT MATCHED insert would be skipped."""
+    from delta_tpu.commands.delete import DeleteCommand
+
+    log = _mk_table(tmp_table)
+    e = _entry(log)
+    with conf.set_temporarily(**{"delta.tpu.deletionVectors.enabled": True}):
+        DeleteCommand(log, "k = 42").run()
+    e2 = _entry(log)
+    assert e2 is e
+    res = e2.probe_async(np.array([42, 43], np.int64),
+                         np.array([True, True])).result()
+    assert res.s_matched.tolist() == [False, True]
+
+
+def test_dv_shrink_revives_rows(tmp_table):
+    """_set_dv recomputes validity exactly: removing the DV (RESTORE shape)
+    brings rows back."""
+    log = _mk_table(tmp_table, files=1)
+    e = _entry(log)
+    path = next(iter(e.slabs))
+    e.ensure_resident()
+    e._set_dv(path, np.array([3, 7], np.int64))
+    res = e.probe_async(np.array([3], np.int64), np.array([True])).result()
+    assert res.s_matched.tolist() == [False]
+    e._set_dv(path, np.empty(0, np.int64))
+    res = e.probe_async(np.array([3], np.int64), np.array([True])).result()
+    assert res.s_matched.tolist() == [True]
+
+
+def test_metadata_change_invalidates(tmp_table):
+    from delta_tpu.commands.alter import set_table_properties
+
+    log = _mk_table(tmp_table)
+    e1 = _entry(log)
+    set_table_properties(log, {"delta.appendOnly": "false"})
+    e2 = _entry(log)
+    assert e2 is not e1 and e2.version == log.update().version
+
+
+def test_composite_pack_parity():
+    tab = pa.table({"a": pa.array([1, 2, None], pa.int64()),
+                    "b": pa.array([10, -3, 5], pa.int64())})
+    from delta_tpu.expr.vectorized import evaluate
+
+    packed = _pack_lanes(tab, [ir.Column("a"), ir.Column("b")], evaluate)
+    keys, ok = packed
+    assert ok.tolist() == [True, True, False]
+    assert keys[0] == (1 << 32) | 10
+    assert keys[1] == (2 << 32) | (np.int64(-3) & 0xFFFFFFFF)
+
+
+# -- resident path through MERGE -------------------------------------------
+
+
+def _copy_table(src_path, dst_path):
+    shutil.copytree(src_path, dst_path)
+    return DeltaLog.for_table(dst_path)
+
+
+def test_resident_merge_parity(tmp_path):
+    """Forced resident merge == host-pinned merge, end to end (DV mode)."""
+    import pyarrow.compute as pc
+
+    from delta_tpu.exec.scan import scan_to_table
+
+    a_path, b_path = str(tmp_path / "a"), str(tmp_path / "b")
+    log_a = _mk_table(a_path)
+    _copy_table(a_path, b_path)
+    log_b = DeltaLog.for_table(b_path)
+
+    sig_exprs = None  # built by the command's signature, seeded below
+    # seed the resident entry for table a using the merge's own key exprs
+    snap = log_a.update()
+    cmd_probe = MergeIntoCommand(
+        log_a, _source([1]), "t.k = s.k",
+        [MergeClause("update", assignments=None)],
+        [MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    cond = cmd_probe._resolve(cmd_probe.condition, ["k", "v"], ["k", "v"])
+    equi, _res = cmd_probe._split_equi_keys(cond)
+    t_exprs = [t for t, _ in equi]
+    sig = MergeIntoCommand._key_signature(t_exprs)
+    e = KeyCache.instance().get(snap, sig, ["k"], t_exprs)
+    assert e is not None
+
+    src_keys = [5, 50, 150, 400, 401]  # 3 updates, 2 inserts
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    cmd_a = _merge(log_a, _source(src_keys, vals), mode="force")
+    cmd_b = _merge(log_b, _source(src_keys, vals), mode="off")
+    assert cmd_a._device_join is not None
+    assert cmd_a._join_path == "resident"
+    assert cmd_a.metrics["numTargetRowsUpdated"] == 3
+    assert cmd_a.metrics["numTargetRowsInserted"] == 2
+    for k in ("numTargetRowsUpdated", "numTargetRowsInserted",
+              "numTargetRowsCopied"):
+        assert cmd_a.metrics[k] == cmd_b.metrics[k], k
+
+    ta = scan_to_table(log_a.update()).sort_by("k")
+    tb = scan_to_table(log_b.update()).sort_by("k")
+    assert ta.column("k").to_pylist() == tb.column("k").to_pylist()
+    assert ta.column("v").to_pylist() == tb.column("v").to_pylist()
+
+
+def test_resident_merge_after_dv_round(tmp_path):
+    """Second resident merge after the first created DVs: deleted rows must
+    not block inserts, updated values must land (the CDC steady state)."""
+    from delta_tpu.exec.scan import scan_to_table
+
+    a_path = str(tmp_path / "a")
+    log = _mk_table(a_path)
+    snap = log.update()
+    cmd0 = MergeIntoCommand(
+        log, _source([1]), "t.k = s.k",
+        [MergeClause("update", assignments=None)],
+        [MergeClause("insert", assignments=None)],
+        source_alias="s", target_alias="t",
+    )
+    cond = cmd0._resolve(cmd0.condition, ["k", "v"], ["k", "v"])
+    equi, _ = cmd0._split_equi_keys(cond)
+    t_exprs = [t for t, _ in equi]
+    sig = MergeIntoCommand._key_signature(t_exprs)
+    KeyCache.instance().get(snap, sig, ["k"], t_exprs)
+
+    cmd1 = _merge(log, _source([10, 20, 300], [1.0, 2.0, 3.0]))
+    assert cmd1._join_path == "resident"
+    # second merge: hits rows now carrying DVs + the fresh insert file
+    cmd2 = _merge(log, _source([10, 300, 301], [7.0, 8.0, 9.0]))
+    assert cmd2._join_path == "resident"
+    assert cmd2.metrics["numTargetRowsUpdated"] == 2
+    assert cmd2.metrics["numTargetRowsInserted"] == 1
+    t = scan_to_table(log.update())
+    got = dict(zip(t.column("k").to_pylist(), t.column("v").to_pylist()))
+    assert got[10] == 7.0 and got[300] == 8.0 and got[301] == 9.0
+    assert t.num_rows == 202  # 200 original + 300 + 301
+
+
+def test_resident_multi_match_errors(tmp_path):
+    from delta_tpu.utils.errors import DeltaUnsupportedOperationError
+
+    log = _mk_table(str(tmp_path / "a"))
+    snap = log.update()
+    e = KeyCache.instance().get(
+        snap, MergeIntoCommand._key_signature([ir.Column("k")]),
+        ["k"], [ir.Column("k")])
+    assert e is not None
+    with pytest.raises(DeltaUnsupportedOperationError, match="multiple source"):
+        _merge(log, _source([5, 5], [1.0, 2.0]))
+
+
+def test_background_build_after_merge(tmp_table):
+    import time
+
+    log = _mk_table(tmp_table)
+    with conf.set_temporarily(**{"delta.tpu.merge.residentKeys.minRows": "1"}):
+        cmd = _merge(log, _source([5, 400], [1.0, 2.0]), mode="auto")
+        sig = None
+        # the command recorded + consumed the candidate; poll the cache
+        for _ in range(100):
+            entries = list(KeyCache.instance()._entries.values())
+            if entries:
+                break
+            time.sleep(0.05)
+    assert entries, "background build after an eligible merge"
+    assert entries[0].version == log.update().version
+
+
+def test_probe_absent_key_sharing_lo_with_member(tmp_table):
+    """A member key Z and an absent key Y with searchsorted lo(Y) == lo(Z)
+    must not race in the mark scatter: Z stays matched (round-4 review —
+    mixed True/False scatter to one index has unspecified winner on XLA)."""
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({
+        "k": np.array([100, 200, 300], np.int64), "v": np.zeros(3)})).run()
+    e = _entry(log)
+    # many interleaved probes: absent keys just below each member key share
+    # the member's lo; order inside the scatter must not matter
+    s = np.array([99, 100, 199, 200, 299, 300, 150, 250], np.int64)
+    res = e.probe_async(s, np.ones(len(s), bool)).result()
+    assert res.s_matched.tolist() == [False, True, False, True, False, True,
+                                      False, False]
+    assert res.t_bits.tolist() == [True, True, True]
