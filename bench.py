@@ -255,14 +255,17 @@ def bench_merge_upsert(workdir):
     # state being measured
     run_merge(copies["warm"], "force", src_tab=mk_source(0), resident=True)
     run_merge(copies["warm"], "force", src_tab=mk_source(1), resident=True)
-    drain()
-    resident_s, res_cmd = _timed(lambda: run_merge(
-        copies["warm"], "force", src_tab=mk_source(2), resident=True))
+    res_trials = []
+    for i in (2, 3):
+        drain()
+        res_trials.append(_timed(lambda i=i: run_merge(
+            copies["warm"], "force", src_tab=mk_source(i), resident=True)))
+    resident_s, res_cmd = min(res_trials, key=lambda x: x[0])
     assert res_cmd._join_path == "resident", res_cmd._join_path
     # what auto picks with the lane resident (honest link-model verdict)
     drain()
     res_auto_s, res_auto_cmd = _timed(lambda: run_merge(
-        copies["warm"], "auto", src_tab=mk_source(3), resident=True))
+        copies["warm"], "auto", src_tab=mk_source(4), resident=True))
 
     from delta_tpu.parallel import link
 

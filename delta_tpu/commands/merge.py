@@ -775,7 +775,9 @@ class MergeIntoCommand:
                       + n * link.HOST_KEY_DECODE_S_PER_ROW)
             if device_s > host_s:
                 return None
-        probe = entry.probe_async(s_keys, s_ok)
+        probe = entry.probe_async(
+            s_keys, s_ok, expected_version=txn.snapshot.version
+        )
         if probe is None:
             return None
         return entry, probe, s_keys, s_ok

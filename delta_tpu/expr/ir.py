@@ -634,8 +634,104 @@ class CaseWhen(Expression):
         return " ".join(parts)
 
 
+def _substring(s, pos, ln=None):
+    """Spark substring window semantics: 1-based positive positions, 0
+    treated as 1, negative positions count from the end — and when the
+    window begins BEFORE the string (|pos| > length), the out-of-range
+    prefix still consumes length: substring('abc', -5, 4) = 'ab'."""
+    if s is None or pos is None:
+        return None
+    n = len(s)
+    start0 = pos - 1 if pos > 0 else (n + pos if pos < 0 else 0)
+    end0 = n if ln is None else start0 + max(ln, 0)
+    return s[max(start0, 0):max(end0, 0)]
+
+
+def _to_date(s, fmt=None):
+    import datetime as _dt
+
+    if s is None:
+        return None
+    if isinstance(s, _dt.datetime):
+        return s.date()
+    if isinstance(s, _dt.date):
+        return s
+    try:
+        if fmt is None:
+            return _dt.date.fromisoformat(str(s)[:10])
+        return _dt.datetime.strptime(str(s), java_fmt_to_strftime(fmt)).date()
+    except ValueError:
+        return None  # Spark's to_date returns NULL on unparseable input
+
+
+def _as_date(d):
+    import datetime as _dt
+
+    if isinstance(d, _dt.datetime):
+        return d.date()
+    if isinstance(d, _dt.date):
+        return d
+    return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(d))
+
+
+def _date_add(d, n, sign=1):
+    import datetime as _dt
+
+    if d is None or n is None:
+        return None
+    return _as_date(d) + _dt.timedelta(days=sign * int(n))
+
+
+def _datediff(a, b):
+    if a is None or b is None:
+        return None
+    return (_as_date(a) - _as_date(b)).days
+
+
+def _pad(s, n, pad, left: bool):
+    if s is None or n is None:
+        return None
+    n = int(n)
+    if n <= 0:
+        return ""
+    if len(s) >= n:
+        return s[:n]  # Spark truncates to the target width
+    if not pad:
+        return s
+    fill = (pad * n)[: n - len(s)]
+    return fill + s if left else s + fill
+
+
+def _pow(x, y):
+    if x is None or y is None:
+        return None
+    try:
+        r = float(x) ** float(y)
+    except ZeroDivisionError:
+        return math.inf  # 0 ** negative: IEEE (and Spark/Arrow) say inf
+    except OverflowError:
+        return math.inf
+    if isinstance(r, complex):
+        return math.nan  # negative base, fractional exponent (IEEE pow)
+    return r
+
+
+def _log(*args):
+    if any(a is None for a in args):
+        return None
+    if len(args) == 1:
+        return math.log(args[0]) if args[0] > 0 else None
+    base, x = args
+    if x <= 0 or base <= 0 or base == 1:
+        return None  # Spark yields NULL outside the domain
+    return math.log(x, base)
+
+
 class Func(Expression):
-    """Named scalar function (whitelisted set, used by generated columns)."""
+    """Named scalar function — the engine's analogue of the reference's
+    generated-column whitelist (``SupportedGenerationExpressions.scala``).
+    Exact (row) semantics live here; the Arrow and JAX evaluators vectorize
+    the subset they can reproduce bit-for-bit and fall back otherwise."""
 
     FUNCS: Dict[str, Callable[..., Any]] = {
         "abs": lambda x: None if x is None else abs(x),
@@ -644,16 +740,31 @@ class Func(Expression):
         "upper": lambda x: None if x is None else str(x).upper(),
         "trim": lambda x: None if x is None else str(x).strip(),
         "concat": lambda *xs: None if any(x is None for x in xs) else "".join(str(x) for x in xs),
-        "substring": lambda s, pos, ln=None: None if s is None else (
-            s[max(pos - 1, 0):] if ln is None else s[max(pos - 1, 0):max(pos - 1, 0) + ln]
-        ),
+        "substring": _substring,
+        "substr": _substring,
         "year": lambda d: None if d is None else _epoch_day_field(d, "year"),
         "month": lambda d: None if d is None else _epoch_day_field(d, "month"),
         "day": lambda d: None if d is None else _epoch_day_field(d, "day"),
         "hour": lambda t: None if t is None else ((t // 3_600_000_000) % 24),
+        "minute": lambda t: None if t is None else ((t // 60_000_000) % 60),
+        "second": lambda t: None if t is None else ((t // 1_000_000) % 60),
         "floor": lambda x: None if x is None else math.floor(x),
         "ceil": lambda x: None if x is None else math.ceil(x),
         "round": lambda x, n=0: None if x is None else round(x, n),
+        "to_date": _to_date,
+        "date_add": _date_add,
+        "date_sub": lambda d, n: _date_add(d, n, sign=-1),
+        "datediff": _datediff,
+        "lpad": lambda s, n, pad=" ": _pad(s, n, pad, left=True),
+        "rpad": lambda s, n, pad=" ": _pad(s, n, pad, left=False),
+        "format_string": lambda fmt, *xs: (
+            None if fmt is None or any(x is None for x in xs) else fmt % tuple(xs)
+        ),
+        "pow": lambda x, y: _pow(x, y),
+        "power": lambda x, y: _pow(x, y),
+        "exp": lambda x: None if x is None else math.exp(x),
+        "log": _log,
+        "sqrt": lambda x: None if x is None else (math.sqrt(x) if x >= 0 else None),
     }
 
     def __init__(self, name: str, args: Sequence[Expression]):
@@ -677,3 +788,32 @@ def _epoch_day_field(days: Any, field: str) -> Optional[int]:
     else:
         d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(days))
     return getattr(d, field)
+
+
+_JAVA_FMT = {
+    "yyyy": "%Y", "yy": "%y", "MM": "%m", "dd": "%d",
+    "HH": "%H", "mm": "%M", "ss": "%S",
+}
+
+
+def java_fmt_to_strftime(fmt: str) -> str:
+    """Translate the common subset of Java SimpleDateFormat patterns (what
+    the reference's to_date/unix_timestamp take) into strftime. Unknown
+    letter runs raise — silently misparsing dates corrupts data."""
+    out: List[str] = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c.isalpha():
+            j = i
+            while j < len(fmt) and fmt[j] == c:
+                j += 1
+            run = fmt[i:j]
+            if run not in _JAVA_FMT:
+                raise errors.unsupported_function(f"to_date format token {run!r}")
+            out.append(_JAVA_FMT[run])
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)

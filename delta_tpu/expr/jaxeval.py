@@ -249,10 +249,45 @@ def compile_expr(e: ir.Expression) -> _Compiled:
         else:
             raise NotDeviceCompilable(f"cast to {name} not device-representable")
         return lambda env: (lambda c: DeviceColumn(c.values.astype(dtype), c.valid))(cf(env))
-    if t is ir.Func and e.name in ("abs", "floor", "ceil"):
+    if t is ir.Func and e.name in ("abs", "floor", "ceil", "exp", "sqrt"):
         cf = compile_expr(e.children[0])
-        fn = {"abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil}[e.name]
+        if e.name == "sqrt":
+            # Spark: NULL outside the domain (the row evaluator's contract)
+            return lambda env: (lambda c: DeviceColumn(
+                jnp.sqrt(jnp.maximum(c.values.astype(jnp.float64), 0.0)),
+                c.valid & (c.values >= 0)))(cf(env))
+        fn = {"abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil,
+              "exp": lambda v: jnp.exp(v.astype(jnp.float64))}[e.name]
         return lambda env: (lambda c: DeviceColumn(fn(c.values), c.valid))(cf(env))
+    if t is ir.Func and e.name == "log" and len(e.children) == 1:
+        cf = compile_expr(e.children[0])
+        return lambda env: (lambda c: DeviceColumn(
+            jnp.log(jnp.maximum(c.values.astype(jnp.float64), 1e-300)),
+            c.valid & (c.values > 0)))(cf(env))
+    if t is ir.Func and e.name in ("pow", "power") and len(e.children) == 2:
+        cx = compile_expr(e.children[0])
+        cy = compile_expr(e.children[1])
+        return lambda env: (lambda a, b: DeviceColumn(
+            jnp.power(a.values.astype(jnp.float64), b.values.astype(jnp.float64)),
+            a.valid & b.valid))(cx(env), cy(env))
+    if t is ir.Func and e.name in ("date_add", "date_sub") and len(e.children) == 2:
+        # date lanes are epoch days on device
+        cd = compile_expr(e.children[0])
+        cn = compile_expr(e.children[1])
+        sign = 1 if e.name == "date_add" else -1
+        return lambda env: (lambda d, n: DeviceColumn(
+            d.values + sign * n.values.astype(d.values.dtype),
+            d.valid & n.valid))(cd(env), cn(env))
+    if t is ir.Func and e.name == "datediff" and len(e.children) == 2:
+        ca = compile_expr(e.children[0])
+        cb = compile_expr(e.children[1])
+        return lambda env: (lambda a, b: DeviceColumn(
+            a.values - b.values, a.valid & b.valid))(ca(env), cb(env))
+    if t is ir.Func and e.name in ("minute", "second") and len(e.children) == 1:
+        ct = compile_expr(e.children[0])
+        div = 60_000_000 if e.name == "minute" else 1_000_000
+        return lambda env: (lambda c: DeviceColumn(
+            (c.values // div) % 60, c.valid))(ct(env))
     raise NotDeviceCompilable(f"{type(e).__name__} has no device lowering: {e.sql()}")
 
 

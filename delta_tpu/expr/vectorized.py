@@ -291,6 +291,11 @@ class _Vectorizer:
         "year": pc.year,
         "month": pc.month,
         "day": pc.day,
+        "exp": pc.exp,
+        "log": lambda x: _ln_null(x),
+        "sqrt": lambda x: _sqrt_null(x),
+        "pow": lambda x, y: _pow_f64(x, y),
+        "power": lambda x, y: _pow_f64(x, y),
     }
 
     def _v_Func(self, e: ir.Func):
@@ -339,19 +344,116 @@ class _Vectorizer:
                 self.visit(e.children[0]), ndigits=0,
                 round_mode="half_to_even",
             )
-        if e.name == "substring" and _int_literals(e.children[1:]):
+        if (e.name in ("substring", "substr") and _int_literals(e.children[1:])
+                and int(e.children[1].value) >= 0):
+            # positive positions only: negative-position window semantics
+            # (prefix consumed before the string) keep the exact row path
             s = self.visit(e.children[0])
             pos = int(e.children[1].value)
             start = max(pos - 1, 0)
             if len(e.children) > 2:
-                stop = start + int(e.children[2].value)
+                stop = start + max(int(e.children[2].value), 0)
                 return pc.utf8_slice_codeunits(s, start=start, stop=stop)
             return pc.utf8_slice_codeunits(s, start=start)
+        if e.name in ("minute", "second"):
+            arg = self.visit(e.children[0])
+            t = getattr(arg, "type", None)
+            if t is not None and pa.types.is_timestamp(t):
+                return (pc.minute if e.name == "minute" else pc.second)(arg)
+            return self._fallback(e)  # int-µs inputs keep row semantics
+        if e.name == "to_date":
+            arg = self.visit(e.children[0])
+            t = getattr(arg, "type", None)
+            if t is None or not pa.types.is_string(t):
+                return self._fallback(e)
+            try:
+                if len(e.children) == 1:
+                    # row semantics parse the first 10 chars as ISO; Arrow's
+                    # date32 cast accepts exactly that for ISO strings, but
+                    # errors (not NULLs) bad input — fall back then
+                    return pc.cast(
+                        pc.utf8_slice_codeunits(arg, start=0, stop=10),
+                        pa.date32(),
+                    )
+                if isinstance(e.children[1], ir.Literal):
+                    fmt = ir.java_fmt_to_strftime(e.children[1].value)
+                    ts = pc.strptime(arg, format=fmt, unit="s", error_is_null=True)
+                    return pc.cast(ts, pa.date32())
+            except Exception:
+                return self._fallback(e)
+            return self._fallback(e)
+        if e.name in ("date_add", "date_sub"):
+            d = self.visit(e.children[0])
+            n = self.visit(e.children[1])
+            t = getattr(d, "type", None)
+            if t is None or not pa.types.is_date(t):
+                return self._fallback(e)
+            days = pc.cast(pc.cast(d, pa.date32()), pa.int32())
+            n32 = pc.cast(_as_array(n, self.n), pa.int32())
+            out = (pc.add if e.name == "date_add" else pc.subtract)(days, n32)
+            return pc.cast(out, pa.date32())
+        if e.name == "datediff":
+            a = self.visit(e.children[0])
+            b = self.visit(e.children[1])
+            ta, tb = getattr(a, "type", None), getattr(b, "type", None)
+            if (ta is None or tb is None or not pa.types.is_date(ta)
+                    or not pa.types.is_date(tb)):
+                return self._fallback(e)
+            return pc.subtract(pc.cast(pc.cast(a, pa.date32()), pa.int32()),
+                               pc.cast(pc.cast(b, pa.date32()), pa.int32()))
+        if e.name in ("lpad", "rpad"):
+            tail = e.children[1:]
+            if not (isinstance(tail[0], ir.Literal)
+                    and isinstance(tail[0].value, int)):
+                return self._fallback(e)
+            pad = " "
+            if len(tail) > 1:
+                if not (isinstance(tail[1], ir.Literal)
+                        and isinstance(tail[1].value, str) and tail[1].value):
+                    return self._fallback(e)
+                pad = tail[1].value
+            n = int(tail[0].value)
+            if n <= 0 or len(pad) != 1:
+                return self._fallback(e)  # multi-char pad: row semantics
+            s = self.visit(e.children[0])
+            t = getattr(s, "type", None)
+            if t is None or not pa.types.is_string(t):
+                return self._fallback(e)
+            padded = (pc.utf8_lpad if e.name == "lpad" else pc.utf8_rpad)(
+                s, width=n, padding=pad
+            )
+            # Spark truncates to the target width when the input is longer
+            return pc.utf8_slice_codeunits(padded, start=0, stop=n)
+        if e.name == "log" and len(e.children) == 2:
+            base = pc.cast(_as_array(self.visit(e.children[0]), self.n), pa.float64())
+            x = pc.cast(_as_array(self.visit(e.children[1]), self.n), pa.float64())
+            ok = pc.and_(pc.and_(pc.greater(x, 0.0), pc.greater(base, 0.0)),
+                         pc.not_equal(base, 1.0))
+            return pc.if_else(pc.fill_null(ok, False), pc.logb(x, base),
+                              pa.scalar(None, pa.float64()))
         fn = self._ARROW_FUNCS.get(e.name)
         if fn is None:
             return self._fallback(e)
         args = [self.visit(a) for a in e.children]
         return fn(*args)
+
+
+# domain-guarded math: the row evaluator yields NULL outside the domain
+# (Spark semantics); raw Arrow kernels would yield NaN/-inf — mask them
+def _ln_null(x):
+    xf = pc.cast(x, pa.float64())
+    return pc.if_else(pc.fill_null(pc.greater(xf, 0.0), False),
+                      pc.ln(xf), pa.scalar(None, pa.float64()))
+
+
+def _sqrt_null(x):
+    xf = pc.cast(x, pa.float64())
+    return pc.if_else(pc.fill_null(pc.greater_equal(xf, 0.0), False),
+                      pc.sqrt(xf), pa.scalar(None, pa.float64()))
+
+
+def _pow_f64(x, y):
+    return pc.power(pc.cast(x, pa.float64()), pc.cast(y, pa.float64()))
 
 
 def evaluate(expr: ir.Expression, table: pa.Table) -> pa.ChunkedArray:

@@ -38,12 +38,7 @@ from delta_tpu.utils.config import conf
 
 __all__ = ["ResidentJoinKeys", "KeyCache", "PhysicalProbe"]
 
-
-def _next_pow2(n: int, floor: int = 1024) -> int:
-    p = floor
-    while p < n:
-        p *= 2
-    return p
+from delta_tpu.ops.state_cache import _next_pow2  # shared pad-size bucketing
 
 
 @dataclass
@@ -75,15 +70,8 @@ class PhysicalProbe:
         return self.t_bits[off + positions]
 
 
-class PendingProbe:
-    def __init__(self, finalize):
-        self._finalize = finalize
-        self._result: Optional[PhysicalProbe] = None
-
-    def result(self) -> PhysicalProbe:
-        if self._result is None:
-            self._result = self._finalize()
-        return self._result
+# same memoizing finalize wrapper as the upload path's handle
+from delta_tpu.ops.join_kernel import PendingJoin as PendingProbe
 
 
 @functools.lru_cache(maxsize=None)
@@ -162,7 +150,16 @@ def _update_kernels():
         "revive": jax.jit(lambda v, r: v.at[r].set(True, mode="drop")),
         "append": jax.jit(
             lambda k, v, r, nk, nv: (
-                k.at[r].set(nk, mode="drop"), v.at[r].set(nv, mode="drop")
+                k.at[r].set(nk.astype(k.dtype), mode="drop"),
+                v.at[r].set(nv, mode="drop"),
+            )
+        ),
+        # contiguous appends skip the row-index upload entirely (start is a
+        # scalar); uploaded keys may arrive int32-narrowed and cast up here
+        "slice_append": jax.jit(
+            lambda k, v, start, nk, nv: (
+                jax.lax.dynamic_update_slice(k, nk.astype(k.dtype), (start,)),
+                jax.lax.dynamic_update_slice(v, nv, (start,)),
             )
         ),
     }
@@ -196,8 +193,49 @@ class ResidentJoinKeys:
         self.capacity = 1024
         self._dead = 0
         self._dev = None
+        self._pending = None  # batched device updates (see device_batch)
         self._lock = threading.RLock()
         self.last_used = 0.0
+
+    # -- batched device updates ------------------------------------------
+    #
+    # A log-tail advance touches many files (kill + revive + append per
+    # file); dispatching per file costs a link round trip each — ~100ms x
+    # 2 x n_files on a tunneled chip. Inside a device_batch the mutators
+    # accumulate row indices and the flush issues at most three kernels.
+
+    def device_batch(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def batch():
+            with self._lock:
+                self._pending = {"kill": [], "revive": [],
+                                 "rows": [], "keys": [], "valid": []}
+            try:
+                yield
+            finally:
+                self._flush_batch()
+
+        return batch()
+
+    def _flush_batch(self) -> None:
+        with self._lock:
+            p, self._pending = self._pending, None
+            if p is None or self._dev is None:
+                return  # device copy dropped mid-batch: mirrors re-ship later
+            # row scatter FIRST: a file appended and DV-masked in the same
+            # batch carries pre-DV validity in the scatter — the kill of its
+            # masked rows must land after, never be overwritten
+            if p["rows"]:
+                rows = np.concatenate(p["rows"]).astype(np.int32)
+                keys = np.concatenate(p["keys"]).astype(np.int64)
+                valid = np.concatenate(p["valid"]).astype(bool)
+                self._dev_scatter_rows(rows, keys, valid)
+            if p["kill"]:
+                self._dev_kill(np.concatenate(p["kill"]).astype(np.int32))
+            if p["revive"]:
+                self._dev_revive(np.concatenate(p["revive"]).astype(np.int32))
 
     # -- host-side maintenance -------------------------------------------
 
@@ -226,8 +264,15 @@ class ResidentJoinKeys:
                 self.capacity = max(_bucket(int(self.num_rows * 1.25)), 1024)
                 self._dev = None
                 return True
-            if self._dev is not None:
-                self._dev_append(start, keys, valid)
+            if self._pending is not None:
+                self._pending["rows"].append(
+                    np.arange(start, start + n, dtype=np.int32))
+                self._pending["keys"].append(keys.astype(np.int64))
+                self._pending["valid"].append(valid.astype(bool))
+            elif self._dev is not None:
+                self._dev_scatter_rows(
+                    np.arange(start, start + n, dtype=np.int32),
+                    keys.astype(np.int64), valid.astype(bool))
             return True
 
     def _kill_file(self, path: str) -> None:
@@ -239,7 +284,10 @@ class ResidentJoinKeys:
             off, rows = ent
             self.h_valid[off:off + rows] = False
             self._dead += rows
-            if self._dev is not None:
+            if self._pending is not None:
+                self._pending["kill"].append(
+                    np.arange(off, off + rows, dtype=np.int32))
+            elif self._dev is not None:
                 self._dev_kill(np.arange(off, off + rows, dtype=np.int32))
 
     def _set_dv(self, path: str, positions: np.ndarray) -> None:
@@ -260,7 +308,14 @@ class ResidentJoinKeys:
             if len(diff) == 0:
                 return
             self.h_valid[off:off + rows] = new_valid
-            if self._dev is not None:
+            if self._pending is not None:
+                to_false = diff[~new_valid[diff]]
+                to_true = diff[new_valid[diff]]
+                if len(to_false):
+                    self._pending["kill"].append((off + to_false).astype(np.int32))
+                if len(to_true):
+                    self._pending["revive"].append((off + to_true).astype(np.int32))
+            elif self._dev is not None:
                 to_false = diff[~new_valid[diff]]
                 to_true = diff[new_valid[diff]]
                 if len(to_false):
@@ -300,20 +355,23 @@ class ResidentJoinKeys:
             keys[: self.num_rows] = self.h_keys
             valid = np.zeros(self.capacity, bool)
             valid[: self.num_rows] = self.h_valid
-            tile = 2 << 20
+            # per-transfer overhead on a tunneled link is ~0.3s regardless
+            # of size; ~32MB tiles amortize it without any single transfer
+            # stalling the process for the whole slab (tile counts are in
+            # ELEMENTS, derived from the byte budget per dtype)
+            tile_bytes = 32 << 20
             with jax.enable_x64():
-                if self.capacity <= tile:
-                    dk = jax.device_put(keys)
-                    dv = jax.device_put(valid)
-                else:
-                    dk = jnp.concatenate([
-                        jax.device_put(keys[i:i + tile])
-                        for i in range(0, self.capacity, tile)
+                def ship(arr):
+                    step = max(tile_bytes // arr.itemsize, 1)
+                    if len(arr) <= step:
+                        return jax.device_put(arr)
+                    return jnp.concatenate([
+                        jax.device_put(arr[i:i + step])
+                        for i in range(0, len(arr), step)
                     ])
-                    dv = jnp.concatenate([
-                        jax.device_put(valid[i:i + tile])
-                        for i in range(0, self.capacity, tile)
-                    ])
+
+                dk = ship(keys)
+                dv = ship(valid)
                 jax.block_until_ready((dk, dv))
             self._dev = {"keys": dk, "valid": dv}
 
@@ -337,19 +395,38 @@ class ResidentJoinKeys:
             self._dev["valid"], jnp.asarray(padded)
         )
 
-    def _dev_append(self, start: int, keys: np.ndarray, valid: np.ndarray) -> None:
+    def _dev_scatter_rows(self, row_idx: np.ndarray, keys: np.ndarray,
+                          valid: np.ndarray) -> None:
         import jax
         import jax.numpy as jnp
 
         k = len(keys)
         a = _next_pow2(max(k, 1), floor=64)
-        rows = np.full(a, self.capacity, np.int32)
-        rows[:k] = np.arange(start, start + k, dtype=np.int32)
-        nk = np.zeros(a, np.int64)
+        i32 = np.iinfo(np.int32)
+        kdtype = (np.int32 if len(keys) and keys.min() >= i32.min
+                  and keys.max() <= i32.max else np.int64)
+        nk = np.zeros(a, kdtype)
         nk[:k] = keys
         nv = np.zeros(a, bool)
         nv[:k] = valid
+        contiguous = (
+            k > 0
+            and row_idx[0] + a <= self.capacity
+            and bool((row_idx == np.arange(row_idx[0], row_idx[0] + k,
+                                           dtype=row_idx.dtype)).all())
+        )
         with jax.enable_x64():
+            if contiguous:
+                self._dev["keys"], self._dev["valid"] = (
+                    _update_kernels()["slice_append"](
+                        self._dev["keys"], self._dev["valid"],
+                        jnp.asarray(np.int32(row_idx[0])),
+                        jnp.asarray(nk), jnp.asarray(nv),
+                    )
+                )
+                return
+            rows = np.full(a, self.capacity, np.int32)
+            rows[:k] = row_idx
             self._dev["keys"], self._dev["valid"] = _update_kernels()["append"](
                 self._dev["keys"], self._dev["valid"],
                 jnp.asarray(rows), jnp.asarray(nk), jnp.asarray(nv),
@@ -357,16 +434,24 @@ class ResidentJoinKeys:
 
     # -- probing ----------------------------------------------------------
 
-    def probe_async(self, s_keys: np.ndarray, s_ok: np.ndarray) -> Optional[PendingProbe]:
+    def probe_async(self, s_keys: np.ndarray, s_ok: np.ndarray,
+                    expected_version: Optional[int] = None) -> Optional[PendingProbe]:
         """Membership probe of sentinel-encodable source keys against the
         resident slab. Returns None when no sentinel room exists (valid keys
-        span int64) — callers fall back to the host join."""
+        span int64) — callers fall back to the host join.
+
+        ``expected_version`` guards the advance race: a tail advance holds
+        the entry lock for its whole multi-step application, so under the
+        lock the slab is either fully at the caller's version or fully past
+        it — never half-advanced. Past it → None (caller falls back)."""
         import jax
         import jax.numpy as jnp
 
         from delta_tpu.ops.join_kernel import _bucket
 
         with self._lock:
+            if expected_version is not None and self.version != expected_version:
+                return None
             n = self.num_rows
             cap = self.capacity
             if n == 0:
@@ -588,6 +673,11 @@ class KeyCache:
                 return e
             if e is not None:
                 if not self._advance(e, snapshot, key_cols, exprs):
+                    # a failed advance may have half-applied its tail: the
+                    # entry must not stay visible at its (stale) version
+                    with self._lock:
+                        if self._entries.get(key) is e:
+                            self._entries.pop(key, None)
                     e = None
             if e is None:
                 if not build_if_missing:
@@ -643,28 +733,32 @@ class KeyCache:
         w = cols.winner_mask()
         actions = cols.materialize(w)
         data_path = log.data_path
-        for a in actions:
-            if isinstance(a, RemoveFile):
-                e._kill_file(a.path)
-            elif isinstance(a, AddFile):
-                if a.path not in e.slabs:
-                    kv = _file_keys(data_path, a, key_cols, exprs)
-                    if kv is None:
-                        return False
-                    e._append_file(a.path, *kv)
-                # re-adds keep their keys (physical rows are immutable);
-                # only the deletion-vector validity may change
-                new_tag = _dv_tag(a.deletion_vector)
-                if e.dv_tags.get(a.path) != new_tag:
-                    if a.deletion_vector is not None:
-                        pos = _dv_positions(a.deletion_vector, data_path)
-                        if pos is None:
+        # hold the ENTRY lock across the whole multi-step application (and
+        # the version bump): a concurrent probe then sees the slab either
+        # fully at its version or fully past it, never in between
+        with e._lock, e.device_batch():
+            for a in actions:
+                if isinstance(a, RemoveFile):
+                    e._kill_file(a.path)
+                elif isinstance(a, AddFile):
+                    if a.path not in e.slabs:
+                        kv = _file_keys(data_path, a, key_cols, exprs)
+                        if kv is None:
                             return False
-                    else:
-                        pos = np.empty(0, np.int64)
-                    e._set_dv(a.path, pos)
-                    e.dv_tags[a.path] = new_tag
-        e.version = snapshot.version
+                        e._append_file(a.path, *kv)
+                    # re-adds keep their keys (physical rows are immutable);
+                    # only the deletion-vector validity may change
+                    new_tag = _dv_tag(a.deletion_vector)
+                    if e.dv_tags.get(a.path) != new_tag:
+                        if a.deletion_vector is not None:
+                            pos = _dv_positions(a.deletion_vector, data_path)
+                            if pos is None:
+                                return False
+                        else:
+                            pos = np.empty(0, np.int64)
+                        e._set_dv(a.path, pos)
+                        e.dv_tags[a.path] = new_tag
+            e.version = snapshot.version
         return True
 
     def _evict(self, keep) -> None:

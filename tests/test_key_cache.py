@@ -291,3 +291,24 @@ def test_probe_absent_key_sharing_lo_with_member(tmp_table):
     assert res.s_matched.tolist() == [False, True, False, True, False, True,
                                       False, False]
     assert res.t_bits.tolist() == [True, True, True]
+
+
+def test_batched_advance_append_plus_dv_same_file(tmp_table):
+    """A file appended AND DV-masked within one tail batch: the flush must
+    apply the row scatter before the kills (append captures pre-DV
+    validity)."""
+    from delta_tpu.commands.delete import DeleteCommand
+
+    log = _mk_table(tmp_table, files=1)
+    e1 = _entry(log)
+    e1.ensure_resident()
+    # in one tail window: append a file, then DV-delete some of its rows
+    WriteIntoDelta(log, "append", pa.table({
+        "k": np.arange(1000, 1050, dtype=np.int64), "v": np.zeros(50)})).run()
+    with conf.set_temporarily(**{"delta.tpu.deletionVectors.enabled": True}):
+        DeleteCommand(log, "k = 1010").run()
+    e2 = _entry(log)
+    assert e2 is e1 and e2.is_resident
+    res = e2.probe_async(np.array([1010, 1011], np.int64),
+                         np.array([True, True])).result()
+    assert res.s_matched.tolist() == [False, True]
