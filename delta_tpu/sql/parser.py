@@ -297,6 +297,10 @@ def parse_statement(sql: str):
     if t.kind != "WORD":
         raise errors.sql_expected_statement(t.value)
     head = t.value.upper()
+    if head == "SELECT":
+        return _select(p)
+    if head == "INSERT":
+        return _insert(p)
     if head == "VACUUM":
         return _vacuum(p)
     if head == "DESCRIBE" or head == "DESC":
@@ -326,6 +330,240 @@ def execute_sql(sql: str) -> Any:
 
 
 # -- statement parsers -------------------------------------------------------
+
+
+def _select(p: _Parser):
+    """SELECT <*|expr [AS alias], ...> FROM <table>
+    [VERSION AS OF n | TIMESTAMP AS OF ts] [WHERE pred]
+    [ORDER BY col [ASC|DESC], ...] [LIMIT n] — the read surface reference
+    users get from Spark SQL (`DeltaTableV2` + relation), routed through the
+    engine's scan planner (`exec/scan.scan_to_table`). Returns an Arrow
+    table."""
+    import re as _re
+
+    p.expect_word("SELECT")
+    star = False
+    items: List[Tuple[str, Optional[str]]] = []  # (expr sql, alias)
+    if p.accept_punct("*"):
+        star = True
+    else:
+        while True:
+            text = p.slice_expr(stop_words=("FROM",), stop_comma=True)
+            if text is None:
+                raise errors.sql_expected("projection expression",
+                                          p.peek().start)
+            m = _re.search(r"(?is)\s+as\s+([A-Za-z_][A-Za-z_0-9]*|`[^`]+`)\s*$",
+                           text)
+            alias = None
+            if m:
+                alias = m.group(1).strip("`")
+                text = text[: m.start()]
+            items.append((text.strip(), alias))
+            if not p.accept_punct(","):
+                break
+    p.expect_word("FROM")
+    path = p.table_path()
+    version = timestamp = None
+    if p.accept_word("VERSION"):
+        p.expect_word("AS")
+        p.expect_word("OF")
+        version = int(p.number(as_int=True))
+    elif p.accept_word("TIMESTAMP"):
+        p.expect_word("AS")
+        p.expect_word("OF")
+        t = p.next()
+        if t.kind not in ("STRING", "NUMBER"):
+            raise errors.sql_expected("timestamp literal", t.start)
+        timestamp = t.value
+    cond = None
+    if p.accept_word("WHERE"):
+        cond = p.slice_expr(stop_words=("ORDER", "LIMIT"))
+        if cond is None:
+            raise DeltaParseError("Empty WHERE clause")
+    order: List[Tuple[str, str]] = []
+    if p.accept_word("ORDER"):
+        p.expect_word("BY")
+        while True:
+            col = p.ident()
+            direction = "ascending"
+            if p.accept_word("DESC"):
+                direction = "descending"
+            else:
+                p.accept_word("ASC")
+            order.append((col, direction))
+            if not p.accept_punct(","):
+                break
+    limit = None
+    if p.accept_word("LIMIT"):
+        limit = int(p.number(as_int=True))
+    p.expect_end()
+
+    def run():
+        from delta_tpu.exec.scan import scan_to_table
+        from delta_tpu.expr import ir as _ir
+        from delta_tpu.expr.parser import parse_expression
+        from delta_tpu.expr.vectorized import evaluate
+
+        log = _log_for(path)
+        snap = log.snapshot_for(version, timestamp)
+        schema_cols = [f.name for f in snap.metadata.schema.fields]
+        lower = {c.lower(): c for c in schema_cols}
+        parsed_items = None
+        read_cols = None
+        if not star:
+            # projection pushdown: decode only the referenced columns
+            parsed_items = []
+            needed = set()
+            for text, alias in items:
+                key = text.strip("`").lower()
+                if key in lower:
+                    parsed_items.append(("col", lower[key], alias))
+                    needed.add(lower[key])
+                else:
+                    e = parse_expression(text)
+                    parsed_items.append(("expr", e, alias or text))
+                    for r in _ir.references(e):
+                        if r.lower() in lower:
+                            needed.add(lower[r.lower()])
+            for col, _dir in order:
+                if col.strip("`").lower() in lower:
+                    needed.add(lower[col.strip("`").lower()])
+            read_cols = [c for c in schema_cols if c in needed] or None
+        table = scan_to_table(snap, filters=[cond] if cond else (),
+                              columns=read_cols)
+        # ORDER BY resolves against source columns first (SQL allows sorting
+        # by non-projected columns), then post-projection aliases
+        src_lower = {c.lower(): c for c in table.column_names}
+        pre_sort = bool(order) and all(
+            c.strip("`").lower() in src_lower for c, _d in order)
+        if pre_sort:
+            table = table.sort_by([
+                (src_lower[c.strip("`").lower()], d) for c, d in order])
+        if parsed_items is not None:
+            import pyarrow as pa
+
+            arrays, names = [], []
+            for kind, payload, alias in parsed_items:
+                if kind == "col":
+                    arrays.append(table.column(payload))
+                    names.append(alias or payload)
+                else:
+                    arrays.append(evaluate(payload, table))
+                    names.append(alias)
+            # from_arrays keeps duplicate output names (SELECT id, id)
+            out = pa.Table.from_arrays(
+                [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                 for a in arrays], names=names)
+        else:
+            out = table
+        if order and not pre_sort:
+            out_lower = {c.lower(): c for c in out.column_names}
+            keys = []
+            for col, direction in order:
+                real = out_lower.get(col.strip("`").lower())
+                if real is None:
+                    raise errors.column_not_found_in_table(col, out.column_names)
+                keys.append((real, direction))
+            out = out.sort_by(keys)
+        if limit is not None:
+            out = out.slice(0, limit)
+        return out
+
+    return run
+
+
+def _insert(p: _Parser):
+    """INSERT INTO|OVERWRITE <table> [(col, ...)] VALUES (...), ... |
+    SELECT ... — the write companion of the SELECT surface (Spark handles
+    this for the reference; here it routes through WriteIntoDelta)."""
+    p.expect_word("INSERT")
+    mode = "append"
+    if p.accept_word("OVERWRITE"):
+        mode = "overwrite"
+        p.accept_word("INTO", "TABLE")
+    else:
+        p.expect_word("INTO")
+    path = p.table_path()
+    cols: Optional[List[str]] = None
+    if p.accept_punct("("):
+        cols = []
+        while True:
+            cols.append(p.ident())
+            if p.accept_punct(")"):
+                break
+            p.expect_punct(",")
+    if p.peek().is_word("SELECT"):
+        select_run = _select(p)
+
+        def run():
+            from delta_tpu.commands.write import WriteIntoDelta
+
+            log = _log_for(path)
+            data = select_run()
+            if cols is not None:
+                if len(cols) != data.num_columns:
+                    raise errors.sql_insert_arity_mismatch(
+                        len(cols), data.num_columns)
+                data = data.rename_columns(cols)
+            else:
+                # INSERT ... SELECT binds positionally: the projection must
+                # cover the whole target schema (silent null-fill of missing
+                # columns is a data bug, not a convenience)
+                target = [f.name for f in log.update().metadata.schema.fields]
+                if len(target) != data.num_columns:
+                    raise errors.sql_insert_arity_mismatch(
+                        len(target), data.num_columns)
+                data = data.rename_columns(target)
+            return WriteIntoDelta(log, mode, data).run()
+
+        return run
+    p.expect_word("VALUES")
+    rows: List[List[str]] = []
+    while True:
+        p.expect_punct("(")
+        vals: List[str] = []
+        while True:
+            v = p.slice_expr(stop_comma=True)
+            if v is None:
+                raise DeltaParseError("Empty VALUES expression")
+            vals.append(v)
+            if p.accept_punct(")"):
+                break
+            p.expect_punct(",")
+        rows.append(vals)
+        if not p.accept_punct(","):
+            break
+    p.expect_end()
+    widths = {len(r) for r in rows}
+    if len(widths) != 1:
+        raise errors.sql_insert_arity_mismatch(min(widths), max(widths))
+    if cols is not None and len(cols) != next(iter(widths)):
+        raise errors.sql_insert_arity_mismatch(len(cols), next(iter(widths)))
+
+    def run():
+        import pyarrow as pa
+
+        from delta_tpu.commands.write import WriteIntoDelta
+        from delta_tpu.expr.parser import parse_expression
+        from delta_tpu.expr.vectorized import arrow_type_for
+
+        log = _log_for(path)
+        schema = log.update().metadata.schema
+        names = cols if cols is not None else [f.name for f in schema.fields]
+        # parse time already checked the explicit-column-list arity; this
+        # guards the schema-width binding when no column list was given
+        if cols is None and len(names) != next(iter(widths)):
+            raise errors.sql_insert_arity_mismatch(len(names), next(iter(widths)))
+        types = {f.name.lower(): arrow_type_for(f.data_type) for f in schema.fields}
+        arrays = {}
+        for j, name in enumerate(names):
+            vals = [parse_expression(r[j]).eval({}) for r in rows]
+            at = types.get(name.lower())
+            arrays[name] = pa.array(vals, type=at)
+        data = pa.table(arrays)
+        return WriteIntoDelta(log, mode, data).run()
+
+    return run
 
 
 def _vacuum(p: _Parser):

@@ -12,6 +12,7 @@ from delta_tpu.api.tables import DeltaTable
 from delta_tpu.commands.write import WriteIntoDelta
 from delta_tpu.sql.lexer import tokenize
 from delta_tpu.sql.parser import execute_sql
+from delta_tpu.utils.errors import DeltaError
 from delta_tpu.utils.errors import (
     DeltaAnalysisError,
     DeltaParseError,
@@ -318,3 +319,126 @@ def test_convert_to_delta_sql(tmp_path):
     execute_sql(f"CONVERT TO DELTA parquet.`{d}`")
     t = DeltaTable.for_path(str(d))
     assert t.to_arrow().num_rows == 2
+
+
+# -- SELECT (round-4: the SQL read surface) ---------------------------------
+
+
+def _select_table(tmp_path):
+    import numpy as np
+
+    path = str(tmp_path / "sel")
+    log = DeltaLog.for_table(path)
+    WriteIntoDelta(log, "append", pa.table({
+        "id": np.arange(10, dtype=np.int64),
+        "v": np.arange(10, dtype=np.float64) * 1.5,
+        "name": pa.array([f"u{i}" for i in range(10)]),
+    })).run()
+    return path, log
+
+
+def test_select_star_where(tmp_path):
+    path, _ = _select_table(tmp_path)
+    t = execute_sql(f"SELECT * FROM delta.`{path}` WHERE id >= 7")
+    assert t.num_rows == 3
+    assert set(t.column_names) == {"id", "v", "name"}
+
+
+def test_select_columns_exprs_aliases(tmp_path):
+    path, _ = _select_table(tmp_path)
+    t = execute_sql(
+        f"SELECT id, v * 2 AS dbl, upper(name) AS nm FROM delta.`{path}` "
+        "WHERE id < 3 ORDER BY id DESC"
+    )
+    assert t.column_names == ["id", "dbl", "nm"]
+    assert t.column("id").to_pylist() == [2, 1, 0]
+    assert t.column("dbl").to_pylist() == [6.0, 3.0, 0.0]
+    assert t.column("nm").to_pylist() == ["U2", "U1", "U0"]
+
+
+def test_select_limit_and_order(tmp_path):
+    path, _ = _select_table(tmp_path)
+    t = execute_sql(f"SELECT id FROM delta.`{path}` ORDER BY id DESC LIMIT 4")
+    assert t.column("id").to_pylist() == [9, 8, 7, 6]
+
+
+def test_select_version_as_of(tmp_path):
+    import numpy as np
+
+    path, log = _select_table(tmp_path)
+    v0 = log.update().version
+    WriteIntoDelta(log, "append", pa.table({
+        "id": np.arange(100, 105, dtype=np.int64),
+        "v": np.zeros(5), "name": pa.array(["x"] * 5),
+    })).run()
+    t_now = execute_sql(f"SELECT * FROM delta.`{path}`")
+    t_old = execute_sql(f"SELECT * FROM delta.`{path}` VERSION AS OF {v0}")
+    assert t_now.num_rows == 15 and t_old.num_rows == 10
+
+
+def test_select_write_read_roundtrip_sql_only(tmp_path):
+    """The capability the VERDICT asked for: execute_sql users can read what
+    they write, including time travel."""
+    path = str(tmp_path / "rt")
+    execute_sql(f"CREATE TABLE delta.`{path}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES (1, 1.5), (2, 2.5)")
+    execute_sql(f"UPDATE delta.`{path}` SET v = v + 1 WHERE id = 2")
+    t = execute_sql(f"SELECT id, v FROM delta.`{path}` ORDER BY id")
+    assert t.column("v").to_pylist() == [1.5, 3.5]
+    t1 = execute_sql(f"SELECT v FROM delta.`{path}` VERSION AS OF 1 ORDER BY v")
+    assert t1.column("v").to_pylist() == [1.5, 2.5]
+
+
+def test_insert_select_and_overwrite(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    execute_sql(f"CREATE TABLE delta.`{src}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{src}` VALUES (1, 1.0), (2, 2.0), (3, 3.0)")
+    execute_sql(f"CREATE TABLE delta.`{dst}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{dst}` SELECT id, v FROM delta.`{src}` WHERE id >= 2")
+    t = execute_sql(f"SELECT id FROM delta.`{dst}` ORDER BY id")
+    assert t.column("id").to_pylist() == [2, 3]
+    execute_sql(f"INSERT OVERWRITE delta.`{dst}` VALUES (9, 9.0)")
+    t = execute_sql(f"SELECT * FROM delta.`{dst}`")
+    assert t.column("id").to_pylist() == [9]
+
+
+def test_insert_arity_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "t")
+    execute_sql(f"CREATE TABLE delta.`{path}` (id BIGINT, v DOUBLE)")
+    with pytest.raises(DeltaError, match="differ"):
+        execute_sql(f"INSERT INTO delta.`{path}` (id) VALUES (1, 2.0)")
+
+
+def test_select_unknown_statement_mentions_select():
+    with pytest.raises(DeltaError, match="SELECT"):
+        execute_sql("FROBNICATE x")
+
+
+def test_select_order_by_unprojected_and_duplicate(tmp_path):
+    path = str(tmp_path / "o")
+    execute_sql(f"CREATE TABLE delta.`{path}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES (2, 20.0), (1, 10.0)")
+    # sorting by a non-projected source column (standard SQL)
+    t = execute_sql(f"SELECT v FROM delta.`{path}` ORDER BY id")
+    assert t.column("v").to_pylist() == [10.0, 20.0]
+    # duplicate output names survive
+    t = execute_sql(f"SELECT id, id FROM delta.`{path}`")
+    assert t.num_columns == 2
+    # sorting by an alias
+    t = execute_sql(f"SELECT v AS x FROM delta.`{path}` ORDER BY x DESC")
+    assert t.column("x").to_pylist() == [20.0, 10.0]
+    # unknown order column is a DeltaError, not a raw Arrow crash
+    with pytest.raises(DeltaError, match="not found"):
+        execute_sql(f"SELECT v AS x FROM delta.`{path}` ORDER BY zzz")
+
+
+def test_insert_select_arity_enforced(tmp_path):
+    src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+    execute_sql(f"CREATE TABLE delta.`{src}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{src}` VALUES (1, 1.0)")
+    execute_sql(f"CREATE TABLE delta.`{dst}` (id BIGINT, v DOUBLE)")
+    with pytest.raises(DeltaError, match="differ"):
+        execute_sql(f"INSERT INTO delta.`{dst}` SELECT id FROM delta.`{src}`")
+    with pytest.raises(DeltaError, match="differ"):
+        execute_sql(f"INSERT INTO delta.`{dst}` (id) SELECT id, v FROM delta.`{src}`")
