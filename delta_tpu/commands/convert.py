@@ -22,7 +22,11 @@ from delta_tpu.exec.write import unescape_partition_value
 from delta_tpu.protocol.actions import Action, AddFile, Metadata
 from delta_tpu.schema.arrow_interop import schema_from_arrow
 from delta_tpu.schema.types import StructType
-from delta_tpu.utils.errors import DeltaAnalysisError, DeltaFileNotFoundError
+from delta_tpu.utils.errors import (
+    DeltaAnalysisError,
+    DeltaFileNotFoundError,
+    DeltaIllegalStateError,
+)
 from delta_tpu.utils import errors
 
 __all__ = ["ConvertToDeltaCommand"]
@@ -34,20 +38,29 @@ class ConvertToDeltaCommand:
         delta_log,
         partition_schema: Optional[StructType] = None,
         collect_stats: bool = False,
+        distribute: bool = False,
     ):
         self.delta_log = delta_log
         self.partition_schema = partition_schema
         self.collect_stats = collect_stats
+        # multi-process conversion: each host footers/stats its slice of the
+        # listing and publishes a fragment through the shared store; process
+        # 0 gathers the fragments and commits (SURVEY §2.8's executor
+        # fan-out, coordinated through the filesystem like everything else)
+        self.distribute = distribute
 
     def _list_parquet_files(self) -> List[Tuple[str, int, int]]:
         """(rel_path, size, mtime_ms) for every data file under the table."""
         base = self.delta_log.data_path
         out = []
         for root, dirs, files in os.walk(base):
-            dirs[:] = [
+            # sorted traversal: multi-host convert relies on every process
+            # computing the IDENTICAL index->file mapping, and os.scandir
+            # order is filesystem-dependent
+            dirs[:] = sorted(
                 d for d in dirs
                 if not ((d.startswith("_") or d.startswith(".")) and "=" not in d)
-            ]
+            )
             for name in sorted(files):
                 if name.startswith("_") or name.startswith("."):
                     continue
@@ -84,12 +97,40 @@ class ConvertToDeltaCommand:
                 f"No parquet files found in {log.data_path} to convert"
             )
 
-        # merge footers into one schema (performConvert :314-365)
+        if self.distribute:
+            from delta_tpu.parallel.distributed import (
+                host_shard_indices, process_info,
+            )
+
+            proc, n_procs = process_info()
+        else:
+            proc, n_procs = 0, 1
+
+        # per-file work (footer read for the schema merge; optional stats
+        # read): this host's deterministic slice of the listing
+        mine = (host_shard_indices(len(files), proc, n_procs)
+                if n_procs > 1 else range(len(files)))
         merged = None
-        for rel, _, _ in files:
+        frag_adds: List[dict] = []
+        for i in mine:
+            rel, size, mtime = files[i]
             abs_p = os.path.join(log.data_path, rel.replace("/", os.sep))
             s = pq.ParquetFile(abs_p).schema_arrow
             merged = s if merged is None else _merge_arrow(merged, s)
+            frag_adds.append({
+                "i": i, "rel": rel, "size": size, "mtime": mtime,
+                "stats": self._stats_for(rel) if self.collect_stats else None,
+            })
+
+        if n_procs > 1:
+            merged, frag_adds = self._exchange_fragments(
+                proc, n_procs, merged, frag_adds, files
+            )
+            if proc != 0:
+                # non-coordinators published their fragment; the commit is
+                # process 0's — wait for it so every process returns the
+                # same version
+                return self._await_converted()
         data_schema = schema_from_arrow(merged)
 
         part_fields = list(self.partition_schema.fields) if self.partition_schema else []
@@ -100,16 +141,17 @@ class ConvertToDeltaCommand:
         )
 
         adds: List[Action] = []
-        for rel, size, mtime in files:
+        for f in sorted(frag_adds, key=lambda d: d["i"]):
+            rel = f["rel"]
             pv = self._partition_values(rel)
             adds.append(
                 AddFile(
                     path=urllib.parse.quote(rel, safe="/:@!$&'()*+,;=-._~"),
                     partition_values=pv,
-                    size=size,
-                    modification_time=mtime,
+                    size=f["size"],
+                    modification_time=f["mtime"],
                     data_change=True,
-                    stats=self._stats_for(rel) if self.collect_stats else None,
+                    stats=f["stats"],
                 )
             )
 
@@ -128,6 +170,92 @@ class ConvertToDeltaCommand:
 
         abs_p = os.path.join(self.delta_log.data_path, rel.replace("/", os.sep))
         return stats_json(pq.read_table(abs_p))
+
+    # -- multi-process fragment exchange (shared-store coordination) ------
+
+    @staticmethod
+    def _listing_token(files) -> str:
+        """Deterministic attempt token: a hash of the (sorted) listing. All
+        hosts of one attempt compute the same token; a retry after the data
+        changed gets a fresh namespace, so stale fragments from a crashed
+        earlier attempt can never be consumed (fragments from an identical
+        listing ARE valid — same inputs, same outputs)."""
+        import hashlib
+        import json as _json
+
+        payload = _json.dumps(files, sort_keys=True).encode()
+        return hashlib.sha256(payload).hexdigest()[:16]
+
+    def _fragment_path(self, token: str, proc: int) -> str:
+        return (f"{self.delta_log.log_path}/.convert_fragments/"
+                f"{token}-part-{proc:05d}.json")
+
+    @staticmethod
+    def _timeout_s() -> float:
+        from delta_tpu.utils.config import conf
+
+        return int(conf.get("delta.tpu.distributed.timeoutMs", 600_000)) / 1000
+
+    def _exchange_fragments(self, proc, n_procs, merged, frag_adds, files):
+        """Publish this host's fragment (schema + per-file rows) through the
+        store; process 0 gathers every fragment and returns the combined
+        (schema, rows). An empty slice publishes a schema-less fragment."""
+        import io
+        import json as _json
+        import time as _time
+
+        import pyarrow as pa
+
+        store = self.delta_log.store
+        token = self._listing_token(files)
+        schema_hex = None
+        if merged is not None:
+            sink = io.BytesIO()
+            pa.ipc.new_stream(sink, pa.schema(merged)).close()
+            schema_hex = sink.getvalue().hex()
+        payload = _json.dumps({"schema_ipc": schema_hex, "adds": frag_adds})
+        store.write_bytes(self._fragment_path(token, proc), payload.encode(),
+                          overwrite=True)
+        if proc != 0:
+            return merged, frag_adds
+        deadline = _time.monotonic() + self._timeout_s()
+        out_adds = list(frag_adds)
+        for other in range(1, n_procs):
+            path = self._fragment_path(token, other)
+            while not store.exists(path):
+                if _time.monotonic() > deadline:
+                    raise DeltaIllegalStateError(
+                        f"Timed out waiting for convert fragment {path}"
+                    )
+                _time.sleep(0.05)
+            d = _json.loads(store.read_bytes(path))
+            if d["schema_ipc"] is not None:
+                other_schema = pa.ipc.open_stream(
+                    bytes.fromhex(d["schema_ipc"])).schema
+                merged = (other_schema if merged is None
+                          else _merge_arrow(merged, other_schema))
+            out_adds.extend(d["adds"])
+        if len(out_adds) != len(files):
+            raise DeltaIllegalStateError(
+                f"Convert fragments cover {len(out_adds)} of {len(files)} files"
+            )
+        return merged, out_adds
+
+    def _await_converted(self) -> int:
+        import time as _time
+
+        deadline = _time.monotonic() + self._timeout_s()
+        log = self.delta_log
+        while True:
+            snap = log.update()
+            if snap.version >= 0:
+                return snap.version
+            if _time.monotonic() > deadline:
+                raise DeltaIllegalStateError(
+                    "Timed out waiting for the coordinating process's "
+                    "CONVERT commit"
+                )
+            _time.sleep(0.05)
 
 
 def _merge_arrow(a, b):

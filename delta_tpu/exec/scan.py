@@ -39,6 +39,7 @@ def read_files_as_table(
     columns: Optional[Sequence[str]] = None,
     per_file: bool = False,
     position_column: Optional[str] = None,
+    distribute: bool = False,
 ):
     """Decode AddFiles to one Arrow table, materializing partition columns.
 
@@ -46,12 +47,20 @@ def read_files_as_table(
     the GIL) — the host fan-out the reference gets from Spark executors
     (`files/TahoeFileIndex.scala:58-81`). ``per_file=True`` returns the list
     of per-file tables (same order as ``files``) instead of one concat.
+    ``distribute=True`` restricts the decode to THIS host's deterministic
+    slice of the file list (`parallel/distributed.host_partition`) — the
+    multi-host scan shape where each process consumes its partition; on a
+    single host it is the identity.
 
     Rows marked in a file's deletion vector are dropped. When
     ``position_column`` is given, each row carries its PHYSICAL position in
     the file as written (int64) — DML needs physical positions to extend a
     file's deletion vector.
     """
+    if distribute:
+        from delta_tpu.parallel.distributed import host_partition
+
+        files = host_partition(list(files))
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
     part_schema = metadata.partition_schema
@@ -229,9 +238,11 @@ def scan_to_table(
     snapshot,
     filters: Sequence[Union[str, ir.Expression]] = (),
     columns: Optional[Sequence[str]] = None,
+    distribute: bool = False,
 ) -> pa.Table:
     """Full read path: prune → decode (projection ∪ filter columns) →
-    residual filter → project."""
+    residual filter → project. ``distribute=True``: this host decodes only
+    its partition of the pruned file list (multi-host scan)."""
     exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
     scan = pruning.files_for_scan(snapshot, exprs)
     data_path = snapshot.delta_log.data_path
@@ -244,7 +255,8 @@ def scan_to_table(
             needed.update(ir.references(e))
         read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
                      if c in needed]
-    table = read_files_as_table(data_path, scan.files, snapshot.metadata, read_cols)
+    table = read_files_as_table(data_path, scan.files, snapshot.metadata,
+                                read_cols, distribute=distribute)
     if residual and table.num_rows:
         table = filter_table(table, ir.and_all(residual))
     if columns is not None and read_cols != list(columns):

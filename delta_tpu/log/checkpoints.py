@@ -404,6 +404,7 @@ def write_checkpoint(
     actions: Sequence[Action],
     parts: Optional[int] = None,
     part_size: int = 1_000_000,
+    distribute: bool = False,
 ) -> CheckpointMetaData:
     """Write a checkpoint for ``version`` holding ``actions`` (the reconciled
     state from :meth:`LogReplay.checkpoint_actions`).
@@ -445,19 +446,71 @@ def write_checkpoint(
         pq.write_table(table, sink, compression="snappy")
         store.write_bytes(path, sink.getvalue().to_pybytes(), overwrite=True)
 
+    if distribute:
+        from delta_tpu.parallel.distributed import process_info
+
+        proc, n_procs = process_info()
+    else:
+        proc, n_procs = 0, 1
+
     if parts == 1:
         path = f"{log_path}/{filenames.checkpoint_file_single(version)}"
-        _write_one(path, actions)
+        if proc == 0:
+            _write_one(path, actions)
         md = CheckpointMetaData(version, n, None)
+        all_paths = [path]
     else:
         paths = [f"{log_path}/{p}" for p in filenames.checkpoint_file_with_parts(version, parts)]
         chunk = math.ceil(n / parts) if n else 0
         slices = [actions[i * chunk:(i + 1) * chunk] for i in range(parts)]
-        with ThreadPoolExecutor(max_workers=min(parts, 16)) as ex:
-            list(ex.map(lambda pz: _write_one(pz[0], pz[1]), zip(paths, slices)))
+        if n_procs > 1:
+            # each host writes its deterministic slice of the parts — the
+            # reference fans part writes over executors; here over processes
+            from delta_tpu.parallel.distributed import host_shard_indices
+
+            mine = host_shard_indices(parts, proc, n_procs)
+            paths_slices = [(paths[i], slices[i]) for i in mine]
+        else:
+            paths_slices = list(zip(paths, slices))
+        if paths_slices:
+            with ThreadPoolExecutor(max_workers=min(len(paths_slices), 16)) as ex:
+                list(ex.map(lambda pz: _write_one(pz[0], pz[1]), paths_slices))
         md = CheckpointMetaData(version, n, parts)
-    write_last_checkpoint(store, log_path, md)
+        all_paths = paths
+    if proc == 0:
+        if n_procs > 1:
+            _wait_for_paths(store, all_paths)
+        # only the coordinating process publishes the pointer, and only
+        # after every host's parts are visible — readers trust it
+        write_last_checkpoint(store, log_path, md)
     return md
+
+
+def _distributed_timeout_s() -> float:
+    from delta_tpu.utils.config import conf
+
+    return int(conf.get("delta.tpu.distributed.timeoutMs", 600_000)) / 1000
+
+
+def _wait_for_paths(store: LogStore, paths: Sequence[str],
+                    timeout_s: Optional[float] = None) -> None:
+    """Poll until every path exists (multi-host checkpoint barrier over the
+    shared store — no RPC, matching the engine's no-lock-service stance).
+    Existence checks only — never downloads (`LogStore.exists`)."""
+    import time as _time
+
+    deadline = _time.monotonic() + (timeout_s or _distributed_timeout_s())
+    pending = list(paths)
+    while pending:
+        pending = [p for p in pending if not store.exists(p)]
+        if not pending:
+            return
+        if _time.monotonic() > deadline:
+            raise DeltaIllegalStateError(
+                f"Timed out waiting for checkpoint parts from other hosts: "
+                f"{pending[:3]}{'...' if len(pending) > 3 else ''}"
+            )
+        _time.sleep(0.05)
 
 
 def _row_to_action(name: str, d: Dict[str, Any]) -> Optional[Action]:

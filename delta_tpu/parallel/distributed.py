@@ -8,10 +8,14 @@ split is:
   device mesh (`parallel/mesh.py`): the replay, join, and skipping kernels.
 * **inter-host (DCN)** — `jax.distributed` + the deterministic per-host
   work partitioner below: every host computes the same strided assignment
-  with no RPC. Wired today into VACUUM's delete fan-out
-  (`commands/vacuum.py` — each host removes its slice, the reference's
-  distributed GC); other host-IO loops can adopt :func:`host_partition`
-  the same way when launched multi-process.
+  with no RPC. Consumers: VACUUM's delete fan-out (`commands/vacuum.py`),
+  multi-host scan decode (`exec/scan.read_files_as_table(distribute=True)`),
+  checkpoint part writing (`log/checkpoints.write_checkpoint` — proc 0
+  publishes `_last_checkpoint` after all hosts' parts are visible), and
+  CONVERT's footer/stats collection (`commands/convert.py` — fragments
+  exchanged through the shared store, proc 0 commits). A real 2-process
+  `jax.distributed` cluster exercises all of these in
+  `tests/test_multihost.py`.
 * **control plane** — unchanged from single-host: commits still serialize
   through the LogStore's atomic create, which is host-agnostic. There is
   deliberately no lock service (the reference's stance,

