@@ -226,6 +226,19 @@ class Snapshot:
         files = self._columnar.materialize(self._alive_mask)
         return sorted(files, key=lambda a: a.path)
 
+    @cached_property
+    def _alive_row_by_path(self) -> Dict[str, int]:
+        rows = np.nonzero(self._alive_mask)[0]
+        return dict(zip(self._columnar.paths_for(rows), rows.tolist()))
+
+    def files_for_paths(self, paths: Sequence[str]) -> List[AddFile]:
+        """Materialize AddFiles for exactly the given (alive) paths, sorted
+        by path — the selective alternative to ``all_files`` when a resident
+        plan already knows which few files survive (`ops/state_cache`)."""
+        by_path = self._alive_row_by_path
+        rows = np.asarray(sorted(by_path[p] for p in paths), np.int64)
+        return sorted(self._columnar.materialize(rows), key=lambda a: a.path)
+
     def _tombstone_mask(self, cutoff_ms: int) -> np.ndarray:
         _, tomb = self._columnar.replay(cutoff_ms, winner=self._winner)
         return tomb

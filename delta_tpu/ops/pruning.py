@@ -238,6 +238,50 @@ def prune_files(
     return [f for f, k in zip(files, keep) if k]
 
 
+def _resident_scan(snapshot, data_filters: Sequence[ir.Expression]) -> Optional[DeltaScan]:
+    """Serve a scan from the HBM/mirror-resident state cache
+    (`ops/state_cache`, the reference's `StateCache` role): only the few
+    surviving files materialize as dataclasses — ``all_files`` (every
+    AddFile as a Python object) is never built. Only taken when the range
+    lowering is EXACT (no strict comparison was relaxed), so the result
+    matches the evaluator file-for-file. None → normal path."""
+    if not conf.get_bool("delta.tpu.stateCache.serveScans", True):
+        return None
+    from delta_tpu.ops.state_cache import DeviceStateCache, extract_ranges
+
+    entry = DeviceStateCache.instance().get(snapshot)
+    if entry is None:
+        return None
+    pred = skipping_predicate(ir.and_all(list(data_filters)), frozenset())
+    r = extract_ranges(pred, entry.columns)
+    if r is None or not r.exact:
+        return None
+    plans = entry.plan_ranges([r], k=max(entry.num_rows, 1),
+                              expected_version=snapshot.version)
+    if plans is None:
+        return None
+    plan = plans[0]
+    paths = [entry.paths[i] for i in plan.rows]
+    kept = snapshot.files_for_paths(paths)
+    alive = entry.h_alive[: entry.num_rows]
+    total_bytes = int(entry.h_size[: entry.num_rows][alive].sum())
+    n_alive = int(alive.sum())
+    total = DataSize(bytes_compressed=total_bytes, files=n_alive)
+    return DeltaScan(
+        version=snapshot.version,
+        files=kept,
+        total=total,
+        partition=total,  # unpartitioned: nothing pruned by partition
+        scanned=DataSize(
+            bytes_compressed=sum(f.size or 0 for f in kept),
+            files=len(kept),
+            rows=sum(f.num_logical_records or 0 for f in kept) or None,
+        ),
+        partition_filters=[],
+        data_filters=list(data_filters),
+    )
+
+
 def files_for_scan(
     snapshot,
     filters: Sequence[ir.Expression] = (),
@@ -246,8 +290,9 @@ def files_for_scan(
     """Partition-prune then stats-prune the snapshot's files for a query.
 
     The partition step matches `PartitionFiltering.scala:27-42`; the stats
-    step is the skipping path the reference leaves unwired.
-    """
+    step is the skipping path the reference leaves unwired. Unpartitioned
+    tables with an exactly-lowerable predicate serve from the resident
+    state cache instead of materializing every AddFile."""
     metadata = snapshot.metadata
     part_schema = metadata.partition_schema
     part_cols = metadata.partition_columns
@@ -259,6 +304,11 @@ def files_for_scan(
                 partition_filters.append(conj)
             else:
                 data_filters.append(conj)
+
+    if not part_cols and data_filters and not partition_filters:
+        fast = _resident_scan(snapshot, data_filters)
+        if fast is not None:
+            return fast
 
     all_files = snapshot.all_files
     total = DataSize(

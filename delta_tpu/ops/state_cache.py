@@ -85,6 +85,9 @@ class RangeSet:
     lo: np.ndarray  # float64, len C, NaN = -inf
     hi: np.ndarray  # float64, len C, NaN = +inf
     verdict: Optional[str] = None  # None | 'empty' | 'all'
+    # True when the lowering lost nothing: no strict comparison was relaxed
+    # to non-strict, so the range verdict EQUALS the exact evaluator's
+    exact: bool = True
 
 
 def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[RangeSet]:
@@ -97,9 +100,10 @@ def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[Rang
     lo = np.full(len(columns), np.nan)
     hi = np.full(len(columns), np.nan)
     empty = False
+    exact = True
 
     def walk(e: ir.Expression) -> bool:
-        nonlocal empty
+        nonlocal empty, exact
         t = type(e)
         if t is ir.And:
             return walk(e.left) and walk(e.right)
@@ -122,12 +126,16 @@ def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[Rang
                 i = col_ix.get(name[4:])
                 if i is None:
                     return False
+                if t is ir.Lt:
+                    exact = False
                 hi[i] = v if np.isnan(hi[i]) else min(hi[i], v)
                 return True
             if name.startswith("max.") and t in (ir.Ge, ir.Gt):
                 i = col_ix.get(name[4:])
                 if i is None:
                     return False
+                if t is ir.Gt:
+                    exact = False
                 lo[i] = v if np.isnan(lo[i]) else max(lo[i], v)
                 return True
             return False
@@ -136,10 +144,10 @@ def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[Rang
     if not walk(pred):
         return None
     if empty:
-        return RangeSet(lo, hi, verdict="empty")
+        return RangeSet(lo, hi, verdict="empty", exact=exact)
     if np.isnan(lo).all() and np.isnan(hi).all():
-        return RangeSet(lo, hi, verdict="all")
-    return RangeSet(lo, hi)
+        return RangeSet(lo, hi, verdict="all", exact=exact)
+    return RangeSet(lo, hi, exact=exact)
 
 
 # -- the resident entry ------------------------------------------------------

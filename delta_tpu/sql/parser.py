@@ -332,13 +332,33 @@ def execute_sql(sql: str) -> Any:
 # -- statement parsers -------------------------------------------------------
 
 
+def _parse_aggregate(text: str):
+    """(func, inner_sql|'*') when ``text`` is a top-level aggregate call
+    (COUNT/SUM/AVG/MIN/MAX), else None."""
+    import re as _re
+
+    m = _re.match(r"(?is)^\s*(count|sum|avg|min|max)\s*\((.*)\)\s*$", text)
+    if not m:
+        return None
+    inner = m.group(2).strip()
+    # the closing paren must match the opening one (reject `min(a) + max(b)`)
+    depth = 0
+    for ch in m.group(2):
+        depth += ch == "("
+        depth -= ch == ")"
+        if depth < 0:
+            return None
+    return m.group(1).lower(), inner
+
+
 def _select(p: _Parser):
-    """SELECT <*|expr [AS alias], ...> FROM <table>
+    """SELECT <*|expr|aggregate [AS alias], ...> FROM <table>
     [VERSION AS OF n | TIMESTAMP AS OF ts] [WHERE pred]
-    [ORDER BY col [ASC|DESC], ...] [LIMIT n] — the read surface reference
-    users get from Spark SQL (`DeltaTableV2` + relation), routed through the
-    engine's scan planner (`exec/scan.scan_to_table`). Returns an Arrow
-    table."""
+    [GROUP BY col, ...] [ORDER BY col [ASC|DESC], ...] [LIMIT n] — the read
+    surface reference users get from Spark SQL (`DeltaTableV2` + relation),
+    routed through the engine's scan planner (`exec/scan.scan_to_table`).
+    Aggregates: COUNT(*)/COUNT/SUM/AVG/MIN/MAX, optionally grouped. Returns
+    an Arrow table."""
     import re as _re
 
     p.expect_word("SELECT")
@@ -377,9 +397,16 @@ def _select(p: _Parser):
         timestamp = t.value
     cond = None
     if p.accept_word("WHERE"):
-        cond = p.slice_expr(stop_words=("ORDER", "LIMIT"))
+        cond = p.slice_expr(stop_words=("GROUP", "ORDER", "LIMIT"))
         if cond is None:
             raise DeltaParseError("Empty WHERE clause")
+    group_by: List[str] = []
+    if p.accept_word("GROUP"):
+        p.expect_word("BY")
+        while True:
+            group_by.append(p.ident())
+            if not p.accept_punct(","):
+                break
     order: List[Tuple[str, str]] = []
     if p.accept_word("ORDER"):
         p.expect_word("BY")
@@ -410,13 +437,29 @@ def _select(p: _Parser):
         lower = {c.lower(): c for c in schema_cols}
         parsed_items = None
         read_cols = None
+        has_agg = False
         if not star:
             # projection pushdown: decode only the referenced columns
             parsed_items = []
             needed = set()
             for text, alias in items:
                 key = text.strip("`").lower()
-                if key in lower:
+                agg = _parse_aggregate(text)
+                if agg is not None:
+                    func, inner = agg
+                    if inner == "*":
+                        if func != "count":
+                            raise errors.sql_star_only_in_count(func)
+                        inner_e = None
+                    else:
+                        inner_e = parse_expression(inner)
+                        for r in _ir.references(inner_e):
+                            if r.lower() in lower:
+                                needed.add(lower[r.lower()])
+                    parsed_items.append(
+                        ("agg", (func, inner_e), alias or text))
+                    has_agg = True
+                elif key in lower:
                     parsed_items.append(("col", lower[key], alias))
                     needed.add(lower[key])
                 else:
@@ -425,37 +468,56 @@ def _select(p: _Parser):
                     for r in _ir.references(e):
                         if r.lower() in lower:
                             needed.add(lower[r.lower()])
+            for g in group_by:
+                if g.strip("`").lower() in lower:
+                    needed.add(lower[g.strip("`").lower()])
             for col, _dir in order:
                 if col.strip("`").lower() in lower:
                     needed.add(lower[col.strip("`").lower()])
-            read_cols = [c for c in schema_cols if c in needed] or None
+            if needed:
+                read_cols = [c for c in schema_cols if c in needed]
+            elif has_agg and schema_cols:
+                # aggregate-only projection (e.g. COUNT(*)): one narrow
+                # column is enough to carry the row count
+                read_cols = [schema_cols[0]]
+            else:
+                read_cols = None
+        if (has_agg or group_by) and star:
+            raise DeltaParseError("SELECT * cannot be combined with GROUP BY")
         table = scan_to_table(snap, filters=[cond] if cond else (),
                               columns=read_cols)
-        # ORDER BY resolves against source columns first (SQL allows sorting
-        # by non-projected columns), then post-projection aliases
-        src_lower = {c.lower(): c for c in table.column_names}
-        pre_sort = bool(order) and all(
-            c.strip("`").lower() in src_lower for c, _d in order)
-        if pre_sort:
-            table = table.sort_by([
-                (src_lower[c.strip("`").lower()], d) for c, d in order])
-        if parsed_items is not None:
-            import pyarrow as pa
-
-            arrays, names = [], []
-            for kind, payload, alias in parsed_items:
-                if kind == "col":
-                    arrays.append(table.column(payload))
-                    names.append(alias or payload)
-                else:
-                    arrays.append(evaluate(payload, table))
-                    names.append(alias)
-            # from_arrays keeps duplicate output names (SELECT id, id)
-            out = pa.Table.from_arrays(
-                [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
-                 for a in arrays], names=names)
+        pre_sort = False
+        hidden: List[str] = []
+        if has_agg or group_by:
+            order_keys = [c.strip("`").lower() for c, _d in order]
+            out, hidden = _run_aggregate(table, parsed_items, group_by,
+                                         order_keys, evaluate)
         else:
-            out = table
+            # ORDER BY resolves against source columns first (SQL allows
+            # sorting by non-projected columns), then aliases
+            src_lower = {c.lower(): c for c in table.column_names}
+            pre_sort = bool(order) and all(
+                c.strip("`").lower() in src_lower for c, _d in order)
+            if pre_sort:
+                table = table.sort_by([
+                    (src_lower[c.strip("`").lower()], d) for c, d in order])
+            if parsed_items is not None:
+                import pyarrow as pa
+
+                arrays, names = [], []
+                for kind, payload, alias in parsed_items:
+                    if kind == "col":
+                        arrays.append(table.column(payload))
+                        names.append(alias or payload)
+                    else:
+                        arrays.append(evaluate(payload, table))
+                        names.append(alias)
+                # from_arrays keeps duplicate output names (SELECT id, id)
+                out = pa.Table.from_arrays(
+                    [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                     for a in arrays], names=names)
+            else:
+                out = table
         if order and not pre_sort:
             out_lower = {c.lower(): c for c in out.column_names}
             keys = []
@@ -465,11 +527,94 @@ def _select(p: _Parser):
                     raise errors.column_not_found_in_table(col, out.column_names)
                 keys.append((real, direction))
             out = out.sort_by(keys)
+        if hidden:
+            # group keys carried only for ORDER BY drop out of the result
+            out = out.drop_columns(hidden)
         if limit is not None:
             out = out.slice(0, limit)
         return out
 
     return run
+
+
+def _run_aggregate(table, parsed_items, group_by, order_keys, evaluate):
+    """Execute the aggregate leg of a SELECT: non-aggregate items must be
+    GROUP BY keys; aggregates compute over Arrow's hash aggregation (or
+    whole-table kernels when ungrouped). Returns (table, hidden) where
+    ``hidden`` are group keys appended ONLY so ORDER BY can resolve them —
+    the caller drops them after sorting."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    tbl_lower = {c.lower(): c for c in table.column_names}
+    group_keys = []
+    for g in group_by:
+        real = tbl_lower.get(g.strip("`").lower())
+        if real is None:
+            raise errors.column_not_found_in_table(g, table.column_names)
+        group_keys.append(real)
+    group_set = {g.lower() for g in group_keys}
+
+    work_cols: dict = {g: table.column(g) for g in group_keys}
+    aggs = []   # (workname, arrow_func, outname) in projection order
+    layout = []  # ("key", real, outname) | ("agg", workname, outname)
+    fn_map = {"count": "count", "sum": "sum", "avg": "mean",
+              "min": "min", "max": "max"}
+    for i, (kind, payload, alias) in enumerate(parsed_items):
+        if kind == "col":
+            if payload.lower() not in group_set:
+                raise errors.sql_column_needs_group_by(payload)
+            layout.append(("key", payload, alias or payload))
+        elif kind == "expr":
+            raise DeltaParseError(
+                "Non-aggregate expressions in an aggregate SELECT must be "
+                "GROUP BY columns"
+            )
+        else:
+            func, inner_e = payload
+            work = f"__agg{i}"
+            if inner_e is None:  # COUNT(*): count a non-null constant
+                work_cols[work] = pa.chunked_array(
+                    [pa.array(np.ones(table.num_rows, np.int8))])
+            else:
+                work_cols[work] = evaluate(inner_e, table)
+            aggs.append((work, fn_map[func], alias))
+            layout.append(("agg", work, alias))
+
+    work = pa.table(work_cols)
+    if group_keys:
+        res = work.group_by(group_keys).aggregate(
+            [(w, f) for w, f, _ in aggs])
+        agg_out = {w: f"{w}_{f}" for w, f, _ in aggs}
+    else:
+        cols = {}
+        for w, f, _ in aggs:
+            col = work.column(w)
+            if f == "count":
+                cols[f"{w}_{f}"] = pa.array([len(col) - col.null_count])
+            else:
+                kern = {"sum": pc.sum, "mean": pc.mean,
+                        "min": pc.min, "max": pc.max}[f]
+                cols[f"{w}_{f}"] = pa.array([kern(col).as_py()])
+        res = pa.table(cols)
+        agg_out = {w: f"{w}_{f}" for w, f, _ in aggs}
+
+    # ORDER BY may reference a group key the projection dropped: carry it
+    # through under its real name and let the caller drop it after sorting
+    hidden = []
+    projected = {outname.lower() for _k, _n, outname in layout}
+    for g in group_keys:
+        if g.lower() not in projected and g.lower() in order_keys:
+            layout.append(("key", g, g))
+            hidden.append(g)
+    arrays, names = [], []
+    for kind, name, outname in layout:
+        src = name if kind == "key" else agg_out[name]
+        col = res.column(src)
+        arrays.append(col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col)
+        names.append(outname)
+    return pa.Table.from_arrays(arrays, names=names), hidden
 
 
 def _insert(p: _Parser):

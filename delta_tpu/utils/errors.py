@@ -622,6 +622,19 @@ def sql_expected_statement(got) -> DeltaParseError:
     return DeltaParseError(f"Expected a statement keyword, got {got!r}")
 
 
+def sql_star_only_in_count(func: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"{func}(*) is not valid; '*' is only allowed in COUNT(*)."
+    )
+
+
+def sql_column_needs_group_by(column: str) -> DeltaParseError:
+    return DeltaParseError(
+        f"Column {column} must appear in GROUP BY or inside an aggregate "
+        "function"
+    )
+
+
 def sql_expected_table_identifier(after: str, offset) -> DeltaParseError:
     return DeltaParseError(
         f"Expected table identifier after {after}. at offset {offset}"

@@ -442,3 +442,60 @@ def test_insert_select_arity_enforced(tmp_path):
         execute_sql(f"INSERT INTO delta.`{dst}` SELECT id FROM delta.`{src}`")
     with pytest.raises(DeltaError, match="differ"):
         execute_sql(f"INSERT INTO delta.`{dst}` (id) SELECT id, v FROM delta.`{src}`")
+
+
+def test_select_aggregates_global(tmp_path):
+    path = str(tmp_path / "agg")
+    execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES "
+                "('a', 1.0), ('a', 3.0), ('b', 10.0), ('b', 20.0), ('b', 30.0)")
+    t = execute_sql(f"SELECT count(*) AS n, sum(v) AS s, avg(v) AS m, "
+                    f"min(v) AS lo, max(v) AS hi FROM delta.`{path}`")
+    assert t.num_rows == 1
+    assert t.column("n").to_pylist() == [5]
+    assert t.column("s").to_pylist() == [64.0]
+    assert t.column("m").to_pylist() == [12.8]
+    assert t.column("lo").to_pylist() == [1.0]
+    assert t.column("hi").to_pylist() == [30.0]
+
+
+def test_select_group_by(tmp_path):
+    path = str(tmp_path / "agg2")
+    execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES "
+                "('a', 1.0), ('a', 3.0), ('b', 10.0), ('b', 20.0), ('b', 30.0)")
+    t = execute_sql(
+        f"SELECT g, count(*) AS n, sum(v * 2) AS s2 FROM delta.`{path}` "
+        "GROUP BY g ORDER BY g"
+    )
+    assert t.column("g").to_pylist() == ["a", "b"]
+    assert t.column("n").to_pylist() == [2, 3]
+    assert t.column("s2").to_pylist() == [8.0, 120.0]
+    # WHERE composes with GROUP BY
+    t = execute_sql(
+        f"SELECT g, max(v) AS hi FROM delta.`{path}` WHERE v > 1.0 "
+        "GROUP BY g ORDER BY hi DESC"
+    )
+    assert t.column("g").to_pylist() == ["b", "a"]
+    assert t.column("hi").to_pylist() == [30.0, 3.0]
+
+
+def test_select_aggregate_errors(tmp_path):
+    path = str(tmp_path / "agg3")
+    execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES ('a', 1.0)")
+    with pytest.raises(DeltaError, match="GROUP BY"):
+        execute_sql(f"SELECT g, sum(v) FROM delta.`{path}`")
+    with pytest.raises(DeltaError, match=r"\(\*\)"):
+        execute_sql(f"SELECT sum(*) FROM delta.`{path}`")
+
+
+def test_group_by_order_by_unprojected_key(tmp_path):
+    path = str(tmp_path / "agg4")
+    execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES "
+                "('b', 1.0), ('a', 2.0), ('a', 4.0)")
+    t = execute_sql(f"SELECT count(v) AS n FROM delta.`{path}` "
+                    "GROUP BY g ORDER BY g")
+    assert t.column_names == ["n"]
+    assert t.column("n").to_pylist() == [2, 1]  # a first, then b
