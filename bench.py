@@ -96,7 +96,8 @@ def bench_overwrite_read(workdir):
         t = pq.read_table(raw)
         return t.filter(pc.less(t.column("v"), 100)).num_rows
 
-    raw_s, raw_rows = _timed(raw_roundtrip)
+    trials = [_timed(raw_roundtrip) for _ in range(2)]
+    raw_s, raw_rows = min(trials, key=lambda x: x[0])
     assert eng_rows == raw_rows, (eng_rows, raw_rows)
     return {
         "metric": "overwrite_plus_filtered_read_2M_rows",
@@ -406,7 +407,7 @@ def bench_streaming_tail(workdir):
             seen = t.num_rows
         return total
 
-    naive_s, naive_rows = _timed(naive)
+    naive_s, naive_rows = min((_timed(naive) for _ in range(2)), key=lambda x: x[0])
     assert naive_rows == rows_read
 
     # CDC-tailing leg (the BASELINE config names it): the change feed of the
@@ -501,7 +502,7 @@ def bench_checkpoint_replay(workdir):
                     state.pop(a.path, None)
         return len(state)
 
-    host_s, host_n = _timed(host_end_to_end)
+    host_s, host_n = min((_timed(host_end_to_end) for _ in range(2)), key=lambda x: x[0])
     assert host_n == len(active)
 
     phases = {}

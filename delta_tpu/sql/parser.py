@@ -991,6 +991,38 @@ def _create(p: _Parser):
         p.expect_word("EXISTS")
         if_not_exists = True
     path = p.table_path()
+    if p.peek().is_word("SHALLOW"):
+        # CREATE TABLE <dst> SHALLOW CLONE <src> [VERSION|TIMESTAMP AS OF]
+        p.expect_word("SHALLOW")
+        p.expect_word("CLONE")
+        src = p.table_path()
+        version = timestamp = None
+        if p.accept_word("VERSION"):
+            p.expect_word("AS")
+            p.expect_word("OF")
+            version = int(p.number(as_int=True))
+        elif p.accept_word("TIMESTAMP"):
+            p.expect_word("AS")
+            p.expect_word("OF")
+            t = p.next()
+            if t.kind not in ("STRING", "NUMBER"):
+                raise errors.sql_expected("timestamp literal", t.start)
+            timestamp = t.value
+        p.expect_end()
+
+        def run_clone():
+            from delta_tpu.commands.clone import CloneCommand
+
+            kind, value = path
+            if kind != "path":
+                raise errors.create_table_needs_location(value)
+            cmd = CloneCommand(
+                _log_for(src), value, version=version, timestamp=timestamp,
+            )
+            cmd.run()
+            return cmd.metrics
+
+        return run_clone
     fields: List[StructField] = []
     if p.accept_punct("("):
         fields.append(p.column_def())

@@ -499,3 +499,17 @@ def test_group_by_order_by_unprojected_key(tmp_path):
                     "GROUP BY g ORDER BY g")
     assert t.column_names == ["n"]
     assert t.column("n").to_pylist() == [2, 1]  # a first, then b
+
+
+def test_sql_shallow_clone(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    execute_sql(f"CREATE TABLE delta.`{src}` (id BIGINT, v DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{src}` VALUES (1, 1.0), (2, 2.0)")
+    execute_sql(f"INSERT INTO delta.`{src}` VALUES (3, 3.0)")
+    execute_sql(f"CREATE TABLE delta.`{dst}` SHALLOW CLONE delta.`{src}` VERSION AS OF 1")
+    t = execute_sql(f"SELECT id FROM delta.`{dst}` ORDER BY id")
+    assert t.column("id").to_pylist() == [1, 2]
+    dst2 = str(tmp_path / "dst2")
+    execute_sql(f"CREATE TABLE delta.`{dst2}` SHALLOW CLONE delta.`{src}`")
+    assert execute_sql(f"SELECT * FROM delta.`{dst2}`").num_rows == 3
