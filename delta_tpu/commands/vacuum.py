@@ -96,6 +96,8 @@ class VacuumCommand:
                 valid.add(side)
 
         data_path = log.data_path
+        from delta_tpu.utils.telemetry import with_status
+
         all_files: List[str] = []
         all_dirs: List[str] = []
 
@@ -119,6 +121,8 @@ class VacuumCommand:
                 walk(s)
 
         # parallel top-level fan-out (the reference lists with a Spark job)
+        status = with_status("Listing files for VACUUM", table=data_path)
+        status.__enter__()
         top = []
         try:
             for e in sorted(os.scandir(data_path), key=lambda x: x.name):
@@ -133,6 +137,7 @@ class VacuumCommand:
         if top:
             with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
                 list(pool.map(walk, top))
+        status.__exit__(None, None, None)
 
         to_delete: List[str] = []
         for rel in all_files:

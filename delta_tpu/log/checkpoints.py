@@ -420,6 +420,8 @@ def write_checkpoint(
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from delta_tpu.utils.telemetry import with_status
+
     n = len(actions)
     if parts is None:
         parts = 1 if n <= part_size else math.ceil(n / part_size)

@@ -310,7 +310,10 @@ def files_for_scan(
         if fast is not None:
             return fast
 
-    all_files = snapshot.all_files
+    from delta_tpu.utils.telemetry import with_status
+
+    with with_status("Filtering files for query"):
+        all_files = snapshot.all_files
     total = DataSize(
         bytes_compressed=sum(f.size or 0 for f in all_files), files=len(all_files)
     )

@@ -22,7 +22,7 @@ from typing import Any, Deque, Dict, Iterator, List, Optional
 
 logger = logging.getLogger("delta_tpu.usage")
 
-__all__ = ["record_event", "record_operation", "recent_events", "clear_events", "UsageEvent"]
+__all__ = ["record_event", "record_operation", "with_status", "recent_events", "clear_events", "UsageEvent"]
 
 
 @dataclass
@@ -79,6 +79,19 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
         with _LOCK:
             _BUFFER.append(ev)
         logger.debug("%s", ev.to_json())
+
+
+@contextlib.contextmanager
+def with_status(message: str, **tags: str) -> Iterator[None]:
+    """Human-readable job description around a long step — the analogue of
+    the reference's ``DeltaProgressReporter.withStatusCode`` ("Filtering
+    files for query", `PartitionFiltering.scala:34`). Logs at INFO on entry
+    and records a `delta.status` usage event with the duration on exit, so
+    operators can see WHAT a long-running command is doing, not just that
+    it is running."""
+    logger.info("%s", message)
+    with record_operation("delta.status", {"message": message}, **tags):
+        yield
 
 
 def _maybe_jax_trace(name: str):

@@ -69,3 +69,28 @@ def test_ring_buffer_bounded():
         telemetry.record_event("delta.test.flood")
     # deque(maxlen=4096): exactly full — also catches silent non-recording
     assert len(telemetry.recent_events()) == 4096
+
+
+def test_with_status_records_event_and_duration(tmp_table):
+    import numpy as np
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.exec.scan import scan_files
+    from delta_tpu.utils import telemetry
+
+    telemetry.clear_events()
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(5)})).run()
+    scan_files(log.update(), ["a > 1"])
+    evs = [e for e in telemetry.recent_events("delta.status")
+           if e.data.get("message") == "Filtering files for query"]
+    assert evs and evs[-1].duration_ms is not None
+
+    telemetry.clear_events()
+    from delta_tpu.commands.vacuum import VacuumCommand
+
+    VacuumCommand(log, retention_hours=1000, dry_run=True).run()
+    evs = telemetry.recent_events("delta.status")
+    assert any("VACUUM" in e.data.get("message", "") for e in evs)
