@@ -122,6 +122,24 @@ class DeltaTable:
         # copy-like surfaces resolve their own snapshots synchronously
         return self.delta_log.snapshot_for(version, timestamp, stale_ok=True)
 
+    def plan_queries(self, queries, k: int = 256):
+        """Plan a batch of queries in one shot — each element is a list of
+        filter strings/expressions; returns per-query
+        :class:`delta_tpu.exec.scan.QueryPlan` (pruned file paths + exact
+        counts). With the table's scan lanes HBM-resident
+        (`ops/state_cache`), the whole batch is a single device dispatch —
+        the serving shape for dashboards / query routers."""
+        from delta_tpu.exec.scan import plan_scans
+        from delta_tpu.utils import errors
+
+        for q in queries:
+            if isinstance(q, (str, ir.Expression)):
+                raise errors.DeltaIllegalArgumentError(
+                    "plan_queries takes a list of QUERIES, each a list of "
+                    f"filters — wrap the filter in a list: [[{q!r}]]"
+                )
+        return plan_scans(self.delta_log.update(stale_ok=True), queries, k=k)
+
     @property
     def version(self) -> int:
         return self.delta_log.update().version

@@ -121,23 +121,21 @@ class VacuumCommand:
                 walk(s)
 
         # parallel top-level fan-out (the reference lists with a Spark job)
-        status = with_status("Listing files for VACUUM", table=data_path)
-        status.__enter__()
-        top = []
-        try:
-            for e in sorted(os.scandir(data_path), key=lambda x: x.name):
-                if e.is_dir(follow_symlinks=False):
-                    if not _is_hidden(e.name):
-                        top.append(e.name)
-                        all_dirs.append(e.name)
-                elif not _is_hidden(e.name):
-                    all_files.append(e.name)
-        except FileNotFoundError:
-            pass
-        if top:
-            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
-                list(pool.map(walk, top))
-        status.__exit__(None, None, None)
+        with with_status("Listing files for VACUUM", table=data_path):
+            top = []
+            try:
+                for e in sorted(os.scandir(data_path), key=lambda x: x.name):
+                    if e.is_dir(follow_symlinks=False):
+                        if not _is_hidden(e.name):
+                            top.append(e.name)
+                            all_dirs.append(e.name)
+                    elif not _is_hidden(e.name):
+                        all_files.append(e.name)
+            except FileNotFoundError:
+                pass
+            if top:
+                with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                    list(pool.map(walk, top))
 
         to_delete: List[str] = []
         for rel in all_files:

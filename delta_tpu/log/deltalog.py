@@ -342,11 +342,18 @@ class DeltaLog:
         snap = snapshot or self.update()
         if snap.version < 0:
             raise DeltaIllegalStateError("Cannot checkpoint an uninitialized table")
-        actions = snap.checkpoint_actions()
         part_size = conf.get("delta.tpu.checkpointPartSize")
-        md = ckpt_mod.write_checkpoint(
-            self.store, self.log_path, snap.version, actions, part_size=part_size
+        # columnar fast path: AddFiles stream from the SoA columns without
+        # dataclass materialization (None = unsupported shape)
+        md = ckpt_mod.write_checkpoint_columnar(
+            self.store, self.log_path, snap, part_size=part_size or 1_000_000
         )
+        if md is None:
+            actions = snap.checkpoint_actions()
+            md = ckpt_mod.write_checkpoint(
+                self.store, self.log_path, snap.version, actions,
+                part_size=part_size,
+            )
         self.cleanup_expired_logs(snap)
         return md
 

@@ -293,6 +293,17 @@ def files_for_scan(
     step is the skipping path the reference leaves unwired. Unpartitioned
     tables with an exactly-lowerable predicate serve from the resident
     state cache instead of materializing every AddFile."""
+    from delta_tpu.utils.telemetry import with_status
+
+    with with_status("Filtering files for query"):
+        return _files_for_scan_impl(snapshot, filters, keep_num_indexed_cols)
+
+
+def _files_for_scan_impl(
+    snapshot,
+    filters: Sequence[ir.Expression],
+    keep_num_indexed_cols: Optional[int],
+) -> DeltaScan:
     metadata = snapshot.metadata
     part_schema = metadata.partition_schema
     part_cols = metadata.partition_columns
@@ -310,10 +321,7 @@ def files_for_scan(
         if fast is not None:
             return fast
 
-    from delta_tpu.utils.telemetry import with_status
-
-    with with_status("Filtering files for query"):
-        all_files = snapshot.all_files
+    all_files = snapshot.all_files
     total = DataSize(
         bytes_compressed=sum(f.size or 0 for f in all_files), files=len(all_files)
     )

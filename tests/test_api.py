@@ -259,3 +259,31 @@ def test_sql_convert_and_generate(tmp_table):
     )
     with pytest.raises(DeltaAnalysisError):
         execute_sql("FROBNICATE TABLE x")
+
+
+def test_plan_queries_batch(tmp_table):
+    import numpy as np
+
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(4):
+        WriteIntoDelta(log, "append", pa.table({
+            "a": np.arange(i * 10, (i + 1) * 10, dtype=np.int64)})).run()
+    t = DeltaTable.for_path(tmp_table)
+    plans = t.plan_queries([["a = 5"], ["a >= 20 AND a <= 39"], []])
+    assert plans[0].count == 1
+    assert plans[1].count == 2
+    assert plans[2].count == 4  # empty filter = all files
+
+
+def test_plan_queries_rejects_flat_filter_list(tmp_table):
+    import numpy as np
+
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.utils.errors import DeltaIllegalArgumentError
+
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({"a": np.arange(5)})).run()
+    with pytest.raises(DeltaIllegalArgumentError, match="wrap the filter"):
+        DeltaTable.for_path(tmp_table).plan_queries(["a = 5"])
