@@ -796,45 +796,50 @@ class MergeIntoCommand:
         entry, probe, s_keys, s_ok = resident
 
         def finalize():
+            # any failure in here — the probe itself, the bit mapping, or
+            # the pairing recovery disagreeing with the slab — must surface
+            # as None (documented host-join fallback), never an exception
+            # that crashes the MERGE
             try:
                 res_p = probe.result()
+                n_target = target.num_rows
+                t_first_s = np.full(n_target, -1, np.int64)
+                if insert_only:
+                    # only s_matched / any_multi are consumed downstream
+                    return join_kernel.JoinResult(
+                        t_first_s, res_p.s_matched, res_p.any_multi
+                    )
+                t_matched = np.zeros(n_target, bool)
+                row_base = 0
+                for fid in sorted(tgt_tables):
+                    t = tgt_tables[fid]
+                    add = candidates[fid]
+                    if pos_col is not None:
+                        positions = t.column(pos_col).to_numpy(
+                            zero_copy_only=False)
+                    else:
+                        positions = None
+                    bits = res_p.bits_for_file(add.path, positions, t.num_rows)
+                    if bits is None:
+                        return None  # slab/decode disagree: host fallback
+                    t_matched[row_base:row_base + t.num_rows] = bits
+                    row_base += t.num_rows
+                idx = np.flatnonzero(t_matched)
+                if idx.size:
+                    sub = target.take(pa.array(idx, pa.int64()))
+                    packed = kc_mod._pack_lanes(
+                        sub, [t for t, _ in equi], evaluate
+                    )
+                    if packed is None:
+                        return None
+                    tk, _tok = packed
+                    t_first_s[idx] = join_kernel._first_match_recovery(
+                        tk, np.arange(len(tk)), s_keys, s_ok
+                    )
+                return join_kernel.JoinResult(t_first_s, res_p.s_matched,
+                                              res_p.any_multi)
             except Exception:
                 return None
-            n_target = target.num_rows
-            t_first_s = np.full(n_target, -1, np.int64)
-            if insert_only:
-                # only s_matched / any_multi are consumed downstream
-                return join_kernel.JoinResult(
-                    t_first_s, res_p.s_matched, res_p.any_multi
-                )
-            t_matched = np.zeros(n_target, bool)
-            row_base = 0
-            for fid in sorted(tgt_tables):
-                t = tgt_tables[fid]
-                add = candidates[fid]
-                if pos_col is not None:
-                    positions = t.column(pos_col).to_numpy(zero_copy_only=False)
-                else:
-                    positions = None
-                bits = res_p.bits_for_file(add.path, positions, t.num_rows)
-                if bits is None:
-                    return None  # slab/decode disagree: host fallback
-                t_matched[row_base:row_base + t.num_rows] = bits
-                row_base += t.num_rows
-            idx = np.flatnonzero(t_matched)
-            if idx.size:
-                sub = target.take(pa.array(idx, pa.int64()))
-                packed = kc_mod._pack_lanes(
-                    sub, [t for t, _ in equi], evaluate
-                )
-                if packed is None:
-                    return None
-                tk, _tok = packed
-                t_first_s[idx] = join_kernel._first_match_recovery(
-                    tk, np.arange(len(tk)), s_keys, s_ok
-                )
-            return join_kernel.JoinResult(t_first_s, res_p.s_matched,
-                                          res_p.any_multi)
 
         return join_kernel.PendingJoin(finalize)
 

@@ -290,23 +290,30 @@ class ResidentJoinKeys:
             elif self._dev is not None:
                 self._dev_kill(np.arange(off, off + rows, dtype=np.int32))
 
-    def _set_dv(self, path: str, positions: np.ndarray) -> None:
+    def _set_dv(self, path: str, positions: np.ndarray) -> bool:
         """Install a file's deletion-vector state EXACTLY: validity becomes
         null_ok AND NOT deleted. Handles growth, shrink (RESTORE), and
-        replacement — the device gets only the diff rows, both directions."""
+        replacement — the device gets only the diff rows, both directions.
+
+        Returns False when the DV disagrees with the slab (positions beyond
+        the recorded row count, or no slab at all): masking the mismatch
+        would leave deleted rows valid and matchable, so the caller must
+        rebuild the entry instead."""
         with self._lock:
             ent = self.slabs.get(path)
             if ent is None:
-                return
+                return False
             off, rows = ent
+            if len(positions) and int(positions.max()) >= rows:
+                return False
+            pos = positions
             new_valid = self.h_nullok[off:off + rows].copy()
-            pos = positions[positions < rows] if len(positions) else positions
             if len(pos):
                 new_valid[pos] = False
             old_valid = self.h_valid[off:off + rows]
             diff = np.nonzero(new_valid != old_valid)[0]
             if len(diff) == 0:
-                return
+                return True
             self.h_valid[off:off + rows] = new_valid
             if self._pending is not None:
                 to_false = diff[~new_valid[diff]]
@@ -322,6 +329,7 @@ class ResidentJoinKeys:
                     self._dev_kill((off + to_false).astype(np.int32))
                 if len(to_true):
                     self._dev_revive((off + to_true).astype(np.int32))
+            return True
 
     @property
     def garbage_fraction(self) -> float:
@@ -707,7 +715,8 @@ class KeyCache:
                 pos = _dv_positions(add.deletion_vector, data_path)
                 if pos is None:
                     return None
-                e._set_dv(add.path, pos)
+                if not e._set_dv(add.path, pos):
+                    return None
                 e.dv_tags[add.path] = _dv_tag(add.deletion_vector)
         return e
 
@@ -737,29 +746,39 @@ class KeyCache:
         # the version bump): a concurrent probe then sees the slab either
         # fully at its version or fully past it, never in between
         with e._lock, e.device_batch():
-            for a in actions:
-                if isinstance(a, RemoveFile):
-                    e._kill_file(a.path)
-                elif isinstance(a, AddFile):
-                    if a.path not in e.slabs:
-                        kv = _file_keys(data_path, a, key_cols, exprs)
-                        if kv is None:
-                            return False
-                        e._append_file(a.path, *kv)
-                    # re-adds keep their keys (physical rows are immutable);
-                    # only the deletion-vector validity may change
-                    new_tag = _dv_tag(a.deletion_vector)
-                    if e.dv_tags.get(a.path) != new_tag:
-                        if a.deletion_vector is not None:
-                            pos = _dv_positions(a.deletion_vector, data_path)
-                            if pos is None:
+            # poison a half-applied tail BEFORE releasing the entry lock —
+            # on clean failure AND on exceptions (a raise would otherwise
+            # bypass get()'s pop and leave the entry serving probes at its
+            # old version with some files killed and others not appended)
+            ok = False
+            try:
+                for a in actions:
+                    if isinstance(a, RemoveFile):
+                        e._kill_file(a.path)
+                    elif isinstance(a, AddFile):
+                        if a.path not in e.slabs:
+                            kv = _file_keys(data_path, a, key_cols, exprs)
+                            if kv is None:
                                 return False
-                        else:
-                            pos = np.empty(0, np.int64)
-                        e._set_dv(a.path, pos)
-                        e.dv_tags[a.path] = new_tag
-            e.version = snapshot.version
-        return True
+                            if not e._append_file(a.path, *kv):
+                                return False
+                        # re-adds keep their keys (physical rows are
+                        # immutable); only the DV validity may change
+                        new_tag = _dv_tag(a.deletion_vector)
+                        if e.dv_tags.get(a.path) != new_tag:
+                            if a.deletion_vector is not None:
+                                pos = _dv_positions(a.deletion_vector, data_path)
+                                if pos is None:
+                                    return False
+                            else:
+                                pos = np.empty(0, np.int64)
+                            if not e._set_dv(a.path, pos):
+                                return False
+                            e.dv_tags[a.path] = new_tag
+                ok = True
+                return True
+            finally:
+                e.version = snapshot.version if ok else -1
 
     def _evict(self, keep) -> None:
         budget = int(conf.get("delta.tpu.keyCache.maxBytes", 1 << 30))

@@ -244,22 +244,34 @@ class ResidentState:
         add_paths, add_lo, add_hi, add_size = added
         k = len(add_paths)
         with self._lock:
-            dead_rows = []
+            # Pass 1: count dead rows WITHOUT mutating the mirrors, so the
+            # rebuild-needed verdict below can bail with the entry still
+            # exactly at its old version (a concurrent plan_ranges holding
+            # expected_version=old must keep seeing consistent state).
+            dead_rows: List[int] = []
+            seen_dead = set()
             for p in removed_paths:
-                r = self.path_to_row.pop(p, None)
-                if r is not None and self.h_alive[r]:
-                    self.h_alive[r] = False
-                    dead_rows.append(r)
-            for p in add_paths:
                 r = self.path_to_row.get(p)
-                if r is not None and self.h_alive[r]:
-                    # re-add supersedes the old row's stats
-                    self.h_alive[r] = False
+                if r is not None and self.h_alive[r] and r not in seen_dead:
                     dead_rows.append(r)
-            self._dead += len(dead_rows)
+                    seen_dead.add(r)
+            for p in add_paths:
+                # re-add supersedes the old row's stats
+                r = self.path_to_row.get(p)
+                if r is not None and self.h_alive[r] and r not in seen_dead:
+                    dead_rows.append(r)
+                    seen_dead.add(r)
             start = self.num_rows
-            if start + k > self.capacity or self._dead > max(1024, self.num_rows // 2):
+            if (start + k > self.capacity
+                    or self._dead + len(dead_rows) > max(1024, self.num_rows // 2)):
                 return False
+            # Pass 2: committed — kill exactly the rows Pass 1 counted
+            # (re-added paths keep their mapping until the append below
+            # overwrites it; removed paths drop theirs)
+            for p in removed_paths:
+                self.path_to_row.pop(p, None)
+            self.h_alive[dead_rows] = False
+            self._dead += len(dead_rows)
             if k:
                 self.h_alive = np.concatenate([self.h_alive, np.ones(k, bool)])
                 self.h_lo = np.concatenate([self.h_lo, add_lo], axis=1)

@@ -596,7 +596,11 @@ def _run_aggregate(table, parsed_items, group_by, order_keys, evaluate):
             else:
                 kern = {"sum": pc.sum, "mean": pc.mean,
                         "min": pc.min, "max": pc.max}[f]
-                cols[f"{w}_{f}"] = pa.array([kern(col).as_py()])
+                # the kernel scalar carries the aggregate's natural type even
+                # when its value is null (empty table) — keep it, or an
+                # all-null untyped column breaks INSERT...SELECT casts
+                s = kern(col)
+                cols[f"{w}_{f}"] = pa.array([s.as_py()], type=s.type)
         res = pa.table(cols)
         agg_out = {w: f"{w}_{f}" for w, f, _ in aggs}
 

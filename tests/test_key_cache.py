@@ -138,6 +138,67 @@ def test_dv_shrink_revives_rows(tmp_table):
     assert res.s_matched.tolist() == [True]
 
 
+def test_set_dv_out_of_range_positions_signal_rebuild(tmp_table):
+    """DV positions beyond the slab's recorded row count mean the slab and
+    the file disagree; masking them would let deleted rows keep matching
+    (suppressing NOT MATCHED inserts). _set_dv must refuse (r4 advisor)."""
+    log = _mk_table(tmp_table, files=1)
+    e = _entry(log)
+    rows = e.num_rows
+    assert e._set_dv(next(iter(e.slabs)),
+                     np.array([0, rows + 5], np.int64)) is False
+    # in-range still succeeds
+    assert e._set_dv(next(iter(e.slabs)), np.array([0], np.int64)) is True
+    # and a DV for an unknown file is likewise a consistency failure
+    assert e._set_dv("no-such-file", np.array([0], np.int64)) is False
+
+
+def test_failed_advance_poisons_version(tmp_table, monkeypatch):
+    """A mid-tail failure leaves half-applied mirrors; the entry must not
+    stay probe-able at its old version (r4 advisor: stale-version probe of
+    a half-advanced slab produced spurious NOT MATCHED inserts)."""
+    from delta_tpu.ops import key_cache as kc_mod
+
+    log = _mk_table(tmp_table, files=2)
+    e = _entry(log)
+    v0 = e.version
+    # grow the log, then make the key read fail mid-advance
+    WriteIntoDelta(log, "append", pa.table({
+        "k": np.arange(500, 520, dtype=np.int64), "v": np.zeros(20),
+    })).run()
+    snap = log.update()
+    orig_file_keys = kc_mod._file_keys
+    monkeypatch.setattr(kc_mod, "_file_keys",
+                        lambda *a, **k: None)
+    assert KeyCache.instance()._advance(e, snap, ["k"], list(KEY_EXPRS)) is False
+    assert e.version not in (v0, snap.version)
+    # a thread that cached `e` before the failure now fails its guard
+    assert e.probe_async(np.array([5], np.int64), np.array([True]),
+                         expected_version=v0) is None
+
+    # an EXCEPTION mid-apply (not a clean False) must poison too — it
+    # propagates past get()'s pop-on-failure, so the poisoned version is
+    # the only thing stopping a stale-version probe
+    monkeypatch.setattr(kc_mod, "_file_keys", orig_file_keys)
+    e2 = _entry(log)  # rebuilds at snap.version
+    assert e2 is not None and e2.version == snap.version
+    v1 = e2.version
+    WriteIntoDelta(log, "append", pa.table({
+        "k": np.arange(600, 610, dtype=np.int64), "v": np.zeros(10),
+    })).run()
+    snap2 = log.update()
+
+    def boom(*a, **k):
+        raise ValueError("corrupt")
+
+    monkeypatch.setattr(kc_mod, "_file_keys", boom)
+    with pytest.raises(ValueError):
+        KeyCache.instance()._advance(e2, snap2, ["k"], list(KEY_EXPRS))
+    assert e2.version not in (v1, snap2.version)
+    assert e2.probe_async(np.array([5], np.int64), np.array([True]),
+                          expected_version=v1) is None
+
+
 def test_metadata_change_invalidates(tmp_table):
     from delta_tpu.commands.alter import set_table_properties
 

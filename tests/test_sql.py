@@ -480,6 +480,30 @@ def test_select_group_by(tmp_path):
     assert t.column("hi").to_pylist() == [30.0, 3.0]
 
 
+def test_select_aggregates_empty_table_keeps_types(tmp_path):
+    """Ungrouped aggregates over zero rows must yield null values of the
+    aggregate's NATURAL type (r4 advisor: null-typed columns broke
+    INSERT...SELECT casts downstream)."""
+    path = str(tmp_path / "agg_empty")
+    execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")
+    t = execute_sql(f"SELECT count(*) AS n, sum(v) AS s, avg(v) AS m, "
+                    f"min(v) AS lo, max(v) AS hi FROM delta.`{path}`")
+    assert t.num_rows == 1
+    assert t.column("n").to_pylist() == [0]
+    for name in ("s", "m", "lo", "hi"):
+        col = t.column(name)
+        assert col.to_pylist() == [None]
+        assert not pa.types.is_null(col.type), name
+    assert pa.types.is_floating(t.column("s").type)
+    # and the typed nulls survive an INSERT...SELECT round trip
+    dst = str(tmp_path / "agg_empty_dst")
+    execute_sql(f"CREATE TABLE delta.`{dst}` (lo DOUBLE, hi DOUBLE)")
+    execute_sql(f"INSERT INTO delta.`{dst}` "
+                f"SELECT min(v) AS lo, max(v) AS hi FROM delta.`{path}`")
+    out = execute_sql(f"SELECT lo, hi FROM delta.`{dst}`")
+    assert out.num_rows == 1
+
+
 def test_select_aggregate_errors(tmp_path):
     path = str(tmp_path / "agg3")
     execute_sql(f"CREATE TABLE delta.`{path}` (g STRING, v DOUBLE)")

@@ -314,6 +314,34 @@ def test_plan_ranges_stale_version_returns_none(tmp_table):
     assert entry.plan_ranges(rs, expected_version=snap2.version) is not None
 
 
+def test_apply_tail_reject_leaves_entry_untouched():
+    """A rejected apply_tail (capacity overflow / garbage) must be a clean
+    no-op: the entry keeps its old version AND its old mirrors, so a
+    concurrent plan_ranges(expected_version=old) that passes the version
+    guard still sees every file alive at that snapshot (r4 advisor
+    finding: mutate-then-check dropped files on the False path)."""
+    from delta_tpu.ops.state_cache import ResidentState
+
+    n = 4
+    lanes = {
+        "min": np.arange(n, dtype=np.float64)[None, :],
+        "max": (np.arange(n, dtype=np.float64) + 1.0)[None, :],
+        "size": np.ones(n, np.int64),
+    }
+    e = ResidentState("log", "mid", 7, ["a"], [f"p{i}" for i in range(n)], lanes)
+    e.capacity = n  # shrink so the single append below overflows
+    added = (["q0"], np.zeros((1, 1)), np.ones((1, 1)), np.ones(1, np.int64))
+    assert e.apply_tail(8, ["p1", "p2"], added) is False
+    assert e.version == 7
+    assert e.h_alive.all()
+    assert e.path_to_row == {f"p{i}": i for i in range(n)}
+    assert e._dead == 0
+    # a full-range plan at the old version still returns all 4 files
+    rs = RangeSet(np.array([np.nan]), np.array([np.nan]), verdict="all")
+    plans = e.plan_ranges([rs], k=8, expected_version=7)
+    assert plans is not None and plans[0].count == n
+
+
 def test_max_entries_evicts_whole_tables(tmp_path):
     cache = DeviceStateCache.instance()
     logs = [_mk_table(str(tmp_path / f"m{i}"), n_files=1) for i in range(4)]
