@@ -40,6 +40,11 @@ __all__ = ["ResidentJoinKeys", "KeyCache", "PhysicalProbe"]
 
 from delta_tpu.ops.state_cache import _next_pow2  # shared pad-size bucketing
 
+# sentinel version for an entry whose tail application failed part-way:
+# greater than any real snapshot version, so every staleness guard
+# (`entry.version > snapshot.version`) discards the entry immediately
+_POISON_VERSION = 1 << 62
+
 
 @dataclass
 class PhysicalProbe:
@@ -74,8 +79,20 @@ class PhysicalProbe:
 from delta_tpu.ops.join_kernel import PendingJoin as PendingProbe
 
 
+def _block_rows(cap: int) -> int:
+    """Coarse-fine granularity for the t_bits download: 4096-row blocks
+    (512 B of packed bits each) whenever the capacity tiles evenly,
+    else one block (tiny slabs)."""
+    return 4096 if cap % 4096 == 0 else cap
+
+
 @functools.lru_cache(maxsize=None)
-def _probe_kernel():
+def _sort_kernel():
+    """Sort the slab's key lane once per key mutation (build/append), NOT
+    per probe: steady-state probes against an unchanged table then skip
+    the O(n log n) term entirely and become HBM-bandwidth-bound. Padding
+    rows encode as int64.max so they sort to the tail; a real key equal to
+    int64.max may share their run — harmless, validity excludes them."""
     from delta_tpu.utils.jaxcache import ensure_compilation_cache
 
     ensure_compilation_cache()
@@ -83,60 +100,112 @@ def _probe_kernel():
     import jax.numpy as jnp
 
     @jax.jit
-    def kernel(slab_keys, slab_valid, t_sent, s_keys):
-        # slab: resident int64 + validity; source arrives sentinel-encoded
-        # (possibly int32-narrowed — cast up on device, upload halved).
-        # Invalid slab rows take t_sent (≠ source sentinel, outside the
-        # valid range) so dead/NULL rows never match anything.
-        #
+    def kernel(keys, n):
+        cap = keys.shape[0]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        enc = jnp.where(iota < n, keys, jnp.iinfo(jnp.int64).max)
+        return jax.lax.sort((enc, iota), num_keys=1)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_sorted_kernel():
+    from delta_tpu.utils.jaxcache import ensure_compilation_cache
+
+    ensure_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(sorted_keys, perm, valid, n, s_keys):
         # Probe direction matters enormously on TPU: binary-searching every
         # slab row into the source (n≈17M probes) costs ~3 s, while the
-        # reverse (m≈1M probes into the sorted slab) costs ~0.2 s. So the
-        # kernel only ever probes source→slab and recovers the per-slab-row
-        # matched mask by SEGMENT MARKING in slab-sorted space: +1/-1
-        # scatter-adds at each member key's [lo, hi) range, a cumsum, and an
-        # unsort through the sort permutation. Multi-match (some slab row
-        # matched by ≥2 source rows) falls out of source duplicate runs:
-        # a member key duplicated in the sorted source.
-        n = slab_keys.shape[0]
+        # reverse (m≈1M probes into the sorted slab) costs ~0.2 s. The
+        # kernel probes source→slab only and recovers the per-slab-row
+        # matched mask by SEGMENT MARKING in slab-sorted space.
+        #
+        # The slab arrives PRE-SORTED by raw key (validity NOT encoded into
+        # the sort keys — a DV flip must not force a resort), so validity
+        # is applied here in sorted space via the permutation: a source key
+        # is a member iff its key run contains >=1 valid row, and a slab
+        # row matches iff its run was marked AND the row itself is valid.
+        cap = sorted_keys.shape[0]
         m = s_keys.shape[0]
-        enc = jnp.where(slab_valid, slab_keys, t_sent)
-        s = s_keys.astype(slab_keys.dtype)
-        perm = jnp.arange(n, dtype=jnp.int32)
-        slab_sorted, perm = jax.lax.sort((enc, perm), num_keys=1)
+        blk = _block_rows(cap)  # cap is static under jit; host must agree
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        sv = (valid & (iota < n))[perm]  # sorted-space validity
+        s = s_keys.astype(sorted_keys.dtype)
         s_perm = jnp.arange(m, dtype=jnp.int32)
         s_sorted, s_perm = jax.lax.sort((s, s_perm), num_keys=1)
         # ONE probe: side='left' always lands on the first row of an equal-
-        # key run, so membership is a single gather-compare and the run's
-        # remaining rows are reached by segment propagation below (an
-        # explicit side='right' probe would double the probe cost).
-        lo = jnp.searchsorted(slab_sorted, s_sorted, side="left", method="sort")
-        safe_lo = jnp.minimum(lo, n - 1)
-        member = (slab_sorted[safe_lo] == s_sorted) & (lo < n)
-        # mark matched run starts, then propagate through each equal-key
-        # segment: every row inherits the mark of its segment's first row.
-        # Scatter ONLY member rows (non-members route to the dropped index
-        # n): a mixed True/False scatter to one index — a member key and an
-        # absent key can share lo — has unspecified winner under XLA.
-        marks = jnp.zeros(n, bool).at[
-            jnp.where(member, safe_lo, n)
-        ].set(True, mode="drop")
+        # key run; the run's remaining rows are reached by segment
+        # propagation (an explicit side='right' probe would double cost).
+        lo = jnp.searchsorted(sorted_keys, s_sorted, side="left",
+                              method="scan")
+        safe_lo = jnp.minimum(lo, cap - 1)
+        key_present = (sorted_keys[safe_lo] == s_sorted) & (lo < cap)
+        # equal-key segments + any-valid-in-run via prefix sums
         seg_start = jnp.concatenate([
-            jnp.ones(1, bool), slab_sorted[1:] != slab_sorted[:-1]
+            jnp.ones(1, bool), sorted_keys[1:] != sorted_keys[:-1]
         ])
-        iota = jnp.arange(n, dtype=jnp.int32)
         seg_first = jax.lax.cummax(jnp.where(seg_start, iota, 0))
-        t_match_sorted = marks[seg_first]
-        t_match = jnp.zeros(n, bool).at[perm].set(t_match_sorted)
+        seg_end = jnp.concatenate([seg_start[1:], jnp.ones(1, bool)])
+        seg_last = jax.lax.cummin(
+            jnp.where(seg_end, iota, cap - 1), reverse=True)
+        cs = jnp.cumsum(sv.astype(jnp.int32))
+        seg_base = jnp.where(seg_first > 0,
+                             cs[jnp.maximum(seg_first - 1, 0)], 0)
+        run_valid = (cs[seg_last] - seg_base) > 0
+        member = key_present & run_valid[safe_lo]
+        # mark matched run starts, then every row inherits its segment
+        # head's mark. Scatter ONLY member rows (non-members route to the
+        # dropped index cap): a mixed True/False scatter to one index — a
+        # member and an absent key can share lo — has unspecified winner.
+        marks = jnp.zeros(cap, bool).at[
+            jnp.where(member, safe_lo, cap)
+        ].set(True, mode="drop")
+        t_match_sorted = marks[seg_first] & sv
+        t_match = jnp.zeros(cap, bool).at[perm].set(t_match_sorted)
         t_bits = jnp.packbits(t_match.astype(jnp.uint8))
         s_match = jnp.zeros(m, bool).at[s_perm].set(member)
         s_bits = jnp.packbits(s_match.astype(jnp.uint8))
+        # multi-match: a member key duplicated in the sorted source
         dup = jnp.concatenate([
             jnp.zeros(1, bool), s_sorted[1:] == s_sorted[:-1]
         ])
         dup = dup | jnp.concatenate([dup[1:], jnp.zeros(1, bool)])
         multi = jnp.any(dup & member)
-        return t_bits, s_bits, multi
+        # ONE downloadable head: [multi byte | s_bits | block-any bitmap].
+        # Every small result fetch on a tunneled link costs ~106 ms, so the
+        # probe's always-needed outputs ship as a single uint8 array; the
+        # big t_bits stay on-device for the coarse-fine fetch.
+        blocks = t_match.reshape(cap // blk, blk).any(axis=1)
+        block_bits = jnp.packbits(blocks.astype(jnp.uint8))
+        head = jnp.concatenate([
+            multi.astype(jnp.uint8).reshape(1), s_bits, block_bits
+        ])
+        return t_bits, head
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_blocks_kernel():
+    """Fetch only the hot blocks of the packed match mask: reshape to
+    (blocks, words), gather the requested rows (out-of-range pad indices
+    fill zero), download k*512 bytes instead of cap/8."""
+    from delta_tpu.utils.jaxcache import ensure_compilation_cache
+
+    ensure_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(t_bits, hot):
+        cap = t_bits.shape[0] * 8
+        words = t_bits.reshape(cap // _block_rows(cap), -1)
+        return jnp.take(words, hot, axis=0, mode="fill", fill_value=0)
 
     return kernel
 
@@ -194,6 +263,10 @@ class ResidentJoinKeys:
         self._dead = 0
         self._dev = None
         self._pending = None  # batched device updates (see device_batch)
+        # True when the resident sorted view (sorted_keys + perm) lags the
+        # key lane: set by key appends, NOT by validity flips (DV kills and
+        # revives don't change sort order). The next probe re-sorts once.
+        self._sort_stale = True
         self._lock = threading.RLock()
         self.last_used = 0.0
 
@@ -339,7 +412,8 @@ class ResidentJoinKeys:
 
     @property
     def device_bytes(self) -> int:
-        return self.capacity * 9
+        # keys(8) + valid(1) + sorted view: sorted_keys(8) + perm(4)
+        return self.capacity * 21
 
     @property
     def is_resident(self) -> bool:
@@ -382,6 +456,25 @@ class ResidentJoinKeys:
                 dv = ship(valid)
                 jax.block_until_ready((dk, dv))
             self._dev = {"keys": dk, "valid": dv}
+            self._sort_stale = True
+
+    def _ensure_sorted(self) -> None:
+        """Dispatch the slab sort if the sorted view is stale (caller holds
+        the entry lock). The dispatch is async (~ms); the probe kernel that
+        consumes the handles queues behind it on the device."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            return
+        if not self._sort_stale and "sorted_keys" in self._dev:
+            return
+        with jax.enable_x64():
+            sk, pm = _sort_kernel()(
+                self._dev["keys"], jnp.asarray(np.int32(self.num_rows)))
+        self._dev["sorted_keys"] = sk
+        self._dev["perm"] = pm
+        self._sort_stale = False
 
     def _dev_kill(self, rows: np.ndarray) -> None:
         import jax.numpy as jnp
@@ -423,6 +516,11 @@ class ResidentJoinKeys:
             and bool((row_idx == np.arange(row_idx[0], row_idx[0] + k,
                                            dtype=row_idx.dtype)).all())
         )
+        # key rows changed: the sorted view lags; drop it (frees HBM) and
+        # let the next probe re-sort
+        self._sort_stale = True
+        self._dev.pop("sorted_keys", None)
+        self._dev.pop("perm", None)
         with jax.enable_x64():
             if contiguous:
                 self._dev["keys"], self._dev["valid"] = (
@@ -472,27 +570,31 @@ class ResidentJoinKeys:
             # O(source) sentinel/narrowing decision: the slab's valid range
             # is maintained incrementally (h_min/h_max, a conservative
             # superset), so only the source is scanned here. Narrow the
-            # uploaded side to int32 when every valid key fits — sentinels
-            # then live in int32 space and survive the device-side cast.
+            # uploaded side to int32 when every valid key fits — the source
+            # sentinel then lives in int32 space and survives the device-
+            # side cast. (The slab side needs no sentinel: the sorted-probe
+            # kernel applies validity in sorted space via the permutation.)
             lo = min(self.h_min, int(np.min(s_key64, where=s_okb, initial=2**62)))
             hi = max(self.h_max, int(np.max(s_key64, where=s_okb, initial=-2**62)))
             i32, i64 = np.iinfo(np.int32), np.iinfo(np.int64)
             if lo >= i32.min + 2 and hi <= i32.max - 2:
                 dtype = np.int32
-                t_sent, s_sent = i32.max, i32.max - 1
+                s_sent = i32.max - 1
             elif hi <= i64.max - 2:
                 dtype = np.int64
-                t_sent, s_sent = i64.max, i64.max - 1
+                s_sent = i64.max - 1
             elif lo >= i64.min + 2:
                 dtype = np.int64
-                t_sent, s_sent = i64.min, i64.min + 1
+                s_sent = i64.min + 1
             else:
                 return None  # valid keys span int64: no sentinel room
             s_enc = np.where(s_okb, s_key64, s_sent).astype(dtype)
             self.ensure_resident()
+            self._ensure_sorted()
             # pin this version's arrays: jax arrays are immutable, so a
             # concurrent tail advance replaces, never mutates, these
-            dev = {"keys": self._dev["keys"], "valid": self._dev["valid"]}
+            dev = {"sorted_keys": self._dev["sorted_keys"],
+                   "perm": self._dev["perm"], "valid": self._dev["valid"]}
             slabs = dict(self.slabs)
         m = len(s_enc)
         cap_s = _bucket(m)
@@ -503,9 +605,9 @@ class ResidentJoinKeys:
         def launch():
             try:
                 with jax.enable_x64():
-                    state["out"] = _probe_kernel()(
-                        dev["keys"], dev["valid"],
-                        jnp.asarray(np.int64(t_sent)), jax.device_put(s_in),
+                    state["out"] = _probe_sorted_kernel()(
+                        dev["sorted_keys"], dev["perm"], dev["valid"],
+                        jnp.asarray(np.int32(n)), jax.device_put(s_in),
                     )
                     jax.block_until_ready(state["out"])
             except BaseException as e:
@@ -518,14 +620,43 @@ class ResidentJoinKeys:
             th.join()
             if "err" in state:
                 raise state["err"]
-            t_bits, s_bits, multi = state["out"]
-            # transfer only the live prefix of the bit array (the padded
-            # capacity tail is dead weight on a slow link)
+            t_bits_dev, head_dev = state["out"]
+            # ONE small download carries multi + s_bits + the block-any
+            # bitmap; the exact t_bits then arrive coarse-fine — only hot
+            # blocks ship unless matches are dense (clustered upserts
+            # download KBs instead of the full n/8 bytes)
+            head = np.asarray(head_dev)
+            multi = bool(head[0])
+            s_bytes = cap_s // 8
+            s = np.unpackbits(head[1:1 + s_bytes], count=cap_s)[:m].astype(bool)
+            blk = _block_rows(cap)
+            n_blocks = cap // blk
+            block_any = np.unpackbits(
+                head[1 + s_bytes:], count=n_blocks)[:n_blocks].astype(bool)
+            live_blocks = (n + blk - 1) // blk
+            hot = np.flatnonzero(block_any[:live_blocks])
             n_bytes = (n + 7) // 8
-            t_live = np.asarray(t_bits[:n_bytes])
-            t = np.unpackbits(t_live, count=n_bytes * 8)[:n].astype(bool)
-            s = np.unpackbits(np.asarray(s_bits))[:m].astype(bool)
-            return PhysicalProbe(t, s, bool(multi), slabs)
+            if len(hot) == 0:
+                t = np.zeros(n, bool)
+            elif len(hot) >= int(live_blocks * 0.9) or blk == cap:
+                # dense: the gather saves nothing — fetch the live prefix
+                t_live = np.asarray(t_bits_dev[:n_bytes])
+                t = np.unpackbits(t_live, count=n_bytes * 8)[:n].astype(bool)
+            else:
+                import jax.numpy as jnp2
+
+                pad = _next_pow2(len(hot), floor=8)
+                hot_idx = np.full(pad, 1 << 30, np.int32)
+                hot_idx[: len(hot)] = hot
+                gathered = np.asarray(_gather_blocks_kernel()(
+                    t_bits_dev, jnp2.asarray(hot_idx)))[: len(hot)]
+                bits = np.unpackbits(
+                    gathered.reshape(-1), count=len(hot) * blk
+                ).reshape(len(hot), blk).astype(bool)
+                t_full = np.zeros(live_blocks * blk, bool)
+                t_full.reshape(live_blocks, blk)[hot] = bits
+                t = t_full[:n]
+            return PhysicalProbe(t, s, multi, slabs)
 
         return PendingProbe(finalize)
 
@@ -778,7 +909,10 @@ class KeyCache:
                 ok = True
                 return True
             finally:
-                e.version = snapshot.version if ok else -1
+                # poison ABOVE any real version: get()'s `e.version >
+                # snapshot.version` staleness guard then discards the entry
+                # in O(1) instead of attempting a from-zero tail decode
+                e.version = snapshot.version if ok else _POISON_VERSION
 
     def _evict(self, keep) -> None:
         budget = int(conf.get("delta.tpu.keyCache.maxBytes", 1 << 30))

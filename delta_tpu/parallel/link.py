@@ -50,9 +50,10 @@ HOST_PRUNE_S_PER_CELL = 1.5e-9
 # projected Parquet key-column decode, host Arrow: ~260ms for 10M rows —
 # the cost the resident-key probe avoids and the host join must pay
 HOST_KEY_DECODE_S_PER_ROW = 2.6e-8
-# resident-key membership probe kernel (ops/key_cache._probe_kernel):
-# ~0.35s for an 11M-row join on one v5e — sort-pair + one 'sort'-method
-# searchsorted + segment propagation, transfers excluded
+# resident-key membership probe kernel (ops/key_cache._probe_sorted_kernel):
+# ~0.35s for an 11M-row join on one v5e with the per-probe slab sort; the
+# sorted-slab steady state (sort amortized to key mutations) is cheaper —
+# this constant stays the conservative bound until re-measured
 RESIDENT_PROBE_S_PER_ROW = 3.2e-8
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares

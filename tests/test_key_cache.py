@@ -138,6 +138,74 @@ def test_dv_shrink_revives_rows(tmp_table):
     assert res.s_matched.tolist() == [True]
 
 
+def test_probe_sorted_kernel_fuzz_parity():
+    """Direct slab fuzz of the sorted-slab probe kernel vs a numpy oracle:
+    random keys with duplicates, kills, DV masks, null source rows — and
+    both coarse-fine download paths (sparse hot blocks -> device gather;
+    dense -> full live-prefix fetch)."""
+    from delta_tpu.ops.key_cache import ResidentJoinKeys
+
+    rng = np.random.RandomState(7)
+    n = 20000  # capacity 32768 -> 8 blocks of 4096
+    keys = rng.randint(0, 15000, n).astype(np.int64)  # dense duplicates
+    e = ResidentJoinKeys("log", "mid", 0, "sig", ["k"])
+    half = n // 2
+    e._append_file("f1", keys[:half], np.ones(half, bool))
+    e._append_file("f2", keys[half:], np.ones(n - half, bool))
+    # DV-mask some of f2, kill nothing (validity path)
+    dv_pos = rng.choice(n - half, 500, replace=False).astype(np.int64)
+    assert e._set_dv("f2", dv_pos)
+    valid = np.ones(n, bool)
+    valid[half + dv_pos] = False
+
+    for label, s_keys, s_ok in [
+        ("sparse", np.arange(100, 200, dtype=np.int64),
+         np.ones(100, bool)),  # clusters into few blocks
+        ("dense", rng.randint(0, 15000, 3000).astype(np.int64),
+         rng.rand(3000) > 0.1),
+        ("misses", np.arange(100000, 100050, dtype=np.int64),
+         np.ones(50, bool)),
+    ]:
+        res = e.probe_async(s_keys, s_ok).result()
+        valid_keys = set(keys[valid].tolist())
+        exp_s = np.array([ok and (k in valid_keys)
+                          for k, ok in zip(s_keys.tolist(), s_ok)], bool)
+        src_member = set(s_keys[exp_s].tolist())
+        exp_t = np.array([v and (k in src_member)
+                          for k, v in zip(keys.tolist(), valid)], bool)
+        assert (res.s_matched == exp_s).all(), label
+        assert (res.t_bits == exp_t).all(), label
+        # multi: some valid slab row matched by >=2 source rows
+        matched_counts = {}
+        for k, ok in zip(s_keys[s_ok & exp_s].tolist(), [1] * int(exp_s.sum())):
+            matched_counts[k] = matched_counts.get(k, 0) + 1
+        exp_multi = any(c >= 2 for c in matched_counts.values())
+        assert res.any_multi == exp_multi, label
+
+
+def test_probe_after_kill_and_append_resorts(tmp_table):
+    """Key appends invalidate the sorted view; kills do not. Both must
+    still probe correctly afterwards."""
+    from delta_tpu.ops.key_cache import ResidentJoinKeys
+
+    e = ResidentJoinKeys("log", "mid", 0, "sig", ["k"])
+    e._append_file("a", np.array([10, 20, 30], np.int64), np.ones(3, bool))
+    e.ensure_resident()
+    r = e.probe_async(np.array([20], np.int64), np.array([True])).result()
+    assert r.s_matched.tolist() == [True]
+    assert not e._sort_stale
+    e._kill_file("a")  # validity flip only: no resort needed
+    assert not e._sort_stale
+    r = e.probe_async(np.array([20], np.int64), np.array([True])).result()
+    assert r.s_matched.tolist() == [False]
+    e._append_file("b", np.array([40, 20], np.int64), np.ones(2, bool))
+    assert e._sort_stale  # key rows changed
+    r = e.probe_async(np.array([20, 10, 40], np.int64),
+                      np.ones(3, bool)).result()
+    assert r.s_matched.tolist() == [True, False, True]
+    assert not e._sort_stale
+
+
 def test_set_dv_out_of_range_positions_signal_rebuild(tmp_table):
     """DV positions beyond the slab's recorded row count mean the slab and
     the file disagree; masking them would let deleted rows keep matching
