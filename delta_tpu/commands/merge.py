@@ -760,11 +760,13 @@ class MergeIntoCommand:
             n = entry.num_rows
             p = link.profile()
             # optimistic int32 narrowing (like the upload path's pre-gate);
-            # the kernel constant is the calibrated resident-probe cost
+            # the kernel constants are the calibrated r5 sorted-slab probe
+            # (block-bucketed brute compare: fixed dispatch floor + ~3ns/row)
             device_s = (
                 p.upload_s(m * 4)
                 + p.download_s(n // 8 + m // 8)
                 + (n + m) * link.RESIDENT_PROBE_S_PER_ROW
+                + link.RESIDENT_PROBE_FIXED_S
                 + 3 * p.latency_s
             )
             if not entry.is_resident:

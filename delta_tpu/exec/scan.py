@@ -196,7 +196,10 @@ def plan_scans(
     (`parallel/link`) decides device vs the host float64 mirrors per batch.
     Queries whose predicates don't lower to per-column ranges (ORs, null
     tests, strings) fall back to :func:`scan_files` individually."""
-    from delta_tpu.ops.state_cache import DeviceStateCache, extract_ranges
+    import numpy as np
+
+    from delta_tpu.ops.state_cache import DeviceStateCache, extract_range_union
+    from delta_tpu.utils.telemetry import bump_counter
 
     parsed = [
         [parse_predicate(f) if isinstance(f, str) else f for f in q]
@@ -204,27 +207,50 @@ def plan_scans(
     ]
     out: List[Optional[QueryPlan]] = [None] * len(queries)
     entry = DeviceStateCache.instance().get(snapshot)
-    range_ix, ranges = [], []
+    range_ix, term_lists = [], []
     if entry is not None:
         pcols = frozenset(c.lower() for c in snapshot.metadata.partition_columns)
         for i, exprs in enumerate(parsed):
             if not exprs:
                 continue
             rewritten = pruning.skipping_predicate(ir.and_all(list(exprs)), pcols)
-            r = extract_ranges(rewritten, entry.columns)
-            if r is not None:
+            terms = extract_range_union(rewritten, entry.columns,
+                                        entry.part_info,
+                                        str_lanes=entry.str_lanes)
+            if terms:
                 range_ix.append(i)
-                ranges.append(r)
-    if ranges:
+                term_lists.append(terms)
+            else:
+                bump_counter("stateCache.plan.fallback.lowering")
+    else:
+        bump_counter("stateCache.plan.fallback.noentry", len(queries))
+    if term_lists:
+        # OR queries lower to several boxes; their row sets union after the
+        # plan, so multi-term batches ask for complete row sets
+        flat = [t for terms in term_lists for t in terms]
+        k_int = k if all(len(t) == 1 for t in term_lists) else max(
+            entry.num_rows, 1)
         plans = entry.plan_ranges(
-            ranges, k=k, expected_version=snapshot.version
+            flat, k=k_int, expected_version=snapshot.version
         )
         if plans is not None:  # None: entry advanced past our snapshot
-            for i, p in zip(range_ix, plans):
+            bump_counter("stateCache.plan.resident", len(term_lists))
+            pos = 0
+            for i, terms in zip(range_ix, term_lists):
+                chunk = plans[pos:pos + len(terms)]
+                pos += len(terms)
+                if len(chunk) == 1:
+                    rows, count = chunk[0].rows, chunk[0].count
+                else:
+                    rows = np.unique(np.concatenate([p.rows for p in chunk]))
+                    count = len(rows)
+                over = count > k or chunk[0].overflow
                 out[i] = QueryPlan(
-                    paths=[entry.paths[r] for r in p.rows],
-                    count=p.count, overflow=p.overflow, via=p.via,
+                    paths=[entry.paths[r] for r in rows[:k]],
+                    count=count, overflow=over, via=chunk[0].via,
                 )
+        else:
+            bump_counter("stateCache.plan.fallback.version", len(term_lists))
     for i, exprs in enumerate(parsed):
         if out[i] is None:
             scan = pruning.files_for_scan(snapshot, exprs)

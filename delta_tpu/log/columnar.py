@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -93,6 +93,55 @@ class _Batch:
     # ckpt batches: the Arrow table (map columns intact) + per-row source row
     table: Optional[pa.Table] = None
     table_index: Optional[np.ndarray] = None  # file-action row -> table row
+
+    def partition_strings(
+        self, local_rows: np.ndarray, part_cols: Sequence[str]
+    ) -> Optional[Dict[str, pa.Array]]:
+        """Partition-value strings for batch-local file-action rows (adds;
+        remove rows yield nulls). Checkpoint batches answer vectorized from
+        the retained table's ``add.partitionValues`` map; JSON batches parse
+        their lines (commit tails are short)."""
+        import json as _json
+
+        if self.kind == "json":
+            assert self.lines is not None and self.line_index is not None
+            cols: Dict[str, List[Optional[str]]] = {c: [] for c in part_cols}
+            for r in local_rows:
+                try:
+                    d = _json.loads(self.lines[self.line_index[r]])
+                except Exception:
+                    return None
+                pv = (d.get("add") or {}).get("partitionValues")
+                if pv is None and "add" in d:
+                    return None  # an add without the mandatory map
+                pv = pv or {}
+                for c in part_cols:
+                    v = pv.get(c)
+                    cols[c].append(v if isinstance(v, str) else None)
+            return {c: pa.array(v, pa.string()) for c, v in cols.items()}
+        assert self.table is not None and self.table_index is not None
+        if "add" not in self.table.column_names:
+            return None
+        add = self.table.column("add")
+        add_t = add.type
+        if not any(add_t.field(i).name == "partitionValues"
+                   for i in range(add_t.num_fields)):
+            return None
+        pv = pc.struct_field(add, "partitionValues")
+        if not pa.types.is_map(pv.type):
+            return None
+        sel = pa.array(self.table_index[local_rows])
+        pv = pv.take(sel)
+        out: Dict[str, pa.Array] = {}
+        for c in part_cols:
+            try:
+                vals = pc.map_lookup(pv, query_key=c, occurrence="first")
+            except Exception:
+                return None
+            if isinstance(vals, pa.ChunkedArray):
+                vals = vals.combine_chunks()
+            out[c] = vals.cast(pa.string())
+        return out
 
     def materialize(self, local_rows: np.ndarray) -> List[Action]:
         """Build Add/RemoveFile dataclasses for batch-local file-action rows."""
@@ -203,6 +252,48 @@ class SegmentColumns:
     def paths_for(self, rows: np.ndarray) -> List[str]:
         """Canonical paths for the given *row* indices."""
         return self.path_dict.take(pa.array(self.path_id[rows], pa.int64())).to_pylist()
+
+    def partition_strings(
+        self, rows: np.ndarray, part_cols: Sequence[str]
+    ) -> Optional[Dict[str, pa.Array]]:
+        """Raw partition-value strings for the given *row* indices, one
+        string array per partition column (null = value absent/null).
+
+        Checkpoint batches serve this vectorized from their retained Arrow
+        table (``add.partitionValues`` map via ``pc.map_lookup``, or the
+        typed ``partitionValues_parsed`` struct when present); JSON batches
+        parse their (few) tail lines individually. None when any covering
+        batch can't produce the columns — callers fall back to the
+        dataclass path."""
+        rows = np.asarray(rows, np.int64)
+        if not len(rows):
+            return {c: pa.array([], pa.string()) for c in part_cols}
+        out_chunks: Dict[str, List[pa.Array]] = {c: [] for c in part_cols}
+        offsets = np.array([b.row_offset for b in self.batches], np.int64)
+        which = np.searchsorted(offsets, rows, side="right") - 1
+        order = np.argsort(which, kind="stable")
+        if not (rows[order] == rows).all():
+            # callers pass replay-ordered rows; batches are replay-ordered
+            # too, so a reordering here would desync the output alignment
+            return None
+        for bi in np.unique(which):
+            batch = self.batches[bi]
+            local = rows[which == bi] - batch.row_offset
+            got = batch.partition_strings(local, part_cols)
+            if got is None:
+                return None
+            for c in part_cols:
+                out_chunks[c].append(got[c])
+        result: Dict[str, pa.Array] = {}
+        for c in part_cols:
+            arr = (out_chunks[c][0] if len(out_chunks[c]) == 1
+                   else pa.concat_arrays([a.combine_chunks() if
+                                          isinstance(a, pa.ChunkedArray) else a
+                                          for a in out_chunks[c]]))
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            result[c] = arr
+        return result
 
 
 def _canonicalize(paths, out_of_line: bool) -> pa.Array:

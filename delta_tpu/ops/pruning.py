@@ -238,46 +238,89 @@ def prune_files(
     return [f for f, k in zip(files, keep) if k]
 
 
-def _resident_scan(snapshot, data_filters: Sequence[ir.Expression]) -> Optional[DeltaScan]:
+def _resident_scan(
+    snapshot,
+    partition_filters: Sequence[ir.Expression],
+    data_filters: Sequence[ir.Expression],
+) -> Optional[DeltaScan]:
     """Serve a scan from the HBM/mirror-resident state cache
     (`ops/state_cache`, the reference's `StateCache` role): only the few
     surviving files materialize as dataclasses — ``all_files`` (every
-    AddFile as a Python object) is never built. Only taken when the range
-    lowering is EXACT (no strict comparison was relaxed), so the result
-    matches the evaluator file-for-file. None → normal path."""
+    AddFile as a Python object) is never built. Partition predicates lower
+    to dictionary-code ranges on the same lanes (the reference's primary
+    pruning path, `PartitionFiltering.scala:27-43`). Only taken when the
+    range lowering is EXACT (no strict comparison was relaxed), so the
+    result matches the evaluator file-for-file. None → normal path."""
     if not conf.get_bool("delta.tpu.stateCache.serveScans", True):
         return None
-    from delta_tpu.ops.state_cache import DeviceStateCache, extract_ranges
+    if getattr(snapshot, "delta_log", None) is None:
+        return None  # synthetic snapshots (tests/tools) have no log handle
+    import numpy as np
+
+    from delta_tpu.ops.state_cache import DeviceStateCache, extract_range_union
+    from delta_tpu.utils.telemetry import bump_counter
 
     entry = DeviceStateCache.instance().get(snapshot)
     if entry is None:
+        bump_counter("stateCache.scan.fallback.noentry")
         return None
-    pred = skipping_predicate(ir.and_all(list(data_filters)), frozenset())
-    r = extract_ranges(pred, entry.columns)
-    if r is None or not r.exact:
+    pcols = frozenset(c.lower() for c in snapshot.metadata.partition_columns)
+    pred = skipping_predicate(
+        ir.and_all(list(partition_filters) + list(data_filters)), pcols)
+    terms = extract_range_union(pred, entry.columns, entry.part_info,
+                                str_lanes=entry.str_lanes)
+    if not terms or not all(t.exact for t in terms):
+        bump_counter("stateCache.scan.fallback.lowering")
         return None
-    plans = entry.plan_ranges([r], k=max(entry.num_rows, 1),
+    n_main = len(terms)
+    if partition_filters:
+        # partition-only leg: same lanes, stats bounds dropped — one batch,
+        # one dispatch; feeds the DataSize the scan reports for the
+        # partition-pruning stage
+        ppred = skipping_predicate(ir.and_all(list(partition_filters)), pcols)
+        pterms = extract_range_union(ppred, entry.columns, entry.part_info,
+                                     str_lanes=entry.str_lanes)
+        if not pterms or not all(t.exact for t in pterms):
+            bump_counter("stateCache.scan.fallback.lowering")
+            return None
+        terms = terms + pterms
+    plans = entry.plan_ranges(terms, k=max(entry.num_rows, 1),
                               expected_version=snapshot.version)
     if plans is None:
+        bump_counter("stateCache.scan.fallback.version")
         return None
-    plan = plans[0]
-    paths = [entry.paths[i] for i in plan.rows]
+    bump_counter("stateCache.scan.resident")
+
+    def _union(chunk):
+        if len(chunk) == 1:
+            return chunk[0].rows
+        return np.unique(np.concatenate([p.rows for p in chunk]))
+
+    rows = _union(plans[:n_main])
+    paths = [entry.paths[i] for i in rows]
     kept = snapshot.files_for_paths(paths)
     alive = entry.h_alive[: entry.num_rows]
-    total_bytes = int(entry.h_size[: entry.num_rows][alive].sum())
+    sizes = entry.h_size[: entry.num_rows]
+    total_bytes = int(sizes[alive].sum())
     n_alive = int(alive.sum())
     total = DataSize(bytes_compressed=total_bytes, files=n_alive)
+    if partition_filters:
+        prows = _union(plans[n_main:])
+        partition = DataSize(
+            bytes_compressed=int(sizes[prows].sum()), files=len(prows))
+    else:
+        partition = total  # unpartitioned: nothing pruned by partition
     return DeltaScan(
         version=snapshot.version,
         files=kept,
         total=total,
-        partition=total,  # unpartitioned: nothing pruned by partition
+        partition=partition,
         scanned=DataSize(
             bytes_compressed=sum(f.size or 0 for f in kept),
             files=len(kept),
             rows=sum(f.num_logical_records or 0 for f in kept) or None,
         ),
-        partition_filters=[],
+        partition_filters=list(partition_filters),
         data_filters=list(data_filters),
     )
 
@@ -316,8 +359,8 @@ def _files_for_scan_impl(
             else:
                 data_filters.append(conj)
 
-    if not part_cols and data_filters and not partition_filters:
-        fast = _resident_scan(snapshot, data_filters)
+    if data_filters or partition_filters:
+        fast = _resident_scan(snapshot, partition_filters, data_filters)
         if fast is not None:
             return fast
 

@@ -90,17 +90,239 @@ class RangeSet:
     exact: bool = True
 
 
-def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[RangeSet]:
-    """Lower a *rewritten* skipping predicate (over ``min.c``/``max.c`` lanes)
-    to per-column range bounds, or None when the shape doesn't fit (ORs,
-    null-count tests, unknown columns → caller routes that query to the
-    generic path). Strict comparisons are relaxed to non-strict — pruning may
-    keep a boundary file it could have dropped, never the reverse."""
+def _part_lane_rows(codes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Partition lane rows from int32 codes: min=max=code; null (-1) becomes
+    the inverted range (+inf, -inf) so every bounded query prunes it exactly
+    (NaN would mean 'missing stat: keep' — the wrong semantics for a KNOWN
+    null partition value)."""
+    f = codes.astype(np.float64)
+    lo = np.where(codes >= 0, f, np.inf)
+    hi = np.where(codes >= 0, f, -np.inf)
+    return lo, hi
+
+
+@dataclass
+class PartLane:
+    """One partition column's dictionary lane: codes are ranks in VALUE
+    order at build time (typed order for numeric/temporal columns, code-
+    point order for strings), so value ranges lower to code ranges. A tail
+    extension that arrives out of order appends its code at the end and
+    clears ``sorted`` — equality lowering survives, range lowering stops
+    until the entry rebuilds."""
+
+    values: List[str]  # code -> raw partition string
+    parsed: Optional[np.ndarray]  # typed sort keys (float64) or None (lex)
+    code_of: Dict[str, int]
+    sorted: bool = True
+    dt: object = None  # DataType used to parse (set iff parsed is not None)
+
+    def eq_code(self, lit) -> Optional[int]:
+        """Code whose value equals the literal; -1 = no file has it; None =
+        the literal isn't comparable against this lane."""
+        if self.parsed is not None:
+            if isinstance(lit, bool) or not isinstance(lit, (int, float)):
+                return None
+            v = float(lit)
+            if self.sorted:
+                i = int(np.searchsorted(self.parsed, v))
+                return i if i < len(self.parsed) and self.parsed[i] == v else -1
+            hits = np.nonzero(self.parsed == v)[0]
+            return int(hits[0]) if len(hits) else -1
+        if not isinstance(lit, str):
+            return None
+        c = self.code_of.get(lit)
+        return c if c is not None else -1
+
+    def bound_code(self, lit, op) -> Optional[Tuple[float, float]]:
+        """(lo, hi) code bounds (NaN = unbounded) for `col <op> lit`, or
+        None when not lowerable (unsorted lane / type mismatch)."""
+        import bisect
+
+        if not self.sorted:
+            return None
+        if self.parsed is not None:
+            if isinstance(lit, bool) or not isinstance(lit, (int, float)):
+                return None
+            v = float(lit)
+            left = int(np.searchsorted(self.parsed, v, side="left"))
+            right = int(np.searchsorted(self.parsed, v, side="right"))
+        else:
+            if not isinstance(lit, str):
+                return None
+            left = bisect.bisect_left(self.values, lit)
+            right = bisect.bisect_right(self.values, lit)
+        if op == "lt":
+            return (np.nan, left - 1 + 0.0)  # codes < first value >= lit
+        if op == "le":
+            return (np.nan, right - 1 + 0.0)
+        if op == "gt":
+            return (right + 0.0, np.nan)
+        if op == "ge":
+            return (left + 0.0, np.nan)
+        return None
+
+
+def _intersect_ranges(a: RangeSet, b: RangeSet) -> RangeSet:
+    """Conjunction of two boxes: per-column max of lows / min of highs
+    (NaN = unbounded, so fmax/fmin ignore it)."""
+    if a.verdict == "empty" or b.verdict == "empty":
+        return RangeSet(a.lo, a.hi, verdict="empty",
+                        exact=a.exact and b.exact)
+    if a.verdict == "all":
+        return RangeSet(b.lo, b.hi, verdict=b.verdict,
+                        exact=a.exact and b.exact)
+    if b.verdict == "all":
+        return RangeSet(a.lo, a.hi, verdict=a.verdict,
+                        exact=a.exact and b.exact)
+    return RangeSet(np.fmax(a.lo, b.lo), np.fmin(a.hi, b.hi),
+                    exact=a.exact and b.exact)
+
+
+def extract_range_union(
+    pred: ir.Expression,
+    columns: Sequence[str],
+    part_info: Optional[Dict[str, PartLane]] = None,
+    max_terms: int = 8,
+    str_lanes: Optional[frozenset] = None,
+) -> Optional[List[RangeSet]]:
+    """Lower a rewritten skipping predicate to a UNION of per-column range
+    boxes (limited DNF): OR branches union, AND distributes (capped at
+    ``max_terms``), and partition IN-lists lower to runs of consecutive
+    dictionary codes. Every term exact ⇒ the union equals the exact
+    evaluator's keep-set (terms may overlap; callers union row sets).
+    None when any branch doesn't lower — the caller falls back."""
+    t = type(pred)
+    one = extract_ranges(pred, columns, part_info, str_lanes)
+    if one is not None:
+        return [one]
+    if t is ir.Or:
+        l = extract_range_union(pred.left, columns, part_info, max_terms,
+                                str_lanes)
+        if l is None:
+            return None
+        r = extract_range_union(pred.right, columns, part_info, max_terms,
+                                str_lanes)
+        if r is None or len(l) + len(r) > max_terms:
+            return None
+        return l + r
+    if t is ir.And:
+        l = extract_range_union(pred.left, columns, part_info, max_terms,
+                                str_lanes)
+        if l is None:
+            return None
+        r = extract_range_union(pred.right, columns, part_info, max_terms,
+                                str_lanes)
+        if r is None or len(l) * len(r) > max_terms:
+            return None
+        return [_intersect_ranges(a, b) for a in l for b in r]
+    if (t is ir.In and part_info and isinstance(pred.value, ir.Column)):
+        pmap = {c.lower(): c for c in part_info}
+        key = pmap.get(pred.value.name.lower())
+        if key is None:
+            return None
+        part = part_info[key]
+        i = list(columns).index(key)
+        codes = []
+        for o in pred.options:
+            if not isinstance(o, ir.Literal) or o.value is None:
+                return None
+            c = part.eq_code(o.value)
+            if c is None:
+                return None
+            if c >= 0:
+                codes.append(c)
+        if not codes:
+            e = RangeSet(np.full(len(columns), np.nan),
+                         np.full(len(columns), np.nan), verdict="empty")
+            return [e]
+        codes = sorted(set(codes))
+        runs: List[Tuple[int, int]] = []
+        for c in codes:
+            if runs and c == runs[-1][1] + 1:
+                runs[-1] = (runs[-1][0], c)
+            else:
+                runs.append((c, c))
+        if len(runs) > max_terms:
+            return None
+        out = []
+        for lo_c, hi_c in runs:
+            lo = np.full(len(columns), np.nan)
+            hi = np.full(len(columns), np.nan)
+            lo[i], hi[i] = float(lo_c), float(hi_c)
+            out.append(RangeSet(lo, hi))
+        return out
+    return None
+
+
+def extract_ranges(
+    pred: ir.Expression,
+    columns: Sequence[str],
+    part_info: Optional[Dict[str, PartLane]] = None,
+    str_lanes: Optional[frozenset] = None,
+) -> Optional[RangeSet]:
+    """Lower a *rewritten* skipping predicate (``min.c``/``max.c`` lanes for
+    stats columns; RAW column references for partition columns, which the
+    rewrite passes through) to per-column range bounds, or None when the
+    shape doesn't fit (ORs, null-count tests, unknown columns → caller
+    routes that query to the generic path). Strict stat comparisons are
+    relaxed to non-strict — pruning may keep a boundary file it could have
+    dropped, never the reverse. Partition lowerings stay exact: dictionary
+    codes are discrete, so strict bounds bisect exactly."""
     col_ix = {c: i for i, c in enumerate(columns)}
+    pmap = {c.lower(): c for c in (part_info or {})}
     lo = np.full(len(columns), np.nan)
     hi = np.full(len(columns), np.nan)
     empty = False
     exact = True
+
+    def set_bounds(i: int, b_lo: float, b_hi: float) -> None:
+        nonlocal empty
+        if not np.isnan(b_lo):
+            lo[i] = b_lo if np.isnan(lo[i]) else max(lo[i], b_lo)
+        if not np.isnan(b_hi):
+            hi[i] = b_hi if np.isnan(hi[i]) else min(hi[i], b_hi)
+
+    def walk_part(e, t) -> bool:
+        """Partition-column comparisons: Column(p) <op> Literal, both
+        orientations (the skipping rewrite does not normalize these)."""
+        nonlocal empty
+        flip = {ir.Lt: ir.Gt, ir.Le: ir.Ge, ir.Gt: ir.Lt, ir.Ge: ir.Le,
+                ir.Eq: ir.Eq}
+        l, r = e.left, e.right
+        if isinstance(l, ir.Literal) and isinstance(r, ir.Column):
+            t = flip[t]
+            l, r = r, l
+        if not (isinstance(l, ir.Column) and isinstance(r, ir.Literal)):
+            return False
+        key = pmap.get(l.name.lower())
+        if key is None:
+            return False
+        part = part_info[key]
+        i = col_ix[key]
+        if r.value is None:
+            empty = True  # col <op> NULL matches nothing
+            return True
+        if t is ir.Eq:
+            code = part.eq_code(r.value)
+            if code is None:
+                return False
+            if code < 0:
+                empty = True  # value absent from the table entirely
+                return True
+            set_bounds(i, float(code), float(code))
+            return True
+        op = {ir.Lt: "lt", ir.Le: "le", ir.Gt: "gt", ir.Ge: "ge"}.get(t)
+        if op is None:
+            return False
+        b = part.bound_code(r.value, op)
+        if b is None:
+            return False
+        set_bounds(i, *b)
+        if not np.isnan(hi[i]) and hi[i] < 0:
+            empty = True  # upper bound below every code
+        if not np.isnan(lo[i]) and lo[i] > len(part.values) - 1:
+            empty = True  # lower bound above every code
+        return True
 
     def walk(e: ir.Expression) -> bool:
         nonlocal empty, exact
@@ -114,14 +336,28 @@ def extract_ranges(pred: ir.Expression, columns: Sequence[str]) -> Optional[Rang
                 empty = True
                 return True
             return False
-        if t in (ir.Le, ir.Lt, ir.Ge, ir.Gt):
+        if t in (ir.Le, ir.Lt, ir.Ge, ir.Gt, ir.Eq):
             l, r = e.left, e.right
+            if pmap and walk_part(e, t):
+                return True
+            if t is ir.Eq:
+                return False  # stat lanes never see raw equality
             if not (isinstance(l, ir.Column) and isinstance(r, ir.Literal)):
                 return False
-            if not isinstance(r.value, (int, float)) or isinstance(r.value, bool):
-                return False
-            v = float(r.value)
             name = l.name
+            base = name[4:] if name.startswith(("min.", "max.")) else None
+            if (isinstance(r.value, str) and base is not None
+                    and base in (str_lanes or frozenset())):
+                # string stat lane: compare in 6-byte-prefix space; the
+                # truncation makes the bound conservative, never exact
+                from delta_tpu.ops.state_export import string_prefix_lane_value
+
+                v = string_prefix_lane_value(r.value)
+                exact = False
+            elif not isinstance(r.value, (int, float)) or isinstance(r.value, bool):
+                return False
+            else:
+                v = float(r.value)
             if name.startswith("min.") and t in (ir.Le, ir.Lt):
                 i = col_ix.get(name[4:])
                 if i is None:
@@ -176,11 +412,21 @@ class ResidentState:
 
     def __init__(self, log_path: str, metadata_id: str, version: int,
                  columns: List[str], paths: List[str],
-                 lanes: Dict[str, np.ndarray]):
+                 lanes: Dict[str, np.ndarray],
+                 part_info: Optional[Dict[str, "PartLane"]] = None,
+                 str_lanes: Optional[frozenset] = None):
         self.log_path = log_path
         self.metadata_id = metadata_id
         self.version = version
         self.columns = columns
+        # partition pseudo-lanes: column name -> dictionary metadata; the
+        # lane itself lives in h_lo/h_hi as min=max=code (+inf/-inf = null
+        # partition value: an inverted range that no bounded query keeps)
+        self.part_info: Dict[str, PartLane] = part_info or {}
+        # stats columns whose lanes hold 6-byte string prefixes: literals
+        # must transform through the same encoding, and bounds are never
+        # exact (see state_export.string_prefix_lane_value)
+        self.str_lanes: frozenset = str_lanes or frozenset()
         self.paths = list(paths)
         self.path_to_row: Dict[str, int] = {p: i for i, p in enumerate(paths)}
         n = len(paths)
@@ -285,6 +531,63 @@ class ResidentState:
                 self._apply_tail_device(dead_rows, start, k, add_lo, add_hi)
             self.version = version
             return True
+
+    def map_tail_lanes(self, arr, metadata):
+        """Translate a decoded tail's FileStateArrays into this entry's lane
+        space: stats lanes pass through; partition codes re-map through the
+        entry dictionaries, EXTENDING them for unseen values (an append that
+        sorts after the current maximum keeps range lowering alive; an
+        out-of-order value clears ``sorted`` — equality keeps working and
+        the next rebuild re-sorts). None → caller rebuilds."""
+        from delta_tpu.ops.state_export import _stat_to_lane
+
+        with self._lock:
+            if not arr.paths:  # pure-remove tail: no lanes to translate
+                z = np.empty((len(self.columns), 0))
+                return [], z, z.copy(), np.empty(0, np.int64)
+            part_cols = sorted(self.part_info.keys())
+            if part_cols != sorted(arr.partition_codes.keys()):
+                return None
+            stats_cols = [c for c in self.columns if c not in self.part_info]
+            if stats_cols != sorted(arr.stats_min.keys()):
+                return None
+            mapped: Dict[str, np.ndarray] = {}
+            for c in part_cols:
+                part = self.part_info[c]
+                tail_values = arr.partition_dicts[c]
+                trans = np.empty(len(tail_values), np.int64)
+                for j, v in enumerate(tail_values):
+                    code = part.code_of.get(v)
+                    if code is None:
+                        code = len(part.values)
+                        if code >= (1 << 24):
+                            return None
+                        if part.parsed is not None:
+                            pv = _stat_to_lane(v, part.dt)
+                            # a new STRING mapping to an already-present sort
+                            # key ("1.0" joining "1") would split one value
+                            # across two codes — rebuild (which falls back
+                            # to lex order) instead of mis-serving equality
+                            if pv is None or bool(np.any(part.parsed == pv)):
+                                return None
+                            if part.sorted and len(part.parsed):
+                                part.sorted = pv > part.parsed[-1]
+                            part.parsed = np.append(part.parsed, pv)
+                        elif part.sorted and len(part.values):
+                            part.sorted = v > part.values[-1]
+                        part.values.append(v)
+                        part.code_of[v] = code
+                    trans[j] = code
+                codes = arr.partition_codes[c]
+                if len(tail_values) == 0:  # all-null tail for this column
+                    mapped[c] = np.full(len(codes), -1, np.int32)
+                else:
+                    mapped[c] = np.where(
+                        codes >= 0, trans[np.maximum(codes, 0)], -1
+                    ).astype(np.int32)
+            lanes = _stacked_lanes(arr, stats_cols, mapped)
+            return (list(arr.paths), lanes["min"], lanes["max"],
+                    lanes["size"])
 
     def _apply_tail_device(self, dead_rows, start, k, add_lo, add_hi) -> None:
         """One small upload + one jitted scatter/slice update in HBM.
@@ -500,31 +803,116 @@ def _lanes_from_arrays(arr, columns: Sequence[str]):
     return {"min": lo, "max": hi, "size": arr.size.astype(np.int64)}
 
 
+def _string_stat_cols(metadata) -> List[str]:
+    from delta_tpu.schema.types import StringType
+
+    pset = set(metadata.partition_columns)
+    return sorted(f.name for f in metadata.schema.fields
+                  if isinstance(f.data_type, StringType) and f.name not in pset)
+
+
+def _build_part_info(arr, metadata):
+    """Value-sort each partition dictionary (typed order when the column
+    type parses every value, else code-point order), remap codes to ranks,
+    and emit (part_info, remapped_codes) — or None when a dictionary is too
+    large for exact f32 lanes."""
+    from delta_tpu.ops.state_export import _NUMERIC, _stat_to_lane
+
+    types = {f.name: f.data_type for f in metadata.schema.fields}
+    part_info: Dict[str, PartLane] = {}
+    remapped: Dict[str, np.ndarray] = {}
+    for c in sorted(arr.partition_codes.keys()):
+        values = list(arr.partition_dicts[c])
+        if len(values) > (1 << 24):  # codes must stay f32-exact
+            return None
+        dt = types.get(c)
+        parsed = None
+        if isinstance(dt, _NUMERIC):
+            p = [_stat_to_lane(v, dt) for v in values]
+            if all(x is not None for x in p):
+                cand = np.asarray(p, np.float64)
+                # duplicate sort keys ("1" vs "1.0") would make a value
+                # range span two codes non-contiguously — fall back to lex
+                if len(np.unique(cand)) == len(cand):
+                    parsed = cand
+        if parsed is not None:
+            order = np.argsort(parsed, kind="stable")
+            parsed = parsed[order]
+        else:
+            order = np.argsort(np.asarray(values, object), kind="stable")
+            dt = None
+        rank = np.empty(len(values), np.int64)
+        rank[order] = np.arange(len(values))
+        codes = arr.partition_codes[c]
+        if len(values) == 0:
+            # every alive file carries null for this column: no dictionary,
+            # all codes -1 (the inverted-range lane prunes them exactly)
+            remapped[c] = np.full(len(codes), -1, np.int32)
+        else:
+            remapped[c] = np.where(
+                codes >= 0, rank[np.maximum(codes, 0)], -1).astype(np.int32)
+        svals = [values[i] for i in order]
+        part_info[c] = PartLane(
+            values=svals, parsed=parsed,
+            code_of={v: i for i, v in enumerate(svals)}, dt=dt,
+        )
+    return part_info, remapped
+
+
+def _stacked_lanes(arr, stats_cols, part_codes: Dict[str, np.ndarray]):
+    """Combined lane stack: stats columns first (sorted), then partition
+    pseudo-lanes (sorted) — matching the entry's ``columns`` order."""
+    lanes = _lanes_from_arrays(arr, stats_cols)
+    if part_codes:
+        lo_rows, hi_rows = [], []
+        for c in sorted(part_codes.keys()):
+            lo_r, hi_r = _part_lane_rows(part_codes[c])
+            lo_rows.append(lo_r)
+            hi_rows.append(hi_r)
+        lanes["min"] = np.concatenate([lanes["min"], np.stack(lo_rows)], axis=0)
+        lanes["max"] = np.concatenate([lanes["max"], np.stack(hi_rows)], axis=0)
+    return lanes
+
+
 def build_entry(snapshot) -> Optional[ResidentState]:
-    """Full build of a resident entry from a snapshot's columnar state.
-    None when the table shape is unsupported (partitioned / odd stats)."""
+    """Full build of a resident entry from a snapshot's columnar state —
+    partitioned tables included (dictionary-coded partition lanes; the
+    reference's primary pruning path, `PartitionFiltering.scala:27-43`,
+    served from the same block-cull kernel). None when the shape is
+    unsupported (odd stats / oversized dictionaries)."""
     from delta_tpu.ops.state_export import arrays_from_columns
 
+    str_cols = _string_stat_cols(snapshot.metadata)
     arr = arrays_from_columns(
-        snapshot._columnar, snapshot._alive_mask, snapshot.metadata
+        snapshot._columnar, snapshot._alive_mask, snapshot.metadata,
+        string_prefix_cols=str_cols,
     )
     if arr is None:
         return None
-    columns = sorted(arr.stats_min.keys())
+    built = _build_part_info(arr, snapshot.metadata)
+    if built is None:
+        return None
+    part_info, remapped = built
+    stats_cols = sorted(arr.stats_min.keys())
+    columns = stats_cols + sorted(part_info.keys())
     return ResidentState(
         log_path=snapshot.delta_log.log_path,
         metadata_id=snapshot.metadata.id,
         version=snapshot.version,
         columns=columns,
         paths=list(arr.paths),
-        lanes=_lanes_from_arrays(arr, columns),
+        lanes=_stacked_lanes(arr, stats_cols, remapped),
+        part_info=part_info,
+        str_lanes=frozenset(str_cols),
     )
 
 
 def _decode_tail(snapshot, from_version: int):
     """Decode commits (from_version, snapshot.version] to (removed_paths,
-    (add_paths, lo, hi, size)) or None when incremental apply isn't safe
-    (metadata change in the tail, missing commit files, partitioned...)."""
+    FileStateArrays) or None when incremental apply isn't safe (metadata
+    change in the tail, missing commit files, undecodable shapes). The
+    caller maps the arrays into its entry's lane space (partition code
+    translation happens there, under the entry lock)."""
     from delta_tpu.log.columnar import decode_segment
     from delta_tpu.ops.state_export import arrays_from_columns
     from delta_tpu.protocol import filenames
@@ -545,12 +933,12 @@ def _decode_tail(snapshot, from_version: int):
     alive, _ = cols.replay(winner=w)
     dead_winner = w & ~alive
     removed = cols.paths_for(np.nonzero(dead_winner)[0])
-    arr = arrays_from_columns(cols, alive, snapshot.metadata)
+    arr = arrays_from_columns(
+        cols, alive, snapshot.metadata,
+        string_prefix_cols=_string_stat_cols(snapshot.metadata))
     if arr is None:
         return None
-    columns = sorted(arr.stats_min.keys())
-    lanes = _lanes_from_arrays(arr, columns)
-    return removed, (list(arr.paths), lanes["min"], lanes["max"], lanes["size"]), columns
+    return removed, arr
 
 
 class DeviceStateCache:
@@ -629,8 +1017,9 @@ class DeviceStateCache:
                 tail = _decode_tail(snapshot, e.version)
                 ok = False
                 if tail is not None:
-                    removed, added, columns = tail
-                    if columns == e.columns or not added[0]:
+                    removed, arr = tail
+                    added = e.map_tail_lanes(arr, snapshot.metadata)
+                    if added is not None:
                         ok = e.apply_tail(snapshot.version, removed, added)
                 if not ok:
                     e = None

@@ -254,12 +254,53 @@ def _numeric_to_lane(arr: pa.Array) -> Optional[np.ndarray]:
     return out
 
 
+def string_prefix_lane_value(s: str) -> float:
+    """First-6-bytes big-endian integer of a string's UTF-8 form, as an
+    EXACT float64 (48 bits < 2^53). Monotone non-strict w.r.t. byte order:
+    s1 <= s2 implies prefix(s1) <= prefix(s2), so range pruning over
+    prefix lanes keeps a superset (never drops a match)."""
+    b = s.encode("utf-8")[:6]
+    v = 0
+    for i, byte in enumerate(b):
+        v += byte << (8 * (5 - i))
+    return float(v)
+
+
+def _string_prefix_lanes(arr) -> Optional[np.ndarray]:
+    """Vectorized 6-byte prefix values for a pyarrow string array
+    (null/non-string -> NaN). Pure-numpy over the Arrow buffers — no
+    per-string Python objects."""
+    import pyarrow.compute as pc
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if not pa.types.is_string(arr.type):
+        return None
+    valid = np.asarray(pc.is_valid(arr))
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32,
+                            count=len(arr) + 1, offset=arr.offset * 4)
+    data = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None else \
+        np.empty(0, np.uint8)
+    starts = offsets[:-1].astype(np.int64)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    idx = starts[:, None] + np.arange(6)[None, :]
+    mask = np.arange(6)[None, :] < np.minimum(lens, 6)[:, None]
+    safe = np.clip(idx, 0, max(len(data) - 1, 0))
+    b = np.where(mask, data[safe] if len(data) else 0, 0)
+    weights = (256.0 ** np.arange(5, -1, -1))
+    out = (b * weights[None, :]).sum(axis=1)
+    out[~valid] = np.nan
+    return out
+
+
 def arrays_from_columns(
     cols,
     rows_mask: np.ndarray,
     metadata: Metadata,
     stats_columns: Optional[Sequence[str]] = None,
     sort_by_path: bool = False,
+    string_prefix_cols: Sequence[str] = (),
 ) -> Optional[FileStateArrays]:
     """Vectorized :class:`FileStateArrays` straight from a columnar segment
     (``delta_tpu.log.columnar.SegmentColumns``) — no AddFile dataclasses.
@@ -275,9 +316,25 @@ def arrays_from_columns(
     import pyarrow.compute as pc
     import pyarrow.json as pajson
 
-    if metadata.partition_columns:
-        return None
     rows = np.nonzero(rows_mask)[0] if rows_mask.dtype == bool else np.asarray(rows_mask)
+    part_cols = list(metadata.partition_columns)
+    part_codes: Dict[str, np.ndarray] = {}
+    part_dicts: Dict[str, List[str]] = {}
+    if part_cols:
+        # dictionary-code partition values straight from the columnar batches
+        # (checkpoint map columns / tail JSON lines) — the dynamic-key map
+        # never materializes dataclasses
+        strings = cols.partition_strings(rows, part_cols)
+        if strings is None:
+            return None
+        for c in part_cols:
+            enc = strings[c].dictionary_encode()
+            if isinstance(enc, pa.ChunkedArray):
+                enc = enc.combine_chunks()
+            codes = enc.indices.fill_null(-1).to_numpy(
+                zero_copy_only=False).astype(np.int32, copy=False)
+            part_codes[c] = codes
+            part_dicts[c] = enc.dictionary.to_pylist()
     paths = cols.paths_for(rows)
     size = cols.size[rows].copy()
     mtime = cols.modification_time[rows].copy()
@@ -285,12 +342,19 @@ def arrays_from_columns(
         order = pc.sort_indices(pa.array(paths)).to_numpy(zero_copy_only=False)
         rows, size, mtime = rows[order], size[order], mtime[order]
         paths = [paths[i] for i in order]
+        for c in part_cols:
+            part_codes[c] = part_codes[c][order]
 
     schema: StructType = metadata.schema
     if stats_columns is None:
         stats_columns = [
-            f.name for f in schema.fields if isinstance(f.data_type, _NUMERIC)
+            f.name for f in schema.fields
+            if f.name not in set(part_cols) and isinstance(f.data_type, _NUMERIC)
         ]
+    prefix_set = {c for c in string_prefix_cols if c not in set(part_cols)}
+    stats_columns = list(stats_columns) + [
+        c for c in sorted(prefix_set) if c not in set(stats_columns)
+    ]
     col_types: Dict[str, DataType] = {f.name: f.data_type for f in schema.fields}
 
     n = len(rows)
@@ -300,7 +364,7 @@ def arrays_from_columns(
     snull = {c: np.full(n, -1, np.int64) for c in stats_columns}
     out = FileStateArrays(
         paths=paths, size=size, modification_time=mtime, num_records=num_records,
-        partition_codes={}, partition_dicts={},
+        partition_codes=part_codes, partition_dicts=part_dicts,
         stats_min=smin, stats_max=smax, stats_null_count=snull,
     )
     if cols.stats is None or n == 0:
@@ -318,12 +382,22 @@ def arrays_from_columns(
         return None
     valid = np.asarray(pc.is_valid(blank))
     idx = np.nonzero(valid)[0]
-    lines = blank.drop_null().to_pylist()
-    if not lines:
+    compact = blank.drop_null()
+    if isinstance(compact, pa.ChunkedArray):
+        compact = compact.combine_chunks()
+    if len(compact) == 0:
         return out
+    # newline-join the 1M stats strings in ONE C++ kernel (a ListArray
+    # wrapping the whole column, then binary_join) — the old
+    # to_pylist + "\n".join round-tripped every string through Python
+    # objects and dominated the cold cache build
+    lst = pa.ListArray.from_arrays(
+        pa.array([0, len(compact)], pa.int32()), compact.cast(pa.string()))
+    joined = pc.binary_join(lst, "\n")
+    raw = joined.cast(pa.binary())[0].as_buffer()
     try:
         parsed = pajson.read_json(
-            pa.BufferReader(("\n".join(lines) + "\n").encode("utf-8")),
+            pa.BufferReader(raw),
             read_options=pajson.ReadOptions(use_threads=True, block_size=8 << 20),
         )
     except Exception:
@@ -354,9 +428,12 @@ def arrays_from_columns(
             if c not in fields:
                 continue
             leaf = pc.struct_field(col, c)
-            lane = _numeric_to_lane(leaf)
-            if lane is None:
-                lane = _temporal_to_lane(leaf, col_types.get(c, DoubleType()))
+            if c in prefix_set:
+                lane = _string_prefix_lanes(leaf)
+            else:
+                lane = _numeric_to_lane(leaf)
+                if lane is None:
+                    lane = _temporal_to_lane(leaf, col_types.get(c, DoubleType()))
             _scatter_f(dest[c], lane)
     if "nullCount" in names:
         col = parsed.column("nullCount").combine_chunks()

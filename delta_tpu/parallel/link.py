@@ -34,6 +34,7 @@ __all__ = [
     "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW",
     "HOST_PRUNE_S_PER_CELL", "DEVICE_PRUNE_S_PER_CELL",
     "HOST_KEY_DECODE_S_PER_ROW", "RESIDENT_PROBE_S_PER_ROW",
+    "RESIDENT_PROBE_FIXED_S",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -50,11 +51,14 @@ HOST_PRUNE_S_PER_CELL = 1.5e-9
 # projected Parquet key-column decode, host Arrow: ~260ms for 10M rows —
 # the cost the resident-key probe avoids and the host join must pay
 HOST_KEY_DECODE_S_PER_ROW = 2.6e-8
-# resident-key membership probe kernel (ops/key_cache._probe_sorted_kernel):
-# ~0.35s for an 11M-row join on one v5e with the per-probe slab sort; the
-# sorted-slab steady state (sort amortized to key mutations) is cheaper —
-# this constant stays the conservative bound until re-measured
-RESIDENT_PROBE_S_PER_ROW = 3.2e-8
+# resident-key membership probe kernel (ops/key_cache._probe_sorted_kernel,
+# r5 block-bucketed brute design): measured 0.43s at 10M and 0.68-0.71s at
+# 100M slab rows on one v5e — a ~0.4s dispatch floor plus ~3e-9 s/row of
+# VPU compare/reduce work. The old per-probe-sort kernel cost 3.2e-8 s/row.
+RESIDENT_PROBE_S_PER_ROW = 3.0e-9
+# fixed per-probe device overhead (kernel launch chain + source sort at
+# m<=1M), measured on the v5e behind the tunnel
+RESIDENT_PROBE_FIXED_S = 0.3
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares
 DEVICE_PRUNE_S_PER_CELL = 2.0e-11

@@ -114,3 +114,27 @@ def recent_events(op_prefix: str = "") -> List[UsageEvent]:
 def clear_events() -> None:
     with _LOCK:
         _BUFFER.clear()
+
+
+# -- monotonic counters ------------------------------------------------------
+#
+# Cheap process-wide tallies for questions like "what fraction of scan
+# plans actually served from the resident state cache, and why did the
+# rest fall back?" — the serving envelope as a NUMBER, not a hope.
+
+_COUNTERS: Dict[str, int] = {}
+
+
+def bump_counter(name: str, by: int = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + by
+
+
+def counters(prefix: str = "") -> Dict[str, int]:
+    with _LOCK:
+        return {k: v for k, v in _COUNTERS.items() if k.startswith(prefix)}
+
+
+def clear_counters() -> None:
+    with _LOCK:
+        _COUNTERS.clear()

@@ -183,6 +183,25 @@ def test_probe_sorted_kernel_fuzz_parity():
         assert res.any_multi == exp_multi, label
 
 
+def test_probe_many_above_max_misses_no_overflow():
+    """Source keys above the slab maximum (inserts) fall into NO block's
+    candidate window — the padding tail must not swallow them into the
+    boundary block and trip the overflow tiers."""
+    from delta_tpu.ops.key_cache import ResidentJoinKeys
+
+    n = 20000
+    e = ResidentJoinKeys("log", "mid", 0, "sig", ["k"])
+    e._append_file("f", np.arange(n, dtype=np.int64) * 2, np.ones(n, bool))
+    s = np.concatenate([
+        np.arange(50000, 60000, dtype=np.int64),  # 10k above-max misses
+        np.array([10, 20], np.int64),
+    ])
+    res = e.probe_async(s, np.ones(len(s), bool)).result()
+    assert res.s_matched[-2:].tolist() == [True, True]
+    assert not res.s_matched[:-2].any()
+    assert res.t_bits.sum() == 2
+
+
 def test_probe_after_kill_and_append_resorts(tmp_table):
     """Key appends invalidate the sorted view; kills do not. Both must
     still probe correctly afterwards."""

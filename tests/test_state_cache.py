@@ -226,14 +226,224 @@ def test_time_travel_below_residency_serves_host(tmp_table):
     assert cache.get(old) is None  # residency never serves an older version
 
 
-def test_partitioned_table_unsupported(tmp_table):
+def test_partitioned_table_builds_entry(tmp_table):
+    """r5: partitioned tables get resident entries with dictionary-coded
+    partition pseudo-lanes (was: unsupported -> None)."""
     from delta_tpu.api.tables import DeltaTable
     from delta_tpu.schema.types import IntegerType, StringType, StructType
 
     schema = StructType().add("p", StringType()).add("a", IntegerType())
     DeltaTable.create(tmp_table, schema, partition_columns=["p"])
-    snap = DeltaLog.for_table(tmp_table).update()
-    assert DeviceStateCache.instance().get(snap) is None
+    log = DeltaLog.for_table(tmp_table)
+    for p, lo in (("b", 0), ("a", 100), ("c", 200)):
+        WriteIntoDelta(log, "append", pa.table({
+            "p": [p] * 10, "a": np.arange(lo, lo + 10, dtype=np.int32),
+        })).run()
+    snap = log.update()
+    entry = DeviceStateCache.instance().get(snap)
+    assert entry is not None
+    assert "p" in entry.part_info and "a" in entry.columns
+    part = entry.part_info["p"]
+    assert part.values == ["a", "b", "c"]  # value-sorted codes
+    assert part.sorted and part.parsed is None
+
+
+def _oracle_files(snap, q):
+    """Exact pruner result with ALL resident serving disabled — the
+    parity baseline must not itself be served by the state cache."""
+    from delta_tpu.exec.scan import scan_files
+
+    with conf.set_temporarily(**{"delta.tpu.stateCache.serveScans": False,
+                                 "delta.tpu.stateCache.enabled": False}):
+        return sorted(f.path for f in scan_files(snap, q).files)
+
+
+def _mk_part_table(path, days=("2021-01-01", "2021-01-02", "2021-01-03"),
+                   with_null=False):
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.schema.types import (
+        IntegerType, LongType, StringType, StructType,
+    )
+
+    schema = (StructType().add("day", StringType()).add("year", IntegerType())
+              .add("a", LongType()))
+    DeltaTable.create(path, schema, partition_columns=["day", "year"])
+    log = DeltaLog.for_table(path)
+    lo = 0
+    for i, d in enumerate(days):
+        WriteIntoDelta(log, "append", pa.table({
+            "day": pa.array([d] * 8, pa.string()),
+            "year": pa.array([2020 + i] * 8, pa.int32()),
+            "a": np.arange(lo, lo + 8, dtype=np.int64),
+        })).run()
+        lo += 8
+    if with_null:
+        WriteIntoDelta(log, "append", pa.table({
+            "day": pa.array([None] * 4, pa.string()),
+            "year": pa.array([None] * 4, pa.int32()),
+            "a": np.arange(lo, lo + 4, dtype=np.int64),
+        })).run()
+    return log
+
+
+def test_partitioned_plan_parity_with_host_pruner(tmp_table):
+    """Resident partitioned planning (equality, ranges on string and
+    numeric partition lanes, mixed with data-column stats) must match the
+    exact host pruner file-for-file, device and host mirrors alike."""
+    from delta_tpu.exec.scan import plan_scans, scan_files
+
+    log = _mk_part_table(tmp_table, with_null=True)
+    snap = log.update()
+    queries = [
+        ["day = '2021-01-02'"],
+        ["year = 2021"],
+        ["year >= 2021"],
+        ["year > 2020 AND year <= 2022"],
+        ["day >= '2021-01-02'"],
+        ["day < '2021-01-02'"],
+        ["day = '2021-01-02' AND a >= 10"],
+        ["year = 1999"],          # absent value -> empty
+        ["day = 'zzz'"],          # absent value -> empty
+        ["a >= 12 AND a <= 20"],  # pure stats on a partitioned table
+    ]
+    for mode in ("off", "force"):
+        with conf.set_temporarily(**{
+                "delta.tpu.stateCache.devicePlan.mode": mode}):
+            plans = plan_scans(snap, queries, k=64)
+        for q, plan in zip(queries, plans):
+            expect = _oracle_files(snap, q)
+            assert sorted(plan.paths) == expect, (q, mode)
+            assert plan.via != "scan", (q, mode)  # actually served resident
+
+
+def test_partitioned_null_partition_pruned_exactly(tmp_table):
+    from delta_tpu.exec.scan import plan_scans, scan_files
+
+    log = _mk_part_table(tmp_table, with_null=True)
+    snap = log.update()
+    # every bounded predicate must exclude the null-partition file; an
+    # unconstrained query must keep it
+    plans = plan_scans(snap, [["year >= 1900"], []], k=64)
+    q0 = set(_oracle_files(snap, ["year >= 1900"]))
+    assert set(plans[0].paths) == q0
+    null_files = {f.path for f in snap.all_files
+                  if (f.partition_values or {}).get("year") is None}
+    assert null_files and not (null_files & set(plans[0].paths))
+    assert null_files < set(plans[1].paths)
+
+
+def test_all_null_partition_column_builds_and_advances(tmp_table):
+    """A partition column that is null in EVERY file (empty dictionary)
+    must build an entry and apply tails without crashing (r5 review
+    finding: empty rank/trans arrays were indexed eagerly)."""
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.exec.scan import plan_scans
+    from delta_tpu.schema.types import LongType, StringType, StructType
+
+    schema = StructType().add("p", StringType()).add("a", LongType())
+    DeltaTable.create(tmp_table, schema, partition_columns=["p"])
+    log = DeltaLog.for_table(tmp_table)
+    WriteIntoDelta(log, "append", pa.table({
+        "p": pa.array([None] * 8, pa.string()),
+        "a": np.arange(8, dtype=np.int64)})).run()
+    snap = log.update()
+    entry = DeviceStateCache.instance().get(snap)
+    assert entry is not None and entry.part_info["p"].values == []
+    # a tail commit, also all-null
+    WriteIntoDelta(log, "append", pa.table({
+        "p": pa.array([None] * 4, pa.string()),
+        "a": np.arange(100, 104, dtype=np.int64)})).run()
+    snap2 = log.update()
+    assert DeviceStateCache.instance().get(snap2) is entry
+    plans = plan_scans(snap2, [["a >= 0"], ["p = 'x'"]], k=16)
+    assert plans[0].count == 2  # both files, no partition constraint
+    assert plans[1].count == 0  # null partitions never match equality
+
+
+def test_partitioned_tail_advance_extends_dictionary(tmp_table):
+    """A new partition value that sorts after the current maximum keeps
+    the sorted invariant (range lowering stays); an out-of-order value
+    clears it (equality still serves)."""
+    from delta_tpu.exec.scan import plan_scans, scan_files
+
+    log = _mk_part_table(tmp_table)
+    snap = log.update()
+    cache = DeviceStateCache.instance()
+    entry = cache.get(snap)
+    assert entry is not None
+    # in-order extension: a NEW later day
+    WriteIntoDelta(log, "append", pa.table({
+        "day": pa.array(["2021-01-04"] * 4, pa.string()),
+        "year": pa.array([2023] * 4, pa.int32()),
+        "a": np.arange(100, 104, dtype=np.int64),
+    })).run()
+    snap2 = log.update()
+    e2 = cache.get(snap2)
+    assert e2 is entry, "tail must apply incrementally"
+    assert entry.part_info["day"].sorted
+    assert entry.part_info["day"].values[-1] == "2021-01-04"
+    plans = plan_scans(snap2, [["day >= '2021-01-03'"]], k=64)
+    expect = _oracle_files(snap2, ["day >= '2021-01-03'"])
+    assert sorted(plans[0].paths) == expect and plans[0].via != "scan"
+    # out-of-order extension: an EARLIER day arrives late
+    WriteIntoDelta(log, "append", pa.table({
+        "day": pa.array(["2020-12-31"] * 4, pa.string()),
+        "year": pa.array([2019] * 4, pa.int32()),
+        "a": np.arange(200, 204, dtype=np.int64),
+    })).run()
+    snap3 = log.update()
+    e3 = cache.get(snap3)
+    assert e3 is entry
+    assert not entry.part_info["day"].sorted
+    # equality still serves resident; ranges fall back to the exact scan
+    plans = plan_scans(snap3, [["day = '2020-12-31'"],
+                               ["day >= '2021-01-01'"]], k=64)
+    eq_expect = _oracle_files(snap3, ["day = '2020-12-31'"])
+    assert sorted(plans[0].paths) == eq_expect and plans[0].via != "scan"
+    rng_expect = _oracle_files(snap3, ["day >= '2021-01-01'"])
+    assert sorted(plans[1].paths) == rng_expect
+    assert plans[1].via == "scan"  # unsorted dict: range lowering disabled
+
+
+def test_string_prefix_lanes_prune_conservatively(tmp_table):
+    """String stats ride 6-byte-prefix f64 lanes: resident plans must be
+    SUPERSETS of the oracle (prefix truncation keeps, never drops) and
+    actually prune disjoint files on equality/range/prefix shapes."""
+    from delta_tpu.exec.scan import plan_scans
+
+    log = DeltaLog.for_table(tmp_table)
+    for head in ("apple", "banana", "cherry", "damson"):
+        WriteIntoDelta(log, "append", pa.table({
+            "s": pa.array([f"{head}{i:03d}" for i in range(20)], pa.string()),
+            "v": np.arange(20, dtype=np.int64),
+        })).run()
+    snap = log.update()
+    entry = DeviceStateCache.instance().get(snap)
+    assert entry is not None and "s" in entry.str_lanes
+    queries = [["s = 'banana005'"], ["s >= 'cherry'"], ["s < 'b'"],
+               ["s >= 'damson' AND s <= 'damson999'"]]
+    plans = plan_scans(snap, queries, k=16)
+    for q, plan in zip(queries, plans):
+        expect = set(_oracle_files(snap, q))
+        assert plan.via in ("device", "host-resident"), q
+        assert expect <= set(plan.paths), q
+    # equality on a single head hits exactly one file (prefix 6 bytes
+    # distinguishes these heads)
+    assert len(plans[0].paths) == 1
+
+
+def test_partition_in_list_serves_resident(tmp_table):
+    from delta_tpu.exec.scan import plan_scans
+
+    log = _mk_part_table(tmp_table, days=("d1", "d2", "d3", "d4"))
+    snap = log.update()
+    queries = [["day IN ('d1', 'd3')"], ["day IN ('d2', 'd3', 'd4')"],
+               ["day IN ('zz')"]]
+    plans = plan_scans(snap, queries, k=16)
+    for q, plan in zip(queries, plans):
+        assert sorted(plan.paths) == _oracle_files(snap, q), q
+        assert plan.via in ("device", "host-resident", "verdict"), q
+    assert plans[2].count == 0
 
 
 def test_budget_eviction(tmp_path):
@@ -273,16 +483,20 @@ def test_plan_scans_batch(tmp_table):
     queries = [
         ["a = 25"],                       # range -> resident path
         ["a >= 0 AND a <= 79"],           # range, 2 files
-        ["a = 1 OR a = 190"],             # OR -> per-query fallback
+        ["a = 1 OR a = 190"],             # OR -> union of boxes (r5)
         ["b IS NULL"],                    # null test -> fallback
     ]
     plans = plan_scans(snap, queries, k=8)
     assert plans[0].via in ("device", "host-resident")
-    assert plans[2].via == "scan" and plans[3].via == "scan"
+    assert plans[2].via in ("device", "host-resident")  # OR now lowers
+    assert plans[3].via == "scan"
     for q, plan in zip(queries, plans):
         expect = {f.path for f in scan_files(snap, q).files}
         assert expect <= set(plan.paths), q
         assert plan.count == len(plan.paths)
+    # OR union is exact here: equality boxes on both sides
+    or_expect = sorted(f.path for f in scan_files(snap, ["a = 1 OR a = 190"]).files)
+    assert sorted(plans[2].paths) == or_expect
 
 
 def test_plan_scans_forced_device_matches_host(tmp_table):

@@ -123,13 +123,26 @@ def test_pretty_printed_stats_fall_back(tmp_table):
     assert arr.stats_min["a"][0] == 1.0
 
 
-def test_partitioned_falls_back(tmp_table):
+def test_partitioned_vectorized_codes_match_dataclass(tmp_table):
+    """r5: the vectorized path carries partitioned tables too — codes and
+    dictionaries must decode the same values the dataclass path sees."""
     log = DeltaLog.for_table(tmp_table)
     commit_manually(log, 0, [init_metadata(
         partition_columns=["p"],
         schema=StructType().add("p", StringType()).add("a", IntegerType()))])
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    for p in ("x", "y", "x"):
+        WriteIntoDelta(log, "append", pa.table({
+            "p": [p] * 4, "a": np.arange(4, dtype=np.int32)})).run()
     snap = log.update()
-    assert arrays_from_columns(snap._columnar, snap._alive_mask, snap.metadata) is None
+    arr = arrays_from_columns(snap._columnar, snap._alive_mask, snap.metadata)
+    assert arr is not None and "p" in arr.partition_codes
+    got = {path: arr.partition_dicts["p"][code] if code >= 0 else None
+           for path, code in zip(arr.paths, arr.partition_codes["p"])}
+    expect = {f.path: (f.partition_values or {}).get("p")
+              for f in snap.all_files}
+    assert got == expect
 
 
 def test_row_order_unsorted_matches_rows(tmp_table):
