@@ -35,9 +35,15 @@ __all__ = ["DeltaTable", "DeltaMergeBuilder", "DeltaOptimizeBuilder"]
 class DeltaTable:
     """Programmatic handle on a Delta table (`tables.py:23`)."""
 
-    def __init__(self, delta_log: DeltaLog, alias: Optional[str] = None):
+    def __init__(self, delta_log: DeltaLog, alias: Optional[str] = None,
+                 default_version: Optional[int] = None,
+                 default_timestamp=None):
         self.delta_log = delta_log
         self._alias = alias
+        # pinned by `path@v123` / `path@yyyyMMddHHmmssSSS` identifiers:
+        # reads resolve here unless the call passes explicit options
+        self._default_version = default_version
+        self._default_timestamp = default_timestamp
 
     # -- constructors -----------------------------------------------------
 
@@ -45,6 +51,17 @@ class DeltaTable:
     def for_path(cls, path: str, store=None, clock=None) -> "DeltaTable":
         log = DeltaLog.for_table(path, store=store, clock=clock)
         if not log.table_exists:
+            # `path@v123` embedded time travel (`DeltaTimeTravelSpec.scala
+            # :137`): only when the literal path is not itself a table
+            from delta_tpu.log.deltalog import extract_path_time_travel
+
+            spec = extract_path_time_travel(path)
+            if spec is not None:
+                base, v, ts = spec
+                base_log = DeltaLog.for_table(base, store=store, clock=clock)
+                if base_log.table_exists:
+                    return cls(base_log, default_version=v,
+                               default_timestamp=ts)
             raise errors.not_a_delta_table(path)
         return cls(log)
 
@@ -105,7 +122,9 @@ class DeltaTable:
     # -- reads ------------------------------------------------------------
 
     def alias(self, name: str) -> "DeltaTable":
-        return DeltaTable(self.delta_log, alias=name)
+        return DeltaTable(self.delta_log, alias=name,
+                          default_version=self._default_version,
+                          default_timestamp=self._default_timestamp)
 
     def to_arrow(self, filters: Sequence[Union[str, ir.Expression]] = (),
                  columns: Optional[Sequence[str]] = None,
@@ -120,6 +139,9 @@ class DeltaTable:
                   timestamp: Optional[Union[str, int]] = None):
         # reads may serve within the staleness window (background refresh);
         # copy-like surfaces resolve their own snapshots synchronously
+        if version is None and timestamp is None:
+            version = self._default_version
+            timestamp = self._default_timestamp
         return self.delta_log.snapshot_for(version, timestamp, stale_ok=True)
 
     def plan_queries(self, queries, k: int = 256):
@@ -138,7 +160,7 @@ class DeltaTable:
                     "plan_queries takes a list of QUERIES, each a list of "
                     f"filters — wrap the filter in a list: [[{q!r}]]"
                 )
-        return plan_scans(self.delta_log.update(stale_ok=True), queries, k=k)
+        return plan_scans(self._snapshot(), queries, k=k)
 
     @property
     def version(self) -> int:
@@ -152,13 +174,24 @@ class DeltaTable:
     def write(self, data: Any, mode: str = "append", **options) -> int:
         return WriteIntoDelta(self.delta_log, mode, data, **options).run()
 
+    def _check_mutable(self, operation: str) -> None:
+        """DML on a `path@v` / `path@timestamp` pinned handle is rejected
+        (the reference refuses modification of time-travelled relations)."""
+        if self._default_version is not None or self._default_timestamp is not None:
+            raise errors.DeltaAnalysisError(
+                f"Cannot {operation} a time-travelled table handle: the "
+                "table was resolved with an embedded version/timestamp."
+            )
+
     def delete(self, condition: Optional[Union[str, ir.Expression]] = None) -> Dict[str, int]:
+        self._check_mutable("DELETE from")
         cmd = DeleteCommand(self.delta_log, condition)
         cmd.run()
         return cmd.metrics
 
     def update(self, set: Dict[str, Union[str, ir.Expression]],
                condition: Optional[Union[str, ir.Expression]] = None) -> Dict[str, int]:
+        self._check_mutable("UPDATE")
         cmd = UpdateCommand(self.delta_log, set, condition)
         cmd.run()
         return cmd.metrics
@@ -168,6 +201,7 @@ class DeltaTable:
 
     def merge(self, source: Any, condition: Union[str, ir.Expression],
               source_alias: Optional[str] = None) -> "DeltaMergeBuilder":
+        self._check_mutable("MERGE into")
         return DeltaMergeBuilder(
             self, source, condition,
             source_alias=source_alias, target_alias=self._alias,
@@ -177,6 +211,7 @@ class DeltaTable:
 
     def vacuum(self, retention_hours: Optional[float] = None,
                dry_run: bool = False, retention_check_enabled: bool = True):
+        self._check_mutable("VACUUM")
         return VacuumCommand(
             self.delta_log, retention_hours, dry_run=dry_run,
             retention_check_enabled=retention_check_enabled,
@@ -206,6 +241,7 @@ class DeltaTable:
         preserved). Beyond the reference — modern Delta's RESTORE TABLE."""
         from delta_tpu.commands.restore import RestoreCommand
 
+        self._check_mutable("RESTORE")
         cmd = RestoreCommand(self.delta_log, version=version)
         cmd.run()
         return cmd.metrics
@@ -213,6 +249,7 @@ class DeltaTable:
     def restore_to_timestamp(self, timestamp: Union[str, int]) -> Dict[str, int]:
         from delta_tpu.commands.restore import RestoreCommand
 
+        self._check_mutable("RESTORE")
         cmd = RestoreCommand(self.delta_log, timestamp=timestamp)
         cmd.run()
         return cmd.metrics
@@ -236,9 +273,11 @@ class DeltaTable:
         generate_full_manifest(self.delta_log)
 
     def optimize(self, predicate: Optional[str] = None) -> "DeltaOptimizeBuilder":
+        self._check_mutable("OPTIMIZE")
         return DeltaOptimizeBuilder(self, predicate)
 
     def upgrade_table_protocol(self, reader_version: int, writer_version: int) -> None:
+        self._check_mutable("upgrade the protocol of")
         self.delta_log.upgrade_protocol(
             Protocol(min_reader_version=reader_version, min_writer_version=writer_version)
         )

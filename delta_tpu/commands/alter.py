@@ -114,7 +114,11 @@ def add_columns(
     named nested struct; ``positions`` maps a field name to ``"first"`` or
     ``("after", sibling)`` within its parent (default: append at the end),
     matching the reference's FIRST/AFTER grammar."""
+    from delta_tpu.schema.char_varchar import replace_char_varchar_with_string
+
     positions = positions or {}
+    new_fields = list(
+        replace_char_varchar_with_string(StructType(list(new_fields))).fields)
 
     def body(txn):
         meta = txn.metadata

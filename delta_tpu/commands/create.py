@@ -56,6 +56,15 @@ class CreateDeltaTableCommand:
                 "CREATE TABLE requires a schema or data (CTAS)"
             )
         self.delta_log = delta_log
+        if schema is not None:
+            # char/varchar declare as STRING + type-string field metadata on
+            # the wire (CharVarcharUtils.scala:35-60); lengths enforce on
+            # every write (schema/char_varchar.py)
+            from delta_tpu.schema.char_varchar import (
+                replace_char_varchar_with_string,
+            )
+
+            schema = replace_char_varchar_with_string(schema)
         self.schema = schema
         self.mode = mode
         self.partition_columns = list(partition_columns)

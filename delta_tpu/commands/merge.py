@@ -172,6 +172,11 @@ class MergeIntoCommand:
         for c in self.not_matched_clauses:
             if c.kind != "insert":
                 raise errors_mod.invalid_merge_clause(c.kind, matched=False)
+        for c in self.matched_clauses:
+            if c.kind == "delete" and c.assignments:
+                raise DeltaAnalysisError(
+                    "DELETE clauses cannot carry SET assignments"
+                )
         # only the last clause of each group may lack a condition
         for group in (self.matched_clauses, self.not_matched_clauses):
             for c in group[:-1]:
@@ -180,6 +185,44 @@ class MergeIntoCommand:
                         "When there are more than one MATCHED/NOT MATCHED clauses, "
                         "only the last can omit its condition"
                     )
+        # duplicate assignment targets within one clause (case-insensitive)
+        for group in (self.matched_clauses, self.not_matched_clauses):
+            for c in group:
+                if not c.assignments:
+                    continue
+                seen = set()
+                for col in c.assignments:
+                    low = col.split(".")[-1].lower()
+                    if low in seen:
+                        raise errors_mod.merge_conflicting_set_columns(col)
+                    seen.add(low)
+
+    def _analyze_clauses(self, target_cols, source_cols) -> None:
+        """Post-schema-resolution clause validation: every clause condition
+        and assignment must resolve, insert conditions see only the source,
+        and assignment targets must be real target columns."""
+        t_low = {c.lower() for c in target_cols}
+        for clause in self.matched_clauses:
+            if clause.condition is not None:
+                self._resolve(clause.condition, target_cols, source_cols)
+            if clause.assignments:
+                for col, e in clause.assignments.items():
+                    name = col.split(".")[-1]
+                    if name.lower() not in t_low:
+                        raise errors_mod.merge_unresolvable_column(
+                            col, target_cols, [])
+                    self._resolve(e, target_cols, source_cols)
+        for clause in self.not_matched_clauses:
+            if clause.condition is not None:
+                # NOT MATCHED: there is no target row to reference
+                self._resolve(clause.condition, [], source_cols)
+            if clause.assignments:
+                for col, e in clause.assignments.items():
+                    name = col.split(".")[-1]
+                    if name.lower() not in t_low:
+                        raise errors_mod.merge_unresolvable_column(
+                            col, target_cols, [])
+                    self._resolve(e, [], source_cols)
 
     def _migrate_schema(self, txn):
         """MERGE schema evolution (`deltaMerge.scala:224-424`,
@@ -300,6 +343,10 @@ class MergeIntoCommand:
             if clause.is_star:
                 self._check_star_coverage(target_cols, source_cols, "INSERT", metadata)
                 break
+        # static clause analysis (the reference rejects these shapes at
+        # analysis time regardless of which rows fire,
+        # `deltaMerge.scala:161-221` resolution errors)
+        self._analyze_clauses(target_cols, source_cols)
         cond = self._resolve(self.condition, target_cols, source_cols)
         equi, residual = self._split_equi_keys(cond)
 
@@ -647,22 +694,35 @@ class MergeIntoCommand:
             # or every downstream mask/projection/encode pays per-chunk costs
             joined = joined.combine_chunks()
         else:
-            # general condition: cartesian pairing (small sources only)
-            if target.num_rows * src.num_rows > 50_000_000:
-                raise DeltaUnsupportedOperationError(
-                    "Non-equi MERGE condition over large inputs"
-                )
-            t_idx = pa.array(
-                [i for i in range(target.num_rows) for _ in range(src.num_rows)],
-                pa.int64(),
-            )
-            s_idx = pa.array(
-                list(range(src.num_rows)) * target.num_rows, pa.int64()
-            )
-            joined = target.take(t_idx)
-            s_taken = src.take(s_idx)
-            for name in s_taken.column_names:
-                joined = joined.append_column(name, s_taken.column(name))
+            # general condition: BLOCKED cartesian pairing — tile the
+            # target x source grid and stream each tile through the clause
+            # condition immediately, so peak memory is one tile of pairs
+            # (`delta.tpu.merge.nonEquiPairBudget`) regardless of input
+            # sizes. The reference handles arbitrary conditions via a real
+            # join (`MergeIntoCommand.scala:335-341`); this is the bounded
+            # equivalent for a columnar engine without a theta-join kernel.
+            budget = int(conf.get("delta.tpu.merge.nonEquiPairBudget",
+                                  8_000_000))
+            m = src.num_rows
+            tile = max(budget // max(m, 1), 1)
+            cond = ir.and_all(residual) if residual else None
+            pieces = []
+            s_base = np.tile(np.arange(m, dtype=np.int64), tile)
+            for t0 in range(0, target.num_rows, tile):
+                rows = min(tile, target.num_rows - t0)
+                t_idx = np.repeat(np.arange(t0, t0 + rows, dtype=np.int64), m)
+                piece = target.take(pa.array(t_idx, pa.int64()))
+                s_taken = src.take(pa.array(s_base[: rows * m], pa.int64()))
+                for name in s_taken.column_names:
+                    piece = piece.append_column(name, s_taken.column(name))
+                if cond is not None:
+                    piece = piece.filter(boolean_mask(cond, piece))
+                if piece.num_rows:
+                    pieces.append(piece.combine_chunks())
+            joined = (pa.concat_tables(pieces).combine_chunks()
+                      if pieces else empty_pairs())
+            self.phase_ms["join_ms"] = join_t.lap_ms()
+            return joined, tgt_tables
         if residual:
             joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
         self.phase_ms["join_ms"] = join_t.lap_ms()

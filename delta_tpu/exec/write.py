@@ -186,6 +186,11 @@ def write_files(
     # per-chunk costs the Parquet encoder pays on fragmented columns.
     if table.num_columns and table.column(0).num_chunks > 4:
         table = table.combine_chunks()
+    # char/varchar write semantics: pad char(n) to width, enforce length
+    # bounds (CharVarcharUtils.scala write-side behavior)
+    from delta_tpu.schema import char_varchar
+
+    table = char_varchar.apply_write_semantics(table, metadata)
     if constraints is None:
         constraints = constraints_mod.from_metadata(metadata)
     constraints_mod.enforce(constraints, table)

@@ -38,7 +38,37 @@ from delta_tpu.utils.errors import (
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["DeltaLog"]
+__all__ = ["DeltaLog", "extract_path_time_travel"]
+
+# path-embedded time travel (`DeltaTimeTravelSpec.scala:137` /
+# `DeltaTableUtils.extractIfPathContainsTimeTravel`): `/t@v123` pins a
+# version, `/t@yyyyMMddHHmmssSSS` (17 digits) pins a timestamp
+import re as _re
+
+_TT_SUFFIX = _re.compile(r"^(?P<base>.+)@(?:[vV](?P<ver>\d+)|(?P<ts>\d{17}))$")
+
+
+def extract_path_time_travel(path: str):
+    """(base_path, version, timestamp_ms) when ``path`` carries an embedded
+    time-travel suffix, else None. Callers apply it only when the literal
+    path is NOT itself a Delta table (a directory literally named ``t@v1``
+    wins, matching the reference's resolution order)."""
+    m = _TT_SUFFIX.match(path.rstrip("/"))
+    if not m:
+        return None
+    base = m.group("base")
+    if m.group("ver") is not None:
+        return base, int(m.group("ver")), None
+    import datetime as _dt
+
+    s = m.group("ts")
+    try:
+        d = _dt.datetime.strptime(s[:14], "%Y%m%d%H%M%S").replace(
+            tzinfo=_dt.timezone.utc)
+    except ValueError:
+        return None
+    ts_ms = int(d.timestamp() * 1000) + int(s[14:])
+    return base, None, ts_ms
 
 
 class DeltaLog:

@@ -273,10 +273,11 @@ def _resident_scan(
         bump_counter("stateCache.scan.fallback.lowering")
         return None
     n_main = len(terms)
-    if partition_filters:
+    if partition_filters and data_filters:
         # partition-only leg: same lanes, stats bounds dropped — one batch,
         # one dispatch; feeds the DataSize the scan reports for the
-        # partition-pruning stage
+        # partition-pruning stage. (Pure-partition queries skip it: the
+        # main leg IS the partition leg.)
         ppred = skipping_predicate(ir.and_all(list(partition_filters)), pcols)
         pterms = extract_range_union(ppred, entry.columns, entry.part_info,
                                      str_lanes=entry.str_lanes)
@@ -305,7 +306,7 @@ def _resident_scan(
     n_alive = int(alive.sum())
     total = DataSize(bytes_compressed=total_bytes, files=n_alive)
     if partition_filters:
-        prows = _union(plans[n_main:])
+        prows = _union(plans[n_main:]) if data_filters else rows
         partition = DataSize(
             bytes_compressed=int(sizes[prows].sum()), files=len(prows))
     else:

@@ -387,19 +387,36 @@ def arrays_from_columns(
         compact = compact.combine_chunks()
     if len(compact) == 0:
         return out
-    # newline-join the 1M stats strings in ONE C++ kernel (a ListArray
-    # wrapping the whole column, then binary_join) — the old
-    # to_pylist + "\n".join round-tripped every string through Python
-    # objects and dominated the cold cache build
-    lst = pa.ListArray.from_arrays(
-        pa.array([0, len(compact)], pa.int32()), compact.cast(pa.string()))
-    joined = pc.binary_join(lst, "\n")
-    raw = joined.cast(pa.binary())[0].as_buffer()
+    # newline-join the stats strings in C++ (a ListArray wrapping a slice
+    # of the column, then binary_join) — the old to_pylist + "\n".join
+    # round-tripped every string through Python objects and dominated the
+    # cold cache build. Joins run in <=1 GiB slices: one giant join would
+    # hit Arrow's 2 GiB int32 offset capacity on ~10M-file tables.
     try:
-        parsed = pajson.read_json(
-            pa.BufferReader(raw),
-            read_options=pajson.ReadOptions(use_threads=True, block_size=8 << 20),
-        )
+        parts = []
+        total = len(compact)
+        start = 0
+        budget = 1 << 30
+        offs = np.frombuffer(compact.buffers()[1], np.int32,
+                             count=total + 1, offset=compact.offset * 4)
+        while start < total:
+            end = start + 1
+            base = offs[start]
+            while end < total and offs[end + 1] - base <= budget:
+                end += 1
+            sl = compact.slice(start, end - start)
+            sl = pa.concat_arrays([sl])  # re-materialize exact offsets
+            lst = pa.ListArray.from_arrays(
+                pa.array([0, len(sl)], pa.int32()), sl.cast(pa.string()))
+            raw = pc.binary_join(lst, "\n").cast(pa.binary())[0].as_buffer()
+            parts.append(pajson.read_json(
+                pa.BufferReader(raw),
+                read_options=pajson.ReadOptions(use_threads=True,
+                                                block_size=8 << 20),
+            ))
+            start = end
+        parsed = (parts[0] if len(parts) == 1
+                  else pa.concat_tables(parts, promote_options="permissive"))
     except Exception:
         return out  # malformed stats anywhere → all-missing (keeps every file)
     if parsed.num_rows != len(idx):

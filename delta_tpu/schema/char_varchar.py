@@ -1,0 +1,119 @@
+"""Char/Varchar semantics — the analogue of `CharVarcharUtils.scala`.
+
+The Delta wire format has no char/varchar types: the reference replaces
+them with STRING and records the declared type in the StructField metadata
+under ``__CHAR_VARCHAR_TYPE_STRING`` (`CharVarcharUtils.scala:35-60`), then
+enforces lengths on the write path. This module does the same for the
+engine-native schema machinery:
+
+  - :func:`replace_char_varchar_with_string` — wire-form conversion at
+    table creation / column addition;
+  - :func:`raw_type` — recover the declared char/varchar type of a field;
+  - :func:`apply_write_semantics` — the write-path step: space-pad char
+    values to their declared length, then reject any value longer than
+    the bound (character count, like the reference).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+
+from delta_tpu.schema.types import (
+    CharType,
+    DataType,
+    StringType,
+    StructField,
+    StructType,
+    VarcharType,
+    parse_data_type,
+)
+from delta_tpu.utils import errors
+
+__all__ = [
+    "CHAR_VARCHAR_TYPE_STRING_METADATA_KEY",
+    "replace_char_varchar_with_string",
+    "raw_type",
+    "apply_write_semantics",
+]
+
+# the reference's metadata key, byte-compatible (`CharVarcharUtils.scala:38`)
+CHAR_VARCHAR_TYPE_STRING_METADATA_KEY = "__CHAR_VARCHAR_TYPE_STRING"
+
+
+def replace_char_varchar_with_string(schema: StructType) -> StructType:
+    """Top-level char/varchar fields become STRING + type-string metadata
+    (nested struct/array/map chars are not supported, matching the subset
+    the standalone engine writes)."""
+    fields: List[StructField] = []
+    for f in schema.fields:
+        if isinstance(f.data_type, (CharType, VarcharType)):
+            md = dict(f.metadata or {})
+            md[CHAR_VARCHAR_TYPE_STRING_METADATA_KEY] = f.data_type.name
+            fields.append(StructField(f.name, StringType(), f.nullable, md))
+        else:
+            fields.append(f)
+    return StructType(fields)
+
+
+def raw_type(field: StructField) -> DataType:
+    """The field's DECLARED type: char/varchar recovered from metadata,
+    otherwise the stored type."""
+    ts = (field.metadata or {}).get(CHAR_VARCHAR_TYPE_STRING_METADATA_KEY)
+    if ts:
+        try:
+            dt = parse_data_type(ts)
+        except ValueError:
+            return field.data_type
+        if isinstance(dt, (CharType, VarcharType)):
+            return dt
+    return field.data_type
+
+
+def _bounded_fields(schema: StructType):
+    for f in schema.fields:
+        dt = raw_type(f)
+        if isinstance(dt, (CharType, VarcharType)):
+            yield f, dt
+
+
+def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
+    """Write-path char/varchar step over a batch:
+
+    - char(n): values space-pad on the right to exactly n characters
+      (`CharVarcharUtils` readSidePadding done write-side here — the data
+      file then carries the padded form, so every reader agrees);
+    - both: any value longer than n characters raises the reference's
+      length-violation error.
+    """
+    import pyarrow.compute as pc
+
+    schema: StructType = metadata.schema
+    for f, dt in _bounded_fields(schema):
+        name = _find_col(table, f.name)
+        if name is None:
+            continue
+        col = table.column(name)
+        if not pa.types.is_string(col.type):
+            continue
+        lens = pc.utf8_length(col)
+        too_long = pc.any(pc.greater(lens, dt.length)).as_py()
+        if too_long:
+            bad = table.filter(pc.greater(lens, dt.length))
+            sample = bad.column(name)[0].as_py()
+            raise errors.char_varchar_length_exceeded(
+                f.name, dt.name, dt.length, sample)
+        if isinstance(dt, CharType):
+            padded = pc.utf8_rpad(col, width=dt.length, padding=" ")
+            # nulls stay null (utf8_rpad preserves them)
+            table = table.set_column(
+                table.column_names.index(name),
+                pa.field(name, pa.string(), f.nullable), padded)
+    return table
+
+
+def _find_col(table: pa.Table, name: str) -> Optional[str]:
+    for c in table.column_names:
+        if c.lower() == name.lower():
+            return c
+    return None

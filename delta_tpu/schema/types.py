@@ -37,6 +37,8 @@ __all__ = [
     "TimestampType",
     "DecimalType",
     "NullType",
+    "CharType",
+    "VarcharType",
 ]
 
 
@@ -103,6 +105,42 @@ class DoubleType(AtomicType):
 
 class StringType(AtomicType):
     name = "string"
+
+
+class CharType(AtomicType):
+    """Fixed-length character type (`CharVarcharUtils.scala`). Stored in
+    table metadata as STRING plus the `__CHAR_VARCHAR_TYPE_STRING` field
+    metadata (the reference's wire form); values are space-padded to
+    ``length`` on write and length-enforced."""
+
+    def __init__(self, length: int):
+        if length < 1:
+            raise ValueError("char length must be >= 1")
+        self.length = length
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"char({self.length})"
+
+    def __repr__(self) -> str:
+        return f"CharType({self.length})"
+
+
+class VarcharType(AtomicType):
+    """Bounded-length character type: stored as STRING + field metadata;
+    writes longer than ``length`` characters are rejected."""
+
+    def __init__(self, length: int):
+        if length < 1:
+            raise ValueError("varchar length must be >= 1")
+        self.length = length
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"varchar({self.length})"
+
+    def __repr__(self) -> str:
+        return f"VarcharType({self.length})"
 
 
 class BinaryType(AtomicType):
@@ -261,6 +299,8 @@ _ATOMIC_ALIASES = {
 }
 
 _DECIMAL_RE = re.compile(r"decimal\(\s*(\d+)\s*,\s*(-?\d+)\s*\)")
+_CHAR_RE = re.compile(r"char\(\s*(\d+)\s*\)")
+_VARCHAR_RE = re.compile(r"varchar\(\s*(\d+)\s*\)")
 
 
 def parse_data_type(obj: Any) -> DataType:
@@ -274,6 +314,12 @@ def parse_data_type(obj: Any) -> DataType:
             return DecimalType(int(m.group(1)), int(m.group(2)))
         if s == "decimal":
             return DecimalType(10, 0)
+        m = _CHAR_RE.fullmatch(s)
+        if m:
+            return CharType(int(m.group(1)))
+        m = _VARCHAR_RE.fullmatch(s)
+        if m:
+            return VarcharType(int(m.group(1)))
         raise ValueError(f"Unsupported data type: {obj!r}")
     if isinstance(obj, dict):
         t = obj.get("type")
@@ -331,7 +377,7 @@ def to_arrow_type(dt: DataType):
         return pa.float32()
     if isinstance(dt, DoubleType):
         return pa.float64()
-    if isinstance(dt, StringType):
+    if isinstance(dt, (StringType, CharType, VarcharType)):
         return pa.string()
     if isinstance(dt, BinaryType):
         return pa.binary()

@@ -53,6 +53,12 @@ def _make_type(name: str, args: List[str]):
         except ValueError:
             raise errors.sql_invalid_decimal(args)
         return T.DecimalType(p, s)
+    if low in ("char", "varchar") and args:
+        try:
+            n = int(args[0])
+        except ValueError:
+            raise errors.sql_unsupported_type(f"{name}({args[0]})")
+        return T.CharType(n) if low == "char" else T.VarcharType(n)
     cls = _TYPES.get(low)
     if cls is None:
         raise errors.sql_unsupported_type(name)
@@ -432,7 +438,19 @@ def _select(p: _Parser):
         from delta_tpu.expr.vectorized import evaluate
 
         log = _log_for(path)
-        snap = log.snapshot_for(version, timestamp)
+        sel_version, sel_timestamp = version, timestamp
+        if not log.table_exists and path[0] == "path":
+            # `delta.\`/t@v3\`` embedded time travel (reads only)
+            from delta_tpu.log.deltalog import extract_path_time_travel
+
+            spec = extract_path_time_travel(path[1])
+            if spec is not None:
+                base_log = DeltaLog.for_table(spec[0])
+                if base_log.table_exists:
+                    log = base_log
+                    if sel_version is None and sel_timestamp is None:
+                        sel_version, sel_timestamp = spec[1], spec[2]
+        snap = log.snapshot_for(sel_version, sel_timestamp)
         schema_cols = [f.name for f in snap.metadata.schema.fields]
         lower = {c.lower(): c for c in schema_cols}
         parsed_items = None

@@ -294,6 +294,23 @@ def new_check_constraint_violated(num: int, table: str, expr: str) -> DeltaAnaly
     )
 
 
+def merge_conflicting_set_columns(column: str) -> DeltaAnalysisError:
+    return DeltaAnalysisError(
+        f"There is a conflict from these SET columns: duplicate assignment "
+        f"to {column!r}."
+    )
+
+
+def char_varchar_length_exceeded(
+    column: str, declared: str, limit: int, sample
+) -> InvariantViolationError:
+    return InvariantViolationError(
+        f"Exceeds char/varchar type length limitation: column {column} is "
+        f"declared {declared} but value {sample!r} is longer than {limit} "
+        "characters."
+    )
+
+
 def replace_where_mismatch(replace_where: str, detail: str) -> DeltaAnalysisError:
     return DeltaAnalysisError(
         f"Data written out does not match replaceWhere '{replace_where}'.\n"

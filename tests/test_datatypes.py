@@ -199,3 +199,135 @@ def test_nested_struct_merge_values(tmp_table):
     assert [r["s"] for r in got] == [
         {"x": 1, "y": "a"}, {"x": 20, "y": "B"}, {"x": 30, "y": "C"}
     ]
+
+
+# -- char/varchar (CharVarcharUtils.scala semantics) ------------------------
+
+
+def test_char_varchar_wire_form_and_roundtrip(tmp_table):
+    """char/varchar declare as STRING + __CHAR_VARCHAR_TYPE_STRING field
+    metadata on the wire; the declared type is recoverable."""
+    from delta_tpu.schema.char_varchar import (
+        CHAR_VARCHAR_TYPE_STRING_METADATA_KEY, raw_type,
+    )
+    from delta_tpu.schema.types import (
+        CharType, LongType, StringType, StructType, VarcharType,
+    )
+
+    schema = (StructType().add("id", LongType()).add("c", CharType(4))
+              .add("v", VarcharType(6)))
+    t = DeltaTable.create(tmp_table, schema)
+    stored = t.delta_log.update().metadata.schema
+    by_name = {f.name: f for f in stored.fields}
+    assert isinstance(by_name["c"].data_type, StringType)
+    assert by_name["c"].metadata[CHAR_VARCHAR_TYPE_STRING_METADATA_KEY] == "char(4)"
+    assert by_name["v"].metadata[CHAR_VARCHAR_TYPE_STRING_METADATA_KEY] == "varchar(6)"
+    assert raw_type(by_name["c"]) == CharType(4)
+    assert raw_type(by_name["v"]) == VarcharType(6)
+
+
+def test_char_pads_and_varchar_rejects(tmp_table):
+    from delta_tpu.schema.types import CharType, LongType, StructType, VarcharType
+    from delta_tpu.utils.errors import InvariantViolationError
+
+    schema = (StructType().add("id", LongType()).add("c", CharType(4))
+              .add("v", VarcharType(3)))
+    t = DeltaTable.create(tmp_table, schema)
+    t.delta_log  # create ok
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    WriteIntoDelta(t.delta_log, "append", pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "c": pa.array(["ab", None], pa.string()),
+        "v": pa.array(["xyz", "ab"], pa.string()),
+    })).run()
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got[0]["c"] == "ab  "  # char pads to width
+    assert got[1]["c"] is None    # nulls stay null
+    assert got[0]["v"] == "xyz"   # varchar stores as-is within bound
+    # varchar over the bound rejects
+    with pytest.raises(InvariantViolationError, match="length limitation"):
+        WriteIntoDelta(t.delta_log, "append", pa.table({
+            "id": pa.array([3], pa.int64()),
+            "v": pa.array(["toolong"], pa.string()),
+            "c": pa.array(["a"], pa.string()),
+        })).run()
+    # char over the bound rejects too
+    with pytest.raises(InvariantViolationError, match="length limitation"):
+        WriteIntoDelta(t.delta_log, "append", pa.table({
+            "id": pa.array([4], pa.int64()),
+            "v": pa.array(["ok"], pa.string()),
+            "c": pa.array(["abcde"], pa.string()),
+        })).run()
+
+
+def test_char_varchar_sql_create_and_enforce(tmp_path):
+    from delta_tpu.sql.parser import execute_sql
+    from delta_tpu.utils.errors import DeltaError
+
+    path = str(tmp_path / "cv")
+    execute_sql(f"CREATE TABLE delta.`{path}` (id BIGINT, c CHAR(3), v VARCHAR(5))")
+    execute_sql(f"INSERT INTO delta.`{path}` VALUES (1, 'ab', 'hello')")
+    t = execute_sql(f"SELECT c, v FROM delta.`{path}`")
+    assert t.column("c").to_pylist() == ["ab "]
+    with pytest.raises(DeltaError, match="length limitation"):
+        execute_sql(f"INSERT INTO delta.`{path}` VALUES (2, 'ab', 'toolongg')")
+
+
+# -- path-embedded time travel (DeltaTimeTravelSpec.scala:137) --------------
+
+
+def test_path_at_version_identifier(tmp_table):
+    import numpy as np
+
+    t = DeltaTable.create(tmp_table, data=pa.table({
+        "a": pa.array([1, 2], pa.int64())}))
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    WriteIntoDelta(t.delta_log, "append",
+                   pa.table({"a": pa.array([3], pa.int64())})).run()
+    pinned = DeltaTable.for_path(f"{tmp_table}@v0")
+    assert sorted(pinned.to_arrow().column("a").to_pylist()) == [1, 2]
+    latest = DeltaTable.for_path(tmp_table)
+    assert sorted(latest.to_arrow().column("a").to_pylist()) == [1, 2, 3]
+    # explicit options override the pinned default
+    assert sorted(pinned.to_arrow(version=1).column("a").to_pylist()) == [1, 2, 3]
+    # SQL form
+    from delta_tpu.sql.parser import execute_sql
+
+    out = execute_sql(f"SELECT a FROM delta.`{tmp_table}@v0`")
+    assert sorted(out.column("a").to_pylist()) == [1, 2]
+
+
+def test_path_at_timestamp_identifier(tmp_table):
+    import datetime as dt
+
+    t = DeltaTable.create(tmp_table, data=pa.table({
+        "a": pa.array([1], pa.int64())}))
+    # timestamp far in the future resolves to the latest commit
+    future = (dt.datetime.now(dt.timezone.utc) + dt.timedelta(days=1))
+    stamp = future.strftime("%Y%m%d%H%M%S") + "000"
+    pinned = DeltaTable.for_path(f"{tmp_table}@{stamp}")
+    assert pinned.to_arrow().column("a").to_pylist() == [1]
+
+
+def test_literal_at_path_wins_over_time_travel(tmp_path):
+    # a directory literally named "t@v0" resolves as itself
+    p = str(tmp_path / "t@v0")
+    t = DeltaTable.create(p, data=pa.table({"a": pa.array([7], pa.int64())}))
+    assert DeltaTable.for_path(p).to_arrow().column("a").to_pylist() == [7]
+
+
+def test_pinned_handle_rejects_dml(tmp_table):
+    from delta_tpu.utils.errors import DeltaAnalysisError
+
+    DeltaTable.create(tmp_table, data=pa.table({"a": pa.array([1], pa.int64())}))
+    pinned = DeltaTable.for_path(f"{tmp_table}@v0")
+    with pytest.raises(DeltaAnalysisError, match="time-travelled"):
+        pinned.delete("a > 0")
+    with pytest.raises(DeltaAnalysisError, match="time-travelled"):
+        pinned.update({"a": "2"})
+    with pytest.raises(DeltaAnalysisError, match="time-travelled"):
+        pinned.optimize()
+    # reads still work
+    assert pinned.to_arrow().num_rows == 1
