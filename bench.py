@@ -914,10 +914,52 @@ def bench_merge_scale(workdir):
     src1 = mk_source(31, n_target * 4)
     cold_s, cold = _timed(lambda: run_merge(src1))
     del src1
-    src2 = mk_source(37, n_target * 5)
-    steady_s, steady = _timed(lambda: run_merge(src2))
-    src_gb = src2.nbytes / 1e9
-    del src2
+
+    # steady state needs the resident key lane UP: wait for the background
+    # build the cold merge kicked off (a projected read of every file's
+    # keys — ~a minute of IO at this scale), then ship it to HBM and sort
+    # it explicitly so the timed leg measures the steady probe, not the
+    # one-time residency cost (reported separately here)
+    import jax
+
+    from delta_tpu.ops.key_cache import KeyCache
+
+    with conf.set_temporarily(**{
+            "delta.tpu.keyCache.maxBytes": str(8 << 30)}):
+        t0 = time.perf_counter()
+        entry = None
+        while time.perf_counter() - t0 < 900:
+            with KeyCache.instance()._lock:
+                cands = [e for (k, e) in KeyCache.instance()._entries.items()
+                         if k[0] == log.log_path]
+            if cands:
+                entry = cands[0]
+                break
+            time.sleep(2)
+        build_wait_s = time.perf_counter() - t0
+        residency_upload_s = probe_warm_s = None
+        if entry is not None:
+            t0 = time.perf_counter()
+            entry.ensure_resident()
+            with entry._lock:
+                entry._ensure_sorted()
+            jax.block_until_ready(entry._dev["sorted_keys"])
+            np.asarray(entry._dev["sorted_keys"][:8])  # force completion
+            residency_upload_s = time.perf_counter() - t0
+            # absorb the per-shape probe compile outside the timed leg
+            t0 = time.perf_counter()
+            warm = entry.probe_async(
+                np.zeros(n_source, np.int64), np.ones(n_source, bool))
+            if warm is not None:
+                try:
+                    warm.result()
+                except Exception:
+                    pass
+            probe_warm_s = time.perf_counter() - t0
+        src2 = mk_source(37, n_target * 5)
+        steady_s, steady = _timed(lambda: run_merge(src2))
+        src_gb = src2.nbytes / 1e9
+        del src2
     peak_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     return {
         "metric": "merge_upsert_100M_rows_10GB_class",
@@ -932,6 +974,11 @@ def bench_merge_scale(workdir):
         "table_build_s": round(build_s, 1),
         "cold_merge_s": round(cold_s, 1),
         "steady_merge_s": round(steady_s, 1),
+        "resident_build_wait_s": round(build_wait_s, 1),
+        "residency_upload_s": (round(residency_upload_s, 1)
+                               if residency_upload_s is not None else None),
+        "probe_compile_warm_s": (round(probe_warm_s, 1)
+                                 if probe_warm_s is not None else None),
         "cold_join_path": cold._join_path,
         "steady_join_path": steady._join_path,
         "cold_phases_ms": {k: round(v, 0) for k, v in cold.phase_ms.items()},

@@ -164,14 +164,15 @@ class DeltaTable:
 
     @property
     def version(self) -> int:
-        return self.delta_log.update().version
+        return self._snapshot().version
 
     def schema(self) -> StructType:
-        return self.delta_log.update().metadata.schema
+        return self._snapshot().metadata.schema
 
     # -- writes -----------------------------------------------------------
 
     def write(self, data: Any, mode: str = "append", **options) -> int:
+        self._check_mutable("write to")
         return WriteIntoDelta(self.delta_log, mode, data, **options).run()
 
     def _check_mutable(self, operation: str) -> None:

@@ -55,6 +55,10 @@ class DeleteCommand:
 
     def _perform_delete(self, txn, timer: Timer) -> List[Action]:
         metadata = txn.metadata
+        if self.condition is not None:
+            from delta_tpu.schema.char_varchar import pad_char_literals
+
+            self.condition = pad_char_literals(self.condition, metadata)
         pcols = metadata.partition_columns
 
         if self.condition is None:

@@ -343,6 +343,16 @@ class MergeIntoCommand:
             if clause.is_star:
                 self._check_star_coverage(target_cols, source_cols, "INSERT", metadata)
                 break
+        # read-side char padding on the merge condition and clause
+        # conditions (literals vs char(n) target columns)
+        from delta_tpu.schema.char_varchar import pad_char_literals
+
+        self.condition = pad_char_literals(self.condition, metadata)
+        self.matched_clauses = [
+            MergeClause(c.kind, pad_char_literals(c.condition, metadata)
+                        if c.condition is not None else None, c.assignments)
+            for c in self.matched_clauses
+        ]
         # static clause analysis (the reference rejects these shapes at
         # analysis time regardless of which rows fire,
         # `deltaMerge.scala:161-221` resolution errors)

@@ -66,6 +66,10 @@ class UpdateCommand:
                 raise errors.update_column_not_found(col)
 
         timer = Timer()
+        if self.condition is not None:
+            from delta_tpu.schema.char_varchar import pad_char_literals
+
+            self.condition = pad_char_literals(self.condition, metadata)
         use_dv = dv_enabled(metadata)
         use_cdf = cdf.cdf_enabled(metadata)
         cdf_blocks = []

@@ -349,6 +349,11 @@ def _files_for_scan_impl(
     keep_num_indexed_cols: Optional[int],
 ) -> DeltaScan:
     metadata = snapshot.metadata
+    # read-side char padding (ApplyCharTypePadding): literals compared to
+    # char(n) columns pad to width, so they match the stored padded form
+    from delta_tpu.schema.char_varchar import pad_char_literals
+
+    filters = [pad_char_literals(f, metadata) for f in filters]
     part_schema = metadata.partition_schema
     part_cols = metadata.partition_columns
     partition_filters: List[ir.Expression] = []

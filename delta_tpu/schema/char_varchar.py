@@ -80,11 +80,14 @@ def _bounded_fields(schema: StructType):
 def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
     """Write-path char/varchar step over a batch:
 
+    - over-length values first shed TRAILING SPACES down to the bound
+      (the reference's char/varcharTypeWriteSideCheck trims before
+      erroring — right-padded fixed-width feed data must keep working);
+    - any value still longer than n characters raises the reference's
+      length-violation error;
     - char(n): values space-pad on the right to exactly n characters
       (`CharVarcharUtils` readSidePadding done write-side here — the data
-      file then carries the padded form, so every reader agrees);
-    - both: any value longer than n characters raises the reference's
-      length-violation error.
+      file then carries the padded form, so every reader agrees).
     """
     import pyarrow.compute as pc
 
@@ -97,12 +100,20 @@ def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
         if not pa.types.is_string(col.type):
             continue
         lens = pc.utf8_length(col)
-        too_long = pc.any(pc.greater(lens, dt.length)).as_py()
-        if too_long:
-            bad = table.filter(pc.greater(lens, dt.length))
-            sample = bad.column(name)[0].as_py()
-            raise errors.char_varchar_length_exceeded(
-                f.name, dt.name, dt.length, sample)
+        over = pc.greater(lens, dt.length)
+        if pc.any(over).as_py():
+            # trailing spaces beyond the bound trim away before judgment
+            trimmed = pc.utf8_rtrim(col, characters=" ")
+            col = pc.if_else(over, trimmed, col)
+            lens = pc.utf8_length(col)
+            over = pc.greater(lens, dt.length)
+            if pc.any(over).as_py():
+                sample = pa.table({name: col}).filter(over).column(name)[0].as_py()
+                raise errors.char_varchar_length_exceeded(
+                    f.name, dt.name, dt.length, sample)
+            table = table.set_column(
+                table.column_names.index(name),
+                pa.field(name, pa.string(), f.nullable), col)
         if isinstance(dt, CharType):
             padded = pc.utf8_rpad(col, width=dt.length, padding=" ")
             # nulls stay null (utf8_rpad preserves them)
@@ -110,6 +121,53 @@ def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
                 table.column_names.index(name),
                 pa.field(name, pa.string(), f.nullable), padded)
     return table
+
+
+def pad_char_literals(expr, metadata):
+    """Read-side char padding (the reference's `ApplyCharTypePadding`):
+    string literals compared against a char(n) column pad to width n, so
+    `c = 'ab'` matches the stored 'ab   '. Applies to =, <, <=, >, >=, IN
+    with a char column on either side; other shapes pass through."""
+    from delta_tpu.expr import ir
+
+    schema: StructType = metadata.schema
+    widths = {}
+    for f in schema.fields:
+        dt = raw_type(f)
+        if isinstance(dt, CharType):
+            widths[f.name.lower()] = dt.length
+
+    if not widths:
+        return expr
+
+    def width_of(node) -> Optional[int]:
+        if not isinstance(node, ir.Column):
+            return None
+        # alias-qualified references ("t.c") pad too: the suffix names the
+        # column; a false positive would only pad a literal compared to a
+        # non-char column of the same name, which other layers reject
+        name = node.name.lower().rsplit(".", 1)[-1]
+        return widths.get(name)
+
+    def pad(lit, n: int):
+        if isinstance(lit, ir.Literal) and isinstance(lit.value, str) \
+                and len(lit.value) < n:
+            return ir.Literal(lit.value.ljust(n))
+        return lit
+
+    def rewrite(node):
+        t = type(node)
+        if t in (ir.Eq, ir.Lt, ir.Le, ir.Gt, ir.Ge):
+            n = width_of(node.left) or width_of(node.right)
+            if n:
+                return t(pad(node.left, n), pad(node.right, n))
+        if t is ir.In:
+            n = width_of(node.value)
+            if n:
+                return ir.In(node.value, tuple(pad(o, n) for o in node.options))
+        return None
+
+    return expr.transform(rewrite)
 
 
 def _find_col(table: pa.Table, name: str) -> Optional[str]:

@@ -1,0 +1,254 @@
+"""Remaining SchemaUtilsSuite scenario families — duplicate detection at
+every nesting depth (double-nested structs, arrays-of-arrays, map keys AND
+values), dots/backtick-quoted names as NON-duplicates, case-sensitivity
+variants, normalize-ordering, and merge upcast matrices — re-expressed
+against `schema/schema_utils.py` (reference:
+`schema/SchemaUtilsSuite.scala`, 1,311 LoC)."""
+import pytest
+
+from delta_tpu.schema import schema_utils as su
+from delta_tpu.schema.types import (
+    ArrayType,
+    ByteType,
+    DoubleType,
+    IntegerType,
+    LongType,
+    MapType,
+    NullType,
+    ShortType,
+    StringType,
+    StructField,
+    StructType,
+)
+from delta_tpu.utils.errors import DeltaAnalysisError, SchemaMismatchError
+
+
+def S(*fields):
+    return StructType([StructField(n, t) for n, t in fields])
+
+
+# ---------------------------------------------------------------------------
+# duplicate detection at depth
+# ---------------------------------------------------------------------------
+
+
+def _dup(schema):
+    with pytest.raises(DeltaAnalysisError):
+        su.check_column_name_duplication(schema, "in test")
+
+
+def _ok(schema):
+    su.check_column_name_duplication(schema, "in test")
+
+
+def test_duplicate_top_level():
+    _dup(S(("a", IntegerType()), ("b", StringType()), ("a", LongType())))
+
+
+def test_duplicate_top_level_case_insensitive():
+    _dup(S(("abc", IntegerType()), ("ABC", LongType())))
+
+
+def test_duplicate_in_nested_struct():
+    _dup(S(("top", S(("x", IntegerType()), ("X", LongType())))))
+
+
+def test_duplicate_in_double_nested_struct():
+    inner = S(("d", IntegerType()), ("D", LongType()))
+    _dup(S(("l1", S(("l2", inner)))))
+
+
+def test_duplicate_in_double_nested_array():
+    inner = S(("d", IntegerType()), ("d", LongType()))
+    arr = ArrayType(ArrayType(inner))
+    _dup(S(("top", arr)))
+
+
+def test_duplicate_in_nested_array_element():
+    _dup(S(("top", ArrayType(S(("e", IntegerType()), ("E", LongType()))))))
+
+
+def test_duplicate_in_map_value_struct():
+    m = MapType(StringType(), S(("v", IntegerType()), ("V", LongType())))
+    _dup(S(("top", m)))
+
+
+def test_duplicate_in_map_key_struct():
+    m = MapType(S(("k", IntegerType()), ("K", LongType())), StringType())
+    _dup(S(("top", m)))
+
+
+def test_nested_and_top_level_same_name_not_duplicate():
+    """'a' at top level and 'a' inside a struct are distinct columns."""
+    _ok(S(("a", IntegerType()), ("s", S(("a", LongType())))))
+
+
+def test_same_name_in_sibling_structs_not_duplicate():
+    _ok(S(("s1", S(("x", IntegerType()))), ("s2", S(("x", LongType())))))
+
+
+def test_dotted_name_is_not_duplicate_of_nested_path():
+    """A flat column literally named 'a.b' (backtick-quoted in SQL) is NOT
+    a duplicate of struct a with field b — names compare per level."""
+    _ok(S(("a.b", IntegerType()), ("a", S(("b", LongType())))))
+
+
+def test_dotted_names_duplicate_when_identical():
+    _dup(S(("a.b", IntegerType()), ("a.b", LongType())))
+
+
+# ---------------------------------------------------------------------------
+# findColumnPosition / add / drop edges
+# ---------------------------------------------------------------------------
+
+
+def test_find_position_double_nested():
+    schema = S(("a", S(("b", S(("c", IntegerType()), ("d", LongType()))))))
+    assert su.find_column_position(["a", "b", "d"], schema) == [0, 0, 1]
+
+
+def test_find_position_array_of_struct():
+    schema = S(("arr", ArrayType(S(("x", IntegerType()), ("y", LongType())))))
+    pos = su.find_column_position(["arr", "element", "y"], schema)
+    assert pos[-1] == 1
+
+
+def test_find_position_map_sides():
+    schema = S(("m", MapType(S(("k", IntegerType())), S(("v", LongType())))))
+    assert su.find_column_position(["m", "key", "k"], schema)
+    assert su.find_column_position(["m", "value", "v"], schema)
+
+
+def test_find_position_missing_nested_errors():
+    schema = S(("a", S(("b", IntegerType()))))
+    with pytest.raises(DeltaAnalysisError):
+        su.find_column_position(["a", "zz"], schema)
+
+
+def test_add_column_preserves_sibling_order():
+    schema = S(("a", IntegerType()), ("c", IntegerType()))
+    out = su.add_column(schema, StructField("b", LongType()), [1])
+    assert [f.name for f in out.fields] == ["a", "b", "c"]
+
+
+def test_add_then_drop_round_trip_nested():
+    schema = S(("s", S(("x", IntegerType()))))
+    grown = su.add_column(schema, StructField("y", LongType()), [0, 1])
+    names = [f.name for f in grown.fields[0].data_type.fields]
+    assert names == ["x", "y"]
+    back = su.drop_column_at(grown, [0, 1])[0]
+    assert back.to_json() == schema.to_json()
+
+
+# ---------------------------------------------------------------------------
+# mergeSchemas upcast matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("frm,to", [
+    (ByteType(), ShortType()),
+    (ByteType(), IntegerType()),
+    (ShortType(), IntegerType()),
+])
+def test_merge_upcasts_int_family(frm, to):
+    merged = su.merge_schemas(S(("c", frm)), S(("c", to)))
+    assert merged.fields[0].data_type == to
+    # and the reverse keeps the wider existing type
+    merged = su.merge_schemas(S(("c", to)), S(("c", frm)))
+    assert merged.fields[0].data_type == to
+
+
+@pytest.mark.parametrize("frm", [ByteType(), ShortType(), IntegerType()])
+def test_merge_to_long_requires_implicit_conversions(frm):
+    with pytest.raises(SchemaMismatchError):
+        su.merge_schemas(S(("c", frm)), S(("c", LongType())))
+    merged = su.merge_schemas(S(("c", frm)), S(("c", LongType())),
+                              allow_implicit_conversions=True)
+    assert merged.fields[0].data_type == LongType()
+
+
+def test_merge_null_type_yields_other_side():
+    assert su.merge_schemas(
+        S(("c", NullType())), S(("c", DoubleType()))
+    ).fields[0].data_type == DoubleType()
+    assert su.merge_schemas(
+        S(("c", DoubleType())), S(("c", NullType()))
+    ).fields[0].data_type == DoubleType()
+
+
+def test_merge_keeps_current_metadata_and_nullability():
+    cur = StructType([StructField("c", IntegerType(), False, {"k": "v"})])
+    new = StructType([StructField("c", IntegerType(), True, {"other": "x"})])
+    merged = su.merge_schemas(cur, new)
+    f = merged.fields[0]
+    assert f.nullable is False and f.metadata == {"k": "v"}
+
+
+def test_merge_missing_column_in_data_keeps_schema():
+    cur = S(("a", IntegerType()), ("b", LongType()))
+    merged = su.merge_schemas(cur, S(("a", IntegerType())))
+    assert [f.name for f in merged.fields] == ["a", "b"]
+
+
+def test_merge_new_columns_append_at_tail_nested():
+    cur = S(("s", S(("x", IntegerType()))))
+    new = S(("s", S(("x", IntegerType()), ("y", LongType()))),
+            ("z", StringType()))
+    merged = su.merge_schemas(cur, new)
+    assert [f.name for f in merged.fields] == ["s", "z"]
+    assert [f.name for f in merged.fields[0].data_type.fields] == ["x", "y"]
+
+
+def test_merge_case_differs_keeps_current_case():
+    merged = su.merge_schemas(S(("Col", IntegerType())),
+                              S(("COL", IntegerType())))
+    assert merged.fields[0].name == "Col"
+
+
+def test_merge_incompatible_nested_path_named_in_error():
+    cur = S(("s", S(("x", IntegerType()))))
+    new = S(("s", S(("x", StringType()))))
+    with pytest.raises(SchemaMismatchError, match="[sx]"):
+        su.merge_schemas(cur, new)
+
+
+# ---------------------------------------------------------------------------
+# normalize column names (reference: normalize ordering / dots)
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_fixes_case_any_order():
+    table = S(("aa", IntegerType()), ("bb", LongType()))
+    data = S(("BB", LongType()), ("AA", IntegerType()))
+    fixes = dict(su.normalize_column_names(table, data))
+    assert fixes == {"BB": "bb", "AA": "aa"}
+
+
+def test_normalize_handles_dotted_flat_names():
+    table = S(("a.b", IntegerType()),)
+    data = S(("A.B", IntegerType()),)
+    fixes = dict(su.normalize_column_names(table, data))
+    assert fixes == {"A.B": "a.b"}
+
+
+# ---------------------------------------------------------------------------
+# read compatibility edges
+# ---------------------------------------------------------------------------
+
+
+def test_read_compat_upcast_not_allowed_for_readers():
+    """A reader schema pinned to int cannot read a widened long column."""
+    assert not su.is_read_compatible(S(("c", IntegerType())),
+                                     S(("c", LongType())))
+
+
+def test_read_compat_reordered_columns_ok():
+    a = S(("x", IntegerType()), ("y", LongType()))
+    b = S(("y", LongType()), ("x", IntegerType()))
+    assert su.is_read_compatible(a, b)
+
+
+def test_read_compat_nested_added_nullable_ok():
+    a = S(("s", S(("x", IntegerType()))))
+    b = S(("s", S(("x", IntegerType()), ("y", LongType()))))
+    assert su.is_read_compatible(a, b)

@@ -1,0 +1,184 @@
+"""Streaming restart matrices — the `DeltaSourceSuite` families round 4's
+review flagged as thin: restart at every admission boundary, restart
+across OPTIMIZE/rearrange commits, offset monotonicity under mixed
+admission limits, sink/source composition under restart, and
+startingVersion interactions with restarts."""
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.delete import DeleteCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.streaming.query import StreamingQuery
+from delta_tpu.streaming.sink import DeltaSink
+from delta_tpu.streaming.source import DeltaSource
+
+
+def write(log, ids, mode="append"):
+    WriteIntoDelta(log, mode, pa.table({"id": pa.array(ids, pa.int64())})).run()
+
+
+def drain(source, start=None, limit=100):
+    out, cur = [], start
+    for _ in range(limit):
+        anchor = cur if cur is not None else source.initial_offset()
+        end = source.latest_offset(anchor)
+        if end is None:
+            return out, cur
+        t = source.get_batch(cur, end)
+        if t.num_rows:
+            out.append(sorted(t.column("id").to_pylist()))
+        cur = end
+    raise AssertionError("source did not drain")
+
+
+# -- restart at every admission boundary ------------------------------------
+
+
+@pytest.mark.parametrize("max_files", [1, 2, 3])
+def test_restart_at_each_boundary_no_loss_no_dup(tmp_table, max_files):
+    """Drive to each intermediate offset, then RESTART (fresh source, same
+    offset JSON): the union of batches is exactly the data, no overlap."""
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(5):
+        write(log, [i * 10, i * 10 + 1])
+    source = DeltaSource(log, max_files_per_trigger=max_files)
+    seen = []
+    cur = None
+    while True:
+        anchor = cur if cur is not None else source.initial_offset()
+        end = source.latest_offset(anchor)
+        if end is None:
+            break
+        t = source.get_batch(cur, end)
+        seen.extend(t.column("id").to_pylist())
+        # restart: serialize the offset, build a brand-new source
+        from delta_tpu.streaming.offset import DeltaSourceOffset
+
+        cur = DeltaSourceOffset.from_json(end.json())
+        source = DeltaSource(log, max_files_per_trigger=max_files)
+    assert sorted(seen) == sorted(
+        v for i in range(5) for v in (i * 10, i * 10 + 1))
+
+
+def test_restart_mid_initial_snapshot_with_concurrent_appends(tmp_table):
+    """New commits land while the initial snapshot is still being admitted
+    in slices; a restarted source must deliver snapshot + tail exactly."""
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(4):
+        write(log, [i])
+    source = DeltaSource(log, max_files_per_trigger=2)
+    cur = source.latest_offset(source.initial_offset())
+    got = source.get_batch(None, cur).column("id").to_pylist()
+    write(log, [100])  # lands mid-snapshot-serving
+    source2 = DeltaSource(log, max_files_per_trigger=2)
+    rest, _ = drain(source2, cur)
+    flat = got + [v for b in rest for v in b]
+    assert sorted(flat) == [0, 1, 2, 3, 100]
+
+
+def test_restart_across_rearrange_only_commit(tmp_table):
+    """An OPTIMIZE-shaped commit (dataChange=false) between restarts must
+    not re-emit rows."""
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(3):
+        write(log, [i])
+    source = DeltaSource(log)
+    batches, cur = drain(source)
+    assert batches == [[0, 1, 2]]
+    OptimizeCommand(log).run()  # compacts 3 files -> 1, dataChange=false
+    source2 = DeltaSource(log)
+    batches, cur = drain(source2, cur)
+    assert batches == []
+    write(log, [7])
+    batches, _ = drain(source2, cur)
+    assert batches == [[7]]
+
+
+def test_offsets_monotonic_under_mixed_limits(tmp_table):
+    """Alternating admission limits across restarts never move an offset
+    backwards."""
+    log = DeltaLog.for_table(tmp_table)
+    for i in range(6):
+        write(log, [i])
+    cur = None
+    keys = []
+    for limit in (1, 3, 2, 1000):
+        source = DeltaSource(log, max_files_per_trigger=limit)
+        anchor = cur if cur is not None else source.initial_offset()
+        end = source.latest_offset(anchor)
+        if end is None:
+            break
+        keys.append((end.reservoir_version, end.index))
+        cur = end
+    assert keys == sorted(keys)
+
+
+# -- query-level restart composition ----------------------------------------
+
+
+def test_query_restart_after_each_batch(tmp_path):
+    src_path, dst_path, wal = (str(tmp_path / n) for n in ("s", "d", "w"))
+    log = DeltaLog.for_table(src_path)
+    for i in range(4):
+        write(log, [i])
+    total = 0
+    for _ in range(8):  # fresh query object each loop = restart
+        q = StreamingQuery(
+            DeltaSource(log, max_files_per_trigger=1),
+            DeltaSink(DeltaLog.for_table(dst_path), query_id="q1"), wal,
+        )
+        n = q.process_all_available()
+        total += n
+        if n == 0:
+            break
+    from delta_tpu.exec.scan import scan_to_table
+
+    out = scan_to_table(DeltaLog.for_table(dst_path).update())
+    assert sorted(out.column("id").to_pylist()) == [0, 1, 2, 3]
+
+
+def test_query_restart_with_new_data_between_runs(tmp_path):
+    src_path, dst_path, wal = (str(tmp_path / n) for n in ("s", "d", "w"))
+    log = DeltaLog.for_table(src_path)
+    write(log, [1])
+    q = StreamingQuery(DeltaSource(log),
+                       DeltaSink(DeltaLog.for_table(dst_path), query_id="q2"),
+                       wal)
+    q.process_all_available()
+    write(log, [2])
+    write(log, [3])
+    q2 = StreamingQuery(DeltaSource(log),
+                        DeltaSink(DeltaLog.for_table(dst_path), query_id="q2"),
+                        wal)
+    q2.process_all_available()
+    from delta_tpu.exec.scan import scan_to_table
+
+    out = scan_to_table(DeltaLog.for_table(dst_path).update())
+    assert sorted(out.column("id").to_pylist()) == [1, 2, 3]
+
+
+def test_starting_version_with_restart_and_delete_handling(tmp_table):
+    """startingVersion skips history; a delete AFTER the start version
+    still fails the stream unless ignoreDeletes."""
+    log = DeltaLog.for_table(tmp_table)
+    write(log, [1])
+    write(log, [2])
+    v = log.update().version
+    source = DeltaSource(log, starting_version=v + 1)
+    batches, cur = drain(source)
+    assert batches == []
+    write(log, [3])
+    batches, cur = drain(source, cur)
+    assert batches == [[3]]
+    DeleteCommand(log, "id = 3").run()
+    from delta_tpu.utils.errors import DeltaError
+
+    with pytest.raises(DeltaError):
+        drain(DeltaSource(log, starting_version=v + 1), cur)
+    # ignoreDeletes lets a restarted stream pass the delete commit
+    batches, _ = drain(
+        DeltaSource(log, starting_version=v + 1, ignore_deletes=True), cur)
+    assert batches == []
