@@ -971,7 +971,7 @@ def bench_merge_scale(workdir):
         "metric": "merge_upsert_100M_rows_10GB_class",
         "value": round((gb + src_gb) / cold_s, 3),
         "unit": "GB/s",
-        "vs_baseline": round(cold_s / steady_s, 2),
+        "vs_baseline": round(steady_s / cold_s, 2),
         "baseline": "the second (steady-state) engine merge on the same "
                     "table — an honest scale record, not a win claim: on "
                     "this 1-vCPU host + degrading tunnel the 100M-row "
