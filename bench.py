@@ -1197,10 +1197,7 @@ def bench_resident_probe(workdir):
                 + (len(hot) * (blk // 32 + blk) * 4 + s_bytes) / 12e9
             # the MERGE router's decision for this shape (the cost model
             # in commands/merge.py:_launch_resident_probe, live link terms)
-            auto_device_s = (lp.upload_s(len(s_keys) * 4)
-                             + lp.download_s(n // 8 + len(s_keys) // 8)
-                             + (n + len(s_keys)) * link.RESIDENT_PROBE_S_PER_ROW
-                             + link.RESIDENT_PROBE_FIXED_S + 3 * lp.latency_s)
+            auto_device_s = link.resident_probe_device_s(n, len(s_keys), lp)
             auto_host_s = ((n + len(s_keys)) * link.HOST_JOIN_S_PER_ROW
                            + n * link.HOST_KEY_DECODE_S_PER_ROW)
             entry_res[label] = {

@@ -829,16 +829,9 @@ class MergeIntoCommand:
             m = len(s_keys)
             n = entry.num_rows
             p = link.profile()
-            # optimistic int32 narrowing (like the upload path's pre-gate);
-            # the kernel constants are the calibrated r5 sorted-slab probe
-            # (block-bucketed brute compare: fixed dispatch floor + ~3ns/row)
-            device_s = (
-                p.upload_s(m * 4)
-                + p.download_s(n // 8 + m // 8)
-                + (n + m) * link.RESIDENT_PROBE_S_PER_ROW
-                + link.RESIDENT_PROBE_FIXED_S
-                + 3 * p.latency_s
-            )
+            # the calibrated r5 sorted-slab probe model (shared with the
+            # bench's auto_routes_device report: link.resident_probe_device_s)
+            device_s = link.resident_probe_device_s(n, m, p)
             if not entry.is_resident:
                 # the device copy was evicted / regrown: the probe would
                 # synchronously re-ship the whole slab first — charge it

@@ -34,7 +34,8 @@ __all__ = [
     "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW",
     "HOST_PRUNE_S_PER_CELL", "DEVICE_PRUNE_S_PER_CELL",
     "HOST_KEY_DECODE_S_PER_ROW", "RESIDENT_PROBE_S_PER_ROW",
-    "RESIDENT_PROBE_FIXED_S",
+    "RESIDENT_PROBE_FIXED_S", "RESIDENT_FINALIZE_S_PER_ROW",
+    "resident_probe_device_s",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -56,9 +57,33 @@ HOST_KEY_DECODE_S_PER_ROW = 2.6e-8
 # 100M slab rows on one v5e — a ~0.4s dispatch floor plus ~3e-9 s/row of
 # VPU compare/reduce work. The old per-probe-sort kernel cost 3.2e-8 s/row.
 RESIDENT_PROBE_S_PER_ROW = 3.0e-9
-# fixed per-probe device overhead (kernel launch chain + source sort at
-# m<=1M), measured on the v5e behind the tunnel
+# fixed per-probe device overhead EXCLUDING round trips (those are charged
+# via the latency terms in resident_probe_device_s): kernel launch chain +
+# the m<=1M source sort
 RESIDENT_PROBE_FIXED_S = 0.3
+# host-side finalize work per TARGET row: bitmask unpack + bits_for_file
+# mapping over the DV-filtered decode + first-match pairing recovery (r5
+# measured: the 10M-row resident merge's join phase ran ~2.1 s against a
+# ~0.9 s transfer+kernel model — the residual is this term)
+RESIDENT_FINALIZE_S_PER_ROW = 3.0e-8
+
+
+def resident_probe_device_s(n: int, m: int, p: "LinkProfile") -> float:
+    """The router's cost model for one steady-state resident MERGE probe
+    (n resident target rows, m source rows): source upload (int32-
+    narrowed, optimistic), head + mask downloads, the block-bucketed
+    kernel, the host-side finalize, a fixed dispatch floor, and the
+    probe's sequential round trips. ONE definition — the production
+    router (`commands/merge.py`) and the bench's `auto_routes_device`
+    report both call this, so they cannot drift apart."""
+    return (
+        p.upload_s(m * 4)
+        + p.download_s(n // 8 + m // 8)
+        + (n + m) * RESIDENT_PROBE_S_PER_ROW
+        + n * RESIDENT_FINALIZE_S_PER_ROW
+        + RESIDENT_PROBE_FIXED_S
+        + 3 * p.latency_s
+    )
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares
 DEVICE_PRUNE_S_PER_CELL = 2.0e-11
