@@ -446,6 +446,70 @@ def test_partition_in_list_serves_resident(tmp_table):
     assert plans[2].count == 0
 
 
+def test_partitioned_plans_race_dictionary_extension(tmp_table):
+    """Planner threads race tail advances that EXTEND the partition
+    dictionary: every plan must either match the exact pruner for ITS
+    snapshot or fall back — never serve a wrong file set (the
+    expected_version guard + under-lock dict extension)."""
+    import threading
+
+    from delta_tpu.exec.scan import plan_scans
+
+    log = _mk_part_table(tmp_table, days=("d001", "d002"))
+    cache = DeviceStateCache.instance()
+    cache.get(log.update())
+    stop = threading.Event()
+    errors_seen = []
+
+    def writer():
+        i = 3
+        while not stop.is_set() and i < 14:
+            WriteIntoDelta(log, "append", pa.table({
+                "day": pa.array([f"d{i:03d}"] * 4, pa.string()),
+                "year": pa.array([2020 + i] * 4, pa.int32()),
+                "a": np.arange(i * 100, i * 100 + 4, dtype=np.int64),
+            })).run()
+            i += 1
+
+    from delta_tpu.expr import partition as pexpr
+    from delta_tpu.expr.parser import parse_predicate
+
+    def oracle(snap, q):
+        # thread-safe exact pruner: conf.set_temporarily is process-global,
+        # so the disabled-cache oracle helper must not run concurrently
+        pred = parse_predicate(q)
+        ps = snap.metadata.partition_schema
+        return sorted(f.path for f in snap.all_files
+                      if pexpr.matches(pred, f, ps))
+
+    def planner():
+        try:
+            while not stop.is_set():
+                snap = log.update()
+                expect = {q: oracle(snap, q)
+                          for q in ("day = 'd002'", "day >= 'd003'")}
+                plans = plan_scans(
+                    snap, [[q] for q in expect], k=64)
+                for q, plan in zip(expect, plans):
+                    if sorted(plan.paths) != expect[q]:
+                        errors_seen.append((q, plan.via, plan.paths,
+                                            expect[q]))
+        except Exception as e:  # noqa: BLE001
+            errors_seen.append(repr(e))
+
+    w = threading.Thread(target=writer)
+    ps = [threading.Thread(target=planner) for _ in range(2)]
+    w.start()
+    [t.start() for t in ps]
+    w.join()
+    stop.set()
+    [t.join() for t in ps]
+    assert not errors_seen, errors_seen[:3]
+    # final state: in-order extension kept the sorted invariant
+    entry = cache.get(log.update())
+    assert entry is not None and entry.part_info["day"].sorted
+
+
 def test_budget_eviction(tmp_path):
     cache = DeviceStateCache.instance()
     entries = []
