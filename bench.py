@@ -1248,37 +1248,70 @@ def bench_resident_probe(workdir):
     }
 
 
-def main():
-    only = sys.argv[1] if len(sys.argv) > 1 else None
-    workdir = tempfile.mkdtemp(prefix="delta_tpu_bench_")
-    configs = {
-        "1": lambda: bench_overwrite_read(workdir),
-        "2": lambda: bench_merge_upsert(workdir),
-        "3": lambda: bench_zorder_point_query(workdir),
-        "4": lambda: bench_streaming_tail(workdir),
-        "5": lambda: bench_checkpoint_replay(workdir),
-        "2x": lambda: bench_merge_scale(workdir),
-        "6": lambda: bench_hot_plan(workdir),
-        "6p": lambda: bench_hot_plan(workdir, partitioned=True),
-        "7": lambda: bench_replay_scale(workdir),
-        "8": lambda: bench_resident_probe(workdir),
-    }
-    try:
-        if only:
-            results = {only: configs[only]()}
-            print(json.dumps(results[only]))
-            return
-        results = {k: fn() for k, fn in configs.items()}
-    finally:
-        shutil.rmtree(workdir, ignore_errors=True)
-    headline = results["2"]
+def _emit(results):
+    headline = results.get("2") or next(iter(results.values()))
     print(json.dumps({
         "metric": headline["metric"],
         "value": headline["value"],
         "unit": headline["unit"],
         "vs_baseline": headline["vs_baseline"],
         "all": results,
-    }))
+    }), flush=True)
+
+
+def main():
+    import signal
+
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    workdir = tempfile.mkdtemp(prefix="delta_tpu_bench_")
+    # priority order: the headline and the device-win configs land first,
+    # so a driver-side timeout still records the story; the long auxiliary
+    # scale configs (2x, 7) run last under the soft budget below
+    configs = {
+        "2": lambda: bench_merge_upsert(workdir),
+        "6": lambda: bench_hot_plan(workdir),
+        "6p": lambda: bench_hot_plan(workdir, partitioned=True),
+        "8": lambda: bench_resident_probe(workdir),
+        "5": lambda: bench_checkpoint_replay(workdir),
+        "3": lambda: bench_zorder_point_query(workdir),
+        "4": lambda: bench_streaming_tail(workdir),
+        "1": lambda: bench_overwrite_read(workdir),
+        "2x": lambda: bench_merge_scale(workdir),
+        "7": lambda: bench_replay_scale(workdir),
+    }
+    results: dict = {}
+    emitted = {"done": False}
+
+    def bail(signum, frame):  # pragma: no cover - signal path
+        if results and not emitted["done"]:
+            emitted["done"] = True
+            results["_partial"] = f"terminated by signal {signum}"
+            _emit(results)
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, bail)
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3600"))
+    t_start = time.perf_counter()
+    try:
+        if only:
+            results = {only: configs[only]()}
+            print(json.dumps(results[only]))
+            return
+        for k, fn in configs.items():
+            elapsed = time.perf_counter() - t_start
+            if elapsed > budget_s:
+                results[k] = {
+                    "metric": f"config_{k}", "value": -1, "unit": "skipped",
+                    "vs_baseline": 0,
+                    "note": f"skipped: soft budget BENCH_BUDGET_S="
+                            f"{budget_s:.0f}s exhausted at {elapsed:.0f}s",
+                }
+                continue
+            results[k] = fn()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    emitted["done"] = True
+    _emit(results)
 
 
 if __name__ == "__main__":
