@@ -1295,6 +1295,7 @@ def main():
     try:
         if only:
             results = {only: configs[only]()}
+            emitted["done"] = True  # one-line contract: bail() must not re-emit
             print(json.dumps(results[only]))
             return
         for k, fn in configs.items():
