@@ -40,6 +40,8 @@ import time
 
 import numpy as np
 
+from delta_tpu.utils.jaxcompat import enable_x64
+
 SCALE = float(os.environ.get("BENCH_SCALE", "1.0"))
 
 
@@ -1065,7 +1067,7 @@ def bench_resident_probe(workdir):
             build_s = time.perf_counter() - t0
         else:
             cap = e.capacity
-            with jax.enable_x64():
+            with enable_x64():
                 iota = jnp.arange(cap, dtype=jnp.int64)
                 dk = jnp.where(iota < n, ((iota * A) % n) * 2, 0)
                 dvv = iota < n
@@ -1155,7 +1157,7 @@ def bench_resident_probe(workdir):
             dev_h = e._dev
 
             def kernel_only():
-                with jax.enable_x64():
+                with enable_x64():
                     out = kc._probe_sorted_kernel()(
                         dev_h["sorted_keys"], dev_h["sorted_valid"],
                         jnp.asarray(np.int32(n)), s_dev)

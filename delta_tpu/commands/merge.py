@@ -344,12 +344,16 @@ class MergeIntoCommand:
                 self._check_star_coverage(target_cols, source_cols, "INSERT", metadata)
                 break
         # read-side char padding on the merge condition and clause
-        # conditions (literals vs char(n) target columns)
+        # conditions (literals vs char(n) target columns). Only refs that
+        # resolve to the TARGET pad: a source column sharing a name with a
+        # target char column (s.status = 'x') must keep its literal as-is.
         from delta_tpu.schema.char_varchar import pad_char_literals
 
-        self.condition = pad_char_literals(self.condition, metadata)
+        tq = frozenset({self.target_alias.lower()} if self.target_alias
+                       else ())
+        self.condition = pad_char_literals(self.condition, metadata, tq)
         self.matched_clauses = [
-            MergeClause(c.kind, pad_char_literals(c.condition, metadata)
+            MergeClause(c.kind, pad_char_literals(c.condition, metadata, tq)
                         if c.condition is not None else None, c.assignments)
             for c in self.matched_clauses
         ]

@@ -226,12 +226,16 @@ def plan_scans(
         bump_counter("stateCache.plan.fallback.noentry", len(queries))
     if term_lists:
         # OR queries lower to several boxes; their row sets union after the
-        # plan, so multi-term batches ask for complete row sets
-        flat = [t for terms in term_lists for t in terms]
-        k_int = k if all(len(t) == 1 for t in term_lists) else max(
-            entry.num_rows, 1)
+        # plan, so THEIR boxes ask for complete row sets — but only theirs:
+        # per-range k keeps the single-term queries sharing the dispatch on
+        # small plans instead of dragging the whole batch to num_rows
+        flat, flat_ks = [], []
+        full_k = max(entry.num_rows, 1)
+        for terms in term_lists:
+            flat.extend(terms)
+            flat_ks.extend([k if len(terms) == 1 else full_k] * len(terms))
         plans = entry.plan_ranges(
-            flat, k=k_int, expected_version=snapshot.version
+            flat, k=flat_ks, expected_version=snapshot.version
         )
         if plans is not None:  # None: entry advanced past our snapshot
             bump_counter("stateCache.plan.resident", len(term_lists))

@@ -29,15 +29,38 @@ from delta_tpu.protocol.actions import (
     RemoveFile,
     SetTransaction,
 )
+from delta_tpu.schema.types import (
+    BooleanType,
+    ByteType,
+    DateType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    ShortType,
+    StringType,
+    TimestampType,
+)
 from delta_tpu.storage.logstore import LogStore
 from delta_tpu.utils import errors
 from delta_tpu.utils.errors import DeltaIllegalStateError
+
+# Stats leaf types the VECTORIZED struct-stats builder can cast from one
+# batched ndjson parse. Load-bearing invariant: write_stats_as_struct gates
+# the engine default on this exact set so the columnar and dataclass
+# checkpoint writers can never disagree on a table's checkpoint schema —
+# keep it single-sourced (decimal / nested-struct leaves need the per-value
+# coercion only the dataclass row builder does).
+_SIMPLE_STATS_TYPES = (ByteType, ShortType, IntegerType, LongType, FloatType,
+                       DoubleType, StringType, BooleanType, DateType,
+                       TimestampType)
 
 __all__ = [
     "CheckpointMetaData",
     "read_last_checkpoint",
     "write_last_checkpoint",
     "write_checkpoint",
+    "write_stats_as_struct",
     "read_checkpoint_actions",
     "find_last_complete_checkpoint_before",
     "CheckpointInstance",
@@ -277,28 +300,70 @@ def _action_to_row(a: Action) -> Dict[str, Any]:
     raise ValueError(f"Action not checkpointable: {a!r}")
 
 
+def _struct_stats_vectorizable(meta: Metadata) -> bool:
+    """Can :func:`_v2_arrays_vectorized` type every stats leaf of this
+    schema? (:data:`_SIMPLE_STATS_TYPES` leaves only — decimal and
+    nested-struct leaves need per-value coercion.)"""
+    schema = meta.schema
+    known = {f.name for f in schema.fields}
+    pcols = set(meta.partition_columns)
+    if pcols and not pcols <= known:
+        return False
+    return all(isinstance(f.data_type, _SIMPLE_STATS_TYPES)
+               for f in schema.fields)
+
+
+def write_stats_as_struct(meta: Optional[Metadata]) -> bool:
+    """Struct-stats gate for checkpoint writers. The table property
+    ``delta.checkpoint.writeStatsAsStruct`` (or its session-level
+    ``delta.tpu.properties.defaults.*`` tier) wins when set; otherwise the
+    engine default is the session conf
+    ``delta.tpu.checkpoint.writeStatsAsStruct`` — ON, unlike the reference,
+    because the zero-JSON cold state-cache build depends on the typed
+    columns (``ops/state_export.arrays_from_columns``).
+
+    The engine default only applies to schemas the VECTORIZED builder can
+    type (:func:`_struct_stats_vectorizable`): otherwise the columnar and
+    dataclass writers would disagree — the same table's checkpoints would
+    flip schema depending on which writer a given version happened to take.
+    An explicit property=true still forces struct columns everywhere (the
+    dataclass row builder coerces decimal/nested leaves per value)."""
+    from delta_tpu.utils.config import DeltaConfigs, conf
+
+    if meta is None:
+        return False
+    if DeltaConfigs.CHECKPOINT_WRITE_STATS_AS_STRUCT.is_explicit(meta):
+        return DeltaConfigs.CHECKPOINT_WRITE_STATS_AS_STRUCT.from_metadata(meta)
+    return (conf.get_bool("delta.tpu.checkpoint.writeStatsAsStruct", True)
+            and _struct_stats_vectorizable(meta))
+
+
 def _v2_schema_and_rows(actions: Sequence[Action]):
     """CheckpointV2 columns (``Checkpoints.scala:340-389``): typed
     ``add.partitionValues_parsed`` and ``add.stats_parsed`` structs, built
     from the state's own Metadata action. Returns (extra add fields,
-    row-builder) or (None, None) when the table opts out
-    (``delta.checkpoint.writeStatsAsStruct``, default false)."""
+    row-builder) or (None, None) when the table opts out (see
+    :func:`write_stats_as_struct`)."""
     import pyarrow as pa
 
     from delta_tpu.expr.partition import typed_partition_row
     from delta_tpu.expr.vectorized import arrow_type_for
-    from delta_tpu.utils.config import DeltaConfigs
 
     meta = next((a for a in actions if isinstance(a, Metadata)), None)
-    if meta is None or not DeltaConfigs.CHECKPOINT_WRITE_STATS_AS_STRUCT.from_metadata(meta):
+    if meta is None or not write_stats_as_struct(meta):
         return None, None
     schema = meta.schema
+    known = {f.name for f in schema.fields}
     pcols = list(meta.partition_columns)
     part_schema = meta.partition_schema
     data_fields = [f for f in schema.fields if f.name not in pcols]
+    if not data_fields and not (pcols and set(pcols) <= known):
+        # nothing to type (empty/unknown schema, e.g. synthetic logs):
+        # Parquet cannot write empty structs
+        return None, None
 
     extra_fields = []
-    if pcols:
+    if pcols and set(pcols) <= known:
         extra_fields.append(pa.field(
             "partitionValues_parsed",
             pa.struct([
@@ -345,21 +410,22 @@ def _v2_schema_and_rows(actions: Sequence[Action]):
             return Decimal(str(v))
         return v
 
-    val_struct = pa.struct(
-        [pa.field(f.name, arrow_type_for(f.data_type)) for f in data_fields]
-    )
-    null_struct = pa.struct(
-        [pa.field(f.name, _null_count_type(f.data_type)) for f in data_fields]
-    )
-    extra_fields.append(pa.field(
-        "stats_parsed",
-        pa.struct([
-            pa.field("numRecords", pa.int64()),
-            pa.field("minValues", val_struct),
-            pa.field("maxValues", val_struct),
-            pa.field("nullCount", null_struct),
-        ]),
-    ))
+    if data_fields:  # Parquet cannot write empty min/max structs
+        val_struct = pa.struct(
+            [pa.field(f.name, arrow_type_for(f.data_type)) for f in data_fields]
+        )
+        null_struct = pa.struct(
+            [pa.field(f.name, _null_count_type(f.data_type)) for f in data_fields]
+        )
+        extra_fields.append(pa.field(
+            "stats_parsed",
+            pa.struct([
+                pa.field("numRecords", pa.int64()),
+                pa.field("minValues", val_struct),
+                pa.field("maxValues", val_struct),
+                pa.field("nullCount", null_struct),
+            ]),
+        ))
 
     def _null_count_value(v, dt):
         if isinstance(dt, StructType):
@@ -368,10 +434,14 @@ def _v2_schema_and_rows(actions: Sequence[Action]):
                     for f in dt.fields}
         return int(v) if isinstance(v, (int, float)) else None
 
+    typed_pcols = bool(pcols) and set(pcols) <= known
+
     def build(add: AddFile) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        if pcols:
+        if typed_pcols:
             out["partitionValues_parsed"] = typed_partition_row(add, part_schema)
+        if not data_fields:
+            return out
         s = add.stats_dict() or {}
         out["stats_parsed"] = {
             "numRecords": s.get("numRecords"),
@@ -428,6 +498,166 @@ def _segment_file_extras(cols) -> bool:
     return False
 
 
+def _v2_arrays_vectorized(meta, part_strings, stats, n: int):
+    """Vectorized CheckpointV2 columns straight from the columnar segment:
+    the typed ``partitionValues_parsed`` / ``stats_parsed`` struct arrays
+    for the n alive adds, built from ONE C++ ndjson parse of the stats
+    strings plus Arrow casts — the row-at-a-time twin of
+    :func:`_v2_schema_and_rows` without any dataclasses. Returns
+    ``(extra add fields, child arrays)`` or None when a leaf needs
+    per-value coercion (decimal / nested-struct columns) or a cast fails —
+    the caller falls back to the dataclass row builder, which coerces
+    exactly."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from delta_tpu.expr.vectorized import arrow_type_for
+    from delta_tpu.ops.state_export import stats_json_table
+    from delta_tpu.utils.arrow import one_chunk as _one
+
+    schema = meta.schema
+    known = {f.name for f in schema.fields}
+    pcols = list(meta.partition_columns)
+    typed_pcols = bool(pcols) and set(pcols) <= known
+    part_schema = meta.partition_schema
+    data_fields = [f for f in schema.fields if f.name not in pcols]
+    if not data_fields and not typed_pcols:
+        return [], []  # nothing to type (empty/unknown schema)
+    if not all(isinstance(f.data_type, _SIMPLE_STATS_TYPES) for f in data_fields):
+        return None
+    if typed_pcols and not all(
+            isinstance(part_schema[c].data_type, _SIMPLE_STATS_TYPES)
+            for c in pcols):
+        return None
+
+    def _json_repr_type(dt) -> pa.DataType:
+        """The Arrow type the stats-JSON representation of ``dt`` parses
+        to under an explicit schema: strings stay strings (NEVER inferred —
+        a string column holding '2021-01-01' must round-trip verbatim),
+        temporal values arrive as ISO strings, numbers widen."""
+        if isinstance(dt, (StringType, DateType, TimestampType)):
+            return pa.string()
+        if isinstance(dt, BooleanType):
+            return pa.bool_()
+        if isinstance(dt, (FloatType, DoubleType)):
+            return pa.float64()
+        return pa.int64()
+
+    def _cast_leaf(arr, dt):
+        """Parsed/raw leaf → the field's typed Arrow representation. Stats
+        JSON (and partition maps) carry dates/timestamps as ISO strings."""
+        target = arrow_type_for(dt)
+        arr = _one(arr)
+        if arr.type == target:
+            return arr
+        if isinstance(dt, StringType):
+            # a non-string parse of a string field means type inference
+            # rewrote the literal (ISO-date-like values → timestamp);
+            # rendering it back would persist a DIFFERENT string
+            raise TypeError(f"string stats leaf parsed as {arr.type}")
+        if isinstance(dt, TimestampType) and not pa.types.is_timestamp(arr.type):
+            s = arr.cast(pa.string())
+            try:
+                return pc.cast(s, target)  # tz-naive = wall-clock UTC
+            except Exception:
+                z = pc.replace_substring_regex(s, r"Z$", "+00:00")
+                return pc.cast(z, pa.timestamp("us", tz="UTC")).cast(target)
+        if isinstance(dt, DateType) and not (
+                pa.types.is_timestamp(arr.type) or pa.types.is_date(arr.type)):
+            return arr.cast(pa.string()).cast(target)
+        return arr.cast(target)
+
+    fields: List = []
+    children: List = []
+    if typed_pcols:
+        pv_fields = [pa.field(c, arrow_type_for(part_schema[c].data_type))
+                     for c in pcols]
+        try:
+            pv_children = [_cast_leaf(part_strings[c], part_schema[c].data_type)
+                           for c in pcols]
+        except Exception:
+            return None
+        fields.append(pa.field("partitionValues_parsed", pa.struct(pv_fields)))
+        children.append(pa.StructArray.from_arrays(pv_children, fields=pv_fields))
+    if not data_fields:
+        return fields, children
+
+    val_fields = [pa.field(f.name, arrow_type_for(f.data_type))
+                  for f in data_fields]
+    null_fields = [pa.field(f.name, pa.int64()) for f in data_fields]
+    sp_fields = [
+        pa.field("numRecords", pa.int64()),
+        pa.field("minValues", pa.struct(val_fields)),
+        pa.field("maxValues", pa.struct(val_fields)),
+        pa.field("nullCount", pa.struct(null_fields)),
+    ]
+    sp_type = pa.struct(sp_fields)
+    fields.append(pa.field("stats_parsed", sp_type))
+
+    # explicit parse schema: pins every leaf to its JSON representation so
+    # the Arrow reader never type-infers (see stats_json_table docstring)
+    repr_struct = pa.struct(
+        [pa.field(f.name, _json_repr_type(f.data_type)) for f in data_fields])
+    parse_schema = pa.schema([
+        pa.field("numRecords", pa.int64()),
+        pa.field("minValues", repr_struct),
+        pa.field("maxValues", repr_struct),
+        pa.field("nullCount", pa.struct(
+            [pa.field(f.name, pa.int64()) for f in data_fields])),
+    ])
+    kind, parsed, idx = (
+        stats_json_table(stats, explicit_schema=parse_schema)
+        if stats is not None else ("empty", None, None))
+    if kind in ("newline", "malformed"):
+        return None
+    if kind == "empty":
+        children.append(pa.nulls(n, sp_type))
+        return fields, children
+
+    names = parsed.column_names
+    k = parsed.num_rows
+
+    def _sub(col_name: str, leaf_name: str):
+        if col_name not in names:
+            return None
+        col = _one(parsed.column(col_name))
+        if not pa.types.is_struct(col.type):
+            return None
+        if not any(col.type.field(i).name == leaf_name
+                   for i in range(col.type.num_fields)):
+            return None
+        return _one(pc.struct_field(col, leaf_name))
+
+    try:
+        nr = (_one(parsed.column("numRecords")).cast(pa.int64())
+              if "numRecords" in names else pa.nulls(k, pa.int64()))
+        min_children, max_children, nc_children = [], [], []
+        for f in data_fields:
+            for dest, src in ((min_children, "minValues"),
+                              (max_children, "maxValues")):
+                leaf = _sub(src, f.name)
+                dest.append(pa.nulls(k, arrow_type_for(f.data_type))
+                            if leaf is None else _cast_leaf(leaf, f.data_type))
+            leaf = _sub("nullCount", f.name)
+            nc_children.append(pa.nulls(k, pa.int64()) if leaf is None
+                               else leaf.cast(pa.int64()))
+        sp = pa.StructArray.from_arrays(
+            [nr,
+             pa.StructArray.from_arrays(min_children, fields=val_fields),
+             pa.StructArray.from_arrays(max_children, fields=val_fields),
+             pa.StructArray.from_arrays(nc_children, fields=null_fields)],
+            fields=sp_fields,
+        )
+    except Exception:
+        return None
+    # expand to all n rows: null struct where the file carries no stats
+    inverse = np.full(n, -1, np.int64)
+    inverse[idx] = np.arange(k)
+    children.append(sp.take(pa.array(inverse, pa.int64(), mask=inverse < 0)))
+    return fields, children
+
+
 def write_checkpoint_columnar(
     store: LogStore,
     log_path: str,
@@ -440,28 +670,87 @@ def write_checkpoint_columnar(
     difference between seconds and minutes; the reference funnels the same
     write through a single-task ``repartition(1)`` (`Checkpoints.scala:262-303`).
 
-    Handles the common shape (unpartitioned, stats-as-string, no tags/DVs on
-    file actions — detected conservatively); returns None otherwise and the
-    caller takes the dataclass path. Tombstones and state actions (few) go
-    through the row builder either way."""
+    Partitioned tables build their ``partitionValues`` map column
+    vectorized from the segment's partition strings, and tables with
+    struct stats enabled (:func:`write_stats_as_struct`, default on) get
+    the typed ``partitionValues_parsed``/``stats_parsed`` columns from one
+    batched ndjson parse (:func:`_v2_arrays_vectorized`). Returns None for
+    the shapes that still need per-row coercion (tags/DVs on file actions,
+    decimal or nested-struct stats leaves) and the caller takes the
+    dataclass path. Tombstones and state actions (few) go through the row
+    builder either way."""
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    from delta_tpu.utils.config import DeltaConfigs
-
     meta = snapshot.metadata
-    if meta.partition_columns:
-        return None
-    if DeltaConfigs.CHECKPOINT_WRITE_STATS_AS_STRUCT.from_metadata(meta):
-        return None
     cols = snapshot._columnar
     if _segment_file_extras(cols):
         return None
+    part_cols = list(meta.partition_columns)
+    want_struct = write_stats_as_struct(meta)
 
     schema = _arrow_checkpoint_schema()
     add_type = schema.field("add").type
     str_map = pa.map_(pa.string(), pa.string())
+
+    from delta_tpu.utils.arrow import one_chunk as _one_chunk
+
+    rows = np.nonzero(snapshot._alive_mask)[0]
+    n = len(rows)
+    paths = pa.array(cols.paths_for(rows), pa.string())
+    if cols.stats is not None and n:
+        stats = _one_chunk(cols.stats.take(pa.array(rows, pa.int64())))
+    else:
+        stats = pa.nulls(n, pa.string())
+
+    part_strings = None
+    if part_cols:
+        # raw partition-value strings, vectorized from the segment's map
+        # columns / tail lines — never through AddFile dataclasses
+        part_strings = cols.partition_strings(rows, part_cols)
+        if part_strings is None:
+            return None
+        part_strings = {c: _one_chunk(a) for c, a in part_strings.items()}
+
+    extras_by_name: Dict[str, Any] = {}
+    if want_struct:
+        built = _v2_arrays_vectorized(meta, part_strings, stats, n)
+        if built is None:
+            # runtime vectorization failure (pretty-printed stats, a cast
+            # the batch path can't make): fall back to the dataclass row
+            # builder, which coerces per value — NOT to a struct-less
+            # columnar write, which would flip this table's checkpoint
+            # schema between versions. (Schemas the vectorized builder
+            # can't type at all never reach here under the engine default:
+            # write_stats_as_struct gates on _struct_stats_vectorizable.)
+            return None
+        extra_fields, extra_children = built
+        if extra_fields:
+            extras_by_name = {
+                f.name: c for f, c in zip(extra_fields, extra_children)}
+            add_idx = schema.get_field_index("add")
+            add_type = pa.struct(list(add_type) + extra_fields)
+            schema = schema.set(add_idx, pa.field("add", add_type))
+
+    if part_cols and n:
+        # one map column for all rows: every row carries the same key set,
+        # so offsets/keys are arithmetic and the values interleave with one
+        # C++ take over the per-column string arrays
+        kp = len(part_cols)
+        offsets = pa.array(np.arange(n + 1, dtype=np.int32) * kp)
+        keys = pa.array(part_cols, pa.string()).take(
+            pa.array(np.tile(np.arange(kp, dtype=np.int64), n)))
+        stacked = pa.concat_arrays([part_strings[c] for c in part_cols])
+        perm = (np.tile(np.arange(kp, dtype=np.int64) * n, n)
+                + np.repeat(np.arange(n, dtype=np.int64), kp))
+        part_maps = pa.MapArray.from_arrays(
+            offsets, keys, stacked.take(pa.array(perm))).cast(str_map)
+    else:
+        part_maps = pa.MapArray.from_arrays(
+            pa.array(np.zeros(n + 1, np.int32)),
+            pa.array([], pa.string()), pa.array([], pa.string()),
+        ).cast(str_map)
 
     # few + may carry fields the columns don't (extendedFileMetadata):
     # protocol/metadata/txns/tombstones stay on the exact row path —
@@ -485,28 +774,12 @@ def write_checkpoint_columnar(
     }
     head = pa.Table.from_pydict(head_cols, schema=schema)
 
-    rows = np.nonzero(snapshot._alive_mask)[0]
-    n = len(rows)
-    paths = pa.array(cols.paths_for(rows), pa.string())
-    empty_maps = pa.MapArray.from_arrays(
-        pa.array(np.zeros(n + 1, np.int32)),
-        pa.array([], pa.string()), pa.array([], pa.string()),
-    ).cast(str_map)
-    if cols.stats is not None and n:
-        stats = cols.stats.take(pa.array(rows, pa.int64()))
-        if isinstance(stats, pa.ChunkedArray):
-            stats = stats.combine_chunks()
-            if isinstance(stats, pa.ChunkedArray):
-                stats = (pa.concat_arrays(stats.chunks)
-                         if stats.num_chunks != 1 else stats.chunk(0))
-    else:
-        stats = pa.nulls(n, pa.string())
     children = []
     for f in add_type:
         if f.name == "path":
             children.append(paths)
         elif f.name == "partitionValues":
-            children.append(empty_maps)
+            children.append(part_maps)
         elif f.name == "size":
             children.append(pa.array(cols.size[rows]))
         elif f.name == "modificationTime":
@@ -515,6 +788,8 @@ def write_checkpoint_columnar(
             children.append(pa.array(np.zeros(n, bool)))
         elif f.name == "stats":
             children.append(stats)
+        elif f.name in extras_by_name:
+            children.append(extras_by_name[f.name])
         else:  # tags / deletionVector: absent by the fast-path precondition
             children.append(pa.nulls(n, f.type))
     add_struct = pa.StructArray.from_arrays(children, fields=list(add_type))
@@ -566,9 +841,12 @@ def write_checkpoint(
     reference's multi-part support is read-only in this version — its writer
     is a single-task ``repartition(1)``; we go wider). Files are staged and
     atomically renamed when the store shows partial writes
-    (``Checkpoints.scala:271-303``). Tables with
-    ``delta.checkpoint.writeStatsAsStruct=true`` additionally get the V2
-    ``partitionValues_parsed``/``stats_parsed`` typed columns."""
+    (``Checkpoints.scala:271-303``). Tables with struct stats enabled
+    (:func:`write_stats_as_struct` — explicit
+    ``delta.checkpoint.writeStatsAsStruct`` table property, else the
+    session conf ``delta.tpu.checkpoint.writeStatsAsStruct``, default on)
+    additionally get the V2 ``partitionValues_parsed``/``stats_parsed``
+    typed columns."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -708,18 +986,22 @@ def _fix_maps(d: Dict[str, Any], keys) -> Dict[str, Any]:
 
 
 def read_checkpoint_actions(store: LogStore, paths: Sequence[str]) -> List[Action]:
-    """Read one checkpoint (all its part files) back into actions."""
-    import pyarrow.parquet as pq
-    import pyarrow as pa
+    """Read one checkpoint (all its part files) back into actions.
 
+    Part files fetch and decode concurrently via
+    :func:`delta_tpu.log.columnar.decode_checkpoint_parts` (the writer
+    already writes them that way). Output order is deterministic: parts in
+    input order, per-column action order within a part."""
+    from delta_tpu.log.columnar import decode_checkpoint_parts
+
+    try:
+        tables = decode_checkpoint_parts(store, paths)
+    except FileNotFoundError as e:
+        # all parts share one version; name the checkpoint, not the part
+        version = filenames.get_file_version(os.path.basename(paths[0]))
+        raise errors.missing_part_files(version, e) from e
     out: List[Action] = []
-    for path in paths:
-        try:
-            data = store.read_bytes(path)
-        except FileNotFoundError as e:
-            version = filenames.get_file_version(os.path.basename(path))
-            raise errors.missing_part_files(version, e) from e
-        table = pq.read_table(pa.BufferReader(data))
+    for table in tables:
         for name in ("protocol", "metaData", "txn", "remove", "add"):
             if name not in table.column_names:
                 continue

@@ -39,6 +39,7 @@ from delta_tpu.protocol.actions import (
     action_from_json,
 )
 from delta_tpu.storage.logstore import LogStore
+from delta_tpu.utils.arrow import one_chunk
 from delta_tpu.utils.errors import DeltaIllegalStateError
 
 __all__ = ["SegmentColumns", "decode_segment", "decode_json_commits", "decode_checkpoint_parts"]
@@ -123,6 +124,14 @@ class _Batch:
         if "add" not in self.table.column_names:
             return None
         add = self.table.column("add")
+        sel = pa.array(self.table_index[local_rows])
+        got = self._part_strings_from_map(add, sel, part_cols)
+        if got is None:
+            got = self._part_strings_from_parsed(add, sel, part_cols)
+        return got
+
+    @staticmethod
+    def _part_strings_from_map(add, sel, part_cols) -> Optional[Dict[str, pa.Array]]:
         add_t = add.type
         if not any(add_t.field(i).name == "partitionValues"
                    for i in range(add_t.num_fields)):
@@ -130,7 +139,6 @@ class _Batch:
         pv = pc.struct_field(add, "partitionValues")
         if not pa.types.is_map(pv.type):
             return None
-        sel = pa.array(self.table_index[local_rows])
         pv = pv.take(sel)
         out: Dict[str, pa.Array] = {}
         for c in part_cols:
@@ -138,9 +146,34 @@ class _Batch:
                 vals = pc.map_lookup(pv, query_key=c, occurrence="first")
             except Exception:
                 return None
-            if isinstance(vals, pa.ChunkedArray):
-                vals = vals.combine_chunks()
-            out[c] = vals.cast(pa.string())
+            out[c] = one_chunk(vals).cast(pa.string())
+        return out
+
+    @staticmethod
+    def _part_strings_from_parsed(add, sel, part_cols) -> Optional[Dict[str, pa.Array]]:
+        """Fallback for checkpoints that carry only the typed
+        ``partitionValues_parsed`` struct (no raw map): render each typed
+        leaf back to a string. The rendering is Arrow's canonical cast, so
+        every batch of such a checkpoint encodes a value the same way —
+        dictionary codes stay consistent within the segment."""
+        add_t = add.type
+        if not any(add_t.field(i).name == "partitionValues_parsed"
+                   for i in range(add_t.num_fields)):
+            return None
+        pv = pc.struct_field(add, "partitionValues_parsed")
+        if not pa.types.is_struct(pv.type):
+            return None
+        fields = {pv.type.field(i).name for i in range(pv.type.num_fields)}
+        if not set(part_cols) <= fields:
+            return None
+        pv = pv.take(sel)
+        out: Dict[str, pa.Array] = {}
+        for c in part_cols:
+            try:
+                vals = pc.struct_field(pv, c).cast(pa.string())
+            except Exception:
+                return None
+            out[c] = one_chunk(vals)
         return out
 
     def materialize(self, local_rows: np.ndarray) -> List[Action]:
@@ -187,6 +220,12 @@ class SegmentColumns:
     stats: Optional[pa.ChunkedArray]  # string, aligned with rows (may be None)
     other_actions: List[Action]  # Protocol/Metadata/SetTransaction, replay order
     batches: List[_Batch] = field(default_factory=list)
+    # checkpoint `add.stats_parsed` struct column, aligned with rows: typed
+    # per-file stats (numRecords/minValues/maxValues/nullCount) for the
+    # zero-JSON state export; null on rows whose source batch lacks the
+    # column (JSON commit tails, pre-struct checkpoints). None when no batch
+    # carries it (or batch types disagree).
+    stats_parsed: Optional[pa.ChunkedArray] = None
 
     @property
     def num_rows(self) -> int:
@@ -336,14 +375,24 @@ def _extract_file_columns(table: pa.Table):
 
 
 def decode_checkpoint_parts(store: LogStore, paths: Sequence[str]) -> List[pa.Table]:
-    """Read checkpoint part files into Arrow tables (no row materialization)."""
+    """Read checkpoint part files into Arrow tables (no row materialization).
+
+    Parts fetch and decode concurrently (the writer already writes them
+    that way): both the store read and Arrow's Parquet decode drop the GIL,
+    so a multi-part checkpoint decodes at aggregate disk/codec bandwidth
+    instead of summing per-part latencies. Order is preserved — part order
+    is replay order."""
     import pyarrow.parquet as pq
 
-    tables = []
-    for p in paths:
-        data = store.read_bytes(p)
-        tables.append(pq.read_table(pa.BufferReader(data)))
-    return tables
+    def _one(p: str) -> pa.Table:
+        return pq.read_table(pa.BufferReader(store.read_bytes(p)))
+
+    if len(paths) <= 1:
+        return [_one(p) for p in paths]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(len(paths), 16)) as ex:
+        return list(ex.map(_one, paths))
 
 
 def decode_json_commits(
@@ -413,6 +462,7 @@ def decode_segment(
     path_chunks: List[pa.Array] = []
     col_chunks: List[Tuple[np.ndarray, ...]] = []  # is_add, size, mtime, dts
     stats_chunks: List[pa.Array] = []
+    sp_chunks: List[Tuple[Optional[pa.Array], int]] = []  # (stats_parsed, n)
     other: List[Action] = []
     row_offset = 0
 
@@ -461,8 +511,15 @@ def decode_segment(
                 take_np(r_dts, 0).astype(np.int64, copy=False),
             )
         )
-        st = a_stats.take(sel)
-        stats_chunks.append(st.combine_chunks() if isinstance(st, pa.ChunkedArray) else st)
+        stats_chunks.append(one_chunk(a_stats.take(sel)))
+        sp = None
+        if lines is None and "add" in table.column_names:
+            add_t = table.column("add").type
+            if any(add_t.field(i).name == "stats_parsed"
+                   for i in range(add_t.num_fields)):
+                sp = one_chunk(
+                    pc.struct_field(table.column("add"), "stats_parsed").take(sel))
+        sp_chunks.append((sp, n_files))
         batch.row_offset = row_offset
         batch.num_rows = n_files
         row_offset += n_files
@@ -496,6 +553,17 @@ def decode_segment(
     if isinstance(enc, pa.ChunkedArray):
         enc = enc.combine_chunks()
     path_id = enc.indices.to_numpy(zero_copy_only=False).astype(np.int32, copy=False)
+    # align stats_parsed across batches: batches without the column (JSON
+    # tails, pre-struct checkpoints) contribute typed nulls; disagreeing
+    # struct types (shouldn't happen within one segment) disable the column
+    sp_types = {c.type for c, _n in sp_chunks if c is not None}
+    stats_parsed = None
+    if len(sp_types) == 1:
+        sp_t = next(iter(sp_types))
+        stats_parsed = pa.chunked_array(
+            [c if c is not None else pa.nulls(k, sp_t) for c, k in sp_chunks],
+            type=sp_t,
+        )
     return SegmentColumns(
         path_dict=enc.dictionary,
         path_id=path_id,
@@ -506,4 +574,5 @@ def decode_segment(
         stats=pa.chunked_array(stats_chunks),
         other_actions=other,
         batches=batches,
+        stats_parsed=stats_parsed,
     )

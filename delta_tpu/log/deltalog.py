@@ -52,7 +52,17 @@ def extract_path_time_travel(path: str):
     """(base_path, version, timestamp_ms) when ``path`` carries an embedded
     time-travel suffix, else None. Callers apply it only when the literal
     path is NOT itself a Delta table (a directory literally named ``t@v1``
-    wins, matching the reference's resolution order)."""
+    wins, matching the reference's resolution order).
+
+    DEVIATION (documented, PARITY.md): the ``@yyyyMMddHHmmssSSS`` timestamp
+    form is interpreted as **UTC**, not the session timezone. The reference
+    parses it with a session-zone ``SimpleDateFormat``
+    (`DeltaTimeTravelSpec.scala:137`), so the same literal can pin a
+    different version per client zone; this engine has no session timezone
+    and deliberately resolves the digits as a UTC wall clock — the same
+    path string selects the same version everywhere. Use an explicit
+    ``@v<N>`` pin when cross-engine reproducibility against a non-UTC
+    reference session matters."""
     m = _TT_SUFFIX.match(path.rstrip("/"))
     if not m:
         return None

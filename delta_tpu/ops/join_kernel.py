@@ -51,6 +51,7 @@ hash join.
 from __future__ import annotations
 
 import functools
+from delta_tpu.utils.jaxcompat import enable_x64
 import threading
 from typing import Callable, NamedTuple, Optional
 
@@ -141,7 +142,7 @@ def _sharded_kernel_cached(mesh, axis):
 
 def _sharded_kernel(jax, mesh, axis):
     import jax.numpy as jnp
-    from jax import shard_map
+    from delta_tpu.utils.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @functools.partial(
@@ -331,7 +332,7 @@ def inner_join_async(
         import jax
 
         try:
-            with jax.enable_x64():
+            with enable_x64():
                 if p == 1:
                     kernel = _single_device_kernel_cached()
                     args = [jax.device_put(t_in), jax.device_put(s_in)]

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from delta_tpu.utils.jaxcompat import enable_x64
 from delta_tpu.utils.config import conf
 
 __all__ = ["ResidentJoinKeys", "KeyCache", "PhysicalProbe"]
@@ -548,7 +549,7 @@ class ResidentJoinKeys:
             # stalling the process for the whole slab (tile counts are in
             # ELEMENTS, derived from the byte budget per dtype)
             tile_bytes = 32 << 20
-            with jax.enable_x64():
+            with enable_x64():
                 def ship(arr):
                     step = max(tile_bytes // arr.itemsize, 1)
                     if len(arr) <= step:
@@ -578,7 +579,7 @@ class ResidentJoinKeys:
             return
         if not self._sort_stale and "sorted_keys" in self._dev:
             return
-        with jax.enable_x64():
+        with enable_x64():
             sk, pm, inv, sv = _sort_kernel()(
                 self._dev["keys"], self._dev["valid"],
                 jnp.asarray(np.int32(self.num_rows)))
@@ -637,7 +638,7 @@ class ResidentJoinKeys:
         self._sort_stale = True
         for k in ("sorted_keys", "perm", "inv_perm", "sorted_valid"):
             self._dev.pop(k, None)
-        with jax.enable_x64():
+        with enable_x64():
             if contiguous:
                 self._dev["keys"], self._dev["valid"] = (
                     _update_kernels()["slice_append"](
@@ -721,7 +722,7 @@ class ResidentJoinKeys:
 
         def launch():
             try:
-                with jax.enable_x64():
+                with enable_x64():
                     # no block_until_ready: the dispatch is async and the
                     # first finalize fetch blocks anyway — an explicit sync
                     # here would cost one extra ~100ms round trip on a

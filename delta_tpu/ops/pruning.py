@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 import pyarrow.compute as pc
 
+from delta_tpu.utils.jaxcompat import enable_x64
 from delta_tpu.expr import ir
 from delta_tpu.expr import partition as partition_expr
 from delta_tpu.protocol.actions import AddFile, Metadata
@@ -203,7 +204,7 @@ def _prune_device(arrays: state_export.FileStateArrays, pred: ir.Expression) -> 
     except NotDeviceCompilable:
         return None
     try:
-        with jax.enable_x64():
+        with enable_x64():
             col = fn(arrays.device_env())
     except Exception:
         return None

@@ -26,8 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
-from jax import shard_map
 
+from delta_tpu.utils.jaxcompat import enable_x64, shard_map
 from delta_tpu.ops.state_export import ReplayArrays
 from delta_tpu.parallel.mesh import P, STATE_AXIS, shard_count
 
@@ -100,7 +100,7 @@ def replay_alive_mask(arrays: ReplayArrays, min_retention_ts: int = 0) -> Replay
     cap = _next_pow2(n)
     # x64 scoped to the kernel: seq keys, sizes and retention timestamps are
     # genuine 64-bit lanes, but the process-global dtype default stays intact.
-    with jax.enable_x64():
+    with enable_x64():
         alive, tombstone, stats = _replay_kernel(
             jnp.asarray(_pad(arrays.path_id, cap, np.int32(-1))),
             jnp.asarray(_pad(arrays.seq, cap, np.int64(0))),
@@ -231,7 +231,7 @@ def replay_sharded(
         ntomb = jax.lax.psum(stats.num_tombstones, STATE_AXIS)
         return alive[None], tombstone[None], num, tot, ntomb
 
-    with jax.enable_x64():
+    with enable_x64():
         alive_sh, tomb_sh, num, tot, ntomb = jax.jit(shard_replay)(
             path_id, seq, is_add, size, del_ts
         )

@@ -616,7 +616,7 @@ class ResidentState:
 
     # -- serving ----------------------------------------------------------
 
-    def plan_ranges(self, ranges: Sequence[RangeSet], k: int = 256,
+    def plan_ranges(self, ranges: Sequence[RangeSet], k=256,
                     use_device: Optional[bool] = None,
                     expected_version: Optional[int] = None) -> Optional[List[PlanResult]]:
         """Evaluate a batch of range queries against the resident lanes:
@@ -624,15 +624,24 @@ class ResidentState:
         short-circuit; device/host routing follows the link cost model unless
         pinned (each PlanResult records the route in ``via``).
 
+        ``k`` caps each result's row list: a scalar for the whole batch, or
+        a per-range sequence (len(ranges)) — so a multi-term (OR/IN) query
+        that needs its complete row set for the post-plan union doesn't
+        force every single-term query sharing the dispatch onto huge plans.
+
         Runs under the entry lock so a concurrent ``apply_tail`` cannot
         mutate the mirrors mid-plan; ``expected_version`` guards the other
         race — the entry advancing *past* the caller's snapshot between
         lookup and plan — by returning None (caller re-plans or falls back).
         """
+        n = len(ranges)
+        ks = (np.full(n, int(k), np.int64) if np.isscalar(k)
+              else np.asarray(k, np.int64))
+        if len(ks) != n:
+            raise ValueError(f"per-range k length {len(ks)} != {n} ranges")
         with self._lock:
             if expected_version is not None and self.version != expected_version:
                 return None
-            n = len(ranges)
             real_ix = [i for i, r in enumerate(ranges) if r.verdict is None]
             out: List[Optional[PlanResult]] = [None] * n
             alive_rows = np.nonzero(self.h_alive[: self.num_rows])[0]
@@ -640,23 +649,25 @@ class ResidentState:
                 if r.verdict == "empty":
                     out[i] = PlanResult(0, np.empty(0, np.int64), via="verdict")
                 elif r.verdict == "all":
-                    out[i] = PlanResult(len(alive_rows), alive_rows[:k],
-                                        overflow=len(alive_rows) > k, via="verdict")
+                    out[i] = PlanResult(len(alive_rows), alive_rows[:ks[i]],
+                                        overflow=len(alive_rows) > ks[i],
+                                        via="verdict")
             if not real_ix:
                 return out  # type: ignore[return-value]
             lo = np.stack([ranges[i].lo for i in real_ix])  # (M, C)
             hi = np.stack([ranges[i].hi for i in real_ix])
+            real_ks = ks[real_ix]
             if use_device is None:
-                use_device = self._device_profitable(len(real_ix), k)
-            results = (self._plan_device(lo, hi, k) if use_device
-                       else self._plan_host(lo, hi, k))
+                use_device = self._device_profitable(len(real_ix))
+            results = (self._plan_device(lo, hi, real_ks) if use_device
+                       else self._plan_host(lo, hi, real_ks))
             via = "device" if use_device else "host-resident"
             for j, i in enumerate(real_ix):
                 results[j].via = via
                 out[i] = results[j]
             return out  # type: ignore[return-value]
 
-    def _device_profitable(self, m: int, k: int) -> bool:
+    def _device_profitable(self, m: int) -> bool:
         if not conf.get_bool("delta.tpu.stateCache.devicePlan.enabled", True):
             return False
         mode = conf.get("delta.tpu.stateCache.devicePlan.mode", "auto")
@@ -678,7 +689,8 @@ class ResidentState:
             device_s += p.upload_s(self.device_bytes)
         return device_s < host_s
 
-    def _plan_host(self, lo: np.ndarray, hi: np.ndarray, k: int) -> List[PlanResult]:
+    def _plan_host(self, lo: np.ndarray, hi: np.ndarray,
+                   ks: np.ndarray) -> List[PlanResult]:
         n = self.num_rows
         mins, maxs = self.h_lo[:, :n], self.h_hi[:, :n]
         alive = self.h_alive[:n]
@@ -691,10 +703,12 @@ class ResidentState:
                 if not np.isnan(hi[q, c]):
                     keep &= ~(mins[c] > hi[q, c])
             rows = np.nonzero(keep)[0]
+            k = ks[q]
             out.append(PlanResult(len(rows), rows[:k], overflow=len(rows) > k))
         return out
 
-    def _plan_device(self, lo: np.ndarray, hi: np.ndarray, k: int) -> List[PlanResult]:
+    def _plan_device(self, lo: np.ndarray, hi: np.ndarray,
+                     ks: np.ndarray) -> List[PlanResult]:
         """Coarse-fine plan: the device culls 1024-file BLOCKS (one dispatch
         over the resident f32 lanes, one tiny packed-bitmap download); the
         host then evaluates exactly (float64 mirrors) inside the surviving
@@ -735,6 +749,7 @@ class ResidentState:
                 if not np.isnan(hi[q, c]):
                     keep &= ~(mins[c][cand] > hi[q, c])
             rows = cand[keep]
+            k = ks[q]
             out.append(PlanResult(len(rows), rows[:k], overflow=len(rows) > k))
         return out
 

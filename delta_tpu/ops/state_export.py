@@ -17,6 +17,7 @@ import numpy as np
 import pyarrow as pa
 
 from delta_tpu.protocol.actions import Action, AddFile, Metadata, RemoveFile
+from delta_tpu.utils.arrow import one_chunk as _one_chunk
 from delta_tpu.schema.types import (
     ByteType,
     DataType,
@@ -34,6 +35,7 @@ __all__ = [
     "FileStateArrays",
     "files_to_arrays",
     "arrays_from_columns",
+    "stats_json_table",
     "stats_table",
     "ReplayArrays",
     "actions_to_arrays",
@@ -221,7 +223,7 @@ def _temporal_to_lane(arr: pa.Array, dt: DataType) -> Optional[np.ndarray]:
 
     try:
         if isinstance(dt, DateType):
-            if pa.types.is_timestamp(arr.type):
+            if pa.types.is_timestamp(arr.type) or pa.types.is_date(arr.type):
                 days = arr.cast(pa.date32()).cast(pa.int32())
             else:
                 days = arr.cast(pa.string()).cast(pa.date32()).cast(pa.int32())
@@ -294,6 +296,81 @@ def _string_prefix_lanes(arr) -> Optional[np.ndarray]:
     return out
 
 
+def stats_json_table(st: pa.Array, explicit_schema: Optional[pa.Schema] = None):
+    """One C++ ndjson parse of a per-file stats JSON string column.
+
+    Returns ``(kind, parsed, idx)``: ``idx`` are the input row positions
+    whose stats were non-blank and ``parsed`` is the Arrow table aligned
+    with them (``kind == "ok"``). ``kind == "empty"`` means no stats at
+    all; ``"newline"`` means a pretty-printed stats string would desync
+    the ndjson rows (callers take a per-row path); ``"malformed"`` means
+    the batch parse failed (callers treat every stat as missing — pruning
+    stays conservative).
+
+    ``explicit_schema`` pins the parsed column types (extra JSON fields are
+    ignored). Callers that PERSIST the parsed values (the struct-stats
+    checkpoint writer) must pass one: without it the Arrow JSON reader
+    type-infers, and a *string* column whose values look like ISO dates
+    ('2021-01-01') comes back as timestamp[s] — rendering it back to text
+    would store a different literal than the table holds.
+
+    The newline-join runs entirely in C++ (a ListArray wrapping slices of
+    the column, then ``binary_join``) — a ``to_pylist`` + ``"\\n".join``
+    here round-trips every string through Python objects and dominates the
+    cold cache build. Joins run in <=1 GiB slices: one giant join would
+    hit Arrow's 2 GiB int32 offset capacity on ~10M-file tables.
+    """
+    import pyarrow.compute as pc
+    import pyarrow.json as pajson
+
+    st = _one_chunk(st)
+    blank = pc.if_else(pc.equal(pc.utf8_trim_whitespace(st.fill_null("")), ""), None, st)
+    if bool(pc.any(pc.match_substring(blank.fill_null(""), "\n")).as_py() or False):
+        return "newline", None, None
+    valid = np.asarray(pc.is_valid(blank))
+    idx = np.nonzero(valid)[0]
+    compact = blank.drop_null()
+    if isinstance(compact, pa.ChunkedArray):
+        compact = compact.combine_chunks()
+    if len(compact) == 0:
+        return "empty", None, idx
+    try:
+        parts = []
+        total = len(compact)
+        start = 0
+        budget = 1 << 30
+        offs = np.frombuffer(compact.buffers()[1], np.int32,
+                             count=total + 1, offset=compact.offset * 4)
+        while start < total:
+            end = start + 1
+            base = offs[start]
+            while end < total and offs[end + 1] - base <= budget:
+                end += 1
+            sl = compact.slice(start, end - start)
+            sl = pa.concat_arrays([sl])  # re-materialize exact offsets
+            lst = pa.ListArray.from_arrays(
+                pa.array([0, len(sl)], pa.int32()), sl.cast(pa.string()))
+            raw = pc.binary_join(lst, "\n").cast(pa.binary())[0].as_buffer()
+            parse_opts = (pajson.ParseOptions(
+                explicit_schema=explicit_schema,
+                unexpected_field_behavior="ignore",
+            ) if explicit_schema is not None else None)
+            parts.append(pajson.read_json(
+                pa.BufferReader(raw),
+                read_options=pajson.ReadOptions(use_threads=True,
+                                                block_size=8 << 20),
+                parse_options=parse_opts,
+            ))
+            start = end
+        parsed = (parts[0] if len(parts) == 1
+                  else pa.concat_tables(parts, promote_options="permissive"))
+    except Exception:
+        return "malformed", None, None
+    if parsed.num_rows != len(idx):
+        return "malformed", None, None
+    return "ok", parsed, idx
+
+
 def arrays_from_columns(
     cols,
     rows_mask: np.ndarray,
@@ -305,16 +382,17 @@ def arrays_from_columns(
     """Vectorized :class:`FileStateArrays` straight from a columnar segment
     (``delta_tpu.log.columnar.SegmentColumns``) — no AddFile dataclasses.
 
-    The per-row stats JSON strings are parsed in one C++ ndjson pass
-    (``pyarrow.json``), replacing a Python loop over ``stats_dict()`` calls;
-    at 1M files this is the difference between a cache build in seconds vs
-    minutes. Returns None for shapes the vectorized path can't carry —
-    partitioned tables (``partitionValues`` is a dynamic-key map, recovered
-    only on dataclass materialization) — and callers fall back to
-    :func:`files_to_arrays`.
+    Stat lanes prefer the checkpoint's typed ``stats_parsed`` struct
+    columns (zero JSON: float64 lanes build directly from typed Arrow
+    leaves); rows or columns the struct doesn't cover fall back to one C++
+    ndjson pass over the raw stats strings (``pyarrow.json``), replacing a
+    Python loop over ``stats_dict()`` calls — at 1M files this is the
+    difference between a cache build in seconds vs minutes. Partition
+    values come vectorized from the checkpoint map columns (or the tail's
+    JSON lines). Returns None for shapes neither path can carry, and
+    callers fall back to :func:`files_to_arrays`.
     """
     import pyarrow.compute as pc
-    import pyarrow.json as pajson
 
     rows = np.nonzero(rows_mask)[0] if rows_mask.dtype == bool else np.asarray(rows_mask)
     part_cols = list(metadata.partition_columns)
@@ -367,60 +445,67 @@ def arrays_from_columns(
         partition_codes=part_codes, partition_dicts=part_dicts,
         stats_min=smin, stats_max=smax, stats_null_count=snull,
     )
-    if cols.stats is None or n == 0:
+    if n == 0:
         return out
 
-    st = cols.stats.take(pa.array(rows, pa.int64()))
-    if isinstance(st, pa.ChunkedArray):
-        st = st.combine_chunks()
-        if isinstance(st, pa.ChunkedArray):
-            st = pa.concat_arrays(st.chunks) if st.num_chunks != 1 else st.chunk(0)
-    # pretty-printed stats (embedded newlines) would desync the ndjson rows —
-    # bail to the dataclass path, which parses per row
-    blank = pc.if_else(pc.equal(pc.utf8_trim_whitespace(st.fill_null("")), ""), None, st)
-    if bool(pc.any(pc.match_substring(blank.fill_null(""), "\n")).as_py() or False):
+    import time as _time
+
+    from delta_tpu.utils.telemetry import bump_counter
+
+    _t0 = _time.perf_counter()
+
+    def _lane_us():
+        # stats-lane build time in µs (telemetry: the BENCH metric-6 "parse
+        # time" component, isolated from the shared path/size extraction)
+        bump_counter("stateExport.statsLanes.us",
+                     int((_time.perf_counter() - _t0) * 1e6))
+
+    # -- typed struct-stats fast path (zero JSON) --------------------------
+    # Checkpoints written with `stats_parsed` (struct columns typed from the
+    # table schema) surface it through the columnar segment; the lanes then
+    # build from typed Arrow leaves with no JSON parse at all. Rows the
+    # struct misses (JSON commit tails, old checkpoint parts) fall back to
+    # the batched ndjson parse below, restricted to just those rows.
+    struct_rows: Optional[np.ndarray] = None  # bool mask: struct-covered rows
+    sp = cols.stats_parsed
+    if sp is not None:
+        sp = sp.take(pa.array(rows, pa.int64()))
+        sp = _one_chunk(sp)
+        struct_rows = _struct_stat_lanes(
+            sp, stats_columns, prefix_set, col_types,
+            num_records, smin, smax, snull)
+    if struct_rows is not None and (cols.stats is None
+                                    or bool(struct_rows.all())):
+        # every row struct-served: never materialize the JSON string column
+        bump_counter("stateExport.statsLanes.struct")
+        _lane_us()
+        return out
+
+    st = None
+    if cols.stats is not None:
+        st = _one_chunk(cols.stats.take(pa.array(rows, pa.int64())))
+    if struct_rows is not None:
+        json_rows = np.asarray(pc.is_valid(st)) & ~struct_rows
+        if not json_rows.any():
+            bump_counter("stateExport.statsLanes.struct")
+            _lane_us()
+            return out
+        # mask the struct-covered rows out of the JSON pass
+        st = pc.if_else(pa.array(json_rows), st, pa.scalar(None, pa.string()))
+        bump_counter("stateExport.statsLanes.mixed")
+    if st is None:
+        return out
+
+    kind, parsed, idx = stats_json_table(st)
+    if kind == "newline":
+        # pretty-printed stats would desync the ndjson rows — bail to the
+        # dataclass path, which parses per row
         return None
-    valid = np.asarray(pc.is_valid(blank))
-    idx = np.nonzero(valid)[0]
-    compact = blank.drop_null()
-    if isinstance(compact, pa.ChunkedArray):
-        compact = compact.combine_chunks()
-    if len(compact) == 0:
-        return out
-    # newline-join the stats strings in C++ (a ListArray wrapping a slice
-    # of the column, then binary_join) — the old to_pylist + "\n".join
-    # round-tripped every string through Python objects and dominated the
-    # cold cache build. Joins run in <=1 GiB slices: one giant join would
-    # hit Arrow's 2 GiB int32 offset capacity on ~10M-file tables.
-    try:
-        parts = []
-        total = len(compact)
-        start = 0
-        budget = 1 << 30
-        offs = np.frombuffer(compact.buffers()[1], np.int32,
-                             count=total + 1, offset=compact.offset * 4)
-        while start < total:
-            end = start + 1
-            base = offs[start]
-            while end < total and offs[end + 1] - base <= budget:
-                end += 1
-            sl = compact.slice(start, end - start)
-            sl = pa.concat_arrays([sl])  # re-materialize exact offsets
-            lst = pa.ListArray.from_arrays(
-                pa.array([0, len(sl)], pa.int32()), sl.cast(pa.string()))
-            raw = pc.binary_join(lst, "\n").cast(pa.binary())[0].as_buffer()
-            parts.append(pajson.read_json(
-                pa.BufferReader(raw),
-                read_options=pajson.ReadOptions(use_threads=True,
-                                                block_size=8 << 20),
-            ))
-            start = end
-        parsed = (parts[0] if len(parts) == 1
-                  else pa.concat_tables(parts, promote_options="permissive"))
-    except Exception:
-        return out  # malformed stats anywhere → all-missing (keeps every file)
-    if parsed.num_rows != len(idx):
-        return out
+    if kind != "ok":
+        _lane_us()
+        return out  # no/malformed stats → all-missing (keeps every file)
+    if struct_rows is None:
+        bump_counter("stateExport.statsLanes.json")
 
     def _scatter_f(dst: np.ndarray, lane: Optional[np.ndarray]):
         if lane is not None:
@@ -463,7 +548,71 @@ def arrays_from_columns(
                 lane = _numeric_to_lane(pc.struct_field(col, c))
                 if lane is not None:
                     snull[c][idx] = np.where(np.isnan(lane), -1, lane).astype(np.int64)
+    _lane_us()
     return out
+
+
+
+
+def _struct_fieldset(t: pa.DataType, name: str) -> set:
+    if not pa.types.is_struct(t):
+        return set()
+    for i in range(t.num_fields):
+        f = t.field(i)
+        if f.name == name:
+            if pa.types.is_struct(f.type):
+                return {f.type.field(j).name for j in range(f.type.num_fields)}
+            return set()
+    return set()
+
+
+def _struct_stat_lanes(sp, stats_columns, prefix_set, col_types,
+                       num_records, smin, smax, snull) -> Optional[np.ndarray]:
+    """Scatter stat lanes from a ``stats_parsed`` struct column (aligned
+    with the output rows). Returns the bool mask of rows the struct served,
+    or None when it cannot serve this request — struct absent/all-null, or
+    a requested column missing from its min/max fields (the JSON path then
+    computes everything, so no column is half-served)."""
+    import pyarrow.compute as pc
+
+    if sp is None or not pa.types.is_struct(sp.type):
+        return None
+    minf = _struct_fieldset(sp.type, "minValues")
+    maxf = _struct_fieldset(sp.type, "maxValues")
+    if not set(stats_columns) <= (minf & maxf):
+        return None
+    sp_valid = np.asarray(pc.is_valid(sp))
+    if not sp_valid.any():
+        return None
+    idx = np.nonzero(sp_valid)[0]
+    spc = sp if len(idx) == len(sp) else sp.take(pa.array(idx, pa.int64()))
+    top = {sp.type.field(i).name for i in range(sp.type.num_fields)}
+    if "numRecords" in top:
+        lane = _numeric_to_lane(_one_chunk(pc.struct_field(spc, "numRecords")))
+        if lane is not None:
+            num_records[idx] = np.where(np.isnan(lane), -1, lane).astype(np.int64)
+    for struct_name, dest in (("minValues", smin), ("maxValues", smax)):
+        col = _one_chunk(pc.struct_field(spc, struct_name))
+        for c in stats_columns:
+            leaf = _one_chunk(pc.struct_field(col, c))
+            if c in prefix_set:
+                lane = _string_prefix_lanes(leaf)
+            else:
+                lane = _numeric_to_lane(leaf)
+                if lane is None:
+                    lane = _temporal_to_lane(leaf, col_types.get(c, DoubleType()))
+            if lane is not None:
+                dest[c][idx] = lane
+    ncf = _struct_fieldset(sp.type, "nullCount")
+    if ncf:
+        col = _one_chunk(pc.struct_field(spc, "nullCount"))
+        for c in stats_columns:
+            if c not in ncf:
+                continue
+            lane = _numeric_to_lane(_one_chunk(pc.struct_field(col, c)))
+            if lane is not None:
+                snull[c][idx] = np.where(np.isnan(lane), -1, lane).astype(np.int64)
+    return sp_valid
 
 
 def stats_table(files: Sequence[AddFile], metadata: Metadata,

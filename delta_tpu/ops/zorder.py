@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import numpy as np
+from delta_tpu.utils.jaxcompat import enable_x64
 
 __all__ = ["morton_order", "rank_u16"]
 
@@ -51,7 +52,7 @@ def morton_order(columns: Sequence[np.ndarray]) -> np.ndarray:
                     key = key | (bit.astype(jnp.uint64) << (b * k + c))
             return key
 
-        with jax.enable_x64():
+        with enable_x64():
             key = np.asarray(interleave([jnp.asarray(r) for r in ranks]))
     except Exception:
         key = np.zeros(len(ranks[0]), np.uint64)

@@ -102,15 +102,21 @@ def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
         lens = pc.utf8_length(col)
         over = pc.greater(lens, dt.length)
         if pc.any(over).as_py():
-            # trailing spaces beyond the bound trim away before judgment
+            # trailing spaces beyond the bound trim away before judgment —
+            # but over-length values TRUNCATE to exactly the bound (the
+            # reference's varcharTypeWriteSideCheck: 'ab   ' → varchar(4)
+            # stores 'ab  ', 4 chars — never a full rtrim, which would
+            # diverge stored lengths/equality from the reference format)
             trimmed = pc.utf8_rtrim(col, characters=" ")
-            col = pc.if_else(over, trimmed, col)
-            lens = pc.utf8_length(col)
-            over = pc.greater(lens, dt.length)
-            if pc.any(over).as_py():
-                sample = pa.table({name: col}).filter(over).column(name)[0].as_py()
+            still_over = pc.and_(over, pc.greater(pc.utf8_length(trimmed),
+                                                  dt.length))
+            if pc.any(still_over).as_py():
+                sample = pa.table({name: trimmed}).filter(
+                    still_over).column(name)[0].as_py()
                 raise errors.char_varchar_length_exceeded(
                     f.name, dt.name, dt.length, sample)
+            col = pc.if_else(
+                over, pc.utf8_slice_codeunits(col, 0, dt.length), col)
             table = table.set_column(
                 table.column_names.index(name),
                 pa.field(name, pa.string(), f.nullable), col)
@@ -123,11 +129,20 @@ def apply_write_semantics(table: pa.Table, metadata) -> pa.Table:
     return table
 
 
-def pad_char_literals(expr, metadata):
+def pad_char_literals(expr, metadata, target_qualifiers=None):
     """Read-side char padding (the reference's `ApplyCharTypePadding`):
     string literals compared against a char(n) column pad to width n, so
     `c = 'ab'` matches the stored 'ab   '. Applies to =, <, <=, >, >=, IN
-    with a char column on either side; other shapes pass through."""
+    with a char column on either side; other shapes pass through.
+
+    Only refs that RESOLVE to the target table pad (the reference pads
+    resolved char-typed attributes, never by name coincidence):
+    ``target_qualifiers=None`` means every qualifier names the target —
+    right for single-table contexts (scan/UPDATE/DELETE filters). MERGE
+    passes the set of qualifiers that resolve to the target (its target
+    alias, lowercased) so a SOURCE column that merely shares a name with a
+    target char column — ``s.status = 'x'`` — keeps its literal unpadded
+    instead of silently matching nothing."""
     from delta_tpu.expr import ir
 
     schema: StructType = metadata.schema
@@ -143,11 +158,12 @@ def pad_char_literals(expr, metadata):
     def width_of(node) -> Optional[int]:
         if not isinstance(node, ir.Column):
             return None
-        # alias-qualified references ("t.c") pad too: the suffix names the
-        # column; a false positive would only pad a literal compared to a
-        # non-char column of the same name, which other layers reject
-        name = node.name.lower().rsplit(".", 1)[-1]
-        return widths.get(name)
+        low = node.name.lower()
+        qual, _, col = low.rpartition(".")
+        if qual and target_qualifiers is not None \
+                and qual not in target_qualifiers:
+            return None  # qualified ref resolving elsewhere (merge source)
+        return widths.get(col)
 
     def pad(lit, n: int):
         if isinstance(lit, ir.Literal) and isinstance(lit.value, str) \

@@ -1,0 +1,20 @@
+"""Small shared Arrow helpers used across layers (log, ops)."""
+from __future__ import annotations
+
+import pyarrow as pa
+
+__all__ = ["one_chunk"]
+
+
+def one_chunk(arr):
+    """Collapse a (Chunked)Array to a single contiguous Array.
+
+    ``combine_chunks`` may still return a ChunkedArray (0 or 1 chunks
+    depending on version); normalize all the way down so callers can use
+    buffer-level APIs and ``take`` results uniformly."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = (pa.concat_arrays(arr.chunks)
+                   if arr.num_chunks != 1 else arr.chunk(0))
+    return arr

@@ -70,8 +70,13 @@ class SqlConf:
         "delta.tpu.schema.autoMerge.enabled": False,
         # ≈ DELTA_HISTORY_METRICS_ENABLED
         "delta.tpu.history.metricsEnabled": True,
-        # ≈ DELTA_CHECKPOINT_V2_ENABLED (struct stats columns in checkpoints)
-        "delta.tpu.checkpointV2.enabled": False,
+        # Materialize parsed per-file stats as typed Parquet struct columns
+        # (`add.stats_parsed` / `add.partitionValues_parsed`) in checkpoints
+        # when the table does not set delta.checkpoint.writeStatsAsStruct
+        # itself. Default ON: the cold state-cache build then reads typed
+        # columns instead of re-parsing per-file stats JSON (the dominant
+        # cost of a 1M-file cold build — see BENCH metric 6).
+        "delta.tpu.checkpoint.writeStatsAsStruct": True,
         # ≈ DELTA_WRITE_CHECKSUM_ENABLED
         "delta.tpu.writeChecksum.enabled": True,
         # Target max rows per written data file (write-path sharding unit).
@@ -201,10 +206,21 @@ class DeltaConfig(Generic[T]):
     validate: Optional[Callable[[T], bool]] = None
     help: str = ""
 
+    @property
+    def _session_default_key(self) -> str:
+        return f"delta.tpu.properties.defaults.{self.key[len('delta.'):]}"
+
+    def is_explicit(self, metadata) -> bool:
+        """True when the table (or the session defaults tier) sets this
+        property, i.e. :meth:`from_metadata` would NOT fall back to the
+        built-in default."""
+        return ((metadata.configuration or {}).get(self.key) is not None
+                or conf.get(self._session_default_key) is not None)
+
     def from_metadata(self, metadata) -> T:
         raw = (metadata.configuration or {}).get(self.key)
         if raw is None:
-            raw = conf.get(f"delta.tpu.properties.defaults.{self.key[len('delta.'):]}" )
+            raw = conf.get(self._session_default_key)
         if raw is None:
             raw = self.default
         try:

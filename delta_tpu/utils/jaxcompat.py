@@ -1,0 +1,35 @@
+"""Version-compat shims for the narrow slice of jax API the engine uses.
+
+Two names have moved across the jax releases the engine targets:
+``enable_x64`` (top-level in newer releases, ``jax.experimental`` before)
+and ``shard_map`` (top-level since 0.5, ``jax.experimental.shard_map``
+before). Kernels import the wrappers below so a version bump is a
+one-file fix.
+
+The wrappers resolve jax LAZILY, at call time: several modules
+(``ops/pruning``, ``ops/zorder``, ``ops/key_cache``, ``ops/join_kernel``)
+deliberately keep every jax import function-local so the plain host scan
+path never pays the multi-second ``import jax`` — importing this module
+must not break that.
+"""
+from __future__ import annotations
+
+__all__ = ["enable_x64", "shard_map"]
+
+
+def enable_x64():
+    """Context manager enabling 64-bit dtypes (``jax.enable_x64()``)."""
+    try:  # jax >= 0.5
+        from jax import enable_x64 as _enable_x64
+    except ImportError:  # pragma: no cover - version-dependent import
+        from jax.experimental import enable_x64 as _enable_x64
+    return _enable_x64()
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` / ``jax.experimental.shard_map.shard_map``."""
+    try:  # jax >= 0.5
+        from jax import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - version-dependent import
+        from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(*args, **kwargs)

@@ -27,6 +27,14 @@ from delta_tpu.protocol.actions import Action, Metadata, Protocol
 from delta_tpu.schema.types import IntegerType, StringType, StructType
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: benchmark-scale tests excluded from the tier-1 run "
+        "(-m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _clear_deltalog_cache():
     DeltaLog.clear_cache()

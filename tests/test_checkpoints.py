@@ -126,22 +126,58 @@ def test_v2_checkpoint_struct_columns(tmp_path):
     assert t2.to_arrow(filters=["part = 'b'"]).column("x").to_pylist() == [30]
 
 
-def test_default_checkpoint_has_no_v2_columns(tmp_path):
-    import pyarrow as pa
+def _add_field_names(t, md):
     import pyarrow.parquet as pq
 
-    from delta_tpu.api.tables import DeltaTable
     from delta_tpu.protocol import filenames
+
+    ckpt = f"{t.delta_log.log_path}/{filenames.checkpoint_file_single(md.version)}"
+    add_type = pq.read_table(ckpt).schema.field("add").type
+    return [add_type.field(i).name for i in range(add_type.num_fields)]
+
+
+def test_default_checkpoint_has_v2_stats_struct(tmp_path):
+    """The engine default (`delta.tpu.checkpoint.writeStatsAsStruct`, on)
+    materializes `stats_parsed` so the cold state-cache build reads typed
+    columns instead of re-parsing stats JSON."""
+    import pyarrow as pa
+
+    from delta_tpu.api.tables import DeltaTable
 
     path = str(tmp_path / "t")
     t = DeltaTable.create(
         path, data=pa.table({"x": pa.array([1], pa.int64())})
     )
     md = t.delta_log.checkpoint()
-    ckpt = f"{t.delta_log.log_path}/{filenames.checkpoint_file_single(md.version)}"
-    add_type = pq.read_table(ckpt).schema.field("add").type
-    names = [add_type.field(i).name for i in range(add_type.num_fields)]
+    assert "stats_parsed" in _add_field_names(t, md)
+
+
+def test_table_property_opts_out_of_v2_columns(tmp_path):
+    """An explicit `delta.checkpoint.writeStatsAsStruct=false` table
+    property (and likewise the session conf, when the property is unset)
+    suppresses the V2 typed columns."""
+    import pyarrow as pa
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.utils.config import conf
+
+    path = str(tmp_path / "t")
+    t = DeltaTable.create(
+        path, data=pa.table({"x": pa.array([1], pa.int64())}),
+        configuration={"delta.checkpoint.writeStatsAsStruct": "false"},
+    )
+    md = t.delta_log.checkpoint()
+    names = _add_field_names(t, md)
     assert "stats_parsed" not in names and "partitionValues_parsed" not in names
+
+    path2 = str(tmp_path / "t2")
+    with conf.set_temporarily(**{"delta.tpu.checkpoint.writeStatsAsStruct": False}):
+        t2 = DeltaTable.create(
+            path2, data=pa.table({"x": pa.array([1], pa.int64())})
+        )
+        md2 = t2.delta_log.checkpoint()
+    names2 = _add_field_names(t2, md2)
+    assert "stats_parsed" not in names2 and "partitionValues_parsed" not in names2
 
 
 def test_v2_checkpoint_typed_and_nested_stats(tmp_path):

@@ -261,6 +261,26 @@ def test_char_pads_and_varchar_rejects(tmp_table):
         })).run()
 
 
+def test_varchar_overlength_trailing_spaces_truncate_to_bound(tmp_table):
+    """'ab   ' into varchar(4) stores 'ab  ' (truncated to EXACTLY the
+    bound, like the reference's varcharTypeWriteSideCheck) — not the full
+    rtrim 'ab', which would diverge stored lengths/equality from the
+    reference format."""
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.schema.types import LongType, StructType, VarcharType
+
+    schema = StructType().add("id", LongType()).add("v", VarcharType(4))
+    t = DeltaTable.create(tmp_table, schema)
+    WriteIntoDelta(t.delta_log, "append", pa.table({
+        "id": pa.array([1, 2, 3], pa.int64()),
+        "v": pa.array(["ab   ", "cdef ", "in"], pa.string()),
+    })).run()
+    got = sorted(t.to_arrow().to_pylist(), key=lambda r: r["id"])
+    assert got[0]["v"] == "ab  "   # 4 chars: truncated, not rtrimmed
+    assert got[1]["v"] == "cdef"   # exactly at the bound after truncation
+    assert got[2]["v"] == "in"     # within bound: untouched
+
+
 def test_char_varchar_sql_create_and_enforce(tmp_path):
     from delta_tpu.sql.parser import execute_sql
     from delta_tpu.utils.errors import DeltaError

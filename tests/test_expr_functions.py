@@ -10,6 +10,7 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
+from delta_tpu.utils.jaxcompat import enable_x64
 from delta_tpu.expr import ir
 from delta_tpu.expr.jaxeval import NotDeviceCompilable, columns_from_numpy, compile_expr
 from delta_tpu.expr.parser import parse_expression
@@ -143,7 +144,7 @@ def test_jaxeval_matches_row_eval(sql):
     ]
     cols = {k: np.array([r[k] for r in rows]) for k in rows[0]}
     e = parse_expression(sql)
-    with jax.enable_x64():
+    with enable_x64():
         out = compile_expr(e)(columns_from_numpy(cols))
     vals = np.asarray(out.values)
     valid = np.asarray(out.valid)

@@ -358,6 +358,39 @@ def test_merge_by_unaliased_column_names(tmp_path):
     assert [r["v"] for r in _rows(log)] == [10.0, 5.0]
 
 
+def test_merge_source_column_sharing_char_target_name_not_padded(tmp_path):
+    """ADVICE (high): a clause condition on a SOURCE column that merely
+    shares a name with a target char(n) column must NOT get its literal
+    padded — `s.status = 'x'` compares against the source's raw 'x', not
+    'x    '. The reference pads only refs resolving to char attributes."""
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.schema.types import CharType, LongType, StructType
+
+    path = str(tmp_path / "t")
+    schema = StructType().add("k", LongType()).add("status", CharType(5))
+    t = DeltaTable.create(path, schema)
+    WriteIntoDelta(t.delta_log, "append", pa.table({
+        "k": pa.array([1, 2], pa.int64()),
+        "status": pa.array(["a", "b"], pa.string()),
+    })).run()
+    src = pa.table({
+        "k": pa.array([1, 2], pa.int64()),
+        "status": pa.array(["x", "keep"], pa.string()),
+    })
+    cmd = _merge(t.delta_log, src, "t.k = s.k",
+                 matched=[up("s.status = 'x'", status="s.status")])
+    assert cmd.metrics["numTargetRowsUpdated"] == 1
+    rows = _rows(t.delta_log)
+    assert rows[0]["status"] == "x    "  # updated, then char-padded on write
+    assert rows[1]["status"] == "b    "  # clause condition false: untouched
+
+    # ... while a TARGET-qualified char comparison still pads its literal
+    cmd2 = _merge(t.delta_log, src, "t.k = s.k",
+                  matched=[delete("t.status = 'b'")])
+    assert cmd2.metrics["numTargetRowsDeleted"] == 1
+    assert [r["k"] for r in _rows(t.delta_log)] == [1]
+
+
 # ---------------------------------------------------------------------------
 # insert-only family
 # ---------------------------------------------------------------------------

@@ -70,8 +70,10 @@ def test_partitioned_write_layout_and_pruning(tmp_table):
     files = snap.all_files
     assert len(files) == 2
     assert all(f.path.startswith("country=") for f in files)
-    # physical file must NOT contain the partition column
-    raw = pq.read_table(os.path.join(tmp_table, files[0].path))
+    # physical file must NOT contain the partition column. Read via
+    # ParquetFile: pq.read_table on a path under `country=fr/` re-infers a
+    # hive partition column on some pyarrow versions, masking the check.
+    raw = pq.ParquetFile(os.path.join(tmp_table, files[0].path)).read()
     assert "country" not in raw.column_names
     # partition pruning reads one file
     scan = scan_files(snap, ["country = 'us'"])
