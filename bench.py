@@ -1294,9 +1294,25 @@ def main():
     signal.signal(signal.SIGTERM, bail)
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "3600"))
     t_start = time.perf_counter()
+    def run_with_telemetry(fn):
+        """Per-config isolation: reset the registry, run, attach a compact
+        internal-metrics snapshot (top counters + phase-histogram summaries)
+        so BENCH_*.json trajectories carry attributable phase deltas, not
+        just wall-clock."""
+        from delta_tpu.utils import telemetry
+
+        telemetry.reset_all()
+        out = fn()
+        try:
+            if isinstance(out, dict):
+                out["telemetry"] = telemetry.bench_snapshot()
+        except Exception:  # noqa: BLE001 — metrics must never fail the bench
+            pass
+        return out
+
     try:
         if only:
-            results = {only: configs[only]()}
+            results = {only: run_with_telemetry(configs[only])}
             emitted["done"] = True  # one-line contract: bail() must not re-emit
             print(json.dumps(results[only]))
             return
@@ -1310,7 +1326,7 @@ def main():
                             f"{budget_s:.0f}s exhausted at {elapsed:.0f}s",
                 }
                 continue
-            results[k] = fn()
+            results[k] = run_with_telemetry(fn)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     emitted["done"] = True

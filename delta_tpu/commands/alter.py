@@ -18,6 +18,7 @@ from delta_tpu.schema.constraints import CONSTRAINT_PROP_PREFIX
 from delta_tpu.schema.types import StructField, StructType
 from delta_tpu.utils.errors import DeltaAnalysisError
 from delta_tpu.utils import errors
+from delta_tpu.utils.telemetry import record_operation
 
 __all__ = [
     "set_table_properties",
@@ -37,7 +38,9 @@ def set_table_properties(delta_log, properties: Dict[str, str]) -> int:
         txn.update_metadata(replace(meta, configuration=cfg))
         return txn.commit([], ops.SetTableProperties(properties))
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.setProperties",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)
 
 
 def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = False) -> int:
@@ -57,7 +60,9 @@ def unset_table_properties(delta_log, keys: Sequence[str], if_exists: bool = Fal
         txn.update_metadata(replace(meta, configuration=cfg))
         return txn.commit([], ops.UnsetTableProperties(list(keys), if_exists))
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.unsetProperties",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)
 
 
 def _position_spec(schema: StructType, parent_parts, leaf_spec):
@@ -136,7 +141,9 @@ def add_columns(
         )
         return txn.commit([], op)
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.addColumns",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)
 
 
 def change_column(
@@ -185,7 +192,9 @@ def change_column(
         op = ops.ChangeColumn(name, new_field.json_value())
         return txn.commit([], op)
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.changeColumn",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)
 
 
 def add_constraint(delta_log, name: str, expr_sql: str) -> int:
@@ -216,7 +225,9 @@ def add_constraint(delta_log, name: str, expr_sql: str) -> int:
         txn.update_metadata(replace(meta, configuration=cfg))
         return txn.commit([], ops.AddConstraint(name, expr_sql))
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.addConstraint",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)
 
 
 def drop_constraint(delta_log, name: str, if_exists: bool = True) -> int:
@@ -234,4 +245,6 @@ def drop_constraint(delta_log, name: str, if_exists: bool = True) -> int:
         txn.update_metadata(replace(meta, configuration=cfg))
         return txn.commit([], ops.DropConstraint(name, expr))
 
-    return delta_log.with_new_transaction(body)
+    with record_operation("delta.utility.alter.dropConstraint",
+                          path=delta_log.data_path):
+        return delta_log.with_new_transaction(body)

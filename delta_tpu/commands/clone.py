@@ -45,6 +45,12 @@ class CloneCommand:
         self.metrics: Dict[str, int] = {}
 
     def run(self) -> int:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.utility.clone", path=self.target_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> int:
         from delta_tpu.log.deltalog import DeltaLog
 
         src = self.source_log

@@ -87,6 +87,13 @@ class ConvertToDeltaCommand:
         return values
 
     def run(self) -> int:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.utility.convertToDelta",
+                              path=self.delta_log.data_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> int:
         log = self.delta_log
         if log.table_exists:
             return log.snapshot.version  # already delta: no-op

@@ -113,6 +113,13 @@ class CreateDeltaTableCommand:
     # -- main --------------------------------------------------------------
 
     def run(self) -> int:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.utility.createTable", mode=self.mode,
+                              path=self.delta_log.data_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> int:
         log = self.delta_log
         # pre-checks run on the current snapshot for fast failure, but the
         # authoritative existence read happens INSIDE the transaction (from

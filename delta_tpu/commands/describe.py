@@ -14,6 +14,14 @@ __all__ = ["describe_detail", "describe_history"]
 
 
 def describe_detail(delta_log) -> Dict[str, Any]:
+    from delta_tpu.utils.telemetry import record_operation
+
+    with record_operation("delta.utility.describeDetail",
+                          path=delta_log.data_path):
+        return _describe_detail_impl(delta_log)
+
+
+def _describe_detail_impl(delta_log) -> Dict[str, Any]:
     snapshot = delta_log.update()
     meta = snapshot.metadata
     created = meta.created_time
@@ -35,12 +43,16 @@ def describe_detail(delta_log) -> Dict[str, Any]:
 
 
 def describe_history(delta_log, limit: Optional[int] = None) -> List[Dict[str, Any]]:
-    commits = delta_log.history.get_history(limit)
-    out = []
-    for ci in commits:
-        d = ci.to_dict()
-        out.append(d)
-    return out
+    from delta_tpu.utils.telemetry import record_operation
+
+    with record_operation("delta.utility.describeHistory",
+                          path=delta_log.data_path):
+        commits = delta_log.history.get_history(limit)
+        out = []
+        for ci in commits:
+            d = ci.to_dict()
+            out.append(d)
+        return out
 
 
 def _ts(ms: Optional[int]):

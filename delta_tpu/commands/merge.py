@@ -317,7 +317,10 @@ class MergeIntoCommand:
     # -- main -------------------------------------------------------------
 
     def run(self) -> int:
-        return self.delta_log.with_new_transaction(self._body)
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.dml.merge", path=self.delta_log.data_path):
+            return self.delta_log.with_new_transaction(self._body)
 
     def _body(self, txn) -> int:
         # reset per-execution state: a re-run that takes the host or empty

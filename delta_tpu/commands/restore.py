@@ -64,6 +64,13 @@ class RestoreCommand:
         ).version
 
     def run(self) -> int:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.utility.restore",
+                              path=self.delta_log.data_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> int:
         target_version = self._target_version()
         target = self.delta_log.get_snapshot_at(target_version)
 

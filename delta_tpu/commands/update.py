@@ -54,7 +54,10 @@ class UpdateCommand:
         self.metrics: Dict[str, int] = {}
 
     def run(self) -> int:
-        return self.delta_log.with_new_transaction(self._body)
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.dml.update", path=self.delta_log.data_path):
+            return self.delta_log.with_new_transaction(self._body)
 
     def _body(self, txn) -> int:
         metadata = txn.metadata

@@ -57,6 +57,13 @@ class VacuumCommand:
         self.parallelism = parallelism
 
     def run(self) -> VacuumResult:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.utility.vacuum", dryRun=self.dry_run,
+                              path=self.delta_log.data_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> VacuumResult:
         log = self.delta_log
         snapshot = log.update()
         metadata = snapshot.metadata

@@ -129,6 +129,13 @@ class WriteIntoDelta:
         self.user_metadata = user_metadata
 
     def run(self) -> int:
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.dml.write", mode=self.mode,
+                              path=self.delta_log.data_path):
+            return self._run_impl()
+
+    def _run_impl(self) -> int:
         log = self.delta_log
         if log.table_exists:
             if self.mode == "ignore":

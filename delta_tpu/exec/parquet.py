@@ -163,6 +163,11 @@ def write_parquet_file(
         table = table.combine_chunks()
     pq.write_table(table, abs_path, compression=codec, **kwargs)
     st = os.stat(abs_path)
+    from delta_tpu.utils.telemetry import bump_counter
+
+    bump_counter("parquet.files.written")
+    bump_counter("parquet.bytes.written", st.st_size)
+    bump_counter("parquet.rows.written", table.num_rows)
     return st.st_size, int(st.st_mtime * 1000)
 
 

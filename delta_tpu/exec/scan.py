@@ -57,10 +57,14 @@ def read_files_as_table(
     the file as written (int64) — DML needs physical positions to extend a
     file's deletion vector.
     """
+    from delta_tpu.utils import telemetry
+
     if distribute:
         from delta_tpu.parallel.distributed import host_partition
 
         files = host_partition(list(files))
+    telemetry.bump_counter("scan.files.read", len(files))
+    telemetry.bump_counter("scan.bytes.read", sum(f.size or 0 for f in files))
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
     part_schema = metadata.partition_schema
@@ -147,17 +151,20 @@ def read_files_as_table(
             )
         return t
 
-    if len(files) == 1:
-        pieces = [read_one(files[0])]
-    else:
-        from concurrent.futures import ThreadPoolExecutor
+    with telemetry.record_operation(
+        "delta.scan.read", {"numFiles": len(files)}
+    ):
+        if len(files) == 1:
+            pieces = [read_one(files[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
 
-        workers = min(len(files), os.cpu_count() or 4)
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            pieces = list(pool.map(read_one, files))
-    if per_file:
-        return pieces
-    return pa.concat_tables(pieces, promote_options="permissive")
+            workers = min(len(files), os.cpu_count() or 4)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                pieces = list(pool.map(read_one, files))
+        if per_file:
+            return pieces
+        return pa.concat_tables(pieces, promote_options="permissive")
 
 
 def scan_files(snapshot, filters: Sequence[Union[str, ir.Expression]] = ()) -> pruning.DeltaScan:
@@ -273,22 +280,31 @@ def scan_to_table(
     """Full read path: prune → decode (projection ∪ filter columns) →
     residual filter → project. ``distribute=True``: this host decodes only
     its partition of the pruned file list (multi-host scan)."""
-    exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
-    scan = pruning.files_for_scan(snapshot, exprs)
-    data_path = snapshot.delta_log.data_path
-    residual = scan.partition_filters + scan.data_filters
-    read_cols = columns
-    if columns is not None and residual:
-        # read filter-referenced columns too; project back after filtering
-        needed = set(columns)
-        for e in residual:
-            needed.update(ir.references(e))
-        read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
-                     if c in needed]
-    table = read_files_as_table(data_path, scan.files, snapshot.metadata,
-                                read_cols, distribute=distribute)
-    if residual and table.num_rows:
-        table = filter_table(table, ir.and_all(residual))
-    if columns is not None and read_cols != list(columns):
-        table = table.select([c for c in columns if c in table.column_names])
-    return table
+    from delta_tpu.utils import telemetry
+
+    with telemetry.record_operation(
+        "delta.scan", path=snapshot.delta_log.data_path
+    ) as sev:
+        exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
+        scan = pruning.files_for_scan(snapshot, exprs)
+        data_path = snapshot.delta_log.data_path
+        residual = scan.partition_filters + scan.data_filters
+        read_cols = columns
+        if columns is not None and residual:
+            # read filter-referenced columns too; project back after filtering
+            needed = set(columns)
+            for e in residual:
+                needed.update(ir.references(e))
+            read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
+                         if c in needed]
+        table = read_files_as_table(data_path, scan.files, snapshot.metadata,
+                                    read_cols, distribute=distribute)
+        if residual and table.num_rows:
+            table = filter_table(table, ir.and_all(residual))
+        if columns is not None and read_cols != list(columns):
+            table = table.select([c for c in columns if c in table.column_names])
+        sev.data.update(
+            filesScanned=len(scan.files), rowsOut=table.num_rows,
+            bytesScanned=scan.scanned.bytes_compressed,
+        )
+        return table

@@ -821,6 +821,10 @@ def write_checkpoint_columnar(
             list(ex.map(_write_slice, range(parts)))
     md = CheckpointMetaData(snapshot.version, total, None if parts == 1 else parts)
     write_last_checkpoint(store, log_path, md)
+    from delta_tpu.utils.telemetry import bump_counter
+
+    bump_counter("checkpoint.parts", parts)
+    bump_counter("checkpoint.actions", total)
     return md
 
 
@@ -923,6 +927,10 @@ def _finish_write_checkpoint(store, log_path, version, actions, parts, n,
         # only the coordinating process publishes the pointer, and only
         # after every host's parts are visible — readers trust it
         write_last_checkpoint(store, log_path, md)
+    from delta_tpu.utils.telemetry import bump_counter
+
+    bump_counter("checkpoint.parts", parts)
+    bump_counter("checkpoint.actions", n)
     return md
 
 

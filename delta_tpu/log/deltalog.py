@@ -197,7 +197,11 @@ class DeltaLog:
         return self._do_update()
 
     def _do_update(self) -> Snapshot:
-        with self._update_lock:
+        from delta_tpu.utils import telemetry
+
+        with self._update_lock, telemetry.record_operation(
+            "delta.log.update", path=self.data_path
+        ) as uev:
             previous = self._snapshot
             start_ckpt = None
             last = ckpt_mod.read_last_checkpoint(self.store, self.log_path)
@@ -211,6 +215,8 @@ class DeltaLog:
                 snap: Snapshot = InitialSnapshot(self)
             elif previous is not None and previous.segment == segment:
                 self._last_update_ms = self.clock()
+                uev.data["result"] = "unchanged"
+                telemetry.bump_counter("log.update.unchanged")
                 return previous
             else:
                 snap = Snapshot(self, segment.version, segment)
@@ -232,6 +238,8 @@ class DeltaLog:
                         )
             self._snapshot = snap
             self._last_update_ms = self.clock()
+            uev.data.update(result="installed", version=snap.version)
+            telemetry.bump_counter("log.update.installed")
             return snap
 
     def get_snapshot_at(self, version: int) -> Snapshot:
@@ -379,22 +387,35 @@ class DeltaLog:
     def checkpoint(self, snapshot: Optional[Snapshot] = None) -> ckpt_mod.CheckpointMetaData:
         """Write a checkpoint of ``snapshot`` (default: current) and update
         ``_last_checkpoint`` (``Checkpoints.scala:221-260``)."""
+        from delta_tpu.utils import telemetry
+
         snap = snapshot or self.update()
         if snap.version < 0:
             raise DeltaIllegalStateError("Cannot checkpoint an uninitialized table")
         part_size = conf.get("delta.tpu.checkpointPartSize")
-        # columnar fast path: AddFiles stream from the SoA columns without
-        # dataclass materialization (None = unsupported shape)
-        md = ckpt_mod.write_checkpoint_columnar(
-            self.store, self.log_path, snap, part_size=part_size or 1_000_000
-        )
-        if md is None:
-            actions = snap.checkpoint_actions()
-            md = ckpt_mod.write_checkpoint(
-                self.store, self.log_path, snap.version, actions,
-                part_size=part_size,
+        with telemetry.record_operation(
+            "delta.checkpoint", path=self.data_path
+        ) as cev:
+            # columnar fast path: AddFiles stream from the SoA columns without
+            # dataclass materialization (None = unsupported shape)
+            md = ckpt_mod.write_checkpoint_columnar(
+                self.store, self.log_path, snap, part_size=part_size or 1_000_000
             )
-        self.cleanup_expired_logs(snap)
+            writer = "columnar"
+            if md is None:
+                actions = snap.checkpoint_actions()
+                md = ckpt_mod.write_checkpoint(
+                    self.store, self.log_path, snap.version, actions,
+                    part_size=part_size,
+                )
+                writer = "rows"
+            cev.data.update(version=md.version, numActions=md.size,
+                            parts=md.parts or 1, writer=writer)
+            telemetry.bump_counter("checkpoint.written")
+            self.cleanup_expired_logs(snap)
+        if cev.duration_ms is not None:  # unmeasured (telemetry disabled)
+            telemetry.observe("delta.checkpoint.duration_ms", cev.duration_ms,
+                              path=self.data_path)
         return md
 
     def cleanup_expired_logs(self, snapshot: Snapshot) -> None:

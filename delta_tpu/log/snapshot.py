@@ -126,14 +126,25 @@ class Snapshot:
         checkpoint, or a full JSON replay from version 0. The corrupt
         version is memoized on the DeltaLog so later listings skip it (and
         ``update()``'s segment-equality early-exit keeps working)."""
+        from delta_tpu.utils import telemetry
+
         segment = self.segment
         while True:
             try:
-                return decode_segment(
-                    self.store,
-                    [f.path for f in segment.checkpoint_files],
-                    [f.path for f in segment.deltas],
-                )
+                with telemetry.record_operation(
+                    "delta.snapshot.stateReconstruction",
+                    {"version": self.version,
+                     "checkpointParts": len(segment.checkpoint_files),
+                     "deltas": len(segment.deltas)},
+                    path=self.delta_log.data_path,
+                ) as sev:
+                    cols = decode_segment(
+                        self.store,
+                        [f.path for f in segment.checkpoint_files],
+                        [f.path for f in segment.deltas],
+                    )
+                    sev.data["numActions"] = len(cols.size)
+                    return cols
             except Exception as e:
                 if segment.checkpoint_version is None:
                     raise

@@ -338,10 +338,16 @@ def files_for_scan(
     step is the skipping path the reference leaves unwired. Unpartitioned
     tables with an exactly-lowerable predicate serve from the resident
     state cache instead of materializing every AddFile."""
-    from delta_tpu.utils.telemetry import with_status
+    from delta_tpu.utils.telemetry import record_operation, with_status
 
-    with with_status("Filtering files for query"):
-        return _files_for_scan_impl(snapshot, filters, keep_num_indexed_cols)
+    with record_operation("delta.scan.planning") as pev:
+        with with_status("Filtering files for query"):
+            scan = _files_for_scan_impl(snapshot, filters, keep_num_indexed_cols)
+        pev.data.update(
+            filesTotal=scan.total.files, filesAfterPartition=scan.partition.files,
+            filesScanned=scan.scanned.files,
+        )
+        return scan
 
 
 def _files_for_scan_impl(
@@ -367,7 +373,11 @@ def _files_for_scan_impl(
                 data_filters.append(conj)
 
     if data_filters or partition_filters:
-        fast = _resident_scan(snapshot, partition_filters, data_filters)
+        from delta_tpu.utils.telemetry import record_operation
+
+        with record_operation("delta.scan.stateCache") as rev:
+            fast = _resident_scan(snapshot, partition_filters, data_filters)
+            rev.data["served"] = fast is not None
         if fast is not None:
             return fast
 
@@ -388,7 +398,10 @@ def _files_for_scan_impl(
         bytes_compressed=sum(f.size or 0 for f in after_part), files=len(after_part)
     )
 
-    kept = prune_files(after_part, metadata, data_filters)
+    from delta_tpu.utils.telemetry import record_operation as _rec_op
+
+    with _rec_op("delta.scan.prune", {"candidates": len(after_part)}):
+        kept = prune_files(after_part, metadata, data_filters)
     scanned = DataSize(
         bytes_compressed=sum(f.size or 0 for f in kept),
         files=len(kept),

@@ -1028,18 +1028,33 @@ class DeviceStateCache:
             if verdict == "hit":
                 e.last_used = tick
                 return e
+            from delta_tpu.utils import telemetry
+
             if e is not None:  # behind: try the incremental tail
-                tail = _decode_tail(snapshot, e.version)
-                ok = False
-                if tail is not None:
-                    removed, arr = tail
-                    added = e.map_tail_lanes(arr, snapshot.metadata)
-                    if added is not None:
-                        ok = e.apply_tail(snapshot.version, removed, added)
+                with telemetry.record_operation(
+                    "delta.stateCache.tailApply",
+                    {"fromVersion": e.version, "toVersion": snapshot.version},
+                    path=snapshot.delta_log.data_path,
+                ) as tev:
+                    tail = _decode_tail(snapshot, e.version)
+                    ok = False
+                    if tail is not None:
+                        removed, arr = tail
+                        added = e.map_tail_lanes(arr, snapshot.metadata)
+                        if added is not None:
+                            ok = e.apply_tail(snapshot.version, removed, added)
+                    tev.data["applied"] = ok
                 if not ok:
                     e = None
             if e is None:
-                e = build_entry(snapshot)
+                with telemetry.record_operation(
+                    "delta.stateCache.build",
+                    {"version": snapshot.version},
+                    path=snapshot.delta_log.data_path,
+                ) as bev:
+                    e = build_entry(snapshot)
+                    bev.data["built"] = e is not None
+                telemetry.bump_counter("stateCache.builds")
                 if e is None:
                     return None
                 with self._lock:

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional
 from urllib.parse import urlparse
 
 from delta_tpu.utils.errors import DeltaIOError
+from delta_tpu.utils.telemetry import bump_counter
 
 __all__ = [
     "FileStatus",
@@ -48,6 +49,15 @@ __all__ = [
     "get_log_store",
     "split_scheme",
 ]
+
+
+def _record_io(op: str, nbytes: int = 0) -> None:
+    """Per-request store telemetry: ``logstore.<op>.calls`` (+ ``.bytes``
+    where a size is known) — the request-count/egress numbers an operator
+    needs to price a backend (S3 GET/PUT/LIST bills per request)."""
+    bump_counter(f"logstore.{op}.calls")
+    if nbytes:
+        bump_counter(f"logstore.{op}.bytes", nbytes)
 
 
 @dataclass(frozen=True)
@@ -133,13 +143,16 @@ class LocalLogStore(LogStore):
             f = open(p, "r", encoding="utf-8", newline="")
         except FileNotFoundError:
             raise
+        _record_io("read")
         with f:
             for line in f:
                 yield line.rstrip("\r\n")
 
     def read_bytes(self, path: str) -> bytes:
         with open(_strip_scheme(path), "rb") as f:
-            return f.read()
+            data = f.read()
+        _record_io("read", len(data))
+        return data
 
     def write(self, path: str, lines: Iterable[str], overwrite: bool = False) -> None:
         data = ("".join(line + "\n" for line in lines)).encode("utf-8")
@@ -149,6 +162,7 @@ class LocalLogStore(LogStore):
         p = _strip_scheme(path)
         parent = os.path.dirname(p)
         os.makedirs(parent, exist_ok=True)
+        _record_io("write", len(data))
         if overwrite:
             tmp = os.path.join(parent, f".{os.path.basename(p)}.{uuid.uuid4().hex}.tmp")
             with open(tmp, "wb") as f:
@@ -179,6 +193,7 @@ class LocalLogStore(LogStore):
         start = os.path.basename(p)
         if not os.path.isdir(parent):
             raise FileNotFoundError(parent)
+        _record_io("list")
         names = sorted(n for n in os.listdir(parent) if n >= start)
         for n in names:
             full = os.path.join(parent, n)
@@ -240,7 +255,9 @@ class MemoryLogStore(LogStore):
         with self._lock:
             if path not in self._files:
                 raise FileNotFoundError(path)
-            return self._files[path]
+            data = self._files[path]
+        _record_io("read", len(data))
+        return data
 
     def write(self, path: str, lines: Iterable[str], overwrite: bool = False) -> None:
         data = ("".join(line + "\n" for line in lines)).encode("utf-8")
@@ -249,6 +266,7 @@ class MemoryLogStore(LogStore):
     def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
         if self.before_write:
             self.before_write(path)
+        _record_io("write", len(data))
         with self._lock:
             if not overwrite and path in self._files:
                 raise FileExistsError(path)
@@ -261,6 +279,7 @@ class MemoryLogStore(LogStore):
     def list_from(self, path: str) -> Iterator[FileStatus]:
         if self.before_list:
             self.before_list(path)
+        _record_io("list")
         parent, _, start = path.rpartition("/")
         with self._lock:
             self.list_count += 1

@@ -43,7 +43,24 @@ class DeltaSink:
     def add_batch(self, batch_id: int, data: Any) -> bool:
         """Write one micro-batch; returns False when the batch was already
         committed (idempotent skip)."""
+        from delta_tpu.utils import telemetry
+
+        with telemetry.record_operation(
+            "delta.streaming.sink.addBatch",
+            {"batchId": batch_id, "queryId": self.query_id},
+            path=self.delta_log.data_path,
+        ) as bev:
+            committed = self._add_batch_impl(batch_id, data, bev)
+        if bev.duration_ms is not None:  # unmeasured (telemetry disabled)
+            telemetry.observe(
+                "delta.streaming.sink.batch_ms", bev.duration_ms,
+                path=self.delta_log.data_path,
+            )
+        return committed
+
+    def _add_batch_impl(self, batch_id: int, data: Any, bev) -> bool:
         table = coerce_to_table(data)
+        bev.data["numInputRows"] = table.num_rows
 
         def body(txn) -> bool:
             if txn.txn_version(self.query_id) >= batch_id:
@@ -80,4 +97,10 @@ class DeltaSink:
             txn.commit(actions, op)
             return True
 
-        return self.delta_log.with_new_transaction(body)
+        committed = self.delta_log.with_new_transaction(body)
+        bev.data["committed"] = committed
+        from delta_tpu.utils.telemetry import bump_counter
+
+        bump_counter("streaming.sink.batches" if committed
+                     else "streaming.sink.batchesSkipped")
+        return committed

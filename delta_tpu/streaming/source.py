@@ -259,6 +259,7 @@ class DeltaSource:
         recovered batch must serve exactly what was planned even if the
         table moved on."""
         from delta_tpu.exec.scan import read_files_as_table
+        from delta_tpu.utils import telemetry
 
         if start is None:
             if end.is_starting_version:
@@ -271,16 +272,28 @@ class DeltaSource:
                     start = DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
                 else:
                     return self.get_batch(end, end)  # transition batch: empty
-        files: List[AddFile] = []
-        for f in self._pending(start):
-            if (f.version, f.index) > (end.reservoir_version, end.index):
-                break
-            if f.add is not None:
-                files.append(f.add)
-        snap = self.delta_log.update()
-        return read_files_as_table(
-            self.delta_log.data_path, files, snap.metadata
-        )
+        with telemetry.record_operation(
+            "delta.streaming.source.getBatch",
+            {"endVersion": end.reservoir_version, "endIndex": end.index},
+            path=self.delta_log.data_path,
+        ) as bev:
+            files: List[AddFile] = []
+            for f in self._pending(start):
+                if (f.version, f.index) > (end.reservoir_version, end.index):
+                    break
+                if f.add is not None:
+                    files.append(f.add)
+            snap = self.delta_log.update()
+            table = read_files_as_table(
+                self.delta_log.data_path, files, snap.metadata
+            )
+            bev.data.update(numFiles=len(files), numOutputRows=table.num_rows)
+        if bev.duration_ms is not None:  # unmeasured (telemetry disabled)
+            telemetry.observe(
+                "delta.streaming.source.batch_ms", bev.duration_ms,
+                path=self.delta_log.data_path,
+            )
+        return table
 
 
 class DeltaCDFSource(DeltaSource):
@@ -325,6 +338,7 @@ class DeltaCDFSource(DeltaSource):
     ) -> pa.Table:
         from delta_tpu.exec import cdf as cdf_exec
         from delta_tpu.exec.scan import read_files_as_table
+        from delta_tpu.utils import telemetry
 
         if start is None:
             if end.is_starting_version:
@@ -337,6 +351,14 @@ class DeltaCDFSource(DeltaSource):
                     start = DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
                 else:
                     return self.get_batch(end, end)
+        with telemetry.record_operation(
+            "delta.streaming.source.getBatch",
+            {"endVersion": end.reservoir_version, "cdf": True},
+            path=self.delta_log.data_path,
+        ):
+            return self._cdf_batch_impl(start, end, cdf_exec, read_files_as_table)
+
+    def _cdf_batch_impl(self, start, end, cdf_exec, read_files_as_table) -> pa.Table:
         snap = self.delta_log.update()
         parts: List[pa.Table] = []
         if start.is_starting_version:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import contextvars
 import logging
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence
 
 from delta_tpu.expr import ir
@@ -33,6 +33,7 @@ from delta_tpu.txn import conflicts as conflicts_mod
 from delta_tpu.txn import isolation
 from delta_tpu.utils.config import DeltaConfigs, conf
 from delta_tpu.utils import errors
+from delta_tpu.utils import telemetry
 from delta_tpu.utils.telemetry import record_operation
 
 logger = logging.getLogger(__name__)
@@ -60,6 +61,27 @@ class CommitStats:
     size_in_bytes_total: int = 0
     isolation_level: str = ""
     is_blind_append: bool = False
+    # per-phase wall times: prepare / conflictCheck / write / postCommit
+    phase_durations_ms: Dict[str, int] = field(default_factory=dict)
+
+    def to_event_data(self) -> Dict[str, Any]:
+        """The ``delta.commit.stats`` payload, reference CommitStats field
+        names (``OptimisticTransaction.scala:45-71``)."""
+        return {
+            "readVersion": self.start_version,
+            "commitVersion": self.committed_version,
+            "attempts": self.attempts,
+            "txnDurationMs": self.txn_duration_ms,
+            "commitDurationMs": self.commit_duration_ms,
+            "numAdd": self.num_add,
+            "numRemove": self.num_remove,
+            "bytesNew": self.bytes_new,
+            "numFilesTotal": self.num_files_total,
+            "sizeInBytesTotal": self.size_in_bytes_total,
+            "isolationLevel": self.isolation_level,
+            "isBlindAppend": self.is_blind_append,
+            "phaseDurationsMs": dict(self.phase_durations_ms),
+        }
 
 
 class OptimisticTransaction:
@@ -234,8 +256,10 @@ class OptimisticTransaction:
     def commit(self, actions: Sequence[Action], op, tags: Optional[Dict[str, str]] = None) -> int:
         """Run the full commit pipeline; returns the committed version
         (``OptimisticTransaction.scala:422-490``)."""
-        with record_operation("delta.commit", path=self.delta_log.data_path):
-            actions = self._prepare_commit(list(actions))
+        with record_operation("delta.commit", path=self.delta_log.data_path) as commit_ev:
+            with record_operation("delta.commit.prepare", path=self.delta_log.data_path) as pev:
+                actions = self._prepare_commit(list(actions))
+            self.stats.phase_durations_ms["prepare"] = pev.duration_ms or 0
 
             if DeltaConfigs.SYMLINK_FORMAT_MANIFEST_ENABLED.from_metadata(self.metadata):
                 from delta_tpu.hooks.symlink_manifest import SymlinkManifestHook
@@ -283,7 +307,14 @@ class OptimisticTransaction:
             full_actions = [commit_info] + actions
 
             commit_start = self.delta_log.clock()
-            version = self._do_commit_retry(full_actions)
+            with record_operation("delta.commit.write", path=self.delta_log.data_path) as wev:
+                version = self._do_commit_retry(full_actions)
+            # conflictCheck runs inside the retry loop (so its span nests
+            # under write); report the write phase NET of it, keeping the
+            # phases additive: prepare+conflictCheck+write+postCommit ≈ commit
+            self.stats.phase_durations_ms["write"] = max(
+                0, (wev.duration_ms or 0)
+                - self.stats.phase_durations_ms.get("conflictCheck", 0))
             self._committed = True
 
             self.stats.committed_version = version
@@ -297,7 +328,30 @@ class OptimisticTransaction:
                 a.size for a in actions if isinstance(a, AddFile) and a.data_change
             )
 
-            self._post_commit(version)
+            with record_operation("delta.commit.postCommit", path=self.delta_log.data_path) as hev:
+                self._post_commit(version)
+            self.stats.phase_durations_ms["postCommit"] = hev.duration_ms or 0
+
+            # CommitStats parity: one delta.commit.stats event per commit
+            # (the reference's `CommitStats` recordDeltaEvent), with the
+            # command's operationMetrics riding along when history metrics
+            # are enabled — the same gate as CommitInfo.operationMetrics.
+            stats_data = self.stats.to_event_data()
+            stats_data["operation"] = op.name
+            op_metrics = self._final_metrics(op)
+            if op_metrics:
+                stats_data["opMetrics"] = op_metrics
+            commit_ev.data.update(stats_data)
+            telemetry.record_event(
+                "delta.commit.stats", stats_data, path=self.delta_log.data_path
+            )
+            telemetry.bump_counter("commit.total")
+            if self.stats.attempts > 1:
+                telemetry.bump_counter("commit.retries", self.stats.attempts - 1)
+            telemetry.observe(
+                "delta.commit.duration_ms", self.stats.commit_duration_ms,
+                path=self.delta_log.data_path,
+            )
             return version
 
     # -- commit internals ------------------------------------------------
@@ -412,7 +466,7 @@ class OptimisticTransaction:
     def _check_and_retry(self, failed_version: int, actions: List[Action]) -> int:
         """Replay winning commits through the conflict checker
         (``checkForConflicts``); returns the next version to attempt."""
-        with record_operation("delta.commit.retry.conflictCheck", path=self.delta_log.data_path):
+        with record_operation("delta.commit.retry.conflictCheck", path=self.delta_log.data_path) as cev:
             next_attempt = failed_version
             while True:
                 path = f"{self.delta_log.log_path}/{filenames.delta_file(next_attempt)}"
@@ -422,11 +476,18 @@ class OptimisticTransaction:
                     break
                 conflicts_mod.check_for_conflicts(self, next_attempt, winning)
                 next_attempt += 1
+            cev.data["winningCommits"] = next_attempt - failed_version
             if next_attempt == failed_version:
                 # The write failed but the file doesn't exist: storage lied about
                 # mutual exclusion (scala:683-691).
                 raise errors.concurrent_write_exception()
-            return next_attempt
+        # duration_ms is stamped when the span closes; accumulate across the
+        # retry loop's successive conflict checks
+        self.stats.phase_durations_ms["conflictCheck"] = (
+            self.stats.phase_durations_ms.get("conflictCheck", 0)
+            + (cev.duration_ms or 0)
+        )
+        return next_attempt
 
     def _post_commit(self, version: int) -> None:
         """Checkpointing, checksum, hooks (scala:582-594, 880-915)."""
@@ -465,5 +526,11 @@ class OptimisticTransaction:
         return {k: v for k, v in self.operation_metrics.items() if k in whitelist}
 
     def report_metrics(self, **metrics: Any) -> None:
+        """DML rewrite metrics — one layer feeding both
+        ``CommitInfo.operationMetrics`` (DESCRIBE HISTORY) and the enclosing
+        telemetry span (``delta.dml.*``), so MERGE's numTargetRowsUpdated et
+        al. show up on the trace without a second bookkeeping path."""
         for k, v in metrics.items():
             self.operation_metrics[k] = str(v)
+        if conf.get("delta.tpu.history.metricsEnabled"):
+            telemetry.add_span_data(**{k: str(v) for k, v in metrics.items()})

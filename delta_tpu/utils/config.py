@@ -70,6 +70,11 @@ class SqlConf:
         "delta.tpu.schema.autoMerge.enabled": False,
         # ≈ DELTA_HISTORY_METRICS_ENABLED
         "delta.tpu.history.metricsEnabled": True,
+        # Usage-event/span recording (utils/telemetry). False = no events or
+        # spans are buffered (zero-overhead blackout); counters stay live.
+        "delta.tpu.telemetry.enabled": True,
+        # Telemetry ring-buffer capacity (events + spans).
+        "delta.tpu.telemetry.bufferSize": 4096,
         # Materialize parsed per-file stats as typed Parquet struct columns
         # (`add.stats_parsed` / `add.partitionValues_parsed`) in checkpoints
         # when the table does not set delta.checkpoint.writeStatsAsStruct

@@ -1,28 +1,64 @@
-"""Structured telemetry — the usage-logging interface.
+"""Structured telemetry — the engine-wide observability subsystem.
 
 Reference: ``metering/DeltaLogging.scala:50-109`` wraps every user action in
 ``recordDeltaOperation(opType)`` / ``recordDeltaEvent`` with hierarchical op
 types (e.g. ``delta.commit.retry.conflictCheck``) and JSON payloads; the OSS
-backend is a no-op stub. Here the backend is real: events go to an in-process
-ring buffer (inspectable in tests / ops tooling) and to a standard ``logging``
-logger, and each operation is additionally wrapped in a JAX profiler trace
-annotation when JAX is initialized, so device timelines line up with engine
-operations.
+backend is a no-op stub. Here the backend is real, in three pieces:
+
+1. **Hierarchical spans** — :func:`record_operation` nests via a contextvar
+   parent stack, so ``delta.commit`` contains its ``prepare`` /
+   ``conflictCheck`` / ``write`` / ``postCommit`` phases and a scan contains
+   its planning/prune phases. Spans export as Chrome trace-event JSON
+   (:func:`export_chrome_trace`) loadable in Perfetto / ``chrome://tracing``
+   alongside the ``jax.named_scope`` annotations each span also opens, so
+   device timelines line up with engine operations. Contextvars give each
+   thread its own stack: concurrent writers never parent each other's spans.
+
+2. **A metrics registry** — monotonic counters (:func:`bump_counter`),
+   gauges (:func:`set_gauge`) and fixed log2-bucket latency histograms
+   (:func:`observe`), with Prometheus text exposition
+   (:func:`prometheus_text`) and a JSON snapshot
+   (:func:`metrics_snapshot`). Gauges and histograms take labels (e.g. the
+   table path); counters stay label-free name strings — they are the hot
+   path and a dict bump must stay a dict bump.
+
+3. **Events** — :func:`record_event` point-in-time payloads (the analogue of
+   ``recordDeltaEvent``), e.g. the per-commit ``delta.commit.stats``.
+
+Everything lands in one in-process ring buffer (size:
+``delta.tpu.telemetry.bufferSize``, default 4096) and a standard ``logging``
+logger. ``delta.tpu.telemetry.enabled=False`` suppresses events and spans
+entirely (zero allocation on the hot path); counters keep working — they are
+cheap and the serving-envelope numbers must survive an event blackout.
 """
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import itertools
 import json
 import logging
+import os
+import re
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, Iterator, List, Optional
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+
+from delta_tpu.utils.config import conf
 
 logger = logging.getLogger("delta_tpu.usage")
 
-__all__ = ["record_event", "record_operation", "with_status", "recent_events", "clear_events", "UsageEvent"]
+__all__ = [
+    "record_event", "record_operation", "with_status", "recent_events",
+    "clear_events", "UsageEvent", "bump_counter", "counters",
+    "clear_counters", "set_gauge", "gauges", "observe", "histograms",
+    "prometheus_text", "metrics_snapshot", "bench_snapshot",
+    "export_chrome_trace", "current_span", "add_span_data", "reset_all",
+    "HISTOGRAM_BUCKETS",
+]
 
 
 @dataclass
@@ -33,6 +69,15 @@ class UsageEvent:
     tags: Dict[str, str] = field(default_factory=dict)
     data: Dict[str, Any] = field(default_factory=dict)
     error: Optional[str] = None
+    # span identity (0/None on plain events recorded outside any operation)
+    span_id: int = 0
+    parent_id: Optional[int] = None
+    depth: int = 0
+    # trace-export timeline: microseconds on the perf_counter clock
+    start_us: int = 0
+    duration_us: Optional[int] = None
+    thread_id: int = 0
+    thread_name: str = ""
 
     def to_json(self) -> str:
         return json.dumps(
@@ -43,6 +88,8 @@ class UsageEvent:
                 "tags": self.tags,
                 "data": self.data,
                 "error": self.error,
+                "spanId": self.span_id or None,
+                "parentId": self.parent_id,
             },
             separators=(",", ":"),
             default=str,
@@ -51,34 +98,115 @@ class UsageEvent:
 
 _BUFFER: Deque[UsageEvent] = deque(maxlen=4096)
 _LOCK = threading.Lock()
+_SPAN_IDS = itertools.count(1)
+# innermost-last tuple of active span ids for THIS thread/context
+_SPAN_STACK: "contextvars.ContextVar[Tuple[int, ...]]" = contextvars.ContextVar(
+    "delta_telemetry_span_stack", default=()
+)
+# spans currently open (still mutable via add_span_data), by span id
+_ACTIVE: Dict[int, UsageEvent] = {}
+
+
+def _enabled() -> bool:
+    return conf.get_bool("delta.tpu.telemetry.enabled", True)
+
+
+def _buffer_size() -> int:
+    """Resolve the configured ring size OUTSIDE the telemetry lock — the
+    conf lock must never be taken while holding ``_LOCK``."""
+    try:
+        size = int(conf.get("delta.tpu.telemetry.bufferSize", 4096))
+    except (TypeError, ValueError):
+        size = 4096
+    return size if size > 0 else 4096
+
+
+def _buffer_locked(size: int) -> Deque[UsageEvent]:
+    """The ring buffer at ``size``; callers hold ``_LOCK``."""
+    global _BUFFER
+    if _BUFFER.maxlen != size:
+        _BUFFER = deque(_BUFFER, maxlen=size)
+    return _BUFFER
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
 
 
 def record_event(op_type: str, data: Optional[Dict[str, Any]] = None, **tags: str) -> None:
-    ev = UsageEvent(op_type, int(time.time() * 1000), tags={k: str(v) for k, v in tags.items()},
-                    data=data or {})
+    if not _enabled():
+        return
+    th = threading.current_thread()
+    ev = UsageEvent(op_type, int(time.time() * 1000),
+                    tags={k: str(v) for k, v in tags.items()},
+                    data=data or {},
+                    parent_id=(_SPAN_STACK.get() or (None,))[-1],
+                    start_us=_now_us(),
+                    thread_id=th.ident or 0, thread_name=th.name)
+    size = _buffer_size()
     with _LOCK:
-        _BUFFER.append(ev)
+        _buffer_locked(size).append(ev)
     logger.debug("%s", ev.to_json())
 
 
 @contextlib.contextmanager
 def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags: str) -> Iterator[UsageEvent]:
-    """Wrap an operation: duration + error capture + JAX profiler annotation."""
-    ev = UsageEvent(op_type, int(time.time() * 1000), tags={k: str(v) for k, v in tags.items()},
-                    data=dict(data or {}))
-    start = time.monotonic()
-    trace_ctx = _maybe_jax_trace(op_type)
+    """Wrap an operation in a span: duration + error capture + parent/child
+    nesting + JAX profiler annotation. The yielded event is live — mutate
+    ``ev.data`` (or call :func:`add_span_data` from anywhere below) to attach
+    payloads before the span closes."""
+    if not _enabled():
+        # zero-overhead: no span bookkeeping, no buffer append, no timing
+        yield UsageEvent(op_type, 0, data=dict(data or {}))
+        return
+    th = threading.current_thread()
+    stack = _SPAN_STACK.get()
+    ev = UsageEvent(op_type, int(time.time() * 1000),
+                    tags={k: str(v) for k, v in tags.items()},
+                    data=dict(data or {}),
+                    span_id=next(_SPAN_IDS),
+                    parent_id=stack[-1] if stack else None,
+                    depth=len(stack),
+                    start_us=_now_us(),
+                    thread_id=th.ident or 0, thread_name=th.name)
+    with _LOCK:
+        _ACTIVE[ev.span_id] = ev
+    token = _SPAN_STACK.set(stack + (ev.span_id,))
+    start_ns = time.perf_counter_ns()
     try:
-        with trace_ctx:
+        with _maybe_jax_trace(op_type):
             yield ev
     except BaseException as e:
         ev.error = f"{type(e).__name__}: {e}"
         raise
     finally:
-        ev.duration_ms = int((time.monotonic() - start) * 1000)
+        _SPAN_STACK.reset(token)
+        dur_us = (time.perf_counter_ns() - start_ns) // 1000
+        ev.duration_us = int(dur_us)
+        ev.duration_ms = int(dur_us // 1000)
+        size = _buffer_size()
         with _LOCK:
-            _BUFFER.append(ev)
+            _ACTIVE.pop(ev.span_id, None)
+            _buffer_locked(size).append(ev)
         logger.debug("%s", ev.to_json())
+
+
+def current_span() -> Optional[UsageEvent]:
+    """The innermost open span in this context, or None."""
+    stack = _SPAN_STACK.get()
+    if not stack:
+        return None
+    with _LOCK:
+        return _ACTIVE.get(stack[-1])
+
+
+def add_span_data(**kv: Any) -> None:
+    """Merge key/values into the innermost open span's data payload — how a
+    layer deep inside an operation (e.g. DML rewrite metrics) reports into
+    the span that wraps it, without threading the event object through."""
+    ev = current_span()
+    if ev is not None:
+        ev.data.update(kv)
 
 
 @contextlib.contextmanager
@@ -106,9 +234,15 @@ def _maybe_jax_trace(name: str):
     return contextlib.nullcontext()
 
 
+def _prefix_match(name: str, prefix: str) -> bool:
+    """Dotted-name boundary match: ``"delta.commit"`` matches itself and
+    ``delta.commit.*`` but NOT ``delta.commitFoo``."""
+    return not prefix or name == prefix or name.startswith(prefix + ".")
+
+
 def recent_events(op_prefix: str = "") -> List[UsageEvent]:
     with _LOCK:
-        return [e for e in _BUFFER if e.op_type.startswith(op_prefix)]
+        return [e for e in _BUFFER if _prefix_match(e.op_type, op_prefix)]
 
 
 def clear_events() -> None:
@@ -121,6 +255,8 @@ def clear_events() -> None:
 # Cheap process-wide tallies for questions like "what fraction of scan
 # plans actually served from the resident state cache, and why did the
 # rest fall back?" — the serving envelope as a NUMBER, not a hope.
+# Deliberately label-free and NOT gated on telemetry.enabled: a name lookup
+# plus an int add, even during an event blackout.
 
 _COUNTERS: Dict[str, int] = {}
 
@@ -132,9 +268,254 @@ def bump_counter(name: str, by: int = 1) -> None:
 
 def counters(prefix: str = "") -> Dict[str, int]:
     with _LOCK:
-        return {k: v for k, v in _COUNTERS.items() if k.startswith(prefix)}
+        return {k: v for k, v in _COUNTERS.items() if _prefix_match(k, prefix)}
 
 
 def clear_counters() -> None:
     with _LOCK:
         _COUNTERS.clear()
+
+
+# -- gauges + histograms -----------------------------------------------------
+
+LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+#: Fixed log2 bucket upper bounds (ms when observing latencies):
+#: 1, 2, 4, ..., 65536; values above the last bound land in +Inf.
+HISTOGRAM_BUCKETS: Tuple[float, ...] = tuple(float(2 ** i) for i in range(17))
+
+_GAUGES: Dict[LabelKey, float] = {}
+_HISTOGRAMS: Dict[LabelKey, "_Histogram"] = {}
+
+
+class _Histogram:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(HISTOGRAM_BUCKETS) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+
+def _label_key(name: str, labels: Dict[str, str]) -> LabelKey:
+    return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def set_gauge(name: str, value: float, **labels: str) -> None:
+    with _LOCK:
+        _GAUGES[_label_key(name, labels)] = float(value)
+
+
+def gauges(prefix: str = "") -> Dict[LabelKey, float]:
+    with _LOCK:
+        return {k: v for k, v in _GAUGES.items() if _prefix_match(k[0], prefix)}
+
+
+def observe(name: str, value: float, **labels: str) -> None:
+    """Record ``value`` into the fixed-log-bucket histogram ``name``."""
+    value = float(value)
+    key = _label_key(name, labels)
+    ix = bisect_left(HISTOGRAM_BUCKETS, value)
+    with _LOCK:
+        h = _HISTOGRAMS.get(key)
+        if h is None:
+            h = _HISTOGRAMS[key] = _Histogram()
+        h.counts[ix] += 1
+        h.sum += value
+        h.count += 1
+
+
+def histograms(prefix: str = "") -> Dict[LabelKey, "_Histogram"]:
+    with _LOCK:
+        return {k: v for k, v in _HISTOGRAMS.items() if _prefix_match(k[0], prefix)}
+
+
+def clear_metrics() -> None:
+    with _LOCK:
+        _GAUGES.clear()
+        _HISTOGRAMS.clear()
+
+
+def reset_all() -> None:
+    """Events + counters + gauges + histograms back to empty (tests, bench
+    per-config isolation)."""
+    with _LOCK:
+        _BUFFER.clear()
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTOGRAMS.clear()
+
+
+# -- exposition --------------------------------------------------------------
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return _NAME_SANITIZE.sub("_", name)
+
+
+def _prom_escape(v: str) -> str:
+    # text-format label values require \\, \", \n escaping
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{_prom_name(k)}="{_prom_escape(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def prometheus_text() -> str:
+    """Prometheus text-format exposition of every counter, gauge, and
+    histogram (stable ordering — scrape-diff friendly)."""
+    with _LOCK:
+        ctrs = sorted(_COUNTERS.items())
+        gags = sorted(_GAUGES.items())
+        hists = sorted(_HISTOGRAMS.items(), key=lambda kv: kv[0])
+        hist_rows = [(k, list(h.counts), h.sum, h.count) for k, h in hists]
+    lines: List[str] = []
+    for name, value in ctrs:
+        pn = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {value}")
+    for (name, labels), value in gags:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn}{_prom_labels(labels)} {_fmt(value)}")
+    for (name, labels), counts, total, count in hist_rows:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for bound, c in zip(HISTOGRAM_BUCKETS, counts):
+            cum += c
+            le = _prom_labels(labels, f'le="{_fmt(bound)}"')
+            lines.append(f"{pn}_bucket{le} {cum}")
+        cum += counts[-1]
+        inf_labels = _prom_labels(labels, 'le="+Inf"')
+        lines.append(f"{pn}_bucket{inf_labels} {cum}")
+        lines.append(f"{pn}_sum{_prom_labels(labels)} {_fmt(total)}")
+        lines.append(f"{pn}_count{_prom_labels(labels)} {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _labels_suffix(labels: Tuple[Tuple[str, str], ...]) -> str:
+    return "{" + ",".join(f"{k}={v}" for k, v in labels) + "}" if labels else ""
+
+
+def _hist_quantile(counts: List[int], count: int, q: float) -> Optional[float]:
+    """Upper bucket bound where the cumulative count crosses q (approximate,
+    conservative-upward — the usual bucket-quantile estimate)."""
+    if count <= 0:
+        return None
+    target = q * count
+    cum = 0
+    for bound, c in zip(HISTOGRAM_BUCKETS, counts):
+        cum += c
+        if cum >= target:
+            return bound
+    return None  # beyond the last bound (+Inf bucket) — keep JSON strict
+
+
+def metrics_snapshot() -> Dict[str, Any]:
+    """JSON-able snapshot of the whole registry."""
+    with _LOCK:
+        ctrs = dict(_COUNTERS)
+        gags = dict(_GAUGES)
+        hists = [((n, lb), list(h.counts), h.sum, h.count)
+                 for (n, lb), h in _HISTOGRAMS.items()]
+    out: Dict[str, Any] = {
+        "counters": dict(sorted(ctrs.items())),
+        "gauges": {f"{n}{_labels_suffix(lb)}": v
+                   for (n, lb), v in sorted(gags.items())},
+        "histograms": {},
+    }
+    for (n, lb), counts, total, count in sorted(hists, key=lambda r: r[0]):
+        buckets = {_fmt(b): c for b, c in zip(HISTOGRAM_BUCKETS, counts) if c}
+        if counts[-1]:
+            buckets["+Inf"] = counts[-1]
+        out["histograms"][f"{n}{_labels_suffix(lb)}"] = {
+            "count": count, "sum": round(total, 3), "buckets": buckets,
+        }
+    return out
+
+
+def bench_snapshot(top: int = 12) -> Dict[str, Any]:
+    """Compact per-bench-config attachment: top counters by value plus
+    histogram summaries (count/sum/approx p50/p95) — internal metrics for
+    BENCH_*.json trajectories, not just wall-clock."""
+    with _LOCK:
+        ctrs = sorted(_COUNTERS.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+        hists = [((n, lb), list(h.counts), h.sum, h.count)
+                 for (n, lb), h in _HISTOGRAMS.items()]
+    out: Dict[str, Any] = {"counters": dict(ctrs), "histograms": {}}
+    for (n, lb), counts, total, count in sorted(hists, key=lambda r: r[0]):
+        out["histograms"][f"{n}{_labels_suffix(lb)}"] = {
+            "count": count,
+            "sum": round(total, 3),
+            "p50": _hist_quantile(counts, count, 0.50),
+            "p95": _hist_quantile(counts, count, 0.95),
+        }
+    return out
+
+
+# -- Chrome trace-event export (Perfetto / chrome://tracing) -----------------
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+    """Export the event ring buffer as Chrome trace-event JSON.
+
+    Spans become complete ("X") events with real durations; point events
+    become instants ("i"). Thread-name metadata rows keep multi-writer
+    traces readable. Load the result in https://ui.perfetto.dev or
+    ``chrome://tracing``; with the JAX profiler active, span names also
+    appear as ``delta/...`` named scopes on the device timeline."""
+    pid = os.getpid()
+    with _LOCK:
+        events = list(_BUFFER)
+    rows: List[Dict[str, Any]] = []
+    seen_tids: Dict[int, str] = {}
+    for ev in events:
+        tid = ev.thread_id or 0
+        if tid not in seen_tids:
+            seen_tids[tid] = ev.thread_name or str(tid)
+        args: Dict[str, Any] = {}
+        if ev.tags:
+            args.update(ev.tags)
+        if ev.data:
+            args.update(ev.data)
+        if ev.error:
+            args["error"] = ev.error
+        if ev.span_id:
+            args["spanId"] = ev.span_id
+        if ev.parent_id:
+            args["parentId"] = ev.parent_id
+        row: Dict[str, Any] = {
+            "name": ev.op_type,
+            "cat": "delta",
+            "pid": pid,
+            "tid": tid,
+            "ts": ev.start_us,
+            "args": args,
+        }
+        if ev.duration_us is not None:
+            row["ph"] = "X"
+            row["dur"] = ev.duration_us
+        else:
+            row["ph"] = "i"
+            row["s"] = "t"
+        rows.append(row)
+    for tid, tname in seen_tids.items():
+        rows.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": tname},
+        })
+    trace = {"traceEvents": rows, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f, default=str)
+    return trace

@@ -140,3 +140,20 @@ def test_ctas_metrics(tmp_table):
     assert op == "CREATE TABLE AS SELECT"
     assert int(m["numFiles"]) >= 1
     assert int(m["numOutputRows"]) == 4
+
+
+def test_metrics_enabled_false_gates_describe_history(tmp_table):
+    """`delta.tpu.history.metricsEnabled=False` suppresses operationMetrics
+    END TO END: commits made under the flag carry none in CommitInfo, so
+    DESCRIBE HISTORY shows none — while commits made with it on still do."""
+    from delta_tpu.commands.describe import describe_history
+    from delta_tpu.utils.config import conf
+
+    t = make(tmp_table)  # CTAS with metrics on
+    with conf.set_temporarily(delta__tpu__history__metricsEnabled=False):
+        t.delete("id < 3")
+    rows = describe_history(t.delta_log)
+    assert rows[0]["operation"] == "DELETE"
+    assert not rows[0].get("operationMetrics")
+    # the commit made before the flag flip keeps its metrics
+    assert rows[1].get("operationMetrics")

@@ -1,14 +1,20 @@
 """Usage-logging telemetry (SURVEY §5; ``metering/DeltaLogging.scala:50-109``):
-hierarchical opTypes, the real ring-buffer backend, duration/error capture,
-and the engine wiring (commits emit ``delta.commit`` events).
+hierarchical spans (contextvar nesting, Chrome-trace export), the metrics
+registry (counters/gauges/log-bucket histograms, Prometheus exposition),
+CommitStats parity events, and the engine wiring — plus the static lint that
+keeps every command entry point instrumented.
 """
+import ast
 import json
+import os
+import threading
 
 import pyarrow as pa
 import pytest
 
 from delta_tpu.api.tables import DeltaTable
 from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
 
 
 @pytest.fixture(autouse=True)
@@ -53,32 +59,384 @@ def test_event_json_round_trips():
     assert d["data"] == {"k": [1, 2]}
 
 
-def test_commits_emit_usage_events(tmp_table):
-    t = DeltaTable.create(
-        tmp_table, data=pa.table({"id": pa.array([1], pa.int64())})
-    )
-    t.delete("id = 1")
-    commits = telemetry.recent_events("delta.commit")
-    assert len(commits) >= 2  # create + delete
-    assert all(e.duration_ms is not None for e in commits)
-    assert all(e.tags.get("path") == tmp_table for e in commits)
+def test_prefix_matching_respects_dotted_boundaries():
+    """`recent_events("delta.commit")` must not match `delta.commitFoo.*`."""
+    telemetry.record_event("delta.commit")
+    telemetry.record_event("delta.commit.stats")
+    telemetry.record_event("delta.commitFoo")
+    telemetry.record_event("delta.commitFoo.bar")
+    got = [e.op_type for e in telemetry.recent_events("delta.commit")]
+    assert got == ["delta.commit", "delta.commit.stats"]
+
+    telemetry.clear_counters()
+    telemetry.bump_counter("scan.files", 1)
+    telemetry.bump_counter("scan.files.read", 2)
+    telemetry.bump_counter("scan.filesFoo", 3)
+    assert telemetry.counters("scan.files") == {
+        "scan.files": 1, "scan.files.read": 2,
+    }
 
 
 def test_ring_buffer_bounded():
-    for i in range(5000):
+    for _ in range(5000):
         telemetry.record_event("delta.test.flood")
     # deque(maxlen=4096): exactly full — also catches silent non-recording
     assert len(telemetry.recent_events()) == 4096
 
 
+def test_ring_buffer_size_configurable():
+    with conf.set_temporarily(delta__tpu__telemetry__bufferSize=16):
+        for _ in range(100):
+            telemetry.record_event("delta.test.small")
+        assert len(telemetry.recent_events()) == 16
+    # back to the default on the next record
+    telemetry.record_event("delta.test.restored")
+    assert len(telemetry.recent_events()) == 17  # resize preserves contents
+
+
+# -- hierarchical spans ------------------------------------------------------
+
+
+def test_span_nesting_parent_child_ordering():
+    with telemetry.record_operation("delta.test.outer") as outer:
+        telemetry.record_event("delta.test.point")
+        with telemetry.record_operation("delta.test.outer.mid") as mid:
+            with telemetry.record_operation("delta.test.outer.mid.leaf") as leaf:
+                pass
+    assert outer.parent_id is None and outer.depth == 0
+    assert mid.parent_id == outer.span_id and mid.depth == 1
+    assert leaf.parent_id == mid.span_id and leaf.depth == 2
+    # point events parent to the enclosing span
+    [pt] = telemetry.recent_events("delta.test.point")
+    assert pt.parent_id == outer.span_id
+    # children close (and land in the buffer) before their parent
+    order = [e.op_type for e in telemetry.recent_events("delta.test")]
+    assert order.index("delta.test.outer.mid.leaf") < order.index("delta.test.outer.mid")
+    assert order.index("delta.test.outer.mid") < order.index("delta.test.outer")
+
+
+def test_span_data_attaches_to_innermost_open_span():
+    with telemetry.record_operation("delta.test.host") as ev:
+        telemetry.add_span_data(rows=7)
+    assert ev.data == {"rows": 7}
+    # no open span: silently a no-op
+    telemetry.add_span_data(ignored=True)
+
+
+def test_span_nesting_isolated_across_threads():
+    """Each thread gets its own contextvar stack: concurrent spans never
+    parent across threads, and nesting inside each thread stays intact."""
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def worker(name):
+        barrier.wait()
+        with telemetry.record_operation(f"delta.test.{name}") as root:
+            barrier.wait()  # both roots open simultaneously
+            with telemetry.record_operation(f"delta.test.{name}.child") as child:
+                pass
+        results[name] = (root, child)
+
+    ts = [threading.Thread(target=worker, args=(n,)) for n in ("t1", "t2")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    r1, c1 = results["t1"]
+    r2, c2 = results["t2"]
+    assert r1.parent_id is None and r2.parent_id is None
+    assert c1.parent_id == r1.span_id
+    assert c2.parent_id == r2.span_id
+    assert r1.span_id != r2.span_id
+    assert c1.thread_id != c2.thread_id
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+def test_histogram_bucket_boundaries():
+    telemetry.reset_all()
+    telemetry.observe("delta.test.hist", 1.0)     # == first bound -> le=1
+    telemetry.observe("delta.test.hist", 1.5)     # -> le=2
+    telemetry.observe("delta.test.hist", 2.0)     # == bound -> le=2
+    telemetry.observe("delta.test.hist", 65536.0)  # == last bound
+    telemetry.observe("delta.test.hist", 1e9)     # -> +Inf
+    [(key, h)] = telemetry.histograms("delta.test.hist").items()
+    assert key == ("delta.test.hist", ())
+    bounds = telemetry.HISTOGRAM_BUCKETS
+    assert h.counts[bounds.index(1.0)] == 1
+    assert h.counts[bounds.index(2.0)] == 2
+    assert h.counts[bounds.index(65536.0)] == 1
+    assert h.counts[-1] == 1  # +Inf
+    assert h.count == 5
+    assert h.sum == pytest.approx(1.0 + 1.5 + 2.0 + 65536.0 + 1e9)
+
+
+def test_gauges_with_labels():
+    telemetry.reset_all()
+    telemetry.set_gauge("delta.test.gauge", 3, path="/a")
+    telemetry.set_gauge("delta.test.gauge", 5, path="/a")  # overwrite
+    telemetry.set_gauge("delta.test.gauge", 7, path="/b")
+    g = telemetry.gauges("delta.test.gauge")
+    assert g[("delta.test.gauge", (("path", "/a"),))] == 5.0
+    assert g[("delta.test.gauge", (("path", "/b"),))] == 7.0
+
+
+def test_prometheus_text_golden():
+    telemetry.reset_all()
+    telemetry.bump_counter("commit.total", 3)
+    telemetry.set_gauge("delta.cache.bytes", 128, path="/t")
+    telemetry.observe("delta.op.ms", 3.0, path="/t")
+    telemetry.observe("delta.op.ms", 5.0, path="/t")
+    text = telemetry.prometheus_text()
+    bucket_lines = "".join(
+        f'delta_op_ms_bucket{{path="/t",le="{b}"}} '
+        f"{0 if b < 4 else (1 if b < 8 else 2)}\n"
+        for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                  2048, 4096, 8192, 16384, 32768, 65536)
+    )
+    expected = (
+        "# TYPE commit_total_total counter\n"
+        "commit_total_total 3\n"
+        "# TYPE delta_cache_bytes gauge\n"
+        'delta_cache_bytes{path="/t"} 128\n'
+        "# TYPE delta_op_ms histogram\n"
+        + bucket_lines
+        + 'delta_op_ms_bucket{path="/t",le="+Inf"} 2\n'
+        'delta_op_ms_sum{path="/t"} 8\n'
+        'delta_op_ms_count{path="/t"} 2\n'
+    )
+    assert text == expected
+
+
+def test_prometheus_escapes_label_values():
+    telemetry.reset_all()
+    telemetry.set_gauge("delta.test.esc", 1, path='C:\\data\\"t"\ntbl')
+    text = telemetry.prometheus_text()
+    assert 'path="C:\\\\data\\\\\\"t\\"\\ntbl"' in text
+    assert "\n\n" not in text  # raw newline never leaks into the exposition
+
+
+def test_metrics_snapshot_is_json_serializable():
+    telemetry.reset_all()
+    telemetry.bump_counter("a.b", 2)
+    telemetry.set_gauge("g", 1.5)
+    telemetry.observe("h.ms", 10, path="/t")
+    snap = json.loads(json.dumps(telemetry.metrics_snapshot()))
+    assert snap["counters"] == {"a.b": 2}
+    assert snap["gauges"] == {"g": 1.5}
+    assert snap["histograms"]["h.ms{path=/t}"]["count"] == 1
+    compact = json.loads(json.dumps(telemetry.bench_snapshot()))
+    assert compact["counters"]["a.b"] == 2
+    assert compact["histograms"]["h.ms{path=/t}"]["p50"] == 16.0
+
+
+# -- zero-overhead disable ---------------------------------------------------
+
+
+def test_telemetry_disabled_records_nothing_counters_still_work():
+    telemetry.reset_all()
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        telemetry.record_event("delta.test.blackout")
+        with telemetry.record_operation("delta.test.blackout.op") as ev:
+            telemetry.add_span_data(x=1)
+        telemetry.bump_counter("hot.counter")
+    assert telemetry.recent_events() == []
+    assert ev.duration_ms is None  # span never timed or buffered
+    assert telemetry.counters("hot.counter") == {"hot.counter": 1}
+    # no fabricated 0-ms samples leak into the latency histograms
+    assert telemetry.histograms("delta.streaming") == {}
+    # re-enabled: recording resumes
+    telemetry.record_event("delta.test.back")
+    assert len(telemetry.recent_events()) == 1
+
+
+# -- engine wiring -----------------------------------------------------------
+
+
+def test_commits_emit_usage_events(tmp_table):
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([1], pa.int64())})
+    )
+    t.delete("id = 1")
+    commits = [e for e in telemetry.recent_events("delta.commit")
+               if e.op_type == "delta.commit"]
+    assert len(commits) >= 2  # create + delete
+    assert all(e.duration_ms is not None for e in commits)
+    assert all(e.tags.get("path") == tmp_table for e in commits)
+
+
+def test_commit_stats_on_clean_commit(tmp_table):
+    DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([1, 2], pa.int64())})
+    )
+    [stats] = [e.data for e in telemetry.recent_events("delta.commit.stats")]
+    assert stats["readVersion"] == -1 and stats["commitVersion"] == 0
+    assert stats["attempts"] == 1
+    assert stats["numAdd"] >= 1 and stats["numRemove"] == 0
+    assert stats["bytesNew"] > 0
+    assert stats["isolationLevel"] == "WriteSerializable"
+    for phase in ("prepare", "write", "postCommit"):
+        assert phase in stats["phaseDurationsMs"]
+    # phase spans nest under the commit span
+    [commit] = [e for e in telemetry.recent_events("delta.commit")
+                if e.op_type == "delta.commit"]
+    kids = {e.op_type for e in telemetry.recent_events()
+            if e.parent_id == commit.span_id}
+    assert {"delta.commit.prepare", "delta.commit.write",
+            "delta.commit.postCommit"} <= kids
+
+
+def test_commit_stats_on_conflict_retry(tmp_table):
+    """A commit that loses the race retries through the conflict checker and
+    reports attempts/conflictCheck duration in its CommitStats."""
+    from delta_tpu.commands import operations as ops
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.exec import write as write_exec
+
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([0], pa.int64())})
+    )
+    log = t.delta_log
+    txn = log.start_transaction()
+    # interleaving writer wins version 1 before our txn commits
+    WriteIntoDelta(log, "append", pa.table({"id": pa.array([1], pa.int64())})).run()
+    telemetry.clear_events()
+    actions = write_exec.write_files(
+        log.data_path, pa.table({"id": pa.array([2], pa.int64())}),
+        txn.metadata, data_change=True,
+    )
+    version = txn.commit(actions, ops.Write(mode="Append"))
+    assert version == 2
+    assert txn.stats.attempts == 2
+    [stats] = [e.data for e in telemetry.recent_events("delta.commit.stats")]
+    assert stats["attempts"] == 2
+    assert "conflictCheck" in stats["phaseDurationsMs"]
+    checks = [e for e in telemetry.recent_events("delta.commit.retry.conflictCheck")]
+    assert checks and checks[0].data["winningCommits"] == 1
+    assert telemetry.counters("commit.retries") == {"commit.retries": 1}
+
+
+def test_concurrent_commits_each_emit_stats(tmp_table):
+    """Chaos-harness shape: racing writers all emit CommitStats, spans stay
+    thread-local (no cross-thread parenting)."""
+    from delta_tpu.commands.write import WriteIntoDelta
+
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([0], pa.int64())})
+    )
+    telemetry.clear_events()
+    N = 6
+    errs = []
+
+    def appender(i):
+        try:
+            WriteIntoDelta(t.delta_log, "append", pa.table({
+                "id": pa.array([100 + i], pa.int64()),
+            })).run()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=appender, args=(i,)) for i in range(N)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert errs == []
+    stats = telemetry.recent_events("delta.commit.stats")
+    assert len(stats) == N
+    assert sorted(e.data["commitVersion"] for e in stats) == list(range(1, N + 1))
+    # every commit span is parented by a dml span from ITS OWN thread
+    by_id = {e.span_id: e for e in telemetry.recent_events() if e.span_id}
+    for c in (e for e in telemetry.recent_events("delta.commit")
+              if e.op_type == "delta.commit"):
+        parent = by_id[c.parent_id]
+        assert parent.thread_id == c.thread_id
+
+
+def test_history_metrics_disabled_suppresses_stats_op_metrics(tmp_table):
+    t = DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array(range(5), pa.int64())})
+    )
+    telemetry.clear_events()
+    with conf.set_temporarily(delta__tpu__history__metricsEnabled=False):
+        t.delete("id = 1")
+    [stats] = [e.data for e in telemetry.recent_events("delta.commit.stats")]
+    assert "opMetrics" not in stats
+
+
+# -- acceptance: MERGE observability end to end ------------------------------
+
+
+def test_merge_produces_span_tree_stats_prometheus_and_trace(tmp_table, tmp_path):
+    from delta_tpu.protocol import filenames
+    from delta_tpu.protocol.actions import AddFile, RemoveFile, actions_from_lines
+
+    telemetry.reset_all()
+    t = DeltaTable.create(
+        tmp_table,
+        data=pa.table({"id": pa.array(range(10), pa.int64()),
+                       "v": pa.array(["x"] * 10)}),
+    )
+    src = pa.table({"id": pa.array([3, 100], pa.int64()),
+                    "v": pa.array(["u", "i"])})
+    (t.alias("t").merge(src, "t.id = s.id", source_alias="s")
+     .when_matched_update_all().when_not_matched_insert_all().execute())
+
+    # 1. nested span tree: merge -> commit -> {prepare, write, postCommit}
+    [merge] = telemetry.recent_events("delta.dml.merge")
+    commits = [e for e in telemetry.recent_events("delta.commit")
+               if e.op_type == "delta.commit" and e.parent_id == merge.span_id]
+    assert commits, "delta.commit span must nest under delta.dml.merge"
+    commit = commits[-1]
+    kids = {e.op_type for e in telemetry.recent_events()
+            if e.parent_id == commit.span_id}
+    assert {"delta.commit.prepare", "delta.commit.write"} <= kids
+    # DML rewrite metrics attached to the merge span via report_metrics
+    assert "numTargetRowsUpdated" in merge.data
+
+    # 2. stats event matches the actions actually committed
+    stats = telemetry.recent_events("delta.commit.stats")[-1].data
+    version = stats["commitVersion"]
+    committed = actions_from_lines(t.delta_log.store.read_iter(
+        f"{t.delta_log.log_path}/{filenames.delta_file(version)}"))
+    num_add = sum(isinstance(a, AddFile) for a in committed)
+    num_remove = sum(isinstance(a, RemoveFile) for a in committed)
+    assert stats["numAdd"] == num_add >= 1
+    assert stats["numRemove"] == num_remove >= 1
+
+    # 3. prometheus exposition includes at least one histogram
+    text = telemetry.prometheus_text()
+    assert "# TYPE delta_commit_duration_ms histogram" in text
+    assert "_bucket{" in text and "_count{" in text
+
+    # 4. Perfetto-loadable Chrome trace JSON
+    out = tmp_path / "trace.json"
+    trace = telemetry.export_chrome_trace(str(out))
+    loaded = json.loads(out.read_text())
+    assert loaded["traceEvents"] == json.loads(json.dumps(
+        trace["traceEvents"], default=str))
+    complete = [r for r in loaded["traceEvents"] if r.get("ph") == "X"]
+    names = {r["name"] for r in complete}
+    assert {"delta.dml.merge", "delta.commit"} <= names
+    mrow = next(r for r in complete if r["name"] == "delta.dml.merge")
+    crow = next(r for r in complete
+                if r["name"] == "delta.commit"
+                and r["args"].get("parentId") == mrow["args"]["spanId"])
+    # child timeline contained within the parent's
+    assert mrow["ts"] <= crow["ts"]
+    assert crow["ts"] + crow["dur"] <= mrow["ts"] + mrow["dur"] + 1000
+
+
+# -- engine status events (pre-existing behavior) ----------------------------
+
+
 def test_with_status_records_event_and_duration(tmp_table):
     import numpy as np
-    import pyarrow as pa
 
     from delta_tpu import DeltaLog
     from delta_tpu.commands.write import WriteIntoDelta
     from delta_tpu.exec.scan import scan_files
-    from delta_tpu.utils import telemetry
 
     telemetry.clear_events()
     log = DeltaLog.for_table(tmp_table)
@@ -87,6 +445,9 @@ def test_with_status_records_event_and_duration(tmp_table):
     evs = [e for e in telemetry.recent_events("delta.status")
            if e.data.get("message") == "Filtering files for query"]
     assert evs and evs[-1].duration_ms is not None
+    # the status event nests under the scan-planning span
+    planning = telemetry.recent_events("delta.scan.planning")
+    assert planning and evs[-1].parent_id == planning[-1].span_id
 
     telemetry.clear_events()
     from delta_tpu.commands.vacuum import VacuumCommand
@@ -94,3 +455,81 @@ def test_with_status_records_event_and_duration(tmp_table):
     VacuumCommand(log, retention_hours=1000, dry_run=True).run()
     evs = telemetry.recent_events("delta.status")
     assert any("VACUUM" in e.data.get("message", "") for e in evs)
+    # and the whole command ran under its utility span
+    assert telemetry.recent_events("delta.utility.vacuum")
+
+
+def test_logstore_io_counters(tmp_table):
+    telemetry.reset_all()
+    DeltaTable.create(
+        tmp_table, data=pa.table({"id": pa.array([1], pa.int64())})
+    )
+    io = telemetry.counters("logstore")
+    assert io.get("logstore.write.calls", 0) >= 1
+    assert io.get("logstore.write.bytes", 0) > 0
+    assert io.get("logstore.list.calls", 0) >= 1
+
+
+# -- static lint: every command entry point is instrumented ------------------
+
+_COMMANDS_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "delta_tpu", "commands"
+)
+_EXEMPT_MODULES = {"__init__.py", "operations.py", "dml_common.py"}
+
+
+def _record_operation_op_types(fn: ast.FunctionDef):
+    """All constant op-type strings passed to record_operation inside ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            callee = call.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else callee.attr if isinstance(callee, ast.Attribute)
+                    else None)
+            if name != "record_operation" or not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append(arg.value)
+    return out
+
+
+def test_every_command_entry_point_runs_under_a_span():
+    """New commands can't ship uninstrumented: every public entry point in
+    delta_tpu/commands/ (a class ``run()`` or a module-level function taking
+    ``delta_log`` first) must open a ``delta.dml.*`` or ``delta.utility.*``
+    span via record_operation."""
+    missing = []
+    for fname in sorted(os.listdir(_COMMANDS_DIR)):
+        if not fname.endswith(".py") or fname in _EXEMPT_MODULES:
+            continue
+        path = os.path.join(_COMMANDS_DIR, fname)
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=fname)
+        entry_points = []
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef) and sub.name == "run":
+                        entry_points.append((f"{fname}:{node.name}.run", sub))
+            elif isinstance(node, ast.FunctionDef):
+                if node.name.startswith("_"):
+                    continue
+                args = [a.arg for a in node.args.args]
+                if args and args[0] == "delta_log":
+                    entry_points.append((f"{fname}:{node.name}", node))
+        for label, fn in entry_points:
+            ops = _record_operation_op_types(fn)
+            if not any(op.startswith(("delta.dml.", "delta.utility."))
+                       for op in ops):
+                missing.append(label)
+    assert not missing, (
+        "command entry points without a delta.dml.*/delta.utility.* span: "
+        f"{missing}"
+    )
