@@ -1305,7 +1305,12 @@ def main():
         out = fn()
         try:
             if isinstance(out, dict):
-                out["telemetry"] = telemetry.bench_snapshot()
+                # skip-rate counters always ride along: BENCH rounds track
+                # row-group pruning effectiveness next to latency
+                out["telemetry"] = telemetry.bench_snapshot(
+                    include=("scan.rowgroups", "scan.bytes.skipped",
+                             "footerCache"),
+                )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass
         return out

@@ -173,9 +173,27 @@ class ConvertToDeltaCommand:
         return log.with_new_transaction(body)
 
     def _stats_for(self, rel: str) -> str:
+        """AddFile stats for one data file: derived from footer row-group
+        statistics whenever the footer can stand in for a full decode
+        (shared with the read path's row-group planner, `exec/rowgroups`);
+        decode only when footer stats are absent or unsafe (stats-disabled
+        writers, NaN-polluted float bounds, bounds withheld for oversized
+        binary values)."""
+        import json as _json
+
         from delta_tpu.exec.parquet import stats_json
+        from delta_tpu.exec.rowgroups import read_footer, stats_from_footer
+        from delta_tpu.utils.telemetry import bump_counter
 
         abs_p = os.path.join(self.delta_log.data_path, rel.replace("/", os.sep))
+        try:
+            stats = stats_from_footer(read_footer(abs_p))
+        except Exception:
+            stats = None
+        if stats is not None:
+            bump_counter("convert.stats.fromFooter")
+            return _json.dumps(stats)
+        bump_counter("convert.stats.fromDecode")
         return stats_json(pq.read_table(abs_p))
 
     # -- multi-process fragment exchange (shared-store coordination) ------

@@ -92,6 +92,9 @@ class DeleteCommand:
         touched = read_candidates(
             self.delta_log.data_path, candidates, metadata, self.condition,
             with_positions=use_dv,
+            # DV mode only marks matched positions; the rewrite path needs
+            # every non-matching row (it writes the survivors back)
+            prune_row_groups=use_dv,
         )
         scan_ms = timer.lap_ms()
 

@@ -79,12 +79,22 @@ def read_candidates(
     metadata,
     predicate: Optional[ir.Expression],
     with_positions: bool = False,
+    prune_row_groups: bool = False,
 ) -> List[TouchedFile]:
-    """Read each candidate (parallel decode) and compute its match mask."""
+    """Read each candidate (parallel decode) and compute its match mask.
+
+    ``prune_row_groups=True`` pushes the predicate into the decode so row
+    groups that definitely contain no matches never leave disk
+    (`exec/rowgroups`). Only safe when the caller never rewrites untouched
+    rows — i.e. deletion-vector DML, which consumes ONLY mask-True rows
+    (their physical positions stay correct under skipping). The rewrite
+    path must read files whole: rows in pruned groups are exactly the
+    non-matching rows it must copy forward."""
     out: List[TouchedFile] = []
     tables = read_files_as_table(
         data_path, files, metadata, per_file=True,
         position_column=POSITION_COL if with_positions else None,
+        predicate=predicate if prune_row_groups else None,
     )
     for add, t in zip(files, tables):
         if predicate is None:

@@ -379,7 +379,8 @@ class MergeIntoCommand:
         candidates = candidate_files(txn, ir.and_all(target_only) if target_only else None)
         insert_only = not self.matched_clauses
         matched_pairs, tgt_tables = self._join(
-            txn, candidates, src, equi, residual, metadata
+            txn, candidates, src, equi, residual, metadata,
+            prune_pred=ir.and_all(target_only) if target_only else None,
         )
         scan_ms = timer.lap_ms()
 
@@ -507,7 +508,8 @@ class MergeIntoCommand:
     # -- join -------------------------------------------------------------
 
     def _join(self, txn, candidates: List[AddFile], src: pa.Table, equi, residual,
-              metadata) -> Tuple[pa.Table, Dict[int, pa.Table]]:
+              metadata, prune_pred: Optional[ir.Expression] = None,
+              ) -> Tuple[pa.Table, Dict[int, pa.Table]]:
         """Inner-join source×candidate-target. Returns (pair table with
         target cols bare + source cols prefixed + ids, per-file target
         tables with row ids).
@@ -515,7 +517,14 @@ class MergeIntoCommand:
         Device path: the join-key columns decode first (a cheap projected
         Parquet read), the membership kernel launches asynchronously, and
         the full-column decode of the candidates runs on the host *while the
-        device probes* — the kernel's wall-clock hides under the decode."""
+        device probes* — the kernel's wall-clock hides under the decode.
+
+        ``prune_pred`` (the target-only conjuncts of the merge condition)
+        enables row-group skipping inside candidate files: a pruned group
+        can hold no join matches (the conjuncts are implied by the full
+        condition). Applied only when unmatched target rows are never
+        written back — DV mode (positions stay physical) or insert-only
+        merges (target rows feed the join and nothing else)."""
         import numpy as np
 
         target_cols = [f.name for f in metadata.schema.fields]
@@ -578,6 +587,11 @@ class MergeIntoCommand:
             if (not insert_only and dv_common.dv_enabled(metadata))
             else None
         )
+        # row-group skipping is only safe when unmatched target rows never
+        # need writing back: DV mode (matched rows mark by physical
+        # position) or insert-only (target rows exist only to probe)
+        if pos_col is None and not insert_only:
+            prune_pred = None
         decode_t = Timer()
         pending = None
         resident = None
@@ -596,7 +610,12 @@ class MergeIntoCommand:
             key_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
                 columns=key_cols or None, per_file=True,
-                position_column=pos_col,
+                position_column=pos_col, predicate=prune_pred,
+                # the key read and the full read below must stay row-aligned
+                # (the device probe's indices map onto the full decode) —
+                # stats-pruning is deterministic across both, but late
+                # materialization's verdict depends on the decoded columns
+                late_materialize=False,
             )
             key_tab = pa.concat_tables(key_pieces, promote_options="permissive")
             if key_tab.num_rows:
@@ -613,6 +632,7 @@ class MergeIntoCommand:
             raw_pieces = read_files_as_table(
                 self.delta_log.data_path, candidates, metadata,
                 columns=read_cols, per_file=True, position_column=pos_col,
+                predicate=prune_pred, late_materialize=False,
             )
         tgt_tables: Dict[int, pa.Table] = {}
         pieces: List[pa.Table] = []

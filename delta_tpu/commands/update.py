@@ -80,6 +80,9 @@ class UpdateCommand:
         touched = read_candidates(
             self.delta_log.data_path, candidates, metadata, self.condition,
             with_positions=use_dv,
+            # DV mode only touches matched rows, so match-free row groups
+            # can skip decode; the rewrite path must read files whole
+            prune_row_groups=use_dv,
         )
         scan_ms = timer.lap_ms()
 

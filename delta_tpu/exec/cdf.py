@@ -114,9 +114,6 @@ def read_changes(
     ending_version: Optional[int] = None,
 ) -> pa.Table:
     """The table's change feed for versions [starting, ending] (inclusive)."""
-    import pyarrow.parquet as pq
-
-
     snapshot = delta_log.update()
     if ending_version is None:
         ending_version = snapshot.version
@@ -162,11 +159,14 @@ def read_changes(
             break
         cdc_files = [a for a in actions if isinstance(a, AddCDCFile)]
         if cdc_files:
-            for c in cdc_files:
-                abs_path = os.path.join(
-                    delta_log.data_path, c.path.replace("/", os.sep)
-                )
-                emit(pq.read_table(abs_path, memory_map=True), None, version)
+            from delta_tpu.exec.parquet import read_parquet_files
+
+            abs_paths = [
+                os.path.join(delta_log.data_path, c.path.replace("/", os.sep))
+                for c in cdc_files
+            ]
+            for t in read_parquet_files(abs_paths):
+                emit(t, None, version)
             continue
         # reconstruction: no CDC files in this commit
         adds: Dict[str, AddFile] = {
@@ -192,9 +192,13 @@ def read_changes(
                 bare = AddFile(path=add.path,
                                partition_values=dict(add.partition_values or {}),
                                size=add.size)
+                # the newly-marked positions are known before any decode:
+                # read only the row groups containing them (positions stay
+                # physical, so the isin selection below is unchanged)
                 [t] = read_files_as_table(
                     delta_log.data_path, [bare], metadata, per_file=True,
                     position_column=POSITION_COL,
+                    positions_of_interest=[newly],
                 )
                 sel = np.isin(
                     t.column(POSITION_COL).to_numpy(zero_copy_only=False), newly

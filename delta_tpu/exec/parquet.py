@@ -21,11 +21,19 @@ import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
-__all__ = ["write_parquet_file", "read_parquet_files", "collect_stats", "stats_json"]
+__all__ = [
+    "write_parquet_file",
+    "read_parquet_files",
+    "collect_stats",
+    "stats_json",
+    "json_stat_value",
+]
 
 
-def _stat_value(scalar: pa.Scalar, round_up: bool = False) -> Any:
-    v = scalar.as_py()
+def json_stat_value(v: Any, round_up: bool = False) -> Any:
+    """Encode one Python min/max value for the protocol's JSON stats —
+    shared by the decode path (:func:`collect_stats`) and the footer path
+    (`exec.rowgroups.stats_from_footer`), so both emit identical bounds."""
     if isinstance(v, _dt.datetime):
         if round_up and v.microsecond % 1000:
             # maxValues truncated to ms must round UP or data skipping would
@@ -45,6 +53,10 @@ def _stat_value(scalar: pa.Scalar, round_up: bool = False) -> Any:
         # struct — absent bounds are the only always-safe encoding
         return None
     return v
+
+
+def _stat_value(scalar: pa.Scalar, round_up: bool = False) -> Any:
+    return json_stat_value(scalar.as_py(), round_up)
 
 
 def collect_stats(table: pa.Table, num_indexed_cols: int = 32) -> Dict[str, Any]:
@@ -161,6 +173,12 @@ def write_parquet_file(
     # many-block concats) encode one page set per chunk otherwise
     if table.num_rows and table.column(0).num_chunks > 8:
         table = table.combine_chunks()
+    # bounded row groups are the skipping granule of the read path's second
+    # pruning tier (exec/rowgroups): Arrow's 1Mi-row default would leave
+    # most engine-written files as ONE group, with nothing to skip
+    rg_rows = int(conf.get("delta.tpu.write.rowGroupRows", 131_072))
+    if rg_rows > 0:
+        kwargs["row_group_size"] = rg_rows
     pq.write_table(table, abs_path, compression=codec, **kwargs)
     st = os.stat(abs_path)
     from delta_tpu.utils.telemetry import bump_counter
@@ -177,10 +195,19 @@ def read_parquet_files(
     schema: Optional[pa.Schema] = None,
 ) -> List[pa.Table]:
     """Read data files; one table per file (callers attach partition values
-    before concatenation)."""
-    out = []
-    for p in abs_paths:
-        out.append(pq.read_table(
+    before concatenation). Files decode in parallel on a thread pool —
+    Arrow's Parquet reader drops the GIL, the same host fan-out
+    ``write_files``/``read_files_as_table`` already use."""
+
+    def read_one(p: str) -> pa.Table:
+        return pq.read_table(
             p, columns=list(columns) if columns else None, memory_map=True,
-        ))
-    return out
+        )
+
+    if len(abs_paths) <= 1:
+        return [read_one(p) for p in abs_paths]
+    from concurrent.futures import ThreadPoolExecutor
+
+    workers = min(len(abs_paths), os.cpu_count() or 4)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(read_one, abs_paths))

@@ -22,6 +22,7 @@ from delta_tpu.expr.vectorized import arrow_type_for, filter_table
 from delta_tpu.ops import pruning
 from delta_tpu.protocol.actions import AddFile
 from delta_tpu.schema.types import StructType
+from delta_tpu.utils.config import conf
 
 __all__ = ["scan_files", "read_files_as_table", "scan_to_table", "plan_scans", "QueryPlan"]
 
@@ -40,6 +41,9 @@ def read_files_as_table(
     per_file: bool = False,
     position_column: Optional[str] = None,
     distribute: bool = False,
+    predicate=None,
+    positions_of_interest: Optional[Sequence] = None,
+    late_materialize: bool = True,
 ):
     """Decode AddFiles to one Arrow table, materializing partition columns.
 
@@ -52,14 +56,34 @@ def read_files_as_table(
     multi-host scan shape where each process consumes its partition; on a
     single host it is the identity.
 
+    ``predicate`` (an `expr/ir` expression) turns on the second pruning
+    tier (`exec/rowgroups`): row groups whose footer stats definitely
+    cannot match skip decode entirely, and of the survivors, predicate
+    columns decode FIRST — remaining projected columns decode only for
+    row groups with at least one possibly-matching row (late
+    materialization). Rows within surviving row groups are NOT filtered;
+    callers apply the residual predicate exactly as before, so the result
+    is identical to a full decode. Callers must only pass ``predicate``
+    when rows outside it are never needed (scans re-filter; DML may pass
+    it only when it doesn't rewrite untouched rows — deletion-vector
+    mode). ``positions_of_interest`` (per-file physical row positions,
+    aligned with ``files``; entries may be None) additionally restricts
+    decode to row groups containing those positions — the CDF DV-diff
+    shape. Both are gated by ``delta.tpu.read.rowGroupSkipping``.
+
     Rows marked in a file's deletion vector are dropped. When
     ``position_column`` is given, each row carries its PHYSICAL position in
     the file as written (int64) — DML needs physical positions to extend a
-    file's deletion vector.
+    file's deletion vector; positions stay physical under row-group
+    skipping (offset by the row counts of skipped groups).
     """
     from delta_tpu.utils import telemetry
 
     if distribute:
+        if positions_of_interest is not None:
+            raise ValueError(
+                "positions_of_interest cannot be combined with distribute"
+            )
         from delta_tpu.parallel.distributed import host_partition
 
         files = host_partition(list(files))
@@ -82,26 +106,215 @@ def read_files_as_table(
 
     import pyarrow.parquet as pq
 
-    def read_one(add: AddFile) -> pa.Table:
-        abs_path = _abs_data_path(data_path, add.path)
-        # memory_map: decoded columns reference page-cache pages instead of
-        # round-tripping file bytes through the Arrow memory pool — on
-        # single-core hosts the pool churn costs more than the decode
-        pf = pq.ParquetFile(abs_path, memory_map=True)
-        # project to the columns this file actually has (files written before
-        # a schema evolution lack the newer columns — read fills them w/ null)
-        present = set(pf.schema_arrow.names)
-        file_cols = [c for c in data_cols if c in present]
-        if file_cols:
-            t = pf.read(columns=file_cols)
-        else:
-            # no stored columns requested (partition-only projection, or all
-            # requested columns post-date this file): carry just the row
-            # count — the dummy column is dropped by the final select
-            t = pa.table({"__dummy": pa.nulls(pf.metadata.num_rows)})
+    rg_skipping = conf.get_bool("delta.tpu.read.rowGroupSkipping", True)
+    pred_refs = (
+        frozenset(r.lower() for r in ir.references(predicate))
+        if predicate is not None
+        else frozenset()
+    )
+    pcols_lower = frozenset(c.lower() for c in part_cols)
+    pos_hints = list(positions_of_interest) if positions_of_interest else None
+    # per-file (rgTotal, rgPruned, rgLateSkipped, bytesSkipped) — summed
+    # into counters/span attributes after the pool drains
+    rg_stats: List[tuple] = []
+
+    def _dummy(n: int) -> pa.Table:
+        # no stored columns requested (partition-only projection, or all
+        # requested columns post-date this file): carry just the row
+        # count — the dummy column is dropped by the final select
+        return pa.table({"__dummy": pa.nulls(n)})
+
+    def _mask_table(t1: pa.Table, add: AddFile) -> pa.Table:
+        """Attach everything the predicate may reference beyond the decoded
+        predicate columns: typed partition constants and nulls for columns
+        this file predates — mirroring the final table the residual filter
+        sees, so the late-materialization verdict can never diverge."""
+        mt = t1
+        for f in schema.fields:
+            if f.name.lower() not in pred_refs:
+                continue
+            if f.name in mt.column_names or f.name in part_cols:
+                continue
+            at = arrow_type_for(f.data_type)
+            mt = mt.append_column(pa.field(f.name, at, True), pa.nulls(mt.num_rows, at))
+        if part_cols:
+            typed = typed_partition_row(add, part_schema)
+            for c in part_cols:
+                if c.lower() not in pred_refs or c in mt.column_names:
+                    continue
+                f = part_schema[c]
+                at = arrow_type_for(f.data_type)
+                v = typed.get(c)
+                arr = (
+                    pa.nulls(mt.num_rows, at)
+                    if v is None
+                    else pa.array([v] * mt.num_rows, type=at)
+                )
+                mt = mt.append_column(pa.field(c, at, f.nullable), arr)
+        return mt
+
+    def _decode_pruned(abs_path, meta, keep_idx, add, need_positions):
+        """Decode only ``keep_idx`` row groups (late-materializing around
+        the predicate columns); returns (table, physical_positions | None,
+        late_skipped_groups, late_skipped_bytes)."""
         import numpy as np
 
+        from delta_tpu.exec import rowgroups
+
+        offsets = rowgroups.row_group_offsets(meta)
+        late_skipped = 0
+        late_bytes = 0
+        if not keep_idx:
+            t = _dummy(0)
+            pos = np.empty(0, dtype=np.int64) if need_positions else None
+            return t, pos, 0, 0
+        pf = pq.ParquetFile(abs_path, memory_map=True, metadata=meta)
+        present = set(pf.schema_arrow.names)
+        file_cols = [c for c in data_cols if c in present]
+        if not file_cols:
+            t = _dummy(int(sum(meta.row_group(i).num_rows for i in keep_idx)))
+        else:
+            pred_cols = [c for c in file_cols if c.lower() in pred_refs]
+            rest_cols = [c for c in file_cols if c not in pred_cols]
+            # a predicate column STORED in the file but outside the
+            # projection would mask as all-null and late-skip groups that
+            # genuinely match — late materialization needs every stored
+            # predicate column in the decode set
+            refs_covered = not (
+                pred_refs
+                & {c.lower() for c in present}
+                - {c.lower() for c in file_cols}
+            )
+            t = None
+            if late_materialize and refs_covered \
+                    and predicate is not None and pred_cols and rest_cols:
+                t1 = pf.read_row_groups(keep_idx, columns=pred_cols)
+                try:
+                    from delta_tpu.expr.vectorized import boolean_mask
+
+                    mask = boolean_mask(
+                        predicate, _mask_table(t1, add)
+                    ).to_numpy(zero_copy_only=False)
+                except Exception:
+                    mask = None  # unevaluable here: keep every group
+                if mask is not None:
+                    survivors, slices = [], []
+                    start = 0
+                    for i in keep_idx:
+                        n_i = meta.row_group(i).num_rows
+                        if mask[start:start + n_i].any():
+                            survivors.append(i)
+                            slices.append((start, n_i))
+                        else:
+                            late_skipped += 1
+                            rg = meta.row_group(i)
+                            by_name = {
+                                rg.column(j).path_in_schema: j
+                                for j in range(rg.num_columns)
+                            }
+                            late_bytes += sum(
+                                rg.column(by_name[c]).total_uncompressed_size
+                                for c in rest_cols
+                                if c in by_name
+                            )
+                        start += n_i
+                    if late_skipped:
+                        t1 = (
+                            pa.concat_tables([t1.slice(s, n) for s, n in slices])
+                            if slices
+                            else t1.slice(0, 0)
+                        )
+                        keep_idx = survivors
+                if keep_idx and rest_cols:
+                    t2 = pf.read_row_groups(keep_idx, columns=rest_cols)
+                    cols = {c: t1.column(c) for c in t1.column_names}
+                    cols.update({c: t2.column(c) for c in t2.column_names})
+                    t = pa.table([cols[c] for c in file_cols], names=file_cols)
+                elif keep_idx:
+                    t = t1
+                else:
+                    t = pf.schema_arrow.empty_table().select(file_cols)
+            if t is None:
+                t = pf.read_row_groups(keep_idx, columns=file_cols)
+        pos = None
+        if need_positions:
+            pos = (
+                np.concatenate(
+                    [np.arange(offsets[i], offsets[i + 1]) for i in keep_idx]
+                ).astype(np.int64)
+                if keep_idx
+                else np.empty(0, dtype=np.int64)
+            )
+        return t, pos, late_skipped, late_bytes
+
+    def read_one(job) -> pa.Table:
+        add, pos_hint = job
+        abs_path = _abs_data_path(data_path, add.path)
+        import numpy as np
+
+        need_positions = (
+            add.deletion_vector is not None or position_column is not None
+        )
+        t = None
         positions = None
+        meta = None
+        if rg_skipping and (predicate is not None or pos_hint is not None):
+            from delta_tpu.exec import rowgroups
+
+            try:
+                meta = rowgroups.read_footer(abs_path)
+            except Exception:
+                meta = None
+        if meta is not None and meta.num_row_groups > 0:
+            n_rg = meta.num_row_groups
+            keep_idx = list(range(n_rg))
+            skipped_bytes = 0
+            if predicate is not None and n_rg > 1:
+                part_row = (
+                    typed_partition_row(add, part_schema) if part_cols else None
+                )
+                plan = rowgroups.plan_row_groups(
+                    meta, predicate, part_row, pcols_lower
+                )
+                keep_idx, skipped_bytes = plan.keep, plan.skipped_bytes
+            if pos_hint is not None:
+                wanted = rowgroups.row_groups_for_positions(meta, pos_hint)
+                for i in keep_idx:
+                    if i not in wanted:
+                        skipped_bytes += meta.row_group(i).total_byte_size
+                keep_idx = [i for i in keep_idx if i in wanted]
+            pruned = n_rg - len(keep_idx)
+            late_capable = (
+                late_materialize and predicate is not None
+                and keep_idx and pred_refs and n_rg > 1
+            )
+            if pruned or late_capable:
+                t, positions, late_n, late_bytes = _decode_pruned(
+                    abs_path, meta, keep_idx, add, need_positions
+                )
+                rg_stats.append(
+                    (n_rg, pruned, late_n, skipped_bytes + late_bytes)
+                )
+            else:
+                rg_stats.append((n_rg, 0, 0, 0))
+        if t is None:
+            # full decode — the seed path; reuse the already-parsed footer
+            # when the planner fetched one.
+            # memory_map: decoded columns reference page-cache pages
+            # instead of round-tripping file bytes through the Arrow
+            # memory pool — on single-core hosts the pool churn costs
+            # more than the decode
+            pf = pq.ParquetFile(abs_path, memory_map=True, metadata=meta)
+            # project to the columns this file actually has (files written
+            # before a schema evolution lack the newer columns — read
+            # fills them w/ null)
+            present = set(pf.schema_arrow.names)
+            file_cols = [c for c in data_cols if c in present]
+            if file_cols:
+                t = pf.read(columns=file_cols)
+            else:
+                t = _dummy(pf.metadata.num_rows)
+
         if add.deletion_vector is not None:
             from delta_tpu.protocol.deletion_vectors import (
                 DeletionVectorDescriptor,
@@ -111,11 +324,17 @@ def read_files_as_table(
             dv_rows = read_deletion_vector(
                 DeletionVectorDescriptor.from_dict(add.deletion_vector), data_path
             )
-            keep = np.ones(t.num_rows, dtype=bool)
-            keep[dv_rows] = False
+            if positions is None:
+                keep = np.ones(t.num_rows, dtype=bool)
+                keep[dv_rows] = False
+                positions = np.flatnonzero(keep)
+            else:
+                # pruned decode: positions are physical but sparse — map
+                # the DV through membership, not direct indexing
+                keep = ~np.isin(positions, dv_rows)
+                positions = positions[keep]
             t = t.filter(pa.array(keep))
-            positions = np.flatnonzero(keep)
-        elif position_column is not None:
+        elif position_column is not None and positions is None:
             positions = np.arange(t.num_rows, dtype=np.int64)
         for f in schema.fields:
             if f.name in data_cols and f.name not in t.column_names:
@@ -151,17 +370,39 @@ def read_files_as_table(
             )
         return t
 
+    if pos_hints is not None and len(pos_hints) != len(files):
+        raise ValueError(
+            f"positions_of_interest has {len(pos_hints)} entries "
+            f"for {len(files)} files"
+        )
+    jobs = list(zip(files, pos_hints if pos_hints else [None] * len(files)))
     with telemetry.record_operation(
         "delta.scan.read", {"numFiles": len(files)}
-    ):
-        if len(files) == 1:
-            pieces = [read_one(files[0])]
+    ) as rev:
+        if len(jobs) == 1:
+            pieces = [read_one(jobs[0])]
         else:
             from concurrent.futures import ThreadPoolExecutor
 
-            workers = min(len(files), os.cpu_count() or 4)
+            workers = min(len(jobs), os.cpu_count() or 4)
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                pieces = list(pool.map(read_one, files))
+                pieces = list(pool.map(read_one, jobs))
+        if rg_stats:
+            rg_total = sum(s[0] for s in rg_stats)
+            rg_pruned = sum(s[1] for s in rg_stats)
+            rg_late = sum(s[2] for s in rg_stats)
+            bytes_skipped = sum(s[3] for s in rg_stats)
+            telemetry.bump_counter("scan.rowgroups.total", rg_total)
+            if rg_pruned:
+                telemetry.bump_counter("scan.rowgroups.pruned", rg_pruned)
+            if rg_late:
+                telemetry.bump_counter("scan.rowgroups.lateSkipped", rg_late)
+            if bytes_skipped:
+                telemetry.bump_counter("scan.bytes.skipped", bytes_skipped)
+            rev.data.update(
+                rowGroupsTotal=rg_total, rowGroupsPruned=rg_pruned,
+                rowGroupsLateSkipped=rg_late, bytesSkipped=bytes_skipped,
+            )
         if per_file:
             return pieces
         return pa.concat_tables(pieces, promote_options="permissive")
@@ -297,8 +538,13 @@ def scan_to_table(
                 needed.update(ir.references(e))
             read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
                          if c in needed]
+        # the residual predicate rides into the decode: footer row-group
+        # stats prune inside each file (second tier), and the residual
+        # filter below re-applies the exact semantics over the survivors
         table = read_files_as_table(data_path, scan.files, snapshot.metadata,
-                                    read_cols, distribute=distribute)
+                                    read_cols, distribute=distribute,
+                                    predicate=(ir.and_all(residual)
+                                               if residual else None))
         if residual and table.num_rows:
             table = filter_table(table, ir.and_all(residual))
         if columns is not None and read_cols != list(columns):

@@ -171,16 +171,38 @@ def pad_char_literals(expr, metadata, target_qualifiers=None):
             return ir.Literal(lit.value.ljust(n))
         return lit
 
+    def lit_len(node) -> int:
+        if isinstance(node, ir.Literal) and isinstance(node.value, str):
+            return len(node.value)
+        return 0
+
+    def rpad_col(node, width: int):
+        """Pad the COLUMN side out to the literal's length — the reference
+        pads both sides to the longest (`ApplyCharTypePadding`), so a
+        literal LONGER than char(n) still compares against the stored
+        padded form: char(3) c = 'ab  ' matches stored 'ab '."""
+        if isinstance(node, ir.Column) and width_of(node):
+            return ir.Func("rpad", (node, ir.Literal(width), ir.Literal(" ")))
+        return node
+
     def rewrite(node):
         t = type(node)
         if t in (ir.Eq, ir.Lt, ir.Le, ir.Gt, ir.Ge):
             n = width_of(node.left) or width_of(node.right)
             if n:
-                return t(pad(node.left, n), pad(node.right, n))
+                width = max(n, lit_len(node.left), lit_len(node.right))
+                l, r = pad(node.left, width), pad(node.right, width)
+                if width > n:
+                    l, r = rpad_col(l, width), rpad_col(r, width)
+                return t(l, r)
         if t is ir.In:
             n = width_of(node.value)
             if n:
-                return ir.In(node.value, tuple(pad(o, n) for o in node.options))
+                width = max([n] + [lit_len(o) for o in node.options])
+                value = node.value
+                if width > n:
+                    value = rpad_col(value, width)
+                return ir.In(value, tuple(pad(o, width) for o in node.options))
         return None
 
     return expr.transform(rewrite)

@@ -84,6 +84,7 @@ class DeltaSource:
         exclude_regex: Optional[str] = None,
         starting_version: Optional[int] = None,
         starting_timestamp: Optional[str] = None,
+        filters: Optional[Sequence] = None,
     ):
         self.delta_log = delta_log
         self.max_files = max_files_per_trigger
@@ -92,6 +93,18 @@ class DeltaSource:
         self.ignore_changes = ignore_changes
         self.fail_on_data_loss = fail_on_data_loss
         self.exclude = re.compile(exclude_regex) if exclude_regex else None
+        # pushed-down row filter: batches carry only matching rows. The
+        # predicate rides into the Parquet decode (row-group skipping +
+        # late materialization, exec/rowgroups) and re-applies exactly
+        # post-decode. Offsets/admission are unaffected — a filter changes
+        # what a batch CONTAINS, never where it ends. Row source only; the
+        # CDF source ignores it (change rows are the product there).
+        from delta_tpu.expr.parser import parse_predicate as _parse_pred
+
+        self.filters = [
+            _parse_pred(f) if isinstance(f, str) else f
+            for f in (filters or [])
+        ]
         if starting_version is not None and starting_timestamp is not None:
             raise DeltaAnalysisError(
                 "Cannot set both startingVersion and startingTimestamp"
@@ -284,9 +297,22 @@ class DeltaSource:
                 if f.add is not None:
                     files.append(f.add)
             snap = self.delta_log.update()
+            pred = None
+            if self.filters:
+                from delta_tpu.expr import ir
+                from delta_tpu.schema.char_varchar import pad_char_literals
+
+                pred = pad_char_literals(
+                    ir.and_all(list(self.filters)), snap.metadata
+                )
             table = read_files_as_table(
-                self.delta_log.data_path, files, snap.metadata
+                self.delta_log.data_path, files, snap.metadata,
+                predicate=pred,
             )
+            if pred is not None and table.num_rows:
+                from delta_tpu.expr.vectorized import filter_table
+
+                table = filter_table(table, pred)
             bev.data.update(numFiles=len(files), numOutputRows=table.num_rows)
         if bev.duration_ms is not None:  # unmeasured (telemetry disabled)
             telemetry.observe(

@@ -95,6 +95,20 @@ class SqlConf:
         "delta.tpu.write.compression": "auto",
         # Device mesh axis name used by sharded kernels.
         "delta.tpu.mesh.axis": "shards",
+        # Second pruning tier inside the Parquet decode (exec/rowgroups):
+        # footer row-group stats skip non-matching row groups, and predicate
+        # columns decode first so remaining columns decode only for row
+        # groups with possible matches (late materialization). False = every
+        # surviving file decodes in full (the pre-tier behavior).
+        "delta.tpu.read.rowGroupSkipping": True,
+        # Bounded LRU of parsed Parquet footers keyed by path and validated
+        # by (size, mtime): hot-table queries stop re-parsing footers per
+        # open. 0 disables caching (footers parse on every open).
+        "delta.tpu.read.footerCacheEntries": 1024,
+        # Max rows per row group written by the engine (the skipping granule
+        # of the read tier above). Arrow's 1Mi default would leave most
+        # files as a single group with nothing to skip. <= 0 = Arrow default.
+        "delta.tpu.write.rowGroupRows": 131_072,
         # Use the JAX device path for scan planning / pruning when possible.
         "delta.tpu.device.pruning": True,
         # Below this many candidate files, stats skipping runs on the host

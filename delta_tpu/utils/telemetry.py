@@ -445,12 +445,21 @@ def metrics_snapshot() -> Dict[str, Any]:
     return out
 
 
-def bench_snapshot(top: int = 12) -> Dict[str, Any]:
+def bench_snapshot(top: int = 12,
+                   include: Sequence[str] = ()) -> Dict[str, Any]:
     """Compact per-bench-config attachment: top counters by value plus
     histogram summaries (count/sum/approx p50/p95) — internal metrics for
-    BENCH_*.json trajectories, not just wall-clock."""
+    BENCH_*.json trajectories, not just wall-clock. Counters matching an
+    ``include`` prefix ride along even when they miss the top-N cut (skip
+    rates matter at every magnitude)."""
     with _LOCK:
         ctrs = sorted(_COUNTERS.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+        if include:
+            seen = {k for k, _ in ctrs}
+            ctrs += [
+                (k, v) for k, v in sorted(_COUNTERS.items())
+                if k not in seen and any(_prefix_match(k, p) for p in include)
+            ]
         hists = [((n, lb), list(h.counts), h.sum, h.count)
                  for (n, lb), h in _HISTOGRAMS.items()]
     out: Dict[str, Any] = {"counters": dict(ctrs), "histograms": {}}
