@@ -109,6 +109,12 @@ def bench_overwrite_read(workdir):
     trials = [_timed(raw_roundtrip) for _ in range(2)]
     raw_s, raw_rows = min(trials, key=lambda x: x[0])
     assert eng_rows == raw_rows, (eng_rows, raw_rows)
+
+    # publish table.health.* gauges so this config's telemetry snapshot
+    # carries layout health (small-file debt, stats coverage) per round
+    from delta_tpu.obs.doctor import doctor
+
+    doctor(path)
     return {
         "metric": "overwrite_plus_filtered_read_2M_rows",
         "value": round(eng_s, 3),
@@ -1309,7 +1315,7 @@ def main():
                 # row-group pruning effectiveness next to latency
                 out["telemetry"] = telemetry.bench_snapshot(
                     include=("scan.rowgroups", "scan.bytes.skipped",
-                             "footerCache"),
+                             "footerCache", "table.health"),
                 )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass

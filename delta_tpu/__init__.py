@@ -28,6 +28,12 @@ def __getattr__(name):
         from delta_tpu.sql.parser import execute_sql
 
         return execute_sql
+    if name == "obs":
+        # `delta_tpu.obs` — operator surface (doctor, scan reports, HTTP
+        # endpoint, flight recorder); lazy like the data-plane glue
+        import delta_tpu.obs as obs
+
+        return obs
     raise AttributeError(f"module 'delta_tpu' has no attribute {name!r}")
 
 
@@ -35,4 +41,5 @@ def __dir__():
     return sorted(set(globals()) | set(__all__))
 
 
-__all__ = ["DeltaLog", "DeltaTable", "conf", "execute_sql", "__version__"]
+__all__ = ["DeltaLog", "DeltaTable", "conf", "execute_sql", "obs",
+           "__version__"]

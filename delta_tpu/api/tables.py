@@ -237,6 +237,16 @@ class DeltaTable:
     def detail(self) -> Dict[str, Any]:
         return describe_detail(self.delta_log)
 
+    def doctor(self):
+        """Table-health report: per-dimension severities (checkpoint
+        staleness, small-file debt, deletion-vector debt, stats coverage,
+        partition skew, tombstones, protocol) with suggested remedies, all
+        numbers published as ``table.health.*`` gauges. Beyond the reference
+        — see `delta_tpu/obs/doctor.py`."""
+        from delta_tpu.obs.doctor import doctor as _doctor
+
+        return _doctor(self.delta_log, snapshot=self._snapshot())
+
     def restore_to_version(self, version: int) -> Dict[str, int]:
         """Roll the table back to ``version`` as a NEW commit (history is
         preserved). Beyond the reference — modern Delta's RESTORE TABLE."""

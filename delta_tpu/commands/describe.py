@@ -25,7 +25,7 @@ def _describe_detail_impl(delta_log) -> Dict[str, Any]:
     snapshot = delta_log.update()
     meta = snapshot.metadata
     created = meta.created_time
-    return {
+    out = {
         "format": "delta",
         "id": meta.id,
         "name": meta.name,
@@ -40,6 +40,27 @@ def _describe_detail_impl(delta_log) -> Dict[str, Any]:
         "minReaderVersion": snapshot.protocol.min_reader_version,
         "minWriterVersion": snapshot.protocol.min_writer_version,
     }
+    # health columns (beyond the reference's DESCRIBE DETAIL): the doctor's
+    # per-dimension verdicts inline, so one detail row answers "is this
+    # table in debt" without a second call. Gauges stay untouched — a
+    # read-only metadata query must not restamp the table.health.* series
+    # an operator dashboard scrapes.
+    from delta_tpu.obs.doctor import doctor
+
+    report = doctor(delta_log, snapshot=snapshot, publish_gauges=False)
+    out.update({
+        "healthSeverity": report.severity,
+        "healthRemedies": report.remedies(),
+        "health": {d.name: d.severity for d in report.dimensions},
+        "numCommitsSinceCheckpoint":
+            report.dimension("checkpoint").metrics["commitsSince"],
+        "numSmallFiles": report.dimension("smallFiles").metrics["count"],
+        "numDeletionVectorFiles": report.dimension("dv").metrics["files"],
+        "numDeletedRows": report.dimension("dv").metrics["deletedRows"],
+        "statsCoveragePct": report.dimension("stats").metrics["coveragePct"],
+        "numTombstones": report.dimension("tombstones").metrics["count"],
+    })
+    return out
 
 
 def describe_history(delta_log, limit: Optional[int] = None) -> List[Dict[str, Any]]:

@@ -145,7 +145,20 @@ class OptimizeCommand:
             op = ops.Optimize(
                 predicate=pred_sql, z_order_by=self.z_order_by or None,
             )
-        return txn.commit(removes + adds, op)
+        version = txn.commit(removes + adds, op)
+        # feed the table-health doctor: maintenance recency as gauges, work
+        # done as counters (obs/metric_names.py catalog)
+        from delta_tpu.utils import telemetry
+
+        telemetry.set_gauge("table.maintenance.lastOptimizeVersion", version,
+                            path=self.delta_log.data_path)
+        if removes:
+            telemetry.bump_counter("maintenance.optimize.filesCompacted",
+                                   len(removes))
+        if adds:
+            telemetry.bump_counter("maintenance.optimize.filesWritten",
+                                   len(adds))
+        return version
 
 
 def np_col(table: pa.Table, name: str):

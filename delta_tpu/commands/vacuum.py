@@ -145,16 +145,18 @@ class VacuumCommand:
                     list(pool.map(walk, top))
 
         to_delete: List[str] = []
+        bytes_reclaimed = 0
         for rel in all_files:
             if rel in valid:
                 continue
             abs_p = os.path.join(data_path, rel)
             try:
-                mtime_ms = int(os.stat(abs_p).st_mtime * 1000)
+                st = os.stat(abs_p)
             except FileNotFoundError:
                 continue
-            if mtime_ms < cutoff:
+            if int(st.st_mtime * 1000) < cutoff:
                 to_delete.append(rel)
+                bytes_reclaimed += st.st_size
 
         if self.dry_run:
             return VacuumResult(
@@ -191,6 +193,17 @@ class VacuumCommand:
                     dirs_deleted += 1
             except OSError:
                 pass
+
+        # feed the table-health doctor: vacuum recency + work done
+        from delta_tpu.utils import telemetry
+
+        telemetry.set_gauge("table.maintenance.lastVacuumTimestamp",
+                            log.clock(), path=data_path)
+        if to_delete:
+            telemetry.bump_counter("maintenance.vacuum.filesDeleted",
+                                   len(to_delete))
+            telemetry.bump_counter("maintenance.vacuum.bytesReclaimed",
+                                   bytes_reclaimed)
 
         return VacuumResult(
             path=data_path,

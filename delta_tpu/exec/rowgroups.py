@@ -46,6 +46,7 @@ from delta_tpu.utils.config import conf
 __all__ = [
     "FooterCache",
     "read_footer",
+    "footer_cache_info",
     "RowGroupPlan",
     "plan_row_groups",
     "row_group_offsets",
@@ -127,6 +128,14 @@ class FooterCache:
 
 def read_footer(abs_path: str):
     return FooterCache.instance().get(abs_path)
+
+
+def footer_cache_info() -> dict:
+    """Residency snapshot of the process footer cache — served by the obs
+    endpoint's ``/healthz`` next to the hit/miss counters, so an operator
+    can tell a cold cache from a disabled one."""
+    cache = FooterCache.instance()
+    return {"entries": len(cache), "capacity": cache.capacity()}
 
 
 # ---------------------------------------------------------------------------

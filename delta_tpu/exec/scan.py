@@ -87,8 +87,12 @@ def read_files_as_table(
         from delta_tpu.parallel.distributed import host_partition
 
         files = host_partition(list(files))
+    total_bytes = sum(f.size or 0 for f in files)
     telemetry.bump_counter("scan.files.read", len(files))
-    telemetry.bump_counter("scan.bytes.read", sum(f.size or 0 for f in files))
+    telemetry.bump_counter("scan.bytes.read", total_bytes)
+    from delta_tpu.obs import scan_report as scan_report_mod
+
+    scan_report_mod.contribute(bytes_read=total_bytes)
     schema: StructType = metadata.schema
     part_cols = list(metadata.partition_columns)
     part_schema = metadata.partition_schema
@@ -403,6 +407,15 @@ def read_files_as_table(
                 rowGroupsTotal=rg_total, rowGroupsPruned=rg_pruned,
                 rowGroupsLateSkipped=rg_late, bytesSkipped=bytes_skipped,
             )
+            # the in-flight per-query ScanReport (obs/scan_report) gets the
+            # SAME sums that fed the counters — report/counter parity by
+            # construction
+            from delta_tpu.obs import scan_report as scan_report_mod
+
+            scan_report_mod.contribute(
+                row_groups_total=rg_total, row_groups_pruned=rg_pruned,
+                row_groups_late_skipped=rg_late, bytes_skipped=bytes_skipped,
+            )
         if per_file:
             return pieces
         return pa.concat_tables(pieces, promote_options="permissive")
@@ -520,37 +533,75 @@ def scan_to_table(
 ) -> pa.Table:
     """Full read path: prune → decode (projection ∪ filter columns) →
     residual filter → project. ``distribute=True``: this host decodes only
-    its partition of the pruned file list (multi-host scan)."""
+    its partition of the pruned file list (multi-host scan).
+
+    Each call records a per-query :class:`delta_tpu.obs.scan_report.ScanReport`
+    (files/row-groups considered vs pruned, bytes, phase durations),
+    retrievable via ``obs.last_scan_report()`` and attached to the
+    ``delta.scan`` span — skipped entirely under a telemetry blackout."""
+    import time as _time
+
+    from delta_tpu.obs import scan_report as scan_report_mod
     from delta_tpu.utils import telemetry
 
-    with telemetry.record_operation(
-        "delta.scan", path=snapshot.delta_log.data_path
-    ) as sev:
-        exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
-        scan = pruning.files_for_scan(snapshot, exprs)
-        data_path = snapshot.delta_log.data_path
-        residual = scan.partition_filters + scan.data_filters
-        read_cols = columns
-        if columns is not None and residual:
-            # read filter-referenced columns too; project back after filtering
-            needed = set(columns)
-            for e in residual:
-                needed.update(ir.references(e))
-            read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
-                         if c in needed]
-        # the residual predicate rides into the decode: footer row-group
-        # stats prune inside each file (second tier), and the residual
-        # filter below re-applies the exact semantics over the survivors
-        table = read_files_as_table(data_path, scan.files, snapshot.metadata,
-                                    read_cols, distribute=distribute,
-                                    predicate=(ir.and_all(residual)
-                                               if residual else None))
-        if residual and table.num_rows:
-            table = filter_table(table, ir.and_all(residual))
-        if columns is not None and read_cols != list(columns):
-            table = table.select([c for c in columns if c in table.column_names])
-        sev.data.update(
-            filesScanned=len(scan.files), rowsOut=table.num_rows,
-            bytesScanned=scan.scanned.bytes_compressed,
-        )
-        return table
+    track = conf.get_bool("delta.tpu.telemetry.enabled", True)
+    token = (scan_report_mod.start_report(snapshot.delta_log.data_path,
+                                          snapshot.version)
+             if track else None)
+    scan_ok = False
+    try:
+        with telemetry.record_operation(
+            "delta.scan", path=snapshot.delta_log.data_path
+        ) as sev:
+            t0 = _time.perf_counter_ns()
+            exprs = [parse_predicate(f) if isinstance(f, str) else f for f in filters]
+            scan = pruning.files_for_scan(snapshot, exprs)
+            t1 = _time.perf_counter_ns()
+            data_path = snapshot.delta_log.data_path
+            residual = scan.partition_filters + scan.data_filters
+            read_cols = columns
+            if columns is not None and residual:
+                # read filter-referenced columns too; project back after filtering
+                needed = set(columns)
+                for e in residual:
+                    needed.update(ir.references(e))
+                read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
+                             if c in needed]
+            # the residual predicate rides into the decode: footer row-group
+            # stats prune inside each file (second tier), and the residual
+            # filter below re-applies the exact semantics over the survivors
+            table = read_files_as_table(data_path, scan.files, snapshot.metadata,
+                                        read_cols, distribute=distribute,
+                                        predicate=(ir.and_all(residual)
+                                                   if residual else None))
+            t2 = _time.perf_counter_ns()
+            if residual and table.num_rows:
+                table = filter_table(table, ir.and_all(residual))
+            if columns is not None and read_cols != list(columns):
+                table = table.select([c for c in columns if c in table.column_names])
+            t3 = _time.perf_counter_ns()
+            sev.data.update(
+                filesScanned=len(scan.files), rowsOut=table.num_rows,
+                bytesScanned=scan.scanned.bytes_compressed,
+            )
+            if token is not None:
+                rep = scan_report_mod.current_report()
+                if rep is not None:
+                    rep.predicate = (ir.and_all(residual).sql()
+                                     if residual else None)
+                    rep.columns = list(columns) if columns is not None else None
+                    rep.files_total = scan.total.files or 0
+                    rep.files_after_partition = scan.partition.files or 0
+                    rep.files_scanned = len(scan.files)
+                    rep.rows_out = table.num_rows
+                    rep.phase_ms = {
+                        "planning": (t1 - t0) // 1_000_000,
+                        "read": (t2 - t1) // 1_000_000,
+                        "filter": (t3 - t2) // 1_000_000,
+                    }
+                    sev.data["scanReport"] = rep.to_dict()
+            scan_ok = True
+            return table
+    finally:
+        if token is not None:
+            scan_report_mod.finish_report(token, completed=scan_ok)

@@ -1,0 +1,24 @@
+"""Operator-facing observability: the interpretive layer over
+``utils/telemetry``.
+
+* :mod:`delta_tpu.obs.doctor` — table-health report (severities + remedies)
+* :mod:`delta_tpu.obs.scan_report` — per-query data-skipping reports
+* :mod:`delta_tpu.obs.server` — ``/metrics`` ``/healthz`` ``/events``
+  ``/trace`` ``/doctor`` HTTP endpoint (opt-in)
+* :mod:`delta_tpu.obs.flight_recorder` — incident files on operation failure
+* :mod:`delta_tpu.obs.metric_names` — the single catalog of metric names
+
+Importing this package installs the (inert-until-configured) flight-recorder
+failure hook; everything else is pull-by-call.
+"""
+from delta_tpu.obs import flight_recorder as _flight_recorder
+from delta_tpu.obs.doctor import TableHealthReport, doctor
+from delta_tpu.obs.scan_report import ScanReport, last_scan_report
+from delta_tpu.obs.server import ObsServer, start_server, stop_server
+
+_flight_recorder.install()
+
+__all__ = [
+    "doctor", "TableHealthReport", "ScanReport", "last_scan_report",
+    "ObsServer", "start_server", "stop_server",
+]

@@ -1,0 +1,83 @@
+"""Single catalog of every observability metric name and public entry point.
+
+The AST lint in ``tests/test_telemetry.py`` enforces that (a) every string
+constant passed to ``set_gauge`` anywhere in ``delta_tpu/`` appears in
+:data:`GAUGES`, (b) every counter bumped from ``delta_tpu/obs/`` (and the
+maintenance/conflict counters wired for the doctor) appears in
+:data:`COUNTERS`, and (c) each ``obs/`` module's ``__all__`` matches
+:data:`PUBLIC_API` — so dashboards and the doctor never chase stringly-typed
+drift: a renamed gauge fails the suite, not a Grafana panel.
+
+``table.health.*`` gauges are emitted by :func:`delta_tpu.obs.doctor.doctor`
+(labeled by table path) and validated against this catalog at publish time.
+"""
+from __future__ import annotations
+
+__all__ = ["GAUGES", "COUNTERS", "PUBLIC_API", "health_gauge"]
+
+#: Every labeled gauge the engine publishes.
+GAUGES = frozenset({
+    # -- doctor: table-health gauges (obs/doctor.py, label: path) --------
+    "table.health.severity",
+    "table.health.files.count",
+    "table.health.files.bytes",
+    "table.health.checkpoint.commitsSince",
+    "table.health.checkpoint.tailBytes",
+    "table.health.checkpoint.tailFiles",
+    "table.health.smallFiles.count",
+    "table.health.smallFiles.bytes",
+    "table.health.smallFiles.estReduction",
+    "table.health.dv.files",
+    "table.health.dv.deletedRows",
+    "table.health.dv.deletedPct",
+    "table.health.dv.filesPastPurge",
+    "table.health.stats.coveragePct",
+    "table.health.stats.parsedPct",
+    "table.health.partition.count",
+    "table.health.partition.gini",
+    "table.health.tombstones.count",
+    "table.health.tombstones.bytes",
+    "table.health.protocol.minReader",
+    "table.health.protocol.minWriter",
+    # -- streaming consumer lag (streaming/source.py, label: path) -------
+    "streaming.source.backlogFiles",
+    "streaming.source.backlogBytes",
+    "streaming.source.lastBatchVersionLag",
+    # -- maintenance recency (commands/optimize.py, vacuum.py) -----------
+    "table.maintenance.lastOptimizeVersion",
+    "table.maintenance.lastVacuumTimestamp",
+})
+
+#: Counters introduced by the obs layer and its doctor feeds.
+COUNTERS = frozenset({
+    "obs.incidents.written",
+    "obs.server.requests",
+    "commit.conflicts",
+    "maintenance.optimize.filesCompacted",
+    "maintenance.optimize.filesWritten",
+    "maintenance.vacuum.filesDeleted",
+    "maintenance.vacuum.bytesReclaimed",
+})
+
+#: Public surface of each obs module, lint-matched against its ``__all__``.
+PUBLIC_API = {
+    "doctor": ("HealthDimension", "TableHealthReport", "doctor",
+               "SEVERITY_RANK"),
+    "scan_report": ("ScanReport", "last_scan_report", "clear_last_report",
+                    "start_report", "current_report", "contribute",
+                    "finish_report"),
+    "server": ("ObsServer", "start_server", "stop_server"),
+    "flight_recorder": ("install", "uninstall", "record_incident",
+                        "incident_files"),
+    "metric_names": ("GAUGES", "COUNTERS", "PUBLIC_API", "health_gauge"),
+}
+
+
+def health_gauge(dimension: str, metric: str) -> str:
+    """The catalog-checked gauge name for a doctor metric — raises on a name
+    that is not registered, so a new metric cannot ship un-cataloged."""
+    name = f"table.health.{dimension}.{metric}"
+    if name not in GAUGES:
+        raise ValueError(f"gauge {name!r} is not registered in "
+                         "delta_tpu/obs/metric_names.py")
+    return name

@@ -1,0 +1,128 @@
+"""Per-query scan reports — the EXPLAIN-style counterpart of the reference's
+``DataSkippingReader`` metrics.
+
+The process-wide ``scan.*`` counters aggregate across every query; a
+:class:`ScanReport` answers "what did THIS query cost": files considered vs
+pruned at the file tier, row groups total/pruned/late-skipped at the Parquet
+tier, bytes read vs skipped, per-phase durations, and the residual predicate
+IR. ``exec/scan.scan_to_table`` opens a report (contextvar-scoped, so
+concurrent scans on different threads never cross), ``read_files_as_table``
+contributes the row-group numbers from the same sums that feed the
+``scan.rowgroups.*`` counters — the report and the counters can never
+disagree — and the finished report is retrievable via
+:func:`last_scan_report` and attached to the ``delta.scan`` span.
+
+Zero-overhead when ``delta.tpu.telemetry.enabled=false``: no report is
+opened, and :func:`contribute` is a single contextvar probe.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ScanReport", "last_scan_report", "clear_last_report",
+           "start_report", "current_report", "contribute", "finish_report"]
+
+
+@dataclass
+class ScanReport:
+    """One query's skipping ledger. Row-group and byte numbers are the exact
+    per-scan deltas of the ``scan.rowgroups.*`` / ``scan.bytes.*`` counters."""
+
+    path: str = ""
+    version: int = -1
+    predicate: Optional[str] = None  # residual predicate IR (SQL repr)
+    columns: Optional[List[str]] = None
+    files_total: int = 0            # snapshot files considered
+    files_after_partition: int = 0  # survivors of partition pruning
+    files_scanned: int = 0          # survivors of file-tier stats skipping
+    row_groups_total: int = 0
+    row_groups_pruned: int = 0        # footer-stats tier
+    row_groups_late_skipped: int = 0  # late-materialization tier
+    bytes_read: int = 0
+    bytes_skipped: int = 0
+    rows_out: int = 0
+    phase_ms: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def files_pruned(self) -> int:
+        return max(0, self.files_total - self.files_scanned)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "version": self.version,
+            "predicate": self.predicate,
+            "columns": list(self.columns) if self.columns is not None else None,
+            "filesTotal": self.files_total,
+            "filesAfterPartition": self.files_after_partition,
+            "filesScanned": self.files_scanned,
+            "filesPruned": self.files_pruned,
+            "rowGroupsTotal": self.row_groups_total,
+            "rowGroupsPruned": self.row_groups_pruned,
+            "rowGroupsLateSkipped": self.row_groups_late_skipped,
+            "bytesRead": self.bytes_read,
+            "bytesSkipped": self.bytes_skipped,
+            "rowsOut": self.rows_out,
+            "phaseMs": dict(self.phase_ms),
+        }
+
+
+# the report being filled by the scan running in THIS context
+_CURRENT: "contextvars.ContextVar[Optional[ScanReport]]" = contextvars.ContextVar(
+    "delta_obs_scan_report", default=None
+)
+# last finished report, process-wide (operator pull surface)
+_LAST_LOCK = threading.Lock()
+_LAST: Optional[ScanReport] = None
+
+
+def start_report(path: str, version: int) -> "contextvars.Token":
+    """Open a report for the scan running in this context; returns the
+    contextvar token for :func:`finish_report`."""
+    return _CURRENT.set(ScanReport(path=path, version=version))
+
+
+def current_report() -> Optional[ScanReport]:
+    """The report being filled by the scan in THIS context, if any."""
+    return _CURRENT.get()
+
+
+def contribute(**deltas: int) -> None:
+    """Add row-group / byte tallies into the in-flight report, if any —
+    called from ``read_files_as_table`` with the same sums that bump the
+    process counters. Field names are ``ScanReport`` attributes."""
+    rep = _CURRENT.get()
+    if rep is None:
+        return
+    for k, v in deltas.items():
+        setattr(rep, k, getattr(rep, k) + v)
+
+
+def finish_report(token: "contextvars.Token",
+                  completed: bool = True) -> Optional[ScanReport]:
+    """Close the in-flight report. ``completed=True`` publishes it as
+    :func:`last_scan_report`; a failed scan passes ``False`` so a
+    half-filled report never overwrites the last genuinely completed one."""
+    global _LAST
+    rep = _CURRENT.get()
+    _CURRENT.reset(token)
+    if rep is not None and completed:
+        with _LAST_LOCK:
+            _LAST = rep
+    return rep
+
+
+def last_scan_report() -> Optional[ScanReport]:
+    """The most recently completed scan's report (None before any scan, or
+    while telemetry is disabled)."""
+    with _LAST_LOCK:
+        return _LAST
+
+
+def clear_last_report() -> None:
+    global _LAST
+    with _LAST_LOCK:
+        _LAST = None

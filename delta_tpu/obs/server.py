@@ -1,0 +1,151 @@
+"""Operator HTTP endpoint — stdlib-only, opt-in, daemon-threaded.
+
+PR 2 left the telemetry registry pull-by-code; this serves it:
+
+=============  ==============================================================
+Route          Payload
+=============  ==============================================================
+``/metrics``   Prometheus text exposition (``telemetry.prometheus_text``)
+``/healthz``   ``{"status": "ok", ...}`` liveness JSON
+``/events``    ring-buffer events as JSON; ``?prefix=delta.commit`` filters
+               by dotted-boundary op-type prefix, ``?limit=N`` tails
+``/trace``     Chrome trace-event JSON (open spans included, clamped) —
+               save and load at https://ui.perfetto.dev
+``/doctor``    ``?path=/data/tbl`` → the table-health report JSON
+               (:func:`delta_tpu.obs.doctor.doctor`)
+=============  ==============================================================
+
+Nothing listens unless :func:`start_server` is called (port argument or
+``delta.tpu.obs.port``); the server is a ``ThreadingHTTPServer`` on a daemon
+thread bound to 127.0.0.1 by default — an operator surface, not a public
+one. Zero dependencies beyond the standard library.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+__all__ = ["ObsServer", "start_server", "stop_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the engine's logger, not stderr-per-request
+    def log_message(self, fmt, *args):  # noqa: D401 — stdlib signature
+        telemetry.logger.debug("obs.server %s", fmt % args)
+
+    def _reply(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, payload, status: int = 200) -> None:
+        body = json.dumps(payload, default=str).encode("utf-8")
+        self._reply(status, body, "application/json; charset=utf-8")
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib casing
+        telemetry.bump_counter("obs.server.requests")
+        parsed = urllib.parse.urlsplit(self.path)
+        q = urllib.parse.parse_qs(parsed.query)
+        try:
+            route = parsed.path.rstrip("/") or "/"
+            if route == "/metrics":
+                self._reply(200, telemetry.prometheus_text().encode("utf-8"),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                from delta_tpu.exec.rowgroups import footer_cache_info
+
+                self._json({"status": "ok",
+                            "events": len(telemetry.recent_events()),
+                            "footerCache": footer_cache_info()})
+            elif route == "/events":
+                prefix = q.get("prefix", [""])[0]
+                events = telemetry.recent_events(prefix)
+                limit = q.get("limit", [None])[0]
+                if limit is not None:
+                    n = max(int(limit), 0)
+                    events = events[-n:] if n else []
+                self._json([json.loads(e.to_json()) for e in events])
+            elif route == "/trace":
+                self._json(telemetry.export_chrome_trace())
+            elif route == "/doctor":
+                path = q.get("path", [None])[0]
+                if not path:
+                    self._json({"error": "missing ?path=<table path>"}, 400)
+                    return
+                from delta_tpu.obs.doctor import doctor
+
+                self._json(doctor(path).to_dict())
+            else:
+                self._json({"error": f"unknown route {route!r}",
+                            "routes": ["/metrics", "/healthz", "/events",
+                                       "/trace", "/doctor"]}, 404)
+        except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
+            self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+
+class ObsServer:
+    """Daemon-threaded HTTP server over the telemetry registry."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="delta-obs-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_SERVER: Optional[ObsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def start_server(port: Optional[int] = None, host: str = "127.0.0.1") -> ObsServer:
+    """Start (or return) the process-wide endpoint. ``port=None`` reads
+    ``delta.tpu.obs.port`` (0 = ephemeral); raises if neither names a port —
+    the server is strictly opt-in. Installs the flight-recorder hook so a
+    served process also records incidents when ``incidentDir`` is set."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        if port is None:
+            port = conf.get("delta.tpu.obs.port")
+        if port is None:
+            raise ValueError(
+                "no port: pass start_server(port=...) or set delta.tpu.obs.port"
+            )
+        from delta_tpu.obs import flight_recorder
+
+        flight_recorder.install()
+        _SERVER = ObsServer(int(port), host)
+        return _SERVER
+
+
+def stop_server() -> None:
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None

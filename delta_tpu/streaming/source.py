@@ -16,6 +16,7 @@ Mirrors `sources/DeltaSource.scala:57-539`:
 """
 from __future__ import annotations
 
+import itertools
 import re
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence
@@ -285,18 +286,62 @@ class DeltaSource:
                     start = DeltaSourceOffset(sv, BASE_INDEX, False, self.table_id)
                 else:
                     return self.get_batch(end, end)  # transition batch: empty
+        from delta_tpu.utils.config import conf as _conf
+
+        # StreamingQueryProgress parity: publish consumer-lag gauges so the
+        # doctor and /metrics can see how far this source trails the table.
+        # Counting the backlog walks the pending tail past the batch end —
+        # skipped entirely under a telemetry blackout.
+        track_lag = _conf.get_bool("delta.tpu.telemetry.enabled", True)
         with telemetry.record_operation(
             "delta.streaming.source.getBatch",
             {"endVersion": end.reservoir_version, "endIndex": end.index},
             path=self.delta_log.data_path,
         ) as bev:
             files: List[AddFile] = []
-            for f in self._pending(start):
+            backlog_files = 0
+            backlog_bytes = 0
+            pending = self._pending(start)
+            overflow: Optional[IndexedFile] = None
+            for f in pending:
                 if (f.version, f.index) > (end.reservoir_version, end.index):
+                    overflow = f
                     break
                 if f.add is not None:
                     files.append(f.add)
+            backlog_cap = int(_conf.get(
+                "delta.tpu.obs.streamingBacklogMaxFiles", 1024) or 0)
+            if track_lag and backlog_cap > 0:
+                # walk the tail past the batch end for the backlog count —
+                # bounded by the cap so a deeply lagging consumer never
+                # re-reads its whole remaining log per batch (the count is a
+                # floor at the cap). A hygiene failure BEYOND this batch
+                # (e.g. an upstream delete two commits later) must not fail
+                # THIS batch — it surfaces on the next latest_offset call.
+                try:
+                    for f in itertools.chain(
+                        [overflow] if overflow is not None else [], pending
+                    ):
+                        if f.add is not None:
+                            backlog_files += 1
+                            backlog_bytes += f.add.size or 0
+                            if backlog_files >= backlog_cap:
+                                break
+                except Exception:  # noqa: BLE001 — lag is best-effort
+                    pass
             snap = self.delta_log.update()
+            if track_lag:
+                path = self.delta_log.data_path
+                telemetry.set_gauge("streaming.source.backlogFiles",
+                                    backlog_files, path=path)
+                telemetry.set_gauge("streaming.source.backlogBytes",
+                                    backlog_bytes, path=path)
+                telemetry.set_gauge(
+                    "streaming.source.lastBatchVersionLag",
+                    max(0, snap.version - end.reservoir_version), path=path,
+                )
+                bev.data.update(backlogFiles=backlog_files,
+                                backlogBytes=backlog_bytes)
             pred = None
             if self.filters:
                 from delta_tpu.expr import ir

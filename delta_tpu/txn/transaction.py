@@ -474,7 +474,16 @@ class OptimisticTransaction:
                     winning = actions_from_lines(self.delta_log.store.read_iter(path))
                 except FileNotFoundError:
                     break
-                conflicts_mod.check_for_conflicts(self, next_attempt, winning)
+                try:
+                    conflicts_mod.check_for_conflicts(self, next_attempt, winning)
+                except errors.DeltaConcurrentModificationException:
+                    # a genuine logical conflict (not just a lost race):
+                    # count it, and let the error unwind through the open
+                    # conflictCheck span — the obs flight recorder snapshots
+                    # the failing span stack from there. Other exceptions
+                    # (bugs, interrupts) propagate uncounted.
+                    telemetry.bump_counter("commit.conflicts")
+                    raise
                 next_attempt += 1
             cev.data["winningCommits"] = next_attempt - failed_version
             if next_attempt == failed_version:

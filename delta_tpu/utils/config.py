@@ -75,6 +75,23 @@ class SqlConf:
         "delta.tpu.telemetry.enabled": True,
         # Telemetry ring-buffer capacity (events + spans).
         "delta.tpu.telemetry.bufferSize": 4096,
+        # Operator HTTP endpoint (obs/server): serve /metrics, /healthz,
+        # /events, /trace, /doctor on this port. None = no server; 0 = an
+        # ephemeral port (tests). Opt-in only — nothing listens by default.
+        "delta.tpu.obs.port": None,
+        # Failure flight recorder (obs/flight_recorder): directory receiving
+        # incident JSON files when an instrumented operation raises. None =
+        # recorder off (the default; span-error hooks cost nothing then).
+        "delta.tpu.obs.incidentDir": None,
+        # Max incident files kept in incidentDir (oldest deleted first).
+        "delta.tpu.obs.incidentKeep": 20,
+        # Last N ring-buffer events snapshotted into each incident file.
+        "delta.tpu.obs.incidentEvents": 64,
+        # Streaming backlog gauges walk at most this many pending files past
+        # each batch end (a deeply lagging consumer must not re-read its
+        # whole remaining log tail per micro-batch; the published count is a
+        # floor when the cap is hit). <= 0 publishes only the version lag.
+        "delta.tpu.obs.streamingBacklogMaxFiles": 1024,
         # Materialize parsed per-file stats as typed Parquet struct columns
         # (`add.stats_parsed` / `add.partitionValues_parsed`) in checkpoints
         # when the table does not set delta.checkpoint.writeStatsAsStruct

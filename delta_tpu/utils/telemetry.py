@@ -45,7 +45,7 @@ import time
 from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from delta_tpu.utils.config import conf
 
@@ -57,7 +57,8 @@ __all__ = [
     "clear_counters", "set_gauge", "gauges", "observe", "histograms",
     "prometheus_text", "metrics_snapshot", "bench_snapshot",
     "export_chrome_trace", "current_span", "add_span_data", "reset_all",
-    "HISTOGRAM_BUCKETS",
+    "HISTOGRAM_BUCKETS", "span_stack_snapshot", "add_failure_hook",
+    "remove_failure_hook",
 ]
 
 
@@ -105,6 +106,11 @@ _SPAN_STACK: "contextvars.ContextVar[Tuple[int, ...]]" = contextvars.ContextVar(
 )
 # spans currently open (still mutable via add_span_data), by span id
 _ACTIVE: Dict[int, UsageEvent] = {}
+# callables invoked when a span closes with an exception: fn(event, exc).
+# Empty by default — the error path pays one truthiness check. Consumers
+# (obs/flight_recorder) must never raise; failures are swallowed here so a
+# broken hook can't mask the original error.
+_FAILURE_HOOKS: List[Any] = []
 
 
 def _enabled() -> bool:
@@ -178,6 +184,14 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
             yield ev
     except BaseException as e:
         ev.error = f"{type(e).__name__}: {e}"
+        # span still on the stack and in _ACTIVE here: hooks see the full
+        # failing span chain via span_stack_snapshot()
+        if _FAILURE_HOOKS:
+            for hook in list(_FAILURE_HOOKS):
+                try:
+                    hook(ev, e)
+                except Exception:  # noqa: BLE001 — never mask the original
+                    logger.debug("telemetry failure hook raised", exc_info=True)
         raise
     finally:
         _SPAN_STACK.reset(token)
@@ -198,6 +212,49 @@ def current_span() -> Optional[UsageEvent]:
         return None
     with _LOCK:
         return _ACTIVE.get(stack[-1])
+
+
+def span_stack_snapshot() -> List[Dict[str, Any]]:
+    """The open span chain for THIS context, outermost first, as JSON-able
+    dicts (opType/spanId/parentId/depth/tags/data/elapsedMs/error). The raw
+    events stay private — they are still live and mutating."""
+    stack = _SPAN_STACK.get()
+    if not stack:
+        return []
+    now = _now_us()
+    out: List[Dict[str, Any]] = []
+    with _LOCK:
+        # copy payload dicts under the lock — the events are live
+        for sid in stack:
+            ev = _ACTIVE.get(sid)
+            if ev is None:
+                continue
+            out.append({
+                "opType": ev.op_type,
+                "spanId": ev.span_id,
+                "parentId": ev.parent_id,
+                "depth": ev.depth,
+                "tags": dict(ev.tags),
+                "data": dict(ev.data),
+                "elapsedMs": max(0, (now - ev.start_us) // 1000),
+                "error": ev.error,
+            })
+    return out
+
+
+def add_failure_hook(fn) -> None:
+    """Register ``fn(event, exc)`` to run when any span exits with an
+    exception (before the span closes, so the open stack is inspectable).
+    Hooks must be fast and must not raise."""
+    if fn not in _FAILURE_HOOKS:
+        _FAILURE_HOOKS.append(fn)
+
+
+def remove_failure_hook(fn) -> None:
+    try:
+        _FAILURE_HOOKS.remove(fn)
+    except ValueError:
+        pass
 
 
 def add_span_data(**kv: Any) -> None:
@@ -449,9 +506,9 @@ def bench_snapshot(top: int = 12,
                    include: Sequence[str] = ()) -> Dict[str, Any]:
     """Compact per-bench-config attachment: top counters by value plus
     histogram summaries (count/sum/approx p50/p95) — internal metrics for
-    BENCH_*.json trajectories, not just wall-clock. Counters matching an
-    ``include`` prefix ride along even when they miss the top-N cut (skip
-    rates matter at every magnitude)."""
+    BENCH_*.json trajectories, not just wall-clock. Counters AND gauges
+    matching an ``include`` prefix ride along even when they miss the top-N
+    cut (skip rates and health gauges matter at every magnitude)."""
     with _LOCK:
         ctrs = sorted(_COUNTERS.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
         if include:
@@ -460,9 +517,17 @@ def bench_snapshot(top: int = 12,
                 (k, v) for k, v in sorted(_COUNTERS.items())
                 if k not in seen and any(_prefix_match(k, p) for p in include)
             ]
+        gags = (
+            {k: v for k, v in _GAUGES.items()
+             if any(_prefix_match(k[0], p) for p in include)}
+            if include else {}
+        )
         hists = [((n, lb), list(h.counts), h.sum, h.count)
                  for (n, lb), h in _HISTOGRAMS.items()]
     out: Dict[str, Any] = {"counters": dict(ctrs), "histograms": {}}
+    if gags:
+        out["gauges"] = {f"{n}{_labels_suffix(lb)}": v
+                        for (n, lb), v in sorted(gags.items())}
     for (n, lb), counts, total, count in sorted(hists, key=lambda r: r[0]):
         out["histograms"][f"{n}{_labels_suffix(lb)}"] = {
             "count": count,
@@ -479,13 +544,28 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
     """Export the event ring buffer as Chrome trace-event JSON.
 
     Spans become complete ("X") events with real durations; point events
-    become instants ("i"). Thread-name metadata rows keep multi-writer
-    traces readable. Load the result in https://ui.perfetto.dev or
-    ``chrome://tracing``; with the JAX profiler active, span names also
-    appear as ``delta/...`` named scopes on the device timeline."""
+    become instants ("i"). Spans still OPEN at export time (in ``_ACTIVE``,
+    not yet in the ring buffer) are emitted too, with their duration clamped
+    to "now" and ``args.incomplete = true`` — an export taken mid-operation
+    must show the operation, not silently drop it. Thread-name metadata rows
+    keep multi-writer traces readable. Load the result in
+    https://ui.perfetto.dev or ``chrome://tracing``; with the JAX profiler
+    active, span names also appear as ``delta/...`` named scopes on the
+    device timeline."""
     pid = os.getpid()
+    now_us = _now_us()
     with _LOCK:
         events = list(_BUFFER)
+        # open spans are still LIVE (add_span_data mutates ev.data with no
+        # lock): copy their payloads while we hold the lock, or a concurrent
+        # mutation mid-iteration blows up the export
+        open_clamped = [
+            (ev.op_type, ev.thread_id or 0, ev.thread_name,
+             dict(ev.tags), dict(ev.data), ev.error,
+             ev.span_id, ev.parent_id, ev.start_us,
+             max(0, now_us - ev.start_us))
+            for ev in sorted(_ACTIVE.values(), key=lambda e: e.start_us)
+        ]
     rows: List[Dict[str, Any]] = []
     seen_tids: Dict[int, str] = {}
     for ev in events:
@@ -518,6 +598,22 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
             row["ph"] = "i"
             row["s"] = "t"
         rows.append(row)
+    for (op_type, tid, tname, tags, data, error,
+         span_id, parent_id, start_us, dur) in open_clamped:
+        if tid not in seen_tids:
+            seen_tids[tid] = tname or str(tid)
+        args = dict(tags)
+        args.update(data)
+        if error:
+            args["error"] = error
+        args["spanId"] = span_id
+        if parent_id:
+            args["parentId"] = parent_id
+        args["incomplete"] = True
+        rows.append({
+            "name": op_type, "cat": "delta", "pid": pid, "tid": tid,
+            "ts": start_us, "ph": "X", "dur": dur, "args": args,
+        })
     for tid, tname in seen_tids.items():
         rows.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,

@@ -151,6 +151,79 @@ def test_span_nesting_isolated_across_threads():
     assert c1.thread_id != c2.thread_id
 
 
+def test_span_stack_snapshot_reports_open_chain():
+    assert telemetry.span_stack_snapshot() == []
+    with telemetry.record_operation("delta.test.a", path="/t") as a:
+        with telemetry.record_operation("delta.test.a.b") as b:
+            telemetry.add_span_data(rows=3)
+            snap = telemetry.span_stack_snapshot()
+    assert [s["opType"] for s in snap] == ["delta.test.a", "delta.test.a.b"]
+    assert snap[0]["spanId"] == a.span_id and snap[1]["parentId"] == a.span_id
+    assert snap[1]["data"] == {"rows": 3}
+    assert snap[0]["tags"] == {"path": "/t"}
+    assert all(s["elapsedMs"] >= 0 for s in snap)
+    assert b.span_id  # snapshot is JSON-able copies, not the live events
+    json.dumps(snap)
+
+
+def test_failure_hooks_fire_once_per_span_with_stack():
+    calls = []
+
+    def hook(ev, exc):
+        calls.append((ev.op_type, str(exc),
+                      [s["opType"] for s in telemetry.span_stack_snapshot()]))
+
+    telemetry.add_failure_hook(hook)
+    try:
+        with pytest.raises(ValueError):
+            with telemetry.record_operation("delta.test.outer"):
+                with telemetry.record_operation("delta.test.outer.leaf"):
+                    raise ValueError("pow")
+    finally:
+        telemetry.remove_failure_hook(hook)
+    # innermost fires first, with the full open stack; the same exception
+    # then fires again as it unwinds the outer span
+    assert calls[0] == ("delta.test.outer.leaf", "pow",
+                        ["delta.test.outer", "delta.test.outer.leaf"])
+    assert calls[1] == ("delta.test.outer", "pow", ["delta.test.outer"])
+    # a broken hook never masks the real error
+    broken = lambda ev, exc: 1 / 0  # noqa: E731
+    telemetry.add_failure_hook(broken)
+    try:
+        with pytest.raises(ValueError):
+            with telemetry.record_operation("delta.test.brokenhook"):
+                raise ValueError("real")
+    finally:
+        telemetry.remove_failure_hook(broken)
+
+
+def test_chrome_trace_includes_open_spans_with_clamped_duration():
+    """Regression: spans still open at export time used to be dropped (they
+    live in _ACTIVE, not the ring buffer) — they must export as clamped
+    complete events flagged incomplete."""
+    telemetry.clear_events()
+    with telemetry.record_operation("delta.test.live") as live:
+        with telemetry.record_operation("delta.test.live.closedchild"):
+            pass
+        trace = telemetry.export_chrome_trace()
+        rows = [r for r in trace["traceEvents"]
+                if r.get("name") == "delta.test.live"]
+        assert len(rows) == 1, "open span must appear exactly once"
+        [row] = rows
+        assert row["ph"] == "X" and row["dur"] >= 0
+        assert row["args"]["incomplete"] is True
+        assert row["args"]["spanId"] == live.span_id
+        # the closed child exported normally alongside it
+        assert any(r.get("name") == "delta.test.live.closedchild"
+                   and "incomplete" not in r["args"]
+                   for r in trace["traceEvents"])
+    # after the span closes, a fresh export has the real (final) row only
+    trace = telemetry.export_chrome_trace()
+    rows = [r for r in trace["traceEvents"]
+            if r.get("name") == "delta.test.live"]
+    assert len(rows) == 1 and "incomplete" not in rows[0]["args"]
+
+
 # -- metrics registry --------------------------------------------------------
 
 
@@ -229,6 +302,16 @@ def test_metrics_snapshot_is_json_serializable():
     compact = json.loads(json.dumps(telemetry.bench_snapshot()))
     assert compact["counters"]["a.b"] == 2
     assert compact["histograms"]["h.ms{path=/t}"]["p50"] == 16.0
+
+
+def test_bench_snapshot_includes_matching_gauges():
+    """bench.py snapshots carry table.health.* gauges via the include list."""
+    telemetry.reset_all()
+    telemetry.set_gauge("table.health.severity", 1, path="/t")
+    telemetry.set_gauge("unrelated.gauge", 9)
+    snap = telemetry.bench_snapshot(include=("table.health",))
+    assert snap["gauges"] == {"table.health.severity{path=/t}": 1.0}
+    assert "gauges" not in telemetry.bench_snapshot()
 
 
 # -- zero-overhead disable ---------------------------------------------------
@@ -533,3 +616,88 @@ def test_every_command_entry_point_runs_under_a_span():
         "command entry points without a delta.dml.*/delta.utility.* span: "
         f"{missing}"
     )
+
+
+# -- static lint: metric names + obs public API live in one catalog ----------
+
+_ENGINE_DIR = os.path.join(os.path.dirname(__file__), "..", "delta_tpu")
+
+
+def _const_calls(tree, fn_name):
+    """All constant-string first arguments of calls to ``fn_name``."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        name = (callee.id if isinstance(callee, ast.Name)
+                else callee.attr if isinstance(callee, ast.Attribute)
+                else None)
+        if name != fn_name or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append(arg.value)
+    return out
+
+
+def _walk_engine_trees():
+    for root, _dirs, files in os.walk(_ENGINE_DIR):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, _ENGINE_DIR)
+            with open(path, encoding="utf-8") as f:
+                yield rel, ast.parse(f.read(), filename=rel)
+
+
+def test_every_gauge_name_is_cataloged():
+    """Every ``set_gauge`` string constant engine-wide must be registered in
+    obs/metric_names.py GAUGES — no stringly-typed gauge drift."""
+    from delta_tpu.obs import metric_names
+
+    stray = []
+    for rel, tree in _walk_engine_trees():
+        for name in _const_calls(tree, "set_gauge"):
+            if name not in metric_names.GAUGES:
+                stray.append(f"{rel}: {name}")
+    assert not stray, f"gauges missing from obs/metric_names.GAUGES: {stray}"
+
+
+def test_obs_counters_are_cataloged():
+    """Counters bumped from obs/ and the obs-feed namespaces (maintenance.*,
+    commit.conflicts) must be registered in obs/metric_names.py COUNTERS."""
+    from delta_tpu.obs import metric_names
+
+    stray = []
+    for rel, tree in _walk_engine_trees():
+        in_obs = rel.startswith("obs")
+        for name in _const_calls(tree, "bump_counter"):
+            obs_feed = (name.startswith(("obs.", "maintenance."))
+                        or name == "commit.conflicts")
+            if (in_obs or obs_feed) and name not in metric_names.COUNTERS:
+                stray.append(f"{rel}: {name}")
+    assert not stray, f"counters missing from obs/metric_names.COUNTERS: {stray}"
+
+
+def test_obs_public_api_matches_catalog():
+    """Each obs module's ``__all__`` must equal its PUBLIC_API entry — a new
+    entry point (or a rename) has to land in the catalog too."""
+    import importlib
+
+    from delta_tpu.obs import metric_names
+
+    obs_dir = os.path.join(_ENGINE_DIR, "obs")
+    modules = sorted(
+        f[:-3] for f in os.listdir(obs_dir)
+        if f.endswith(".py") and f != "__init__.py"
+    )
+    assert set(modules) == set(metric_names.PUBLIC_API), (
+        "obs modules and PUBLIC_API catalog diverge"
+    )
+    for mod in modules:
+        m = importlib.import_module(f"delta_tpu.obs.{mod}")
+        assert tuple(sorted(m.__all__)) == tuple(
+            sorted(metric_names.PUBLIC_API[mod])
+        ), f"obs/{mod}.py __all__ out of sync with PUBLIC_API"
