@@ -87,6 +87,18 @@ def bench_overwrite_read(workdir):
     })
     path = os.path.join(workdir, "c1")
     log = DeltaLog.for_table(path)
+    # Fault layer is strictly zero-overhead when no plan is configured:
+    # maybe_wrap must return the store UNCHANGED (no wrapper object at all),
+    # and the bench must never accidentally run with injection enabled.
+    from delta_tpu.storage import faults as _faults
+
+    assert _faults.plan_from_conf() is None, (
+        "bench must run without a fault plan (delta.tpu.faults.plan is set)")
+    assert _faults.maybe_wrap(log._base_store) is log._base_store, (
+        "fault layer must install NO wrapper when delta.tpu.faults.plan is unset")
+    assert not isinstance(getattr(log.store, "base", log.store),
+                          _faults.FaultInjectingLogStore), (
+        "DeltaLog store stack must not contain a fault injector by default")
     WriteIntoDelta(log, "append", data).run()
 
     def engine_roundtrip():

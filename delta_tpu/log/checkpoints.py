@@ -105,6 +105,30 @@ class CheckpointInstance:
         return [f"{log_path}/{p}" for p in filenames.checkpoint_file_with_parts(self.version, self.parts)]
 
 
+def _run_all_parts(n: int, write_part) -> None:
+    """Run ``write_part(i)`` for every part on a thread pool, ATTEMPTING ALL
+    parts before re-raising the first (lowest-index) failure.
+
+    ``list(ex.map(...))`` would cancel not-yet-started siblings when its
+    iterator closes on the first exception — leaving a *timing-dependent*
+    subset of parts on disk. Deterministic all-or-each-tried behavior
+    matters for crash consistency: what a failed multi-part checkpoint
+    leaves behind must not depend on thread scheduling (and one slow part's
+    transient error shouldn't silently cancel its siblings mid-write)."""
+    with ThreadPoolExecutor(max_workers=min(n, 16)) as ex:
+        futures = [ex.submit(write_part, i) for i in range(n)]
+        errors_ = [f.exception() for f in futures]  # waits for every part
+    failed = [e for e in errors_ if e is not None]
+    for e in failed:
+        # a non-Exception BaseException (simulated process death from the
+        # fault injector, KeyboardInterrupt) must win over ordinary part
+        # failures — an `except Exception` recovery path may not survive it
+        if not isinstance(e, Exception):
+            raise e
+    for e in failed:
+        raise e
+
+
 def read_last_checkpoint(store: LogStore, log_path: str) -> Optional[CheckpointMetaData]:
     """Read the ``_last_checkpoint`` pointer; on corruption/partial write fall
     back to None so callers re-list (``Checkpoints.scala:148-175``)."""
@@ -817,8 +841,7 @@ def write_checkpoint_columnar(
     if parts == 1:
         _write_slice(0)
     else:
-        with ThreadPoolExecutor(max_workers=min(parts, 16)) as ex:
-            list(ex.map(_write_slice, range(parts)))
+        _run_all_parts(parts, _write_slice)
     md = CheckpointMetaData(snapshot.version, total, None if parts == 1 else parts)
     write_last_checkpoint(store, log_path, md)
     from delta_tpu.utils.telemetry import bump_counter
@@ -917,8 +940,8 @@ def _finish_write_checkpoint(store, log_path, version, actions, parts, n,
         else:
             paths_slices = list(zip(paths, slices))
         if paths_slices:
-            with ThreadPoolExecutor(max_workers=min(len(paths_slices), 16)) as ex:
-                list(ex.map(lambda pz: _write_one(pz[0], pz[1]), paths_slices))
+            _run_all_parts(len(paths_slices),
+                           lambda i: _write_one(*paths_slices[i]))
         md = CheckpointMetaData(version, n, parts)
         all_paths = paths
     if proc == 0:

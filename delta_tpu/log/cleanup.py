@@ -15,13 +15,42 @@ from typing import List
 
 from delta_tpu.log import checkpoints as ckpt_mod
 from delta_tpu.protocol import filenames
-from delta_tpu.utils.config import DeltaConfigs
+from delta_tpu.utils.config import DeltaConfigs, conf
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["cleanup_expired_logs"]
+__all__ = ["cleanup_expired_logs", "sweep_tmp_orphans"]
 
 MS_PER_DAY = 86_400_000
+
+
+def sweep_tmp_orphans(delta_log, now_ms: int) -> int:
+    """Delete aged ``.{name}.{uuid}.tmp`` staging orphans from ``_delta_log``.
+
+    A writer that dies between staging and publishing (LocalLogStore's
+    write-temp-then-link, or a simulated ``crash_before_publish``) strands
+    its temp file; nothing ever references it, but it accumulates forever.
+    Only files older than ``delta.tpu.cleanup.tmpOrphanTtlMs`` go — a
+    young ``.tmp`` may be an in-flight write of a live concurrent writer.
+    """
+    ttl = int(conf.get("delta.tpu.cleanup.tmpOrphanTtlMs"))
+    cutoff = now_ms - ttl
+    # dot-files sort before version digits, so the normal version-prefixed
+    # listings never see them; list from "." to include them
+    try:
+        statuses = list(delta_log.store.list_from(f"{delta_log.log_path}/."))
+    except FileNotFoundError:
+        return 0
+    deleted = 0
+    for fs in statuses:
+        name = fs.name
+        if (name.startswith(".") and name.endswith(".tmp")
+                and fs.modification_time <= cutoff):
+            if delta_log.store.delete(fs.path):
+                deleted += 1
+    if deleted:
+        logger.info("Swept %d orphaned .tmp files from %s", deleted, delta_log.log_path)
+    return deleted
 
 
 def cleanup_expired_logs(delta_log, snapshot) -> int:
@@ -31,16 +60,18 @@ def cleanup_expired_logs(delta_log, snapshot) -> int:
     # Day-truncated cutoff (MetadataCleanup.scala:91-97).
     cutoff = ((now - retention_ms) // MS_PER_DAY) * MS_PER_DAY
 
+    swept = sweep_tmp_orphans(delta_log, now)
+
     last_ckpt = ckpt_mod.read_last_checkpoint(delta_log.store, delta_log.log_path)
     if last_ckpt is None:
-        return 0
+        return swept
     ckpt_version = last_ckpt.version
 
     prefix = f"{delta_log.log_path}/{filenames.check_version_prefix(0)}"
     try:
         statuses = list(delta_log.store.list_from(prefix))
     except FileNotFoundError:
-        return 0
+        return swept
 
     # Candidate files: version < last checkpoint version, mtime <= cutoff.
     # Keep timestamps monotone: stop at the first file (by version) that is
@@ -69,4 +100,4 @@ def cleanup_expired_logs(delta_log, snapshot) -> int:
             deleted += 1
     if deleted:
         logger.info("Deleted %d expired log files older than %d in %s", deleted, cutoff, delta_log.log_path)
-    return deleted
+    return deleted + swept

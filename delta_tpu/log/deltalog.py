@@ -27,6 +27,7 @@ from delta_tpu.protocol.actions import (
     Protocol,
     actions_from_lines,
 )
+from delta_tpu.storage import faults as faults_mod
 from delta_tpu.storage.logstore import LogStore, get_log_store
 from delta_tpu.utils.config import DeltaConfigs, conf
 from delta_tpu.utils import errors as errors_mod
@@ -88,7 +89,18 @@ class DeltaLog:
     def __init__(self, data_path: str, store: Optional[LogStore] = None, clock=None):
         self.data_path = data_path.rstrip("/")
         self.log_path = f"{self.data_path}/_delta_log"
-        self.store = store or get_log_store(self.data_path)
+        # Store stack, inside out: base -> fault injector (ONLY when
+        # `delta.tpu.faults.plan` is set — no wrapper, no overhead
+        # otherwise) -> transient-retry layer for idempotent ops. The retry
+        # layer sits on top so injected transients are actually retried.
+        base_store = store or get_log_store(self.data_path)
+        self._base_store = base_store
+        wrapped = faults_mod.maybe_wrap(base_store)
+        if conf.get_bool("delta.tpu.storage.retry.enabled", True):
+            from delta_tpu.storage.retrying import RetryingLogStore
+
+            wrapped = RetryingLogStore(wrapped)
+        self.store = wrapped
         # Single in-process commit lock (DeltaLog.scala:84). Cross-process
         # exclusion comes from the LogStore's atomic create.
         self.lock = threading.RLock()
@@ -114,13 +126,33 @@ class DeltaLog:
 
     # -- singleton cache (DeltaLog.scala:373-387) -----------------------
 
+    def _store_stack_current(self) -> bool:
+        """Does this instance's (construction-time) store wrapping still
+        match the session conf? A later `delta.tpu.faults.plan` install or
+        retry-layer toggle must not be silently ignored by cache hits."""
+        from delta_tpu.storage.retrying import RetryingLogStore
+
+        retry_on = conf.get_bool("delta.tpu.storage.retry.enabled", True)
+        inner = self.store
+        has_retry = isinstance(inner, RetryingLogStore)
+        if has_retry:
+            inner = inner.base
+        has_faults = isinstance(inner, faults_mod.FaultInjectingLogStore)
+        plan = faults_mod.plan_from_conf()
+        return has_retry == retry_on and (
+            (inner.plan is plan) if has_faults else (plan is None)
+        )
+
     @classmethod
     def for_table(cls, data_path: str, store: Optional[LogStore] = None, clock=None) -> "DeltaLog":
         key = data_path.rstrip("/")
         with cls._cache_lock:
             dl = cls._cache.get(key)
-            if dl is None or clock is not None or (store is not None and dl.store is not store):
-                dl = DeltaLog(key, store=store, clock=clock)
+            if (dl is None or clock is not None
+                    or (store is not None and dl._base_store is not store)
+                    or not dl._store_stack_current()):
+                dl = DeltaLog(key, store=store or (dl._base_store if dl else None),
+                              clock=clock)
                 cls._cache[key] = dl
             return dl
 

@@ -57,6 +57,11 @@ COUNTERS = frozenset({
     "maintenance.optimize.filesWritten",
     "maintenance.vacuum.filesDeleted",
     "maintenance.vacuum.bytesReclaimed",
+    # -- robustness layer (utils/retries, storage/faults, txn) -----------
+    "storage.retry.attempts",     # one per backoff sleep, any store
+    "storage.retry.exhausted",    # gave up: surfaced to the caller
+    "faults.injected",            # deterministic fault injector fired
+    "commit.reconciled",          # ambiguous commit resolved via txnId
 })
 
 #: Public surface of each obs module, lint-matched against its ``__all__``.

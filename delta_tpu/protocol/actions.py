@@ -432,6 +432,10 @@ class CommitInfo(Action):
     operation_metrics: Optional[Dict[str, str]] = None
     user_metadata: Optional[str] = None
     engine_info: Optional[str] = None
+    # per-commit ownership token (actions.scala:489 `txnId`): lets a writer
+    # whose create returned an indeterminate error re-read version N and
+    # decide won/lost (txn/transaction.py ambiguous-commit reconciliation)
+    txn_id: Optional[str] = None
 
     wrap_key = "commitInfo"
 
@@ -455,6 +459,7 @@ class CommitInfo(Action):
                 "operationMetrics": self.operation_metrics,
                 "userMetadata": self.user_metadata,
                 "engineInfo": self.engine_info,
+                "txnId": self.txn_id,
             }
         )
 
@@ -481,6 +486,7 @@ class CommitInfo(Action):
             operation_metrics=d.get("operationMetrics"),
             user_metadata=d.get("userMetadata"),
             engine_info=d.get("engineInfo"),
+            txn_id=d.get("txnId"),
         )
 
     def with_version_timestamp(self, version: int, timestamp: Optional[int] = None) -> "CommitInfo":

@@ -40,24 +40,14 @@ from typing import Iterable, Iterator, Optional, Tuple
 
 from delta_tpu.storage.logstore import FileStatus, LogStore
 from delta_tpu.utils.errors import DeltaIOError
+# RetryPolicy moved to (and is re-exported from) the shared module: the
+# same bounded-backoff-with-deadline policy now drives every store's
+# transient handling, not a private copy here.
+from delta_tpu.utils.retries import RetryPolicy
 
 __all__ = ["HttpObjectLogStore", "RetryPolicy"]
 
 _RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
-
-
-class RetryPolicy:
-    """Bounded exponential backoff for transient object-store failures."""
-
-    def __init__(self, max_attempts: int = 5, base_delay_s: float = 0.05,
-                 max_delay_s: float = 2.0, timeout_s: float = 30.0):
-        self.max_attempts = max_attempts
-        self.base_delay_s = base_delay_s
-        self.max_delay_s = max_delay_s
-        self.timeout_s = timeout_s
-
-    def delay(self, attempt: int) -> float:
-        return min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
 
 
 class _Response:
@@ -126,9 +116,14 @@ class HttpObjectLogStore(LogStore):
         """Run a request with retries. ``ambiguous_hook(attempt)`` is invoked
         before each retry of a non-idempotent request so the caller can
         resolve did-my-first-attempt-land ambiguity."""
+        from delta_tpu.utils import telemetry
+
         headers = dict(headers or {})
         last_exc: Optional[Exception] = None
+        start = time.monotonic()
+        attempts_made = 0
         for attempt in range(self.retry.max_attempts):
+            attempts_made = attempt + 1
             if attempt and ambiguous_hook is not None:
                 resolved = ambiguous_hook(attempt)
                 if resolved is not None:
@@ -137,18 +132,23 @@ class HttpObjectLogStore(LogStore):
                 resp = self._request_once(method, url, body, headers)
             except (ConnectionError, socket.timeout, http.client.HTTPException, OSError) as e:
                 last_exc = e
-                time.sleep(self.retry.delay(attempt))
-                continue
-            if resp.status in _RETRYABLE_STATUS:
+            else:
+                if resp.status not in _RETRYABLE_STATUS:
+                    return resp
                 last_exc = DeltaIOError(
                     f"{method} {url} -> HTTP {resp.status}: {resp.body[:200]!r}"
                 )
-                time.sleep(self.retry.delay(attempt))
-                continue
-            return resp
+            # total-deadline bound: a flapping store fails in deadline_s,
+            # not max_attempts * max_delay_s
+            if self.retry.give_up(attempt, start):
+                break
+            telemetry.bump_counter("storage.retry.attempts")
+            time.sleep(self.retry.delay(attempt))
+        telemetry.bump_counter("storage.retry.exhausted")
         raise DeltaIOError(
             f"{method} {self.endpoint}{url} failed after "
-            f"{self.retry.max_attempts} attempts: {last_exc}"
+            f"{attempts_made} attempts in "
+            f"{time.monotonic() - start:.1f}s: {last_exc}"
         )
 
     # -- LogStore API ----------------------------------------------------

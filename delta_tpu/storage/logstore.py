@@ -163,13 +163,23 @@ class LocalLogStore(LogStore):
         parent = os.path.dirname(p)
         os.makedirs(parent, exist_ok=True)
         _record_io("write", len(data))
+        # Both branches stage to a dot-tmp and clean it in a finally: a
+        # writer dying between staging and publish must not strand temp
+        # files for every future exception path — only a hard process crash
+        # can, and those aged orphans are swept by log/cleanup.py.
         if overwrite:
             tmp = os.path.join(parent, f".{os.path.basename(p)}.{uuid.uuid4().hex}.tmp")
-            with open(tmp, "wb") as f:
-                f.write(data)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, p)  # atomic overwrite
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, p)  # atomic overwrite
+            finally:
+                try:
+                    os.unlink(tmp)  # no-op after a successful replace
+                except OSError:
+                    pass
             return
         tmp = os.path.join(parent, f".{os.path.basename(p)}.{uuid.uuid4().hex}.tmp")
         try:

@@ -1,0 +1,105 @@
+"""RetryingLogStore — transparent transient-fault retry for idempotent ops.
+
+Installed by :class:`delta_tpu.log.deltalog.DeltaLog` around whatever store
+serves the table (above the fault injector, when one is configured, so
+injected transients are actually retried). Every *idempotent* operation —
+reads, listings, existence probes, deletes, and ``overwrite=True`` writes
+(checkpoint parts, ``_last_checkpoint``, ``.crc``: deterministic content, a
+double PUT is harmless) — retries under the shared
+:class:`~delta_tpu.utils.retries.RetryPolicy`.
+
+The ONE operation that must never retry blind is the commit create-if-absent
+(``write(..., overwrite=False)``): a lost response leaves "did my file land?"
+unknowable here, and a blind second attempt either double-commits or
+misreads its own first attempt as a conflict. That call passes straight
+through; ambiguity is resolved by token reconciliation in
+``txn/transaction.py`` (which can actually read the winner back).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional
+
+from delta_tpu.storage.logstore import FileStatus, LogStore
+from delta_tpu.utils.retries import RetryPolicy, call_with_retries
+
+__all__ = ["RetryingLogStore", "policy_from_conf"]
+
+
+def policy_from_conf() -> RetryPolicy:
+    """Session-tunable retry policy (``delta.tpu.storage.retry.*``)."""
+    from delta_tpu.utils.config import conf
+
+    return RetryPolicy(
+        max_attempts=int(conf.get("delta.tpu.storage.retry.maxAttempts")),
+        base_delay_s=float(conf.get("delta.tpu.storage.retry.baseDelayMs")) / 1000.0,
+        max_delay_s=float(conf.get("delta.tpu.storage.retry.maxDelayMs")) / 1000.0,
+        deadline_s=float(conf.get("delta.tpu.storage.retry.deadlineMs")) / 1000.0,
+    )
+
+
+class RetryingLogStore(LogStore):
+    """Wraps ``base``, retrying idempotent ops on transient failures."""
+
+    def __init__(self, base: LogStore, policy: Optional[RetryPolicy] = None):
+        self.base = base
+        self.policy = policy or policy_from_conf()
+
+    def _retry(self, op_name, fn):
+        return call_with_retries(fn, policy=self.policy, op_name=op_name)
+
+    # -- reads (idempotent) ---------------------------------------------
+
+    def read(self, path: str) -> List[str]:
+        return self._retry("read", lambda: self.base.read(path))
+
+    def read_iter(self, path: str) -> Iterator[str]:
+        # materialize under retry: a generator can't re-drive a failed read
+        return iter(self.read(path))
+
+    def read_bytes(self, path: str) -> bytes:
+        return self._retry("read", lambda: self.base.read_bytes(path))
+
+    def list_from(self, path: str) -> Iterator[FileStatus]:
+        return iter(self._retry("list", lambda: list(self.base.list_from(path))))
+
+    def exists(self, path: str) -> bool:
+        return self._retry("exists", lambda: self.base.exists(path))
+
+    # -- writes ----------------------------------------------------------
+
+    def write(self, path: str, lines: Iterable[str], overwrite: bool = False) -> None:
+        if not overwrite:
+            # commit create-if-absent: NEVER retried here (see module doc)
+            return self.base.write(path, lines, overwrite=False)
+        lines = list(lines)
+        return self._retry("write", lambda: self.base.write(path, lines, overwrite=True))
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        if not overwrite:
+            return self.base.write_bytes(path, data, overwrite=False)
+        return self._retry(
+            "write", lambda: self.base.write_bytes(path, data, overwrite=True)
+        )
+
+    def delete(self, path: str) -> bool:
+        # idempotent: a retried delete whose first attempt landed returns
+        # False, which every caller treats as already-gone
+        return self._retry("delete", lambda: self.base.delete(path))
+
+    def mkdirs(self, path: str) -> None:
+        return self._retry("mkdirs", lambda: self.base.mkdirs(path))
+
+    # -- passthrough ------------------------------------------------------
+
+    def is_partial_write_visible(self, path: str) -> bool:
+        return self.base.is_partial_write_visible(path)
+
+    def resolve_path(self, path: str) -> str:
+        return self.base.resolve_path(path)
+
+    def __getattr__(self, name):
+        # test hooks / store extras (set_mtime, write_count, ...) pass through
+        return getattr(self.base, name)
+
+    def __repr__(self) -> str:
+        return f"RetryingLogStore({self.base!r})"

@@ -1,0 +1,7 @@
+"""Deterministic fault-injection test harness (crash-consistency torture).
+
+See :mod:`delta_tpu.testing.harness`.
+"""
+from delta_tpu.testing.harness import TortureHarness, TortureReport, run_torture
+
+__all__ = ["TortureHarness", "TortureReport", "run_torture"]

@@ -9,7 +9,9 @@ checker (``delta_tpu.txn.conflicts``) and retries.
 from __future__ import annotations
 
 import contextvars
+import json
 import logging
+import uuid
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -33,6 +35,7 @@ from delta_tpu.txn import conflicts as conflicts_mod
 from delta_tpu.txn import isolation
 from delta_tpu.utils.config import DeltaConfigs, conf
 from delta_tpu.utils import errors
+from delta_tpu.utils import retries as retries_mod
 from delta_tpu.utils import telemetry
 from delta_tpu.utils.telemetry import record_operation
 
@@ -293,6 +296,10 @@ class OptimisticTransaction:
 
             self.staged_removes = [a for a in actions if isinstance(a, RemoveFile)]
 
+            # per-commit ownership token: if the log-entry create returns an
+            # indeterminate error, re-reading version N and comparing this
+            # token decides won/lost (never double-commit, never false-fail)
+            self._commit_token = uuid.uuid4().hex
             commit_info = CommitInfo(
                 timestamp=self.delta_log.clock(),
                 operation=op.name,
@@ -303,6 +310,7 @@ class OptimisticTransaction:
                 operation_metrics=self._final_metrics(op),
                 user_metadata=self.user_metadata or op.user_metadata,
                 engine_info="delta-tpu/0.1.0",
+                txn_id=self._commit_token,
             )
             full_actions = [commit_info] + actions
 
@@ -452,6 +460,28 @@ class OptimisticTransaction:
                     return attempt_version
                 except FileExistsError:
                     attempt_version = self._check_and_retry(attempt_version, actions)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if not retries_mod.is_transient(e):
+                        raise
+                    # Indeterminate outcome: the create MAY have landed (lost
+                    # response). Resolve by reading version N back and
+                    # comparing our commit token — never retry the create
+                    # blind (double-commit), never fail a commit that won.
+                    outcome = self._reconcile_ambiguous_commit(attempt_version, e)
+                    if outcome is True:
+                        return attempt_version
+                    if outcome is False:
+                        attempt_version = self._check_and_retry(attempt_version, actions)
+                    else:
+                        # None: version N provably absent — our write never
+                        # happened and re-attempting the same version is
+                        # safe. The create bypasses the retry layer by
+                        # design, so back off HERE: a store whose writes
+                        # flap persistently must not hot-loop through
+                        # maxCommitAttempts reconciliations.
+                        import time as _time
+
+                        _time.sleep(min(0.05 * (2 ** min(attempts, 6)), 2.0))
 
     def _write_commit(self, version: int, actions: List[Action]) -> None:
         path = f"{self.delta_log.log_path}/{filenames.delta_file(version)}"
@@ -462,6 +492,49 @@ class OptimisticTransaction:
                 a = a.with_version_timestamp(version)
             out.append(a.json())
         self.delta_log.store.write(path, out, overwrite=False)
+
+    def _reconcile_ambiguous_commit(self, version: int, cause: Exception) -> Optional[bool]:
+        """Decide the outcome of a commit create that failed indeterminately
+        (connection reset after the PUT may have landed). Re-reads version
+        N's ``commitInfo.txnId`` and compares the per-commit token:
+
+        * True  — the file is ours: the commit SUCCEEDED (the response was
+          lost, not the write);
+        * False — someone else owns version N: a plain lost race, go
+          through the conflict checker;
+        * None  — version N does not exist: our write provably never
+          happened and the create is safe to re-attempt.
+
+        ≈ the byte-equality disambiguation ``storage/http_store.py`` does
+        per request, lifted to the transaction layer so EVERY store gets it.
+        """
+        path = f"{self.delta_log.log_path}/{filenames.delta_file(version)}"
+        won: Optional[bool]
+        try:
+            lines = self.delta_log.store.read(path)
+        except FileNotFoundError:
+            won = None
+        else:
+            token = None
+            if lines:
+                try:
+                    token = (json.loads(lines[0]).get("commitInfo") or {}).get("txnId")
+                except (ValueError, AttributeError):
+                    token = None
+            won = token is not None and token == getattr(self, "_commit_token", None)
+        outcome = {True: "won", False: "lost", None: "not_landed"}[won]
+        telemetry.bump_counter("commit.reconciled")
+        telemetry.record_event(
+            "delta.commit.reconcile",
+            {"version": version, "won": won, "outcome": outcome,
+             "cause": f"{type(cause).__name__}: {cause}"},
+            path=self.delta_log.data_path,
+        )
+        logger.warning(
+            "Ambiguous commit outcome at version %s for %s reconciled: %s (%s)",
+            version, self.delta_log.data_path, outcome, cause,
+        )
+        return won
 
     def _check_and_retry(self, failed_version: int, actions: List[Action]) -> int:
         """Replay winning commits through the conflict checker

@@ -131,6 +131,27 @@ class SqlConf:
         # Below this many candidate files, stats skipping runs on the host
         # (one device round-trip costs more than the whole numpy pass).
         "delta.tpu.device.pruning.minFiles": 4096,
+        # Deterministic fault injection (storage/faults.py): a FaultPlan
+        # object or a spec string like "seed=42,rate=0.05,kinds=transient".
+        # None (the default) installs NO wrapper — zero overhead, asserted
+        # by bench.py.
+        "delta.tpu.faults.plan": None,
+        # Transient-retry layer over every table's LogStore (storage/
+        # retrying.py): idempotent ops (reads, listings, overwrite-PUTs)
+        # retry under utils/retries.RetryPolicy; the commit create-if-
+        # absent is NEVER retried (ambiguity is reconciled in the txn
+        # layer via commitInfo.txnId instead).
+        "delta.tpu.storage.retry.enabled": True,
+        "delta.tpu.storage.retry.maxAttempts": 5,
+        "delta.tpu.storage.retry.baseDelayMs": 20,
+        "delta.tpu.storage.retry.maxDelayMs": 1000,
+        # Total wall-clock bound across attempts+sleeps of one op: a
+        # flapping store fails in bounded time.
+        "delta.tpu.storage.retry.deadlineMs": 15_000,
+        # Metadata cleanup also sweeps aged .{name}.{uuid}.tmp staging
+        # orphans (crashed writers) from _delta_log; younger files may be
+        # in-flight writes and are kept.
+        "delta.tpu.cleanup.tmpOrphanTtlMs": 3_600_000,
         # ≈ DELTA_CONVERT_METADATA_CHECK_ENABLED and misc
         "delta.tpu.import.batchSize.statsCollection": 50_000,
         # partition-dir listing parallelism for vacuum/convert

@@ -1,0 +1,149 @@
+"""Shared retry policy for transient storage failures.
+
+Generalized from the private copy that lived in ``storage/http_store.py``:
+one :class:`RetryPolicy` (bounded exponential backoff + a **total deadline**
+so a flapping store fails in bounded time), one transient-vs-permanent
+classifier (:func:`is_transient`), and one driver (:func:`call_with_retries`)
+that only ever wraps *idempotent* operations — reads, listings, existence
+probes, overwrite-PUTs of deterministic content. The commit create-if-absent
+is NEVER driven through here: retrying it blind could double-commit; the
+ambiguous-outcome path lives in ``txn/transaction.py`` reconciliation
+instead (≈ the reference's manual-retry guidance around
+``HDFSLogStore.scala:46-90``).
+
+Telemetry: every retry bumps ``storage.retry.attempts``; giving up bumps
+``storage.retry.exhausted`` and raises the final error through a
+``delta.storage.retry.exhausted`` span so the obs flight recorder
+(``delta_tpu/obs/flight_recorder.py``) captures an incident when configured.
+"""
+from __future__ import annotations
+
+import errno
+import http.client
+import socket
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, TypeVar
+
+from delta_tpu.utils.errors import DeltaIOError
+
+__all__ = [
+    "RetryPolicy",
+    "TransientIOError",
+    "is_transient",
+    "call_with_retries",
+]
+
+T = TypeVar("T")
+
+
+class TransientIOError(DeltaIOError):
+    """An IO failure the caller may retry (connection reset, throttle,
+    injected fault). Permanent failures stay plain :class:`DeltaIOError`."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff for transient storage failures.
+
+    ``deadline_s`` bounds the TOTAL wall time spent across attempts and
+    sleeps: a store that flaps forever fails in ``deadline_s``, not
+    ``max_attempts * max_delay_s`` (which at the defaults would be 4x
+    longer). ``timeout_s`` is the per-request socket timeout HTTP stores
+    apply to each individual attempt.
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    timeout_s: float = 30.0
+    deadline_s: float = 60.0
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+
+    def give_up(self, attempt: int, start_monotonic: float,
+                clock: Callable[[], float] = time.monotonic) -> bool:
+        """True when no further attempt should be made: either the attempt
+        budget is spent or sleeping for the next backoff would cross the
+        total deadline."""
+        if attempt + 1 >= self.max_attempts:
+            return True
+        if self.deadline_s and (
+            clock() - start_monotonic + self.delay(attempt) >= self.deadline_s
+        ):
+            return True
+        return False
+
+
+#: errno values worth retrying on a local filesystem: transient kernel/IO
+#: conditions, not programming or layout errors.
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR,
+    errno.ETIMEDOUT, errno.ENETDOWN, errno.ENETUNREACH, errno.ECONNRESET,
+})
+
+#: OSError subclasses that are *semantic* results, never transient faults.
+_PERMANENT_OSERRORS = (
+    FileNotFoundError, FileExistsError, IsADirectoryError,
+    NotADirectoryError, PermissionError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Is ``exc`` a failure that may succeed on retry?
+
+    FileNotFound/FileExists are load-bearing protocol signals (missing
+    version / OCC conflict) and must surface immediately; a plain
+    :class:`DeltaIOError` is a store's *final* verdict (e.g. the HTTP store
+    after its own internal retries) and is not retried again here.
+    """
+    if isinstance(exc, TransientIOError):
+        return True
+    if isinstance(exc, _PERMANENT_OSERRORS):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError,
+                        socket.timeout, http.client.HTTPException)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+def call_with_retries(
+    fn: Callable[[], T],
+    policy: Optional[RetryPolicy] = None,
+    op_name: str = "storage.op",
+    classify: Callable[[BaseException], bool] = is_transient,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``fn`` retrying transient failures under ``policy``.
+
+    Only for idempotent operations — see the module docstring. Exhaustion
+    re-raises the last error through a telemetry span so the flight
+    recorder can write an incident.
+    """
+    from delta_tpu.utils import telemetry
+
+    policy = policy or RetryPolicy()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not classify(e):
+                raise
+            if policy.give_up(attempt, start):
+                telemetry.bump_counter("storage.retry.exhausted")
+                # raise through a span: the failure hook chain (incl. the
+                # obs flight recorder, when configured) sees the give-up
+                with telemetry.record_operation(
+                    "delta.storage.retry.exhausted",
+                    {"op": op_name, "attempts": attempt + 1,
+                     "elapsedS": round(time.monotonic() - start, 3)},
+                ):
+                    raise
+            telemetry.bump_counter("storage.retry.attempts")
+            sleep(policy.delay(attempt))
+            attempt += 1

@@ -1,0 +1,502 @@
+"""Deterministic fault injection + the hardening it drives.
+
+Covers the tentpole subsystem end to end: scripted and seeded FaultPlans,
+the transient-retry layer (`storage/retrying.py` over `utils/retries.py`),
+ambiguous-commit reconciliation via `commitInfo.txnId`, crash-orphan
+sweeping, torn/stale checkpoint recovery under injection, streaming
+crash-replay idempotency, and the zero-overhead-when-unset contract.
+"""
+import glob
+import json
+import os
+import time
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.protocol import filenames
+from delta_tpu.storage import faults
+from delta_tpu.storage.faults import (
+    FaultInjectingLogStore,
+    FaultPlan,
+    SimulatedCrash,
+)
+from delta_tpu.storage.logstore import LocalLogStore, MemoryLogStore
+from delta_tpu.storage.retrying import RetryingLogStore
+from delta_tpu.utils import retries, telemetry
+from delta_tpu.utils.config import conf
+from delta_tpu.utils.retries import RetryPolicy, TransientIOError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    telemetry.reset_all()
+    yield
+    telemetry.reset_all()
+
+
+def _ids(path):
+    DeltaLog.invalidate_cache(path)
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        return sorted(DeltaTable.for_path(path).to_arrow(columns=["id"])
+                      .column("id").to_pylist())
+
+
+def _table(path, *, plan=None, rows=(1, 2, 3)):
+    """Create a table fault-free, then (optionally) re-open under `plan`."""
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        DeltaTable.create(path, data=pa.table({"id": pa.array(rows, pa.int64())}))
+    DeltaLog.invalidate_cache(path)
+    if plan is not None:
+        conf.set("delta.tpu.faults.plan", plan)
+    try:
+        return DeltaLog(path)
+    finally:
+        if plan is not None:
+            conf.unset("delta.tpu.faults.plan")
+
+
+# -- retry policy / layer ----------------------------------------------------
+
+
+def test_retry_policy_deadline_bounds_total_time():
+    """A flapping store fails in deadline_s, not max_attempts * max_delay_s."""
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise TransientIOError("flap")
+
+    policy = RetryPolicy(max_attempts=1000, base_delay_s=0.01,
+                         max_delay_s=0.02, deadline_s=0.15)
+    t0 = time.monotonic()
+    with pytest.raises(TransientIOError):
+        retries.call_with_retries(always_fails, policy=policy)
+    assert time.monotonic() - t0 < 2.0  # far under 1000 * 0.02
+    assert 2 <= len(calls) < 20
+    assert telemetry.counters("storage.retry")["storage.retry.exhausted"] == 1
+    assert telemetry.counters("storage.retry")["storage.retry.attempts"] == len(calls) - 1
+
+
+def test_retry_exhaustion_writes_flight_recorder_incident(tmp_path):
+    from delta_tpu.obs import flight_recorder
+
+    flight_recorder.install()
+    with conf.set_temporarily(delta__tpu__obs__incidentDir=str(tmp_path / "inc")):
+        with pytest.raises(TransientIOError):
+            retries.call_with_retries(
+                lambda: (_ for _ in ()).throw(TransientIOError("down")),
+                policy=RetryPolicy(max_attempts=2, base_delay_s=0.001),
+                op_name="read",
+            )
+        files = flight_recorder.incident_files(str(tmp_path / "inc"))
+    assert len(files) == 1
+    body = json.loads(open(files[0]).read())
+    assert body["opType"] == "delta.storage.retry.exhausted"
+    assert body["data"]["op"] == "read"
+
+
+def test_is_transient_classification():
+    assert retries.is_transient(TransientIOError("x"))
+    assert retries.is_transient(ConnectionResetError())
+    assert retries.is_transient(TimeoutError())
+    assert not retries.is_transient(FileNotFoundError("v.json"))
+    assert not retries.is_transient(FileExistsError("v.json"))  # OCC signal
+    assert not retries.is_transient(ValueError("bug"))
+    from delta_tpu.utils.errors import DeltaIOError
+
+    assert not retries.is_transient(DeltaIOError("final verdict"))
+
+
+def test_retrying_store_retries_reads_never_commit_creates():
+    plan = FaultPlan(script=[("read", "transient"), ("write.commit", "transient")])
+    base = MemoryLogStore()
+    store = RetryingLogStore(
+        FaultInjectingLogStore(base, plan),
+        RetryPolicy(max_attempts=4, base_delay_s=0.001),
+    )
+    store.write("/t/_delta_log/00000000000000000000.json", ["a"])  # no fault yet? script head is read
+    # scripted read transient: retried transparently
+    assert store.read("/t/_delta_log/00000000000000000000.json") == ["a"]
+    # scripted commit-create transient: surfaces immediately (sub=0 means the
+    # write LANDED before the error — the ambiguity belongs to the txn layer)
+    with pytest.raises(TransientIOError):
+        store.write("/t/_delta_log/00000000000000000001.json", ["b"])
+    assert base.read("/t/_delta_log/00000000000000000001.json") == ["b"]
+    assert telemetry.counters("storage.retry")["storage.retry.attempts"] == 1
+    assert telemetry.counters("faults")["faults.injected"] == 2
+
+
+def test_retrying_store_retries_overwrite_writes():
+    plan = FaultPlan(script=[("write.other", "transient")])
+    base = MemoryLogStore()
+    store = RetryingLogStore(
+        FaultInjectingLogStore(base, plan),
+        RetryPolicy(max_attempts=4, base_delay_s=0.001),
+    )
+    store.write_bytes("/t/_delta_log/whatever.bin", b"x", overwrite=True)
+    assert base.read_bytes("/t/_delta_log/whatever.bin") == b"x"
+
+
+# -- fault plan --------------------------------------------------------------
+
+
+def test_plan_spec_parsing_and_unknown_keys():
+    p = faults._parse_spec("seed=7,rate=0.25,kinds=transient|slow,maxFaults=3,slowMs=1")
+    assert (p.seed, p.rate, p.kinds, p.max_faults, p.slow_ms) == (
+        7, 0.25, ("transient", "slow"), 3, 1.0)
+    with pytest.raises(ValueError):
+        faults._parse_spec("seed=1,bogus=2")
+    with pytest.raises(ValueError):
+        FaultPlan(kinds=("not_a_kind",))
+
+
+def test_plan_from_conf_caches_by_spec_string():
+    spec = "seed=99,rate=0.5,kinds=transient"
+    with conf.set_temporarily(delta__tpu__faults__plan=spec):
+        a = faults.plan_from_conf()
+        b = faults.plan_from_conf()
+    assert a is b  # plan state persists across DeltaLog re-creation
+    # a fresh independent run over the same spec needs a reset to get a
+    # fresh seeded sequence, not the half-consumed streams
+    faults.reset_plan_cache()
+    with conf.set_temporarily(delta__tpu__faults__plan=spec):
+        assert faults.plan_from_conf() is not a
+    faults.reset_plan_cache()
+
+
+def test_for_table_rebuilds_when_plan_installed_later(tmp_table):
+    """The documented install path must work on an already-cached table:
+    conf changes rebuild the cached DeltaLog's store stack."""
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        DeltaTable.create(tmp_table, data=pa.table({"id": pa.array([1], pa.int64())}))
+        log = DeltaLog.for_table(tmp_table)
+        assert not isinstance(log.store.base, FaultInjectingLogStore)
+    plan = FaultPlan(seed=1, rate=0.0)
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        wrapped = DeltaLog.for_table(tmp_table)
+        assert wrapped.store.base.plan is plan
+    # and back: unsetting the plan drops the injector again on next lookup
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        clean = DeltaLog.for_table(tmp_table)
+        assert not isinstance(clean.store.base, FaultInjectingLogStore)
+
+
+def test_run_all_parts_crash_outranks_ordinary_failures():
+    """A simulated process death in ANY part must surface over lower-index
+    Exception failures — `except Exception` recovery may not survive it."""
+    from delta_tpu.log.checkpoints import _run_all_parts
+
+    def part(i):
+        if i == 0:
+            raise ValueError("ordinary part failure")
+        if i == 2:
+            raise SimulatedCrash("write.checkpoint")
+
+    with pytest.raises(SimulatedCrash):
+        _run_all_parts(4, part)
+    # without a crash, the lowest-index failure surfaces (all attempted)
+    ran = []
+
+    def part2(i):
+        ran.append(i)
+        if i in (1, 3):
+            raise ValueError(f"part {i}")
+
+    with pytest.raises(ValueError, match="part 1"):
+        _run_all_parts(4, part2)
+    assert sorted(ran) == [0, 1, 2, 3]
+
+
+def test_seeded_plan_is_deterministic_per_point():
+    def run(seed):
+        plan = FaultPlan(seed=seed, rate=0.3)
+        store = FaultInjectingLogStore(MemoryLogStore(), plan)
+        for i in range(120):
+            try:
+                store.write(f"/t/_delta_log/{filenames.delta_file(i)}", ["x"])
+            except BaseException:  # noqa: BLE001 — crashes/transients expected
+                pass
+            try:
+                list(store.list_from("/t/_delta_log/0"))
+            except BaseException:  # noqa: BLE001
+                pass
+        return plan.per_point
+
+    assert run(42) == run(42)
+    assert run(42) != run(43)
+
+
+def test_maybe_wrap_zero_overhead_when_unset():
+    base = MemoryLogStore()
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        assert faults.maybe_wrap(base) is base
+
+
+def test_deltalog_store_stack_wiring(tmp_table):
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        DeltaTable.create(tmp_table, data=pa.table({"id": pa.array([1], pa.int64())}))
+        DeltaLog.invalidate_cache(tmp_table)
+        log = DeltaLog(tmp_table)
+        # no plan: retry layer directly over the base store — NO fault wrapper
+        assert isinstance(log.store, RetryingLogStore)
+        assert not isinstance(log.store.base, FaultInjectingLogStore)
+    plan = FaultPlan(seed=1, rate=0.0)
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        log = DeltaLog(tmp_table)
+        assert isinstance(log.store.base, FaultInjectingLogStore)
+        assert log.store.base.plan is plan
+    with conf.set_temporarily(delta__tpu__storage__retry__enabled=False,
+                              delta__tpu__faults__plan=None):
+        log = DeltaLog(tmp_table)
+        assert not isinstance(log.store, RetryingLogStore)
+
+
+# -- ambiguous commit reconciliation ----------------------------------------
+
+
+def test_ambiguous_commit_reconciled_as_won(tmp_table):
+    """Commit create raises a transient AFTER the write landed (lost
+    response): the txn re-reads version N, sees its own txnId, and reports
+    success — exactly one commit, no double-commit, no false failure."""
+    plan = FaultPlan(script=[("write.commit", "transient")])
+    log = _table(tmp_table, plan=plan)
+    WriteIntoDelta(log, "append", pa.table({"id": pa.array([9], pa.int64())})).run()
+    assert log.update().version == 1
+    assert _ids(tmp_table) == [1, 2, 3, 9]
+    assert telemetry.counters("commit")["commit.reconciled"] == 1
+    [ev] = telemetry.recent_events("delta.commit.reconcile")
+    assert ev.data["won"] is True
+    # the landed commit carries the reconciliation token
+    line = log.store.read(f"{log.log_path}/{filenames.delta_file(1)}")[0]
+    assert json.loads(line)["commitInfo"]["txnId"]
+
+
+def test_ambiguous_commit_reconciled_as_lost(tmp_table):
+    """Version N exists but belongs to ANOTHER writer: reconciliation says
+    lost, and the commit proceeds through the conflict checker to N+1."""
+    log = _table(tmp_table)
+    txn = log.start_transaction()
+    token_winner = "deadbeef" * 4
+    winner = {"commitInfo": {"timestamp": 0, "operation": "WRITE", "txnId": token_winner}}
+    add = {"add": {"path": "w.parquet", "partitionValues": {}, "size": 1,
+                   "modificationTime": 0, "dataChange": True}}
+    log.store.write(f"{log.log_path}/{filenames.delta_file(1)}",
+                    [json.dumps(winner), json.dumps(add)])
+    txn._commit_token = "feedface" * 4
+    assert txn._reconcile_ambiguous_commit(1, TransientIOError("lost resp")) is False
+    assert telemetry.counters("commit")["commit.reconciled"] == 1
+    # absent version: provably not landed
+    assert txn._reconcile_ambiguous_commit(5, TransientIOError("x")) is None
+
+
+def test_ambiguous_commit_error_before_write_retries_and_lands(tmp_table):
+    """Transient raised BEFORE the create reached storage: reconciliation
+    finds no file and the loop safely re-attempts the same version."""
+    plan = FaultPlan(script=[("write.commit", "transient", 0.9)])
+    log = _table(tmp_table, plan=plan)
+    WriteIntoDelta(log, "append", pa.table({"id": pa.array([7], pa.int64())})).run()
+    assert _ids(tmp_table) == [1, 2, 3, 7]
+    # reconciled (as not-landed), then clean single commit at version 1
+    assert telemetry.counters("commit")["commit.reconciled"] == 1
+    assert not os.path.exists(
+        os.path.join(tmp_table, "_delta_log", filenames.delta_file(2)))
+
+
+# -- crashes -----------------------------------------------------------------
+
+
+def test_crash_before_publish_leaves_orphan_and_no_commit(tmp_table):
+    plan = FaultPlan(script=[("write.commit", "crash_before_publish")])
+    log = _table(tmp_table, plan=plan)
+    with pytest.raises(SimulatedCrash):
+        WriteIntoDelta(log, "append", pa.table({"id": pa.array([9], pa.int64())})).run()
+    # no commit landed; a staged .tmp orphan remains (what a dead writer leaves)
+    assert _ids(tmp_table) == [1, 2, 3]
+    orphans = glob.glob(os.path.join(tmp_table, "_delta_log", ".*.tmp"))
+    assert orphans
+    # recovery: fresh log resumes and the next commit takes version 1
+    DeltaLog.invalidate_cache(tmp_table)
+    log2 = DeltaLog(tmp_table)
+    WriteIntoDelta(log2, "append", pa.table({"id": pa.array([9], pa.int64())})).run()
+    assert _ids(tmp_table) == [1, 2, 3, 9]
+
+
+def test_crash_after_publish_commit_is_durable(tmp_table):
+    plan = FaultPlan(script=[("write.commit", "crash_after_publish")])
+    log = _table(tmp_table, plan=plan)
+    with pytest.raises(SimulatedCrash):
+        WriteIntoDelta(log, "append", pa.table({"id": pa.array([9], pa.int64())})).run()
+    # the writer died AFTER the create: the commit is visible to recovery
+    assert _ids(tmp_table) == [1, 2, 3, 9]
+
+
+def test_simulated_crash_pierces_except_exception():
+    with pytest.raises(SimulatedCrash):
+        try:
+            raise SimulatedCrash("write.commit")
+        except Exception:  # noqa: BLE001 — must NOT catch a crash
+            pytest.fail("SimulatedCrash must not be swallowed by except Exception")
+
+
+# -- orphan sweeping ---------------------------------------------------------
+
+
+def test_cleanup_sweeps_aged_tmp_orphans_keeps_young(tmp_table):
+    from delta_tpu.log.cleanup import sweep_tmp_orphans
+
+    log = _table(tmp_table)
+    log_dir = os.path.join(tmp_table, "_delta_log")
+    old = os.path.join(log_dir, ".00000000000000000009.json.aaaa.tmp")
+    young = os.path.join(log_dir, ".00000000000000000009.json.bbbb.tmp")
+    for p in (old, young):
+        with open(p, "wb") as f:
+            f.write(b"orphan")
+    aged = (time.time() - 7200) * 1000  # 2h old vs the 1h default TTL
+    os.utime(old, (aged / 1000, aged / 1000))
+    swept = sweep_tmp_orphans(log, int(time.time() * 1000))
+    assert swept == 1
+    assert not os.path.exists(old) and os.path.exists(young)
+    # delta/checkpoint/_last_checkpoint files untouched
+    assert os.path.exists(os.path.join(log_dir, filenames.delta_file(0)))
+
+
+def test_local_overwrite_write_failure_leaves_no_tmp(tmp_path, monkeypatch):
+    """Satellite fix: the overwrite branch now stages in try/finally."""
+    store = LocalLogStore()
+    target = str(tmp_path / "_delta_log" / "_last_checkpoint")
+    os.makedirs(os.path.dirname(target))
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError(5, "injected EIO")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        store.write_bytes(target, b"{}", overwrite=True)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert glob.glob(str(tmp_path / "_delta_log" / "*.tmp")) == []
+    assert glob.glob(str(tmp_path / "_delta_log" / ".*.tmp")) == []
+
+
+# -- checkpoint faults -------------------------------------------------------
+
+
+def _commit_n(log, n, start=100):
+    for i in range(n):
+        WriteIntoDelta(log, "append",
+                       pa.table({"id": pa.array([start + i], pa.int64())})).run()
+
+
+def test_torn_multipart_checkpoint_never_blocks_progress(tmp_table):
+    log = _table(tmp_table)
+    _commit_n(log, 5)
+    plan = FaultPlan(script=[("write.checkpoint", "torn_checkpoint")])
+    with conf.set_temporarily(delta__tpu__faults__plan=plan,
+                              delta__tpu__checkpointPartSize=2):
+        DeltaLog.invalidate_cache(tmp_table)
+        flog = DeltaLog(tmp_table)
+        with pytest.raises(SimulatedCrash):
+            flog.checkpoint()
+    # some parts landed, the set is incomplete, the pointer never moved
+    parts = glob.glob(os.path.join(tmp_table, "_delta_log", "*.checkpoint.*.parquet"))
+    assert parts, "torn checkpoint should leave partial parts behind"
+    assert not os.path.exists(os.path.join(tmp_table, "_delta_log", "_last_checkpoint"))
+    # recovery reads the table fine (partial checkpoint ignored) and a fresh
+    # checkpoint at a later version completes
+    assert len(_ids(tmp_table)) == 8
+    DeltaLog.invalidate_cache(tmp_table)
+    log2 = DeltaLog(tmp_table)
+    _commit_n(log2, 1, start=500)
+    log2.checkpoint()
+    assert os.path.exists(os.path.join(tmp_table, "_delta_log", "_last_checkpoint"))
+    assert len(_ids(tmp_table)) == 9
+
+
+def test_stale_last_checkpoint_pointer_is_survivable(tmp_table):
+    log = _table(tmp_table)
+    _commit_n(log, 3)
+    log.checkpoint()  # honest pointer at v3
+    before = open(os.path.join(tmp_table, "_delta_log", "_last_checkpoint")).read()
+    plan = FaultPlan(script=[("write.lastCheckpoint", "stale_last_checkpoint")])
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        DeltaLog.invalidate_cache(tmp_table)
+        flog = DeltaLog(tmp_table)
+        _commit_n(flog, 2, start=200)
+        flog.checkpoint()  # checkpoint parts land; pointer update LOST
+    after = open(os.path.join(tmp_table, "_delta_log", "_last_checkpoint")).read()
+    assert after == before  # pointer is stale (points at v3, log is at v5)
+    # readers list past the stale pointer and see everything
+    snap = DeltaLog(tmp_table).update()
+    assert snap.version == 5
+    assert len(_ids(tmp_table)) == 8
+
+
+def test_listing_lag_serves_older_consistent_snapshot(tmp_table):
+    log = _table(tmp_table)
+    _commit_n(log, 2)  # versions 1, 2
+    plan = FaultPlan(script=[("list", "listing_lag")])
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        DeltaLog.invalidate_cache(tmp_table)
+        lag = DeltaLog(tmp_table)  # init update: newest delta hidden once
+        assert lag.snapshot.version == 1  # older but consistent
+        assert lag.update().version == 2  # next listing sees it
+
+
+def test_slow_fault_only_delays(tmp_table):
+    plan = FaultPlan(script=[("write.commit", "slow")], slow_ms=1)
+    log = _table(tmp_table, plan=plan)
+    WriteIntoDelta(log, "append", pa.table({"id": pa.array([4], pa.int64())})).run()
+    assert _ids(tmp_table) == [1, 2, 3, 4]
+    assert plan.kinds_seen() == {"slow": 1}
+
+
+# -- streaming crash-replay idempotency (satellite) --------------------------
+
+
+def test_streaming_sink_crash_replay_is_idempotent(tmp_table):
+    """Injected crash-after-publish on the sink's commit: the engine
+    re-delivers the batch with the same txnId/batchId — the replay must be
+    a no-op (SetTransaction dedup), rows exactly once."""
+    from delta_tpu.streaming.sink import DeltaSink
+
+    log = _table(tmp_table)
+    plan = FaultPlan(script=[("write.commit", "crash_after_publish")])
+    data = pa.table({"id": pa.array([10, 11], pa.int64())})
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        DeltaLog.invalidate_cache(tmp_table)
+        flog = DeltaLog(tmp_table)
+        sink = DeltaSink(flog, "q-replay")
+        with pytest.raises(SimulatedCrash):
+            sink.add_batch(0, data)
+        # crash-recover: fresh log + sink, SAME batch re-delivered
+        DeltaLog.invalidate_cache(tmp_table)
+        flog2 = DeltaLog(tmp_table)
+        committed = DeltaSink(flog2, "q-replay").add_batch(0, data)
+    assert committed is False  # dedup: already committed by the crashed attempt
+    assert _ids(tmp_table) == [1, 2, 3, 10, 11]
+    # and a NEW batch still goes through
+    with conf.set_temporarily(delta__tpu__faults__plan=None):
+        DeltaLog.invalidate_cache(tmp_table)
+        assert DeltaSink(DeltaLog(tmp_table), "q-replay").add_batch(
+            1, pa.table({"id": pa.array([12], pa.int64())})) is True
+    assert _ids(tmp_table) == [1, 2, 3, 10, 11, 12]
+
+
+def test_streaming_sink_crash_before_publish_replay_commits(tmp_table):
+    from delta_tpu.streaming.sink import DeltaSink
+
+    log = _table(tmp_table)
+    plan = FaultPlan(script=[("write.commit", "crash_before_publish")])
+    data = pa.table({"id": pa.array([20], pa.int64())})
+    with conf.set_temporarily(delta__tpu__faults__plan=plan):
+        DeltaLog.invalidate_cache(tmp_table)
+        with pytest.raises(SimulatedCrash):
+            DeltaSink(DeltaLog(tmp_table), "q2").add_batch(0, data)
+        DeltaLog.invalidate_cache(tmp_table)
+        committed = DeltaSink(DeltaLog(tmp_table), "q2").add_batch(0, data)
+    assert committed is True  # first attempt never landed; replay commits
+    assert _ids(tmp_table) == [1, 2, 3, 20]

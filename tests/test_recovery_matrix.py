@@ -1,0 +1,191 @@
+"""Combined-corruption recovery matrix (satellite of the fault-injection PR).
+
+Single-corruption fallbacks are pinned in test_hardening.py; this matrix
+corrupts ``_last_checkpoint`` AND the checkpoint it points at TOGETHER, and
+verifies recovery on BOTH checkpoint read paths:
+
+* the columnar path — ``Snapshot._columnar`` segment decode with
+  checkpoint exclusion + re-listing (`log/snapshot.py`), and
+* the dataclass path — ``read_checkpoint_actions`` + ``LogReplay`` over
+  the recovered segment (`log/checkpoints.py` / `log/replay.py`).
+"""
+import glob
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.log import checkpoints as ckpt_mod
+from delta_tpu.log import snapshot_management as sm
+from delta_tpu.log.replay import LogReplay
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import actions_from_lines
+from delta_tpu.utils.config import conf
+
+N_COMMITS = 23  # checkpoints at v10 and v20, log tail to v22
+
+
+def _build(tmp_path, part_size=None):
+    path = str(tmp_path / "t")
+    ctx = (conf.set_temporarily(delta__tpu__checkpointPartSize=part_size)
+           if part_size else None)
+    if ctx:
+        ctx.__enter__()
+    try:
+        log = DeltaLog.for_table(path)
+        for i in range(N_COMMITS):
+            WriteIntoDelta(log, "append", pa.table({"a": [i]})).run()
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+    return path
+
+
+def _log_dir(path):
+    return os.path.join(path, "_delta_log")
+
+
+def _truncate(p, n=10):
+    with open(p, "r+b") as f:
+        f.truncate(n)
+
+
+def _corrupt_last_checkpoint(path, mode):
+    lc = os.path.join(_log_dir(path), "_last_checkpoint")
+    if mode == "garbage":
+        with open(lc, "w") as f:
+            f.write("{ NOT JSON !!!")
+    elif mode == "truncated":
+        _truncate(lc, os.path.getsize(lc) // 2)
+    elif mode == "stale_v10":
+        with open(lc, "w") as f:
+            f.write(json.dumps({"version": 10, "size": 12}))
+    elif mode == "phantom_v15":  # points at a checkpoint that never existed
+        with open(lc, "w") as f:
+            f.write(json.dumps({"version": 15, "size": 16}))
+    else:
+        raise AssertionError(mode)
+
+
+def _corrupt_ckpt20(path, mode):
+    cks = sorted(glob.glob(os.path.join(_log_dir(path), "*20.checkpoint*")))
+    assert cks, "expected a checkpoint at v20"
+    if mode == "truncated":
+        _truncate(cks[-1])
+    elif mode == "missing":
+        for p in cks:
+            os.remove(p)
+    elif mode == "one_part_missing":
+        assert len(cks) > 1, "need a multi-part checkpoint"
+        os.remove(cks[1])
+    else:
+        raise AssertionError(mode)
+
+
+def _reload(path):
+    DeltaLog.clear_cache()
+    return DeltaLog.for_table(path)
+
+
+def _assert_recovered_columnar(path):
+    """Columnar read path: full snapshot correct despite the corruption."""
+    log = _reload(path)
+    snap = log.update()
+    assert snap.version == N_COMMITS - 1
+    assert len(snap.all_files) == N_COMMITS
+    assert snap.metadata.schema_string is not None
+    # time travel through the damaged region also recovers
+    tt = log.get_snapshot_at(15)
+    assert tt.version == 15 and len(tt.all_files) == 16
+    return log, snap
+
+
+def _assert_recovered_dataclass(log, snap):
+    """Dataclass read path over the SAME recovered segment: checkpoint parts
+    decode to Action objects, replayed with the JSON tail to the same state."""
+    replay = LogReplay(min_file_retention_timestamp=0)
+    seg = snap.segment
+    start = 0
+    if seg.checkpoint_files:
+        actions = ckpt_mod.read_checkpoint_actions(log.store, [f.path for f in seg.checkpoint_files])
+        replay.append(seg.checkpoint_version, actions)
+        start = seg.checkpoint_version + 1
+        replay.current_version = seg.checkpoint_version
+    for fs in seg.deltas:
+        v = filenames.delta_version(fs.name)
+        assert v >= start
+        replay.append(v, actions_from_lines(log.store.read_iter(fs.path)))
+    assert replay.current_version == N_COMMITS - 1
+    assert len(replay.active_files) == N_COMMITS
+    assert replay.current_metadata is not None
+    assert replay.current_protocol is not None
+
+
+@pytest.mark.parametrize("lc_mode", ["garbage", "truncated", "phantom_v15"])
+@pytest.mark.parametrize("ckpt_mode", ["truncated", "missing"])
+def test_combined_lc_and_ckpt20_corruption(tmp_path, lc_mode, ckpt_mode):
+    """The pointer lies AND the checkpoint it (should) point at is damaged:
+    recovery must land on the v10 checkpoint + deltas 11..22, on both read
+    paths."""
+    path = _build(tmp_path)
+    _corrupt_ckpt20(path, ckpt_mode)
+    _corrupt_last_checkpoint(path, lc_mode)
+    log, snap = _assert_recovered_columnar(path)
+    if ckpt_mode == "truncated":
+        # corrupt parquet is memoized so update() doesn't re-pay recovery
+        assert 20 in log.corrupt_checkpoints
+    assert snap.segment.checkpoint_version == 10
+    _assert_recovered_dataclass(log, snap)
+
+
+def test_stale_pointer_with_truncated_target(tmp_path):
+    """_last_checkpoint points at v10 (stale) while the NEWER v20 checkpoint
+    is corrupt: listing from v10 must not trust the broken v20."""
+    path = _build(tmp_path)
+    _corrupt_ckpt20(path, "truncated")
+    _corrupt_last_checkpoint(path, "stale_v10")
+    log, snap = _assert_recovered_columnar(path)
+    assert snap.segment.checkpoint_version == 10
+    _assert_recovered_dataclass(log, snap)
+
+
+@pytest.mark.parametrize("lc_mode", ["garbage", "phantom_v15"])
+def test_combined_corruption_multipart_one_part_missing(tmp_path, lc_mode):
+    """Multi-part checkpoint at v20 missing one part (torn) + corrupt
+    pointer: the incomplete checkpoint must be skipped at selection, not
+    decoded and failed."""
+    path = _build(tmp_path, part_size=5)
+    _corrupt_ckpt20(path, "one_part_missing")
+    _corrupt_last_checkpoint(path, lc_mode)
+    log, snap = _assert_recovered_columnar(path)
+    assert snap.segment.checkpoint_version == 10
+    _assert_recovered_dataclass(log, snap)
+
+
+def test_both_checkpoints_corrupt_full_json_replay(tmp_path):
+    """Every checkpoint unusable + pointer garbage: recovery is a full JSON
+    replay from version 0 — the last line of defense."""
+    path = _build(tmp_path)
+    for p in glob.glob(os.path.join(_log_dir(path), "*.checkpoint*")):
+        _truncate(p)
+    _corrupt_last_checkpoint(path, "garbage")
+    log, snap = _assert_recovered_columnar(path)
+    assert snap.segment.checkpoint_version is None  # pure delta replay
+    _assert_recovered_dataclass(log, snap)
+
+
+def test_recovered_segment_via_exclusion_listing(tmp_path):
+    """The segment recomputation itself (get_log_segment_for_version with
+    excluded_checkpoints) picks the older checkpoint when the newer is
+    known-corrupt — the unit under the snapshot-level recovery."""
+    path = _build(tmp_path)
+    seg = sm.get_log_segment_for_version(
+        DeltaLog.for_table(path).store, f"{path}/_delta_log",
+        excluded_checkpoints=frozenset({20}),
+    )
+    assert seg.version == N_COMMITS - 1
+    assert seg.checkpoint_version == 10
+    assert [filenames.delta_version(f.name) for f in seg.deltas] == list(range(11, 23))
