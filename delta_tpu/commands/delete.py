@@ -49,12 +49,21 @@ class DeleteCommand:
 
     def _body(self, txn) -> int:
         timer = Timer()
+        self._rewrote_files = False
         actions = self._perform_delete(txn, timer)
         op = ops.Delete(
             predicate=[self.condition.sql()] if self.condition is not None else []
         )
         txn.report_metrics(**self.metrics)
-        return txn.commit(actions, op)
+        version = txn.commit(actions, op)
+        if self._rewrote_files:
+            # survivors rewritten into new files: bump the resident
+            # key-cache epoch (ops/key_cache.py) — plain removes and DV
+            # marks advance incrementally and need no invalidation
+            from delta_tpu.ops.key_cache import KeyCache
+
+            KeyCache.instance().bump_epoch(self.delta_log.log_path)
+        return version
 
     def _perform_delete(self, txn, timer: Timer) -> List[Action]:
         metadata = txn.metadata
@@ -125,6 +134,7 @@ class DeleteCommand:
                         self.delta_log.data_path, survivors, metadata, data_change=True
                     )
                 )
+                self._rewrote_files = True
         cdc_actions: List[Action] = []
         if cdf_blocks:
             cdc_actions = list(

@@ -12,11 +12,18 @@ columnar blocks: matched pairs / unmatched target rows / unmatched source
 rows are materialized separately, and every clause becomes a vectorized mask
 + projection over its block. The join itself has two executors:
 
-- **device** (`ops/join_kernel.py`): single integer equi-key, no residual
-  conjuncts — the TPC-DS upsert shape. Target keys sharded over the mesh,
-  source keys all-gathered over ICI, per-shard sort-merge probe; phase 1's
-  touched files and phase 2's matched pairs both come from its
-  (count, first-match) output. Toggle: ``delta.tpu.merge.devicePath.enabled``.
+- **device** — 1-2 integer equi-keys, no residual conjuncts (the TPC-DS
+  upsert shape), three variants by residency (PR 6 fused pipeline):
+  *resident* (the table's key lane is HBM-resident in `ops/key_cache` —
+  ships only source keys), *device-cold* (per-file key decode streams onto
+  a pre-sized slab while the remaining files decode, then registers the
+  slab so the next merge cache-hits), and *device-upload* (multichip mesh:
+  target sharded, source all-gathered, per-shard sort-merge —
+  `ops/join_kernel.py`). The probe kernel computes match masks AND the
+  matched pairing on device; the host maps O(matched) pairs onto the
+  decode. Toggle: ``delta.tpu.merge.devicePath.enabled``; routing is
+  link-priced per residency case (`parallel/link.py`), and every decision
+  emits a ``delta.merge.router`` event + ``merge.device.*`` counters.
 - **host fallback** (Arrow hash join — the C++ kernel) for string /
   multi-key / non-equi conditions.
 
@@ -327,7 +334,10 @@ class MergeIntoCommand:
         # path must not consume a previous run's device-join flags
         self._device_join = None
         self._resident_candidate = None
-        self._join_path = "host"  # 'resident' | 'device-upload' | 'host'
+        # 'resident' (HBM cache hit) | 'device-cold' (fused slab build) |
+        # 'device-upload' (mesh all-gather kernel) | 'host'
+        self._join_path = "host"
+        self._router: Dict[str, Any] = {}
         self._cdf_blocks = []
         self._use_cdf = cdf_exec.cdf_enabled(txn.metadata)
         self.phase_ms.clear()
@@ -382,6 +392,7 @@ class MergeIntoCommand:
             txn, candidates, src, equi, residual, metadata,
             prune_pred=ir.and_all(target_only) if target_only else None,
         )
+        self._emit_router()
         scan_ms = timer.lap_ms()
 
         if not insert_only:
@@ -563,7 +574,10 @@ class MergeIntoCommand:
         if device_eligible and mode == "auto":
             # pre-decode routing check from AddFile stats row counts: on a
             # slow link even the *optimistic* plan (int32 keys) loses to the
-            # host hash join — skip the early key decode entirely then
+            # host hash join — skip the early key decode entirely then.
+            # This is the COLD price (slab upload + sort + probe); the
+            # cache-hit case was already evaluated above with its own,
+            # upload-free economics.
             n_est = _rows_from_stats(candidates)
             if n_est is not None:
                 import jax
@@ -571,14 +585,26 @@ class MergeIntoCommand:
                 from delta_tpu.parallel import link
 
                 rows = n_est + src.num_rows
-                est = link.estimate_device_s(
-                    up_bytes=rows * 4,
-                    down_bytes=rows // 8,
-                    kernel_rows=rows,
-                    shards=len(jax.devices()),
-                )
-                if est.device_s > rows * link.HOST_JOIN_S_PER_ROW:
+                if not (len(jax.devices()) > 1 and conf.get_bool(
+                        "delta.tpu.merge.devicePath.preferMesh", False)):
+                    device_s = link.cold_merge_device_s(
+                        n_est, src.num_rows, link.profile())
+                else:
+                    device_s = link.estimate_device_s(
+                        up_bytes=rows * 4,
+                        down_bytes=rows // 8,
+                        kernel_rows=rows,
+                        shards=len(jax.devices()),
+                    ).device_s
+                if device_s > rows * link.HOST_JOIN_S_PER_ROW:
                     device_eligible = False
+                    from delta_tpu.utils.telemetry import bump_counter
+
+                    bump_counter("merge.device.declined")
+                    self._router.update(
+                        reason="cold-estimate", deviceEstS=round(device_s, 3),
+                        hostEstS=round(rows * link.HOST_JOIN_S_PER_ROW, 3),
+                    )
 
         # DV-mode matched clauses mark physical rows deleted — every scan
         # that can end up as the phase-2 tables must carry positions
@@ -595,7 +621,9 @@ class MergeIntoCommand:
         decode_t = Timer()
         pending = None
         resident = None
+        via = None
         key_pieces: Optional[List[pa.Table]] = None
+        key_pieces_have_pos = False
         if base_eligible:
             # resident-operand path first: the target key lane already lives
             # in HBM (ops/key_cache), so the probe ships only source keys —
@@ -605,28 +633,65 @@ class MergeIntoCommand:
                 txn, candidates, src, equi, target_cols, key_need,
                 pos_col, insert_only,
             )
+            if resident is not None:
+                via = "resident"
         if resident is None and device_eligible:
-            key_cols = [c for c in target_cols if c.lower() in key_need]
-            key_pieces = read_files_as_table(
-                self.delta_log.data_path, candidates, metadata,
-                columns=key_cols or None, per_file=True,
-                position_column=pos_col, predicate=prune_pred,
-                # the key read and the full read below must stay row-aligned
-                # (the device probe's indices map onto the full decode) —
-                # stats-pruning is deterministic across both, but late
-                # materialization's verdict depends on the decoded columns
-                late_materialize=False,
+            import jax
+
+            prefer_mesh = (
+                len(jax.devices()) > 1
+                and conf.get_bool("delta.tpu.merge.devicePath.preferMesh",
+                                  False)
             )
-            key_tab = pa.concat_tables(key_pieces, promote_options="permissive")
-            if key_tab.num_rows:
-                pending = self._launch_device_join(key_tab, src, equi)
+            if not prefer_mesh:
+                # fused cold pipeline: per-file key decode streams into a
+                # pre-sized HBM slab (upload overlaps decode), then the
+                # block-bucketed probe joins + pairs on device — and the
+                # slab registers in the KeyCache so the NEXT merge against
+                # this table skips the upload entirely
+                resident, key_pieces = self._launch_slab_pipeline(
+                    txn, candidates, src, equi, target_cols, key_need,
+                    pos_col, insert_only, metadata,
+                )
+                if resident is not None:
+                    via = "device-cold"
+                key_pieces_have_pos = key_pieces is not None
+            if resident is None and key_pieces is None:
+                # multichip mesh (all-gather sort-merge kernel, opt-in via
+                # devicePath.preferMesh), or the slab pipeline bailed before
+                # decoding: decode the key projection and launch the upload
+                # join
+                key_cols = [c for c in target_cols if c.lower() in key_need]
+                key_pieces = read_files_as_table(
+                    self.delta_log.data_path, candidates, metadata,
+                    columns=key_cols or None, per_file=True,
+                    position_column=pos_col, predicate=prune_pred,
+                    # the key read and the full read below must stay
+                    # row-aligned (the device probe's indices map onto the
+                    # full decode) — stats-pruning is deterministic across
+                    # both, but late materialization's verdict depends on
+                    # the decoded columns
+                    late_materialize=False,
+                )
+            if resident is None:
+                key_tab = pa.concat_tables(key_pieces,
+                                           promote_options="permissive")
+                if key_tab.num_rows:
+                    pending = self._launch_device_join(key_tab, src, equi)
+                    if pending is not None:
+                        via = "device-upload"
+                    else:
+                        self._router.setdefault("reason", "upload-declined")
         self.phase_ms["key_decode_ms"] = decode_t.lap_ms()
 
         # full-column decode (overlaps the in-flight device probe); when the
-        # key projection already covers every needed column, reuse it
+        # key projection already covers every needed column, reuse it (the
+        # slab pipeline's pieces carry an extra position column — harmless,
+        # every write-side consumer projects to target_cols)
         if key_pieces is not None and read_cols is not None and set(
             c.lower() for c in read_cols
-        ) <= key_need:
+        ) <= key_need and (not key_pieces_have_pos or pos_col is not None
+                           or insert_only):
             raw_pieces = key_pieces
         else:
             raw_pieces = read_files_as_table(
@@ -675,12 +740,11 @@ class MergeIntoCommand:
                 resident, candidates, tgt_tables, target, src, equi,
                 pos_col, insert_only,
             )
-            via = "resident"
-        else:
-            via = "device-upload"
         if pending is not None:
             res = pending.result()
-            if res is not None:
+            if res is None:
+                self._router.setdefault("reason", "device-finalize-fallback")
+            else:
                 self._device_join = res
                 self._join_path = via
                 # insert-only never consumes the pair rows (the not-matched
@@ -830,7 +894,7 @@ class MergeIntoCommand:
         from delta_tpu.ops import key_cache as kc_mod
         from delta_tpu.parallel import link
 
-        if not conf.get_bool("delta.tpu.merge.residentKeys.enabled", True):
+        if not kc_mod.key_cache_enabled():
             return None
         # bit mapping back to the DV-filtered decode needs physical
         # positions; without them only DV-free candidates are alignable
@@ -852,12 +916,13 @@ class MergeIntoCommand:
         if packed is None:
             return None
         s_keys, s_ok = packed
+        self._router["cacheHit"] = True
         if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "auto":
             m = len(s_keys)
             n = entry.num_rows
             p = link.profile()
-            # the calibrated r5 sorted-slab probe model (shared with the
-            # bench's auto_routes_device report: link.resident_probe_device_s)
+            # the fused-path probe model (shared with the bench's
+            # auto_routes_device report: link.resident_probe_device_s)
             device_s = link.resident_probe_device_s(n, m, p)
             if not entry.is_resident:
                 # the device copy was evicted / regrown: the probe would
@@ -866,32 +931,138 @@ class MergeIntoCommand:
             host_s = ((n + m) * link.HOST_JOIN_S_PER_ROW
                       + n * link.HOST_KEY_DECODE_S_PER_ROW)
             if device_s > host_s:
+                from delta_tpu.utils.telemetry import bump_counter
+
+                bump_counter("merge.device.declined")
+                self._router.update(
+                    reason="resident-estimate", deviceEstS=round(device_s, 3),
+                    hostEstS=round(host_s, 3))
                 return None
         probe = entry.probe_async(
-            s_keys, s_ok, expected_version=txn.snapshot.version
+            s_keys, s_ok, expected_version=txn.snapshot.version,
+            insert_only=insert_only,
         )
         if probe is None:
             return None
         return entry, probe, s_keys, s_ok
 
-    def _finalize_resident(self, resident, candidates, tgt_tables, target,
-                           src, equi, pos_col, insert_only):
-        """Map the physical-space probe bits onto the DV-filtered decode and
-        recover the matched pairing from the already-decoded target keys.
-        Returns a PendingJoin whose result is a JoinResult (or None → the
-        caller falls back to the host hash join)."""
-        import numpy as np
+    def _launch_slab_pipeline(self, txn, candidates, src, equi, target_cols,
+                              key_need, pos_col, insert_only, metadata):
+        """The cold fused device MERGE pipeline: decode the key projection
+        per file, streaming each decoded file's packed lane onto a
+        pre-sized HBM slab from an uploader thread (transfer overlaps the
+        remaining Parquet decode), then launch the block-bucketed probe —
+        and register the slab in the KeyCache so repeated MERGEs against a
+        hot table skip the upload entirely.
+
+        Returns ``(resident_tuple_or_None, key_pieces_or_None)`` —
+        ``resident_tuple`` feeds `_finalize_resident`; ``key_pieces`` (the
+        per-file decoded key tables, position column attached) is returned
+        even on build failure so the caller can reuse the decode."""
+        import queue as queue_mod
+        import threading as threading_mod
 
         from delta_tpu.expr.vectorized import evaluate
-        from delta_tpu.ops import join_kernel, key_cache as kc_mod
+        from delta_tpu.ops import key_cache as kc_mod
+
+        # DV alignment guard (mirrors the resident-hit path)
+        if (pos_col is None and not insert_only
+                and any(f.deletion_vector is not None for f in candidates)):
+            return None, None
+        t_exprs = [t for t, _ in equi]
+        s_exprs = [s for _, s in equi]
+        packed = kc_mod._pack_lanes(src, s_exprs, evaluate)
+        if packed is None:
+            return None, None
+        s_keys, s_ok = packed
+        snapshot = txn.snapshot
+        sig = self._key_signature(t_exprs)
+        key_cols = [c for c in target_cols if c.lower() in key_need]
+        cache = kc_mod.KeyCache.instance()
+        try:
+            builder = kc_mod.SlabBuilder(
+                snapshot.delta_log.log_path, snapshot.metadata.id,
+                snapshot.version, sig, key_cols, t_exprs,
+                self.delta_log.data_path, candidates,
+                epoch=cache.epoch(snapshot.delta_log.log_path),
+            )
+        except Exception:
+            return None, None
+        if builder.failed is not None:
+            return None, None
+
+        q: "queue_mod.Queue" = queue_mod.Queue()
+
+        def on_ready(i, add, tab):
+            q.put((add, tab))
+
+        def uploader():
+            # device dispatches are async: this thread mostly queues
+            # transfers, which the transfer engine overlaps with the
+            # decode pool still running on the other files
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                add, tab = item
+                try:
+                    pos = tab.column(POSITION_COL).to_numpy(
+                        zero_copy_only=False)
+                    builder.add_file(add, tab, pos)
+                except Exception:
+                    builder.failed = builder.failed or "slab append failed"
+
+        th = threading_mod.Thread(target=uploader, daemon=True,
+                                  name="merge-slab-upload")
+        th.start()
+        try:
+            # full physical rows per file: no row-group pruning, positions
+            # attached so DV-filtered decodes scatter into slab layout
+            key_pieces = read_files_as_table(
+                self.delta_log.data_path, candidates, metadata,
+                columns=key_cols or None, per_file=True,
+                position_column=POSITION_COL, predicate=None,
+                late_materialize=False, file_ready=on_ready,
+            )
+        finally:
+            q.put(None)
+            th.join()
+        entry = builder.finish(len(candidates))
+        if entry is None:
+            self._router.setdefault("reason", "slab-build-failed")
+            return None, key_pieces
+        # under device eligibility the candidate set is the whole table (a
+        # residual-free condition prunes nothing), so the slab is complete
+        # and future merges can cache-hit it
+        registered = cache.register(entry)
+        if registered:
+            self._resident_candidate = None  # no background build needed
+        probe = entry.probe_async(
+            s_keys, s_ok, expected_version=snapshot.version,
+            insert_only=insert_only,
+        )
+        if probe is None:
+            self._router.setdefault("reason", "no-sentinel-room")
+            return None, key_pieces
+        return (entry, probe, s_keys, s_ok), key_pieces
+
+    def _finalize_resident(self, resident, candidates, tgt_tables, target,
+                           src, equi, pos_col, insert_only):
+        """Map the device-computed pairs (physical slab row → first-match
+        source row) onto the DV-filtered decode: the host does only the
+        O(matched) position mapping — no key re-derivation, no host-side
+        pairing sort. Returns a PendingJoin whose result is a JoinResult
+        (or None → the caller falls back to the host hash join)."""
+        import numpy as np
+
+        from delta_tpu.ops import join_kernel
 
         entry, probe, s_keys, s_ok = resident
 
         def finalize():
-            # any failure in here — the probe itself, the bit mapping, or
-            # the pairing recovery disagreeing with the slab — must surface
-            # as None (documented host-join fallback), never an exception
-            # that crashes the MERGE
+            # any failure in here — the probe itself, or the pair mapping
+            # disagreeing with the slab — must surface as None (documented
+            # host-join fallback), never an exception that crashes the MERGE
             try:
                 res_p = probe.result()
                 n_target = target.num_rows
@@ -901,7 +1072,6 @@ class MergeIntoCommand:
                     return join_kernel.JoinResult(
                         t_first_s, res_p.s_matched, res_p.any_multi
                     )
-                t_matched = np.zeros(n_target, bool)
                 row_base = 0
                 for fid in sorted(tgt_tables):
                     t = tgt_tables[fid]
@@ -911,23 +1081,13 @@ class MergeIntoCommand:
                             zero_copy_only=False)
                     else:
                         positions = None
-                    bits = res_p.bits_for_file(add.path, positions, t.num_rows)
-                    if bits is None:
+                    got = res_p.pairs_for_file(add.path, positions,
+                                               t.num_rows)
+                    if got is None:
                         return None  # slab/decode disagree: host fallback
-                    t_matched[row_base:row_base + t.num_rows] = bits
+                    local_idx, s_rows = got
+                    t_first_s[row_base + local_idx] = s_rows
                     row_base += t.num_rows
-                idx = np.flatnonzero(t_matched)
-                if idx.size:
-                    sub = target.take(pa.array(idx, pa.int64()))
-                    packed = kc_mod._pack_lanes(
-                        sub, [t for t, _ in equi], evaluate
-                    )
-                    if packed is None:
-                        return None
-                    tk, _tok = packed
-                    t_first_s[idx] = join_kernel._first_match_recovery(
-                        tk, np.arange(len(tk)), s_keys, s_ok
-                    )
                 return join_kernel.JoinResult(t_first_s, res_p.s_matched,
                                               res_p.any_multi)
             except Exception:
@@ -935,15 +1095,40 @@ class MergeIntoCommand:
 
         return join_kernel.PendingJoin(finalize)
 
+    def _emit_router(self) -> None:
+        """One `delta.merge.router` event per MERGE — the production-table
+        observable behind the bench's `auto_used_device` field — plus the
+        `merge.device.*` counters the /metrics endpoint and flight recorder
+        surface."""
+        from delta_tpu.utils.telemetry import bump_counter, record_event
+
+        decision = self._join_path
+        if self._device_join is not None:
+            bump_counter("merge.device.engaged")
+            if decision == "resident":
+                bump_counter("merge.device.cacheHit")
+        data = dict(self._router, decision=decision)
+        if "cacheHit" in data:
+            # a cache lookup may have hit and then been abandoned (pricing
+            # decline, no sentinel room): the emitted flag reports whether
+            # the ENGAGED join actually used the cache
+            data["cacheHit"] = decision == "resident"
+        record_event(
+            "delta.merge.router", data,
+            path=self.delta_log.data_path,
+        )
+
     def _maybe_build_resident_keys(self) -> None:
         """Post-commit: start the background build of the resident key lane
         recorded by `_launch_resident_probe`, so the NEXT merge into this
         table probes from HBM. Never blocks the committing merge."""
+        from delta_tpu.ops.key_cache import key_cache_enabled
+
         cand = getattr(self, "_resident_candidate", None)
         if cand is None:
             return
         self._resident_candidate = None
-        if not conf.get_bool("delta.tpu.merge.residentKeys.enabled", True):
+        if not key_cache_enabled():
             return
         if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "off":
             return

@@ -146,6 +146,12 @@ class OptimizeCommand:
                 predicate=pred_sql, z_order_by=self.z_order_by or None,
             )
         version = txn.commit(removes + adds, op)
+        # file rewrite: bump the resident key-cache epoch so a stale HBM
+        # slab can never serve a post-OPTIMIZE MERGE (ops/key_cache.py)
+        if removes or adds:
+            from delta_tpu.ops.key_cache import KeyCache
+
+            KeyCache.instance().bump_epoch(self.delta_log.log_path)
         # feed the table-health doctor: maintenance recency as gauges, work
         # done as counters (obs/metric_names.py catalog)
         from delta_tpu.utils import telemetry

@@ -132,8 +132,15 @@ class RestoreCommand:
                 executionTimeMs=timer.lap_ms(),
             )
             txn.report_metrics(**self.metrics)
-            return txn.commit(actions, Restore(self.version, (
+            version = txn.commit(actions, Restore(self.version, (
                 str(self.timestamp) if self.timestamp is not None else None
             )))
+            if actions:
+                # file-set rewind (re-adds may shrink deletion vectors):
+                # bump the resident key-cache epoch (ops/key_cache.py)
+                from delta_tpu.ops.key_cache import KeyCache
+
+                KeyCache.instance().bump_epoch(self.delta_log.log_path)
+            return version
 
         return self.delta_log.with_new_transaction(body)

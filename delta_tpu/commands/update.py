@@ -142,7 +142,15 @@ class UpdateCommand:
         )
         txn.report_metrics(**self.metrics)
         op = ops.Update(predicate=self.condition.sql() if self.condition else None)
-        return txn.commit(removes + adds + cdc_actions, op)
+        version = txn.commit(removes + adds + cdc_actions, op)
+        if not use_dv and removes:
+            # whole-file rewrite (not a DV mark): bump the resident
+            # key-cache epoch — stale slabs must never serve a
+            # post-rewrite MERGE (DV-mode diffs advance incrementally)
+            from delta_tpu.ops.key_cache import KeyCache
+
+            KeyCache.instance().bump_epoch(self.delta_log.log_path)
+        return version
 
     def _apply_updates(self, table: pa.Table, mask, metadata) -> pa.Table:
         cols = []

@@ -44,6 +44,7 @@ def read_files_as_table(
     predicate=None,
     positions_of_interest: Optional[Sequence] = None,
     late_materialize: bool = True,
+    file_ready=None,
 ):
     """Decode AddFiles to one Arrow table, materializing partition columns.
 
@@ -76,6 +77,12 @@ def read_files_as_table(
     the file as written (int64) — DML needs physical positions to extend a
     file's deletion vector; positions stay physical under row-group
     skipping (offset by the row counts of skipped groups).
+
+    ``file_ready(index, add, table)`` is invoked from the decode pool as
+    each file's table completes (decode-completion order, not list order) —
+    the hook the MERGE fused pipeline uses to stream key lanes onto the
+    device while the remaining files still decode. The callback must not
+    raise; an exception from it fails the whole read.
     """
     from delta_tpu.utils import telemetry
 
@@ -252,7 +259,7 @@ def read_files_as_table(
         return t, pos, late_skipped, late_bytes
 
     def read_one(job) -> pa.Table:
-        add, pos_hint = job
+        fidx, add, pos_hint = job
         abs_path = _abs_data_path(data_path, add.path)
         import numpy as np
 
@@ -372,6 +379,8 @@ def read_files_as_table(
             t = t.append_column(
                 position_column, pa.array(positions, pa.int64())
             )
+        if file_ready is not None:
+            file_ready(fidx, add, t)
         return t
 
     if pos_hints is not None and len(pos_hints) != len(files):
@@ -379,7 +388,8 @@ def read_files_as_table(
             f"positions_of_interest has {len(pos_hints)} entries "
             f"for {len(files)} files"
         )
-    jobs = list(zip(files, pos_hints if pos_hints else [None] * len(files)))
+    jobs = [(i, add, hint) for i, (add, hint) in enumerate(
+        zip(files, pos_hints if pos_hints else [None] * len(files)))]
     with telemetry.record_operation(
         "delta.scan.read", {"numFiles": len(files)}
     ) as rev:

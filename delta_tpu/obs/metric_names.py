@@ -62,6 +62,15 @@ COUNTERS = frozenset({
     "storage.retry.exhausted",    # gave up: surfaced to the caller
     "faults.injected",            # deterministic fault injector fired
     "commit.reconciled",          # ambiguous commit resolved via txnId
+    # -- device MERGE router + resident key cache (commands/merge.py,
+    #    ops/key_cache.py) — `auto_used_device` made observable on
+    #    production tables via /metrics and flight-recorder incidents
+    "merge.device.engaged",       # a device join produced this merge's pairs
+    "merge.device.declined",      # link cost model chose the host
+    "merge.device.cacheHit",      # engaged from an HBM-resident key lane
+    "merge.keyCache.builds",      # cold key-lane builds (inline or bg)
+    "merge.keyCache.advances",    # incremental log-tail applications
+    "merge.keyCache.invalidations",  # entries dropped by a rewrite epoch bump
 })
 
 #: Public surface of each obs module, lint-matched against its ``__all__``.

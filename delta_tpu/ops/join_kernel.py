@@ -1,10 +1,17 @@
-"""Device equi-join for MERGE — the north star's centerpiece.
+"""Device equi-join for MERGE — the mesh (all-gather) kernel + host fallback.
 
 The reference runs MERGE phase 1 (findTouchedFiles) as a Spark inner join
 source×target with a row-id/file-name UDF (`commands/MergeIntoCommand.scala:310-389`)
 and phase 2 as an outer join + row-at-a-time clause interpreter (`:456-561`).
 Here the join itself is a device kernel; clause application stays columnar
 Arrow on the host (`commands/merge.py`).
+
+Since PR 6 the PRIMARY single-chip join is the fused block-bucketed
+membership probe in `ops/key_cache.py` (resident slab + O(matched) pair
+download); `commands/merge.py` routes there first. This module remains the
+multichip path (`delta.tpu.merge.devicePath.preferMesh`) — the sharded
+all-gather sort-merge below — plus the exact host sort-merge fallback and
+the shared `PendingJoin`/`JoinResult` contract both executors return.
 
 Shape of the kernel (TPU-first, not a shuffle translation):
 

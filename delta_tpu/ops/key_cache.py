@@ -24,6 +24,16 @@ Composite integer keys pack into one lane (hi<<32 | lo) exactly like the
 upload path; the packing is part of the signature and is only built when
 the target components fit int32 (the per-merge source side is checked at
 probe time).
+
+The probe is FUSED with the join's pairing step (PR 6): the kernel also
+emits each matched slab row's first-match source index, compacted on
+device into an O(matched) pair download — the host no longer re-derives
+the pairing from decoded target keys. Cold builds stream per-file decoded
+lanes straight onto a pre-sized HBM allocation (:class:`SlabBuilder`), so
+the upload overlaps the remaining Parquet decode, and file rewrites
+(OPTIMIZE / UPDATE-rewrite / RESTORE) bump a per-table epoch
+(:meth:`KeyCache.bump_epoch`) that drops resident entries outright — a
+stale slab can never serve a post-rewrite MERGE.
 """
 from __future__ import annotations
 
@@ -37,7 +47,18 @@ import numpy as np
 from delta_tpu.utils.jaxcompat import enable_x64
 from delta_tpu.utils.config import conf
 
-__all__ = ["ResidentJoinKeys", "KeyCache", "PhysicalProbe"]
+__all__ = ["ResidentJoinKeys", "KeyCache", "PhysicalProbe", "SlabBuilder",
+           "key_cache_enabled"]
+
+
+def key_cache_enabled() -> bool:
+    """Whether the cross-MERGE resident key cache may serve/retain entries.
+    ``delta.tpu.merge.keyCache.enabled`` is the documented name;
+    ``delta.tpu.merge.residentKeys.enabled`` is honored for back-compat —
+    either set to false disables caching (the fused device path itself is
+    governed by ``delta.tpu.merge.devicePath.*``)."""
+    return (conf.get_bool("delta.tpu.merge.keyCache.enabled", True)
+            and conf.get_bool("delta.tpu.merge.residentKeys.enabled", True))
 
 from delta_tpu.ops.state_cache import _next_pow2  # shared pad-size bucketing
 
@@ -55,13 +76,32 @@ class DeltaProbeOverflow(RuntimeError):
 
 @dataclass
 class PhysicalProbe:
-    """Probe output in physical slab space: per-slab-row matched bits plus
-    per-source matched flags. ``slabs`` maps file path → (offset, rows)."""
+    """Probe output in physical slab space: per-source matched flags and —
+    the fused-join addition — the matched PAIRS themselves (physical slab
+    row → first matching source row), computed on device and downloaded
+    O(matched). ``slabs`` maps file path → (offset, rows). ``t_pairs`` is
+    None for an insert-only probe (only the source flags were fetched).
+    ``t_bits`` (the full per-slab-row matched mask) materializes LAZILY
+    from the pairs — the production merge path consumes only
+    :meth:`pairs_for_file` and never pays the O(slab-rows) scatter."""
 
-    t_bits: np.ndarray  # bool per physical slab row
     s_matched: np.ndarray  # bool per source row
     any_multi: bool
     slabs: Dict[str, Tuple[int, int]]
+    num_rows: int = 0  # live slab rows (t_bits length)
+    # (physical slab rows ascending, first-match source row per pair)
+    t_pairs: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    _bits: Optional[np.ndarray] = None
+
+    @property
+    def t_bits(self) -> Optional[np.ndarray]:
+        """Bool per physical slab row; None for an insert-only probe."""
+        if self._bits is None and self.t_pairs is not None:
+            t = np.zeros(self.num_rows, bool)
+            phys, _ = self.t_pairs
+            t[phys[phys < self.num_rows]] = True
+            self._bits = t
+        return self._bits
 
     def bits_for_file(self, path: str, positions: Optional[np.ndarray],
                       num_rows: int) -> Optional[np.ndarray]:
@@ -70,7 +110,7 @@ class PhysicalProbe:
         rows are physical 0..num_rows). None when the file isn't in the slab
         or shapes disagree (caller falls back)."""
         ent = self.slabs.get(path)
-        if ent is None:
+        if ent is None or self.t_bits is None:
             return None
         off, rows = ent
         if positions is None:
@@ -80,6 +120,35 @@ class PhysicalProbe:
         if len(positions) and positions.max() >= rows:
             return None
         return self.t_bits[off + positions]
+
+    def pairs_for_file(self, path: str, positions: Optional[np.ndarray],
+                       num_rows: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """The matched pairs landing in one file, mapped onto its *decoded*
+        rows: (decoded row indices, first-match source rows). ``positions``
+        as in :meth:`bits_for_file`. None when the file isn't in the slab or
+        the slab disagrees with the decode (a matched physical row absent
+        from the DV-filtered decode) — callers fall back to the host join."""
+        ent = self.slabs.get(path)
+        if ent is None or self.t_pairs is None:
+            return None
+        off, rows = ent
+        phys, srows = self.t_pairs
+        lo = int(np.searchsorted(phys, off))
+        hi = int(np.searchsorted(phys, off + rows))
+        p_local = phys[lo:hi] - off
+        s_local = srows[lo:hi]
+        if positions is None:
+            if num_rows != rows:
+                return None
+            return p_local, s_local
+        if len(positions) and int(positions[-1]) >= rows:
+            return None
+        idx = np.searchsorted(positions, p_local)
+        if (idx >= len(positions)).any():
+            return None
+        if len(idx) and not (positions[idx] == p_local).all():
+            return None  # slab matched a row the decode dropped: fall back
+        return idx, s_local
 
 
 # same memoizing finalize wrapper as the upload path's handle
@@ -135,7 +204,8 @@ def _tier1_width(cap: int, m: int) -> int:
 
 @functools.lru_cache(maxsize=None)
 def _probe_sorted_kernel():
-    """Block-bucketed brute-force membership probe — the TPU-shaped design.
+    """Block-bucketed brute-force membership probe — the TPU-shaped design,
+    fused with the join's pairing step.
 
     Measured on a v5e (100M-row slab): random O(n) gathers/scatters cost
     1-3 s and a 1M→100M searchsorted ~0.9 s, while dense elementwise
@@ -146,17 +216,21 @@ def _probe_sorted_kernel():
       - two small searchsorteds (block boundary keys into the sorted
         source) give each block its candidate window [win_lo, win_hi);
       - each block brute-compares its 4096 keys against W window slots as
-        a broadcast compare fused into two reductions (per-row any →
-        t-side; valid-masked per-candidate any → s-side) — ~cap*W int64
-        compares, a few ms of VPU time, nothing materialized;
+        a broadcast compare fused into three reductions (per-row any →
+        t-side; valid-masked per-candidate any → s-side; per-row MIN of
+        the matching candidates' original source index → the pairing) —
+        ~cap*W int64 compares, a few ms of VPU time, nothing materialized;
       - a second tier re-runs the top-K widest windows at W2=4096, so
         locally clustered sources stay exact; wider-than-W2 windows set
         an overflow flag and the caller falls back to the host join.
 
-    Outputs stay in SORTED space (t bits + per-4096-block any-match); the
-    finalize step downloads hot blocks' bits + permutation slices (sparse)
-    or dispatches the unsort kernel (dense). One head array carries
-    [multi | overflow | s_bits | block bitmap] — a single small fetch."""
+    Outputs stay in SORTED space. One head array carries
+    [multi | overflow | matched-count (4 bytes LE) | s_bits] — a single
+    small fetch; the matched count sizes the O(matched) pair download
+    (`_pair_compact_kernel`) without another round trip. The per-row
+    first-match is the MINIMAL original source index among equal keys —
+    exactly `_first_match_recovery`'s stable-tie semantics, so the fused
+    path is row-identical to the host pairing."""
     from delta_tpu.utils.jaxcache import ensure_compilation_cache
 
     ensure_compilation_cache()
@@ -198,27 +272,38 @@ def _probe_sorted_kernel():
         wsize = jnp.maximum(win_hi - win_lo, 0)
 
         def tier(kb, vb, lo, hi, width):
-            """(t_any (B, blk), s_any (B, width), idx (B, width)) for the
-            given blocks' windows, clipped/masked to [lo, hi)."""
+            """(t_any (B, blk), t_first (B, blk), s_any (B, width),
+            idx (B, width)) for the given blocks' windows, clipped/masked
+            to [lo, hi). t_first is the minimal ORIGINAL source row index
+            among the window's equal-key candidates, m when none."""
             idx = lo[:, None] + jnp.arange(width, dtype=lo.dtype)[None, :]
             in_win = idx < hi[:, None]
-            cand = s_sorted[jnp.minimum(idx, m - 1)]  # (B, width)
+            safe = jnp.minimum(idx, m - 1)
+            cand = s_sorted[safe]  # (B, width)
+            # original source rows; out-of-window slots encode m so the
+            # min-reduce ignores them
+            cand_src = jnp.where(in_win, s_perm[safe], m)
             eq = kb[:, :, None] == cand[:, None, :]  # fused into reduces
             t_any = jnp.any(eq & in_win[:, None, :], axis=2)
+            t_first = jnp.min(
+                jnp.where(eq, cand_src[:, None, :], m), axis=2
+            ).astype(jnp.int32)
             s_any = jnp.any(eq & vb[:, :, None], axis=1) & in_win
-            return t_any, s_any, idx
+            return t_any, t_first, s_any, idx
 
-        t1, s1, idx1 = tier(keys_b, valid_b, win_lo, win_hi, w1)
+        t1, f1, s1, idx1 = tier(keys_b, valid_b, win_lo, win_hi, w1)
         t_match_b = t1
+        t_first_b = f1
         s_match_sorted = jnp.zeros(m, bool).at[
             jnp.minimum(idx1, m - 1).reshape(-1)
         ].max(s1.reshape(-1), mode="drop")
         if k2 > 0 and w1 < w2:
             top_w, top_b = jax.lax.top_k(wsize, k2)
-            t2, s2, idx2 = tier(keys_b[top_b], valid_b[top_b],
-                                win_lo[top_b], win_hi[top_b], w2)
+            t2, f2, s2, idx2 = tier(keys_b[top_b], valid_b[top_b],
+                                    win_lo[top_b], win_hi[top_b], w2)
             # tier 2 supersedes tier 1 on its blocks (windows are prefixes)
             t_match_b = t_match_b.at[top_b].set(t2)
+            t_first_b = t_first_b.at[top_b].set(f2)
             s_match_sorted = s_match_sorted.at[
                 jnp.minimum(idx2, m - 1).reshape(-1)
             ].max(s2.reshape(-1), mode="drop")
@@ -228,7 +313,7 @@ def _probe_sorted_kernel():
         else:
             overflow = jnp.any(wsize > w1)
         t_match_sorted = (t_match_b & valid_b).reshape(cap)
-        t_bits = jnp.packbits(t_match_sorted.astype(jnp.uint8))
+        s_first_sorted = t_first_b.reshape(cap)
         s_match = jnp.zeros(m, bool).at[s_perm].set(s_match_sorted)
         s_bits = jnp.packbits(s_match.astype(jnp.uint8))
         # multi-match: a matched key duplicated in the sorted source
@@ -237,62 +322,55 @@ def _probe_sorted_kernel():
         ])
         dup = dup | jnp.concatenate([dup[1:], jnp.zeros(1, bool)])
         multi = jnp.any(dup & s_match_sorted)
-        blocks_any = t_match_b.any(axis=1)
-        block_bits = jnp.packbits(blocks_any.astype(jnp.uint8))
+        mc = jnp.sum(t_match_sorted.astype(jnp.int32))
+        mc_bytes = (
+            jnp.right_shift(mc, jnp.array([0, 8, 16, 24], jnp.int32)) & 0xFF
+        ).astype(jnp.uint8)
         head = jnp.concatenate([
             multi.astype(jnp.uint8).reshape(1),
             overflow.astype(jnp.uint8).reshape(1),
-            s_bits, block_bits,
+            mc_bytes, s_bits,
         ])
-        return t_bits, head, t_match_sorted
+        return head, t_match_sorted, s_first_sorted
 
     return kernel
 
 
+def _decode_head(head: np.ndarray, cap_s: int, m: int):
+    """Decode the probe head fetched from device: (multi, overflow,
+    matched_count, s_matched[:m]). Layout documented on
+    `_probe_sorted_kernel` — shared with the bench's phase decomposition
+    so the two cannot drift."""
+    multi = bool(head[0])
+    overflow = bool(head[1])
+    mc = (int(head[2]) | (int(head[3]) << 8) | (int(head[4]) << 16)
+          | (int(head[5]) << 24))
+    s = np.unpackbits(head[6:6 + cap_s // 8], count=cap_s)[:m].astype(bool)
+    return multi, overflow, mc, s
+
+
 @functools.lru_cache(maxsize=None)
-def _unsort_kernel():
-    """Dense-download path: permute the sorted-space match mask back to
-    physical row space on device (one O(cap) scatter, ~7 ns/row) and pack."""
+def _pair_compact_kernel():
+    """O(matched) pair download: compact the matched sorted-space rows into
+    a dense (2, out_cap) int32 buffer of (physical row, first-match source
+    row) via a cumsum + scatter — the host then fetches exactly the pairs
+    instead of the whole cap/8 mask plus an O(n·log n) host pairing pass.
+    ``out_cap`` is a static pow2 bucket sized from the head's matched
+    count; slots past the count hold zeros (sliced off host-side)."""
     from delta_tpu.utils.jaxcache import ensure_compilation_cache
 
     ensure_compilation_cache()
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def kernel(t_match_sorted, perm):
-        cap = perm.shape[0]
-        t = jnp.zeros(cap, bool).at[perm].set(t_match_sorted)
-        return jnp.packbits(t.astype(jnp.uint8))
-
-    return kernel
-
-
-@functools.lru_cache(maxsize=None)
-def _gather_blocks_kernel():
-    """Sparse-download path: for the requested hot sorted-space blocks,
-    gather their packed match bits AND their permutation slices (sorted
-    position -> physical row), concatenated into ONE int32 array so the
-    host pays a single fetch: k*(blk/32 + blk) int32 words instead of the
-    whole cap/8 mask + an O(cap) device unsort. Out-of-range pad indices
-    fill zero bits / physical row `cap` (dropped host-side)."""
-    from delta_tpu.utils.jaxcache import ensure_compilation_cache
-
-    ensure_compilation_cache()
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def kernel(t_bits, perm, hot):
-        cap = perm.shape[0]
-        blk = _block_rows(cap)
-        words = t_bits.reshape(cap // blk, blk // 32, 4)
-        bits32 = jax.lax.bitcast_convert_type(
-            jnp.take(words, hot, axis=0, mode="fill", fill_value=0),
-            jnp.int32)
-        perm_b = jnp.take(perm.reshape(cap // blk, blk), hot, axis=0,
-                          mode="fill", fill_value=cap)
-        return jnp.concatenate([bits32, perm_b], axis=1)
+    @functools.partial(jax.jit, static_argnums=(3,))
+    def kernel(t_match_sorted, s_first_sorted, perm, out_cap):
+        pos = jnp.cumsum(t_match_sorted.astype(jnp.int32)) - 1
+        idx = jnp.where(t_match_sorted, pos, out_cap)
+        out_t = jnp.zeros(out_cap, jnp.int32).at[idx].set(perm, mode="drop")
+        out_s = jnp.zeros(out_cap, jnp.int32).at[idx].set(
+            s_first_sorted, mode="drop")
+        return jnp.stack([out_t, out_s])
 
     return kernel
 
@@ -343,6 +421,9 @@ class ResidentJoinKeys:
         self.version = version
         self.signature = signature
         self.key_cols = key_cols
+        # table rewrite generation at build time (KeyCache.bump_epoch):
+        # an entry from a pre-rewrite epoch is never cached or served
+        self.epoch = 0
         self.slabs: Dict[str, Tuple[int, int]] = {}  # path -> (offset, rows)
         # path -> (storageType, pathOrInlineDv, cardinality) of the deletion
         # vector whose positions are currently masked (None = no DV applied)
@@ -523,6 +604,24 @@ class ResidentJoinKeys:
         with self._lock:
             self._dev = None
 
+    def alloc_device(self) -> None:
+        """Pre-size the device arrays WITHOUT uploading the host mirrors —
+        the cold-build pipeline (:class:`SlabBuilder`) then scatters each
+        file's lane as it decodes, so the link transfer overlaps the
+        remaining Parquet decode instead of following it. No-op when a
+        device copy already exists."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._dev is not None:
+                return
+            with enable_x64():
+                self._dev = {
+                    "keys": jnp.zeros(self.capacity, jnp.int64),
+                    "valid": jnp.zeros(self.capacity, bool),
+                }
+            self._sort_stale = True
+
     def ensure_resident(self) -> None:
         """Ship the mirrors to HBM in bounded tiles (the uploads queue on
         the transfer engine and overlap, and no single transfer stalls the
@@ -658,10 +757,18 @@ class ResidentJoinKeys:
     # -- probing ----------------------------------------------------------
 
     def probe_async(self, s_keys: np.ndarray, s_ok: np.ndarray,
-                    expected_version: Optional[int] = None) -> Optional[PendingProbe]:
+                    expected_version: Optional[int] = None,
+                    insert_only: bool = False) -> Optional[PendingProbe]:
         """Membership probe of sentinel-encodable source keys against the
-        resident slab. Returns None when no sentinel room exists (valid keys
+        resident slab — fused with the join's pairing: the probe kernel also
+        emits each matched slab row's first-match source index, and the
+        finalize downloads the compacted O(matched) pairs instead of the
+        full mask. Returns None when no sentinel room exists (valid keys
         span int64) — callers fall back to the host join.
+
+        ``insert_only``: the caller consumes only the per-source matched
+        flags (the reference's left-anti fast path) — the finalize then
+        fetches the head alone and skips the pair download entirely.
 
         ``expected_version`` guards the advance race: a tail advance holds
         the entry lock for its whole multi-step application, so under the
@@ -680,8 +787,9 @@ class ResidentJoinKeys:
             if n == 0:
                 m = len(s_keys)
                 slabs = dict(self.slabs)
+                empty = np.empty(0, np.int64)
                 return PendingProbe(lambda: PhysicalProbe(
-                    np.zeros(0, bool), np.zeros(m, bool), False, slabs))
+                    np.zeros(m, bool), False, slabs, 0, (empty, empty)))
             s_key64 = np.ascontiguousarray(s_keys, np.int64)
             s_okb = np.asarray(s_ok, bool)
             # O(source) sentinel/narrowing decision: the slab's valid range
@@ -721,16 +829,24 @@ class ResidentJoinKeys:
         state: dict = {}
 
         def launch():
+            # the whole device pipeline runs on this staging thread so every
+            # round trip (kernel, head fetch, pair compaction dispatch)
+            # overlaps the caller's host-side Parquet decode; finalize only
+            # joins the thread and fetches the compacted pairs
             try:
                 with enable_x64():
-                    # no block_until_ready: the dispatch is async and the
-                    # first finalize fetch blocks anyway — an explicit sync
-                    # here would cost one extra ~100ms round trip on a
-                    # tunneled link (execution errors surface at the fetch)
-                    state["out"] = _probe_sorted_kernel()(
+                    head_dev, t_match_dev, s_first_dev = _probe_sorted_kernel()(
                         dev["sorted_keys"], dev["sorted_valid"],
                         jnp.asarray(np.int32(n)), jax.device_put(s_in),
                     )
+                    head = np.asarray(head_dev)  # blocks until kernel done
+                    state["head"] = head
+                    _multi, overflow, mc, _s = _decode_head(head, cap_s, m)
+                    if overflow or insert_only or mc == 0:
+                        return
+                    out_cap = _next_pow2(mc, floor=64)
+                    state["pairs_dev"] = _pair_compact_kernel()(
+                        t_match_dev, s_first_dev, dev["perm"], out_cap)
             except BaseException as e:
                 state["err"] = e
 
@@ -741,59 +857,25 @@ class ResidentJoinKeys:
             th.join()
             if "err" in state:
                 raise state["err"]
-            t_bits_dev, head_dev, t_match_dev = state["out"]
-            # ONE small download carries multi + overflow + s_bits + the
-            # sorted-space block bitmap; the match mask then arrives
-            # coarse-fine — hot blocks' bits + permutation slices (sparse)
-            # or a device-side unsort + live-prefix fetch (dense)
-            head = np.asarray(head_dev)
-            multi = bool(head[0])
-            if head[1]:
+            multi, overflow, mc, s = _decode_head(state["head"], cap_s, m)
+            if overflow:
                 # candidate window overflowed both tiers (pathologically
                 # skewed source): the mask would be incomplete — callers
                 # fall back to the host join
                 raise DeltaProbeOverflow(
                     "probe candidate window overflow; host fallback")
-            s_bytes = cap_s // 8
-            s = np.unpackbits(head[2:2 + s_bytes], count=cap_s)[:m].astype(bool)
-            blk = _block_rows(cap)
-            n_blocks = cap // blk
-            block_any = np.unpackbits(
-                head[2 + s_bytes:], count=n_blocks)[:n_blocks].astype(bool)
-            hot = np.flatnonzero(block_any)
-            n_bytes = (n + 7) // 8
-            from delta_tpu.parallel import link as _link
-
-            lp = _link.profile()
-            sparse_s = lp.download_s(len(hot) * (blk // 32 + blk) * 4)
-            # dense pays the O(cap) device unsort (~8 ns/row measured on a
-            # v5e) plus the full live-prefix download
-            dense_s = lp.download_s(n_bytes) + cap * 8e-9
-            if len(hot) == 0:
-                t = np.zeros(n, bool)
-            elif sparse_s < dense_s:
-                import jax.numpy as jnp2
-
-                pad = _next_pow2(len(hot), floor=8)
-                hot_idx = np.full(pad, 1 << 30, np.int32)
-                hot_idx[: len(hot)] = hot
-                gathered = np.asarray(_gather_blocks_kernel()(
-                    t_bits_dev, dev["perm"], jnp2.asarray(hot_idx),
-                ))[: len(hot)]
-                words = blk // 32
-                bits = np.unpackbits(
-                    np.ascontiguousarray(gathered[:, :words]).view(np.uint8),
-                    count=len(hot) * blk,
-                ).reshape(len(hot), blk).astype(bool)
-                phys = gathered[:, words:][bits]
-                t = np.zeros(n, bool)
-                t[phys[phys < n]] = True
-            else:
-                # dense: permute back to row space on device, fetch prefix
-                t_live = np.asarray(_unsort_kernel()(
-                    t_match_dev, dev["perm"])[:n_bytes])
-                t = np.unpackbits(t_live, count=n_bytes * 8)[:n].astype(bool)
-            return PhysicalProbe(t, s, multi, slabs)
+            if insert_only:
+                # left-anti fast path: the head already carried everything
+                return PhysicalProbe(s, multi, slabs, n, None)
+            if mc == 0:
+                empty = np.empty(0, np.int64)
+                return PhysicalProbe(s, multi, slabs, n, (empty, empty))
+            pairs = np.asarray(state["pairs_dev"])
+            phys = pairs[0, :mc].astype(np.int64)
+            srows = pairs[1, :mc].astype(np.int64)
+            order = np.argsort(phys, kind="stable")
+            phys, srows = phys[order], srows[order]
+            return PhysicalProbe(s, multi, slabs, n, (phys, srows))
 
         return PendingProbe(finalize)
 
@@ -879,6 +961,108 @@ def _dv_positions(dv_dict, data_path: str) -> Optional[np.ndarray]:
         return None
 
 
+class SlabBuilder:
+    """Streamed cold build of a :class:`ResidentJoinKeys` slab from per-file
+    decoded key tables — the upload leg of the fused device MERGE pipeline
+    (`commands/merge.py`). Files arrive in decode-completion order; each
+    file's packed lane scatters straight onto a pre-sized HBM allocation
+    (a contiguous slice append), so the link transfer overlaps the
+    remaining Parquet decode instead of following it.
+
+    Slab layout must be exact per file even though the decode arrives
+    DV-filtered: per-file PHYSICAL row counts come from AddFile stats
+    (``numRecords`` is physical as this engine writes it; logical ==
+    physical when no deletion vector) or the cached Parquet footer when a
+    deletion vector is present or stats are absent."""
+
+    def __init__(self, log_path: str, metadata_id: str, version: int,
+                 signature: str, key_cols: List[str], exprs,
+                 data_path: str, files, device: bool = True, epoch: int = 0):
+        from delta_tpu.ops.join_kernel import _bucket
+
+        self.exprs = list(exprs)
+        self.data_path = data_path
+        self.failed: Optional[str] = None
+        self.device = device
+        self._alloc_failed = False
+        self._phys: Dict[str, int] = {}
+        total = 0
+        for add in files:
+            nrec = add.num_logical_records
+            if add.deletion_vector is not None or nrec is None:
+                n = self._footer_rows(add)
+                if n is None:
+                    self.failed = f"no physical row count for {add.path}"
+                    break
+            else:
+                n = int(nrec)
+            self._phys[add.path] = n
+            total += n
+        entry = ResidentJoinKeys(log_path, metadata_id, version, signature,
+                                 list(key_cols))
+        entry.epoch = epoch
+        entry.capacity = max(_bucket(max(total, 1)), 1024)
+        self.entry = entry
+
+    def _footer_rows(self, add) -> Optional[int]:
+        from delta_tpu.exec import rowgroups
+        from delta_tpu.exec.scan import _abs_data_path
+
+        try:
+            return int(rowgroups.read_footer(
+                _abs_data_path(self.data_path, add.path)).num_rows)
+        except Exception:
+            return None
+
+    def add_file(self, add, table, positions: Optional[np.ndarray]) -> bool:
+        """Pack one decoded file's key lane and append+upload it.
+        ``positions`` are the decoded rows' physical positions (None when
+        the decode was not DV-filtered). Any disagreement with the recorded
+        physical row count poisons the build (the merge falls back to its
+        other executors)."""
+        if self.failed is not None:
+            return False
+        from delta_tpu.expr.vectorized import evaluate
+
+        phys = self._phys.get(add.path)
+        packed = _pack_lanes(table, self.exprs, evaluate)
+        if phys is None or packed is None:
+            self.failed = f"unpackable key lane for {add.path}"
+            return False
+        keys, valid = packed
+        if positions is None:
+            if len(keys) != phys:
+                self.failed = f"row count mismatch for {add.path}"
+                return False
+            full_k = np.ascontiguousarray(keys, np.int64)
+            full_v = np.asarray(valid, bool)
+        else:
+            if len(positions) != len(keys) or (
+                    len(positions) and int(positions[-1]) >= phys):
+                self.failed = f"position/physical mismatch for {add.path}"
+                return False
+            full_k = np.zeros(phys, np.int64)
+            full_v = np.zeros(phys, bool)
+            full_k[positions] = keys
+            full_v[positions] = valid
+        e = self.entry
+        if self.device and e._dev is None and not self._alloc_failed:
+            try:
+                e.alloc_device()
+            except Exception:
+                self._alloc_failed = True  # host mirrors still work
+        if not e._append_file(add.path, full_k, full_v):
+            self.failed = f"duplicate file {add.path}"
+            return False
+        e.dv_tags[add.path] = _dv_tag(add.deletion_vector)
+        return True
+
+    def finish(self, expected_files: int) -> Optional[ResidentJoinKeys]:
+        if self.failed is not None or len(self.entry.slabs) != expected_files:
+            return None
+        return self.entry
+
+
 class KeyCache:
     """Process-wide registry of resident join-key lanes, keyed by
     (log path, signature). Mirrors `DeviceStateCache`'s locking: registry
@@ -892,6 +1076,9 @@ class KeyCache:
         self._build_locks: Dict[Tuple[str, str], threading.Lock] = {}
         self._lock = threading.RLock()
         self._tick = 0
+        # per-table rewrite generation (bump_epoch): entries built under an
+        # older epoch are never served or cached
+        self._epochs: Dict[str, int] = {}
 
     @classmethod
     def instance(cls) -> "KeyCache":
@@ -911,6 +1098,54 @@ class KeyCache:
                 self._entries.pop(k, None)
                 self._build_locks.pop(k, None)
 
+    def epoch(self, log_path: str) -> int:
+        with self._lock:
+            return self._epochs.get(log_path, 0)
+
+    def bump_epoch(self, log_path: str) -> None:
+        """File-rewrite invalidation (OPTIMIZE / UPDATE-rewrite / RESTORE):
+        drop the table's resident entries outright — a stale slab must never
+        serve a post-rewrite MERGE, and after a rewrite most of the slab is
+        garbage anyway (an advance would kill + re-append nearly every
+        row). In-flight holders of a dropped entry fail their version guard:
+        the version is poisoned before release."""
+        from delta_tpu.utils.telemetry import bump_counter
+
+        with self._lock:
+            self._epochs[log_path] = self._epochs.get(log_path, 0) + 1
+            stale = [k for k in self._entries if k[0] == log_path]
+            for k in stale:
+                e = self._entries.pop(k)
+                e.version = _POISON_VERSION
+                self._build_locks.pop(k, None)
+        if stale:
+            bump_counter("merge.keyCache.invalidations", len(stale))
+
+    def register(self, entry: ResidentJoinKeys) -> bool:
+        """Adopt an externally built slab (the merge cold pipeline's
+        :class:`SlabBuilder` output) so later MERGEs against the table
+        cache-hit. Refused when the table's epoch moved during the build (a
+        rewrite raced it) or a newer entry already holds the key — the
+        caller's probe of the transient entry stays valid either way."""
+        from delta_tpu.utils.telemetry import bump_counter
+
+        if not key_cache_enabled():
+            return False
+        key = (entry.log_path, entry.signature)
+        with self._lock:
+            if entry.epoch != self._epochs.get(entry.log_path, 0):
+                return False
+            cur = self._entries.get(key)
+            if cur is not None and cur.version >= entry.version:
+                return False
+            self._tick += 1
+            entry.last_used = self._tick
+            self._entries[key] = entry
+            self._build_locks.setdefault(key, threading.Lock())
+        bump_counter("merge.keyCache.builds")  # inline cold build adopted
+        self._evict(keep=key)
+        return True
+
     def peek(self, log_path: str, signature: str) -> Optional[ResidentJoinKeys]:
         with self._lock:
             return self._entries.get((log_path, signature))
@@ -922,16 +1157,21 @@ class KeyCache:
         files, masking DV growth). ``build_if_missing=False`` only serves /
         advances an existing entry — the cold build policy stays with the
         caller (merge builds in the background after an eligible merge)."""
-        if not conf.get_bool("delta.tpu.merge.residentKeys.enabled", True):
+        from delta_tpu.utils.telemetry import bump_counter
+
+        if not key_cache_enabled():
             return None
-        key = (snapshot.delta_log.log_path, signature)
+        log_path = snapshot.delta_log.log_path
+        key = (log_path, signature)
         with self._lock:
             self._tick += 1
             tick = self._tick
+            cur_epoch = self._epochs.get(log_path, 0)
             build_lock = self._build_locks.setdefault(key, threading.Lock())
             e = self._entries.get(key)
         if e is not None and (e.metadata_id != snapshot.metadata.id
-                              or e.version > snapshot.version):
+                              or e.version > snapshot.version
+                              or e.epoch != cur_epoch):
             e = None
         if e is not None and e.version == snapshot.version:
             e.last_used = tick
@@ -940,15 +1180,19 @@ class KeyCache:
             return None
         with build_lock:
             with self._lock:
+                cur_epoch = self._epochs.get(log_path, 0)
                 e = self._entries.get(key)
             if e is not None and (e.metadata_id != snapshot.metadata.id
-                                  or e.version > snapshot.version):
+                                  or e.version > snapshot.version
+                                  or e.epoch != cur_epoch):
                 e = None
             if e is not None and e.version == snapshot.version:
                 e.last_used = tick
                 return e
             if e is not None:
-                if not self._advance(e, snapshot, key_cols, exprs):
+                if self._advance(e, snapshot, key_cols, exprs):
+                    bump_counter("merge.keyCache.advances")
+                else:
                     # a failed advance may have half-applied its tail: the
                     # entry must not stay visible at its (stale) version
                     with self._lock:
@@ -958,20 +1202,29 @@ class KeyCache:
             if e is None:
                 if not build_if_missing:
                     return None
-                e = self._build(snapshot, signature, key_cols, exprs)
+                e = self._build(snapshot, signature, key_cols, exprs,
+                                epoch=cur_epoch)
                 if e is None:
                     return None
+                bump_counter("merge.keyCache.builds")
                 with self._lock:
-                    self._entries[key] = e
+                    # a rewrite may have raced the build: the entry stays
+                    # exact for the caller's snapshot (file contents are
+                    # immutable), so serve it — but only CACHE it when the
+                    # epoch still matches
+                    if self._epochs.get(log_path, 0) == cur_epoch:
+                        self._entries[key] = e
             e.last_used = tick
             self._evict(keep=key)
             return e
 
-    def _build(self, snapshot, signature, key_cols, exprs) -> Optional[ResidentJoinKeys]:
+    def _build(self, snapshot, signature, key_cols, exprs,
+               epoch: int = 0) -> Optional[ResidentJoinKeys]:
         e = ResidentJoinKeys(
             snapshot.delta_log.log_path, snapshot.metadata.id,
             snapshot.version, signature, list(key_cols),
         )
+        e.epoch = epoch
         data_path = snapshot.delta_log.data_path
         for add in snapshot.all_files:
             kv = _file_keys(data_path, add, key_cols, exprs)

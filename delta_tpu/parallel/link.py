@@ -35,7 +35,8 @@ __all__ = [
     "HOST_PRUNE_S_PER_CELL", "DEVICE_PRUNE_S_PER_CELL",
     "HOST_KEY_DECODE_S_PER_ROW", "RESIDENT_PROBE_S_PER_ROW",
     "RESIDENT_PROBE_FIXED_S", "RESIDENT_FINALIZE_S_PER_ROW",
-    "resident_probe_device_s",
+    "RESIDENT_PAIR_S_PER_ROW", "DEVICE_SORT_S_PER_ROW",
+    "resident_probe_device_s", "cold_merge_device_s",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -61,28 +62,54 @@ RESIDENT_PROBE_S_PER_ROW = 3.0e-9
 # via the latency terms in resident_probe_device_s): kernel launch chain +
 # the m<=1M source sort
 RESIDENT_PROBE_FIXED_S = 0.3
-# host-side finalize work per TARGET row: bitmask unpack + bits_for_file
-# mapping over the DV-filtered decode + first-match pairing recovery (r5
-# measured: the 10M-row resident merge's join phase ran ~2.1 s against a
-# ~0.9 s transfer+kernel model — the residual is this term)
+# LEGACY (pre-fused path) host-side finalize work per TARGET row: bitmask
+# unpack + bits_for_file mapping + host first-match pairing recovery. The
+# fused probe computes the pairing on device and downloads O(matched)
+# pairs instead; kept exported for calibration comparisons.
 RESIDENT_FINALIZE_S_PER_ROW = 3.0e-8
+# fused-path host finalize per MATCHED pair: positions searchsorted +
+# scatter into t_first_s (estimate pending on-device recalibration; the
+# bench's phase breakdown records the live number each round)
+RESIDENT_PAIR_S_PER_ROW = 1.0e-7
+# device slab sort (lax.sort of the key lane + permutation), amortized per
+# row — paid once per cold build / tail append, not per probe
+DEVICE_SORT_S_PER_ROW = 5.0e-8
 
 
 def resident_probe_device_s(n: int, m: int, p: "LinkProfile") -> float:
     """The router's cost model for one steady-state resident MERGE probe
-    (n resident target rows, m source rows): source upload (int32-
-    narrowed, optimistic), head + mask downloads, the block-bucketed
-    kernel, the host-side finalize, a fixed dispatch floor, and the
-    probe's sequential round trips. ONE definition — the production
-    router (`commands/merge.py`) and the bench's `auto_routes_device`
-    report both call this, so they cannot drift apart."""
+    (n resident target rows, m source rows) on the FUSED path: source
+    upload (int32-narrowed, optimistic), the head download (s_bits +
+    matched count), the block-bucketed kernel, the compacted pair download
+    (matched count unknown pre-probe: modeled at the upsert-typical m/2
+    pairs x 8 bytes), the O(matched) host pair mapping, a fixed dispatch
+    floor, and the probe's sequential round trips. ONE definition — the
+    production router (`commands/merge.py`) and the bench's
+    `auto_routes_device` report both call this, so they cannot drift
+    apart."""
+    est_pairs = m // 2
     return (
         p.upload_s(m * 4)
-        + p.download_s(n // 8 + m // 8)
+        + p.download_s(m // 8 + 6)
         + (n + m) * RESIDENT_PROBE_S_PER_ROW
-        + n * RESIDENT_FINALIZE_S_PER_ROW
+        + p.download_s(est_pairs * 8)
+        + est_pairs * RESIDENT_PAIR_S_PER_ROW
         + RESIDENT_PROBE_FIXED_S
         + 3 * p.latency_s
+    )
+
+
+def cold_merge_device_s(n: int, m: int, p: "LinkProfile") -> float:
+    """Cost of the COLD fused device MERGE (no resident entry): the tiled
+    slab upload (int32-narrowed, optimistic — in the live pipeline it
+    overlaps the host Parquet key decode, so this is conservative), the
+    one-time device sort, then a steady-state probe. Priced separately
+    from the cache-hit case (`resident_probe_device_s`) — the router must
+    not charge a hot table for an upload it will skip."""
+    return (
+        p.upload_s(n * 4)
+        + n * DEVICE_SORT_S_PER_ROW
+        + resident_probe_device_s(n, m, p)
     )
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares

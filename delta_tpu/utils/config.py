@@ -48,11 +48,32 @@ class SqlConf:
         # condition is 1-2 integer equi-keys with no residual conjuncts
         # (composite keys pack into one int64 lane).
         "delta.tpu.merge.devicePath.enabled": True,
-        # Executor routing for the MERGE join: "auto" prices the key upload
-        # against the measured link profile (parallel/link.py) and declines
-        # the device when the host hash join is cheaper; "force" always
-        # launches the kernel; "off" never does.
+        # Executor routing for the MERGE join: "auto" prices the device leg
+        # against the measured link profile (parallel/link.py) — separately
+        # for the resident-cache-hit and the cold slab-upload cases — and
+        # declines the device when the host hash join is cheaper; "force"
+        # always engages the device; "off" never does.
         "delta.tpu.merge.devicePath.mode": "auto",
+        # On a multichip mesh, prefer the all-gather sharded sort-merge
+        # kernel (ops/join_kernel) over the single-device resident-slab
+        # pipeline. Off by default: the resident pipeline wins on link
+        # economics until the multichip executor (ROADMAP item 2) is real.
+        "delta.tpu.merge.devicePath.preferMesh": False,
+        # Cross-MERGE resident key cache (ops/key_cache): keep packed target
+        # join keys HBM-resident keyed by snapshot version + rewrite epoch,
+        # so repeated MERGEs against a hot table skip both the key decode
+        # and the upload. False disables caching AND the background build
+        # (the fused device path then rebuilds a transient slab per merge).
+        # `delta.tpu.merge.residentKeys.enabled` is the legacy alias; either
+        # set to false disables.
+        "delta.tpu.merge.keyCache.enabled": True,
+        "delta.tpu.merge.residentKeys.enabled": True,
+        # Minimum estimated table rows before the post-commit background
+        # key-lane build kicks in (small tables never win on device).
+        "delta.tpu.merge.residentKeys.minRows": 1 << 20,
+        # Resident key-cache budgets (ops/key_cache.KeyCache._evict).
+        "delta.tpu.keyCache.maxBytes": 1 << 30,
+        "delta.tpu.keyCache.maxEntries": 8,
         # Link profile overrides (MB/s). Unset = probe once per process.
         "delta.tpu.link.uploadMBps": None,
         "delta.tpu.link.downloadMBps": None,

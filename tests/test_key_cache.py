@@ -109,10 +109,14 @@ def test_tail_advance_append_and_remove(tmp_table):
 
 def test_dv_deleted_rows_do_not_match(tmp_table):
     """A row logically deleted via deletion vector must not count as a
-    match — else its key's NOT MATCHED insert would be skipped."""
+    match — else its key's NOT MATCHED insert would be skipped. (The table
+    property must be on BEFORE the entry builds: a rewrite-path delete
+    would instead bump the key-cache epoch and force a rebuild.)"""
+    from delta_tpu.commands.alter import set_table_properties
     from delta_tpu.commands.delete import DeleteCommand
 
     log = _mk_table(tmp_table)
+    set_table_properties(log, {"delta.tpu.enableDeletionVectors": "true"})
     e = _entry(log)
     with conf.set_temporarily(**{"delta.tpu.deletionVectors.enabled": True}):
         DeleteCommand(log, "k = 42").run()
@@ -445,9 +449,11 @@ def test_batched_advance_append_plus_dv_same_file(tmp_table):
     """A file appended AND DV-masked within one tail batch: the flush must
     apply the row scatter before the kills (append captures pre-DV
     validity)."""
+    from delta_tpu.commands.alter import set_table_properties
     from delta_tpu.commands.delete import DeleteCommand
 
     log = _mk_table(tmp_table, files=1)
+    set_table_properties(log, {"delta.tpu.enableDeletionVectors": "true"})
     e1 = _entry(log)
     e1.ensure_resident()
     # in one tail window: append a file, then DV-delete some of its rows
@@ -460,6 +466,74 @@ def test_batched_advance_append_plus_dv_same_file(tmp_table):
     res = e2.probe_async(np.array([1010, 1011], np.int64),
                          np.array([True, True])).result()
     assert res.s_matched.tolist() == [False, True]
+
+
+# -- rewrite invalidation (epoch bump) --------------------------------------
+
+
+def test_optimize_bumps_epoch_and_drops_entry(tmp_table):
+    """OPTIMIZE rewrites files: the resident entry must be dropped (never
+    advanced-through or served) and the table's epoch must move."""
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = _mk_table(tmp_table)
+    e = _entry(log)
+    assert e is not None
+    kc = KeyCache.instance()
+    epoch0 = kc.epoch(log.log_path)
+    OptimizeCommand(log, min_file_size=1 << 30).run()
+    assert kc.epoch(log.log_path) == epoch0 + 1
+    assert kc.peek(log.log_path, SIG) is None
+    # a rebuild at the post-rewrite snapshot serves correct members
+    e2 = _entry(log)
+    assert e2 is not e and e2.version == log.update().version
+    res = e2.probe_async(np.array([5, 500], np.int64),
+                         np.ones(2, bool)).result()
+    assert res.s_matched.tolist() == [True, False]
+
+
+def test_stale_entry_cannot_serve_after_rewrite(tmp_table):
+    """Even if a buggy path re-inserts a pre-rewrite entry, the epoch guard
+    refuses to serve it, and version-poisoning fails any in-flight holder's
+    expected-version probe — a stale resident cache can never serve a
+    post-rewrite MERGE."""
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = _mk_table(tmp_table)
+    e = _entry(log)
+    v0 = e.version
+    kc = KeyCache.instance()
+    OptimizeCommand(log, min_file_size=1 << 30).run()
+    # the bump poisoned the dropped entry: in-flight holders fail their guard
+    assert e.probe_async(np.array([5], np.int64), np.array([True]),
+                         expected_version=v0) is None
+    # simulate a buggy re-insert of the stale entry
+    with kc._lock:
+        kc._entries[(log.log_path, SIG)] = e
+    assert kc.get(log.update(), SIG, ["k"], list(KEY_EXPRS),
+                  build_if_missing=False) is None
+
+
+def test_update_rewrite_bumps_epoch_dv_mark_does_not(tmp_table):
+    """UPDATE in rewrite mode invalidates; UPDATE in DV mode advances the
+    entry incrementally (the CDC steady state must not lose residency)."""
+    from delta_tpu.commands.alter import set_table_properties
+    from delta_tpu.commands.update import UpdateCommand
+
+    log = _mk_table(tmp_table)
+    kc = KeyCache.instance()
+    epoch0 = kc.epoch(log.log_path)
+    # rewrite mode (no DV property): epoch bumps
+    UpdateCommand(log, {"v": "0.5"}, "k = 10").run()
+    assert kc.epoch(log.log_path) == epoch0 + 1
+    # DV mode: no bump, existing entry advances in place
+    set_table_properties(log, {"delta.tpu.enableDeletionVectors": "true"})
+    e = _entry(log)
+    with conf.set_temporarily(**{"delta.tpu.deletionVectors.enabled": True}):
+        UpdateCommand(log, {"v": "0.7"}, "k = 11").run()
+    assert kc.epoch(log.log_path) == epoch0 + 1
+    e2 = _entry(log)
+    assert e2 is e and e2.version == log.update().version
 
 
 def test_concurrent_resident_merges_chaos(tmp_path):

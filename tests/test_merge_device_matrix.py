@@ -1,0 +1,297 @@
+"""Device/host MERGE result-identity matrix (ISSUE 6 satellite).
+
+The fused device path — both residency variants: the cold slab pipeline
+(`MergeIntoCommand._launch_slab_pipeline` + `ops/key_cache.SlabBuilder`)
+and the HBM cache hit (`ops/key_cache.KeyCache`) — must be row-identical
+to the host Arrow hash join across the semantic corners: matched /
+not-matched / insert-only / multi-match error / NULL-key sentinels /
+composite packed keys, deletion vectors included. Every scenario runs the
+same merge on two copies of a seeded table, fused-forced vs host-pinned,
+and compares the full sorted row sets.
+"""
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu import DeltaLog
+from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.expr import ir
+from delta_tpu.ops.key_cache import KeyCache
+from delta_tpu.utils.config import conf
+from delta_tpu.utils.errors import DeltaUnsupportedOperationError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    KeyCache.reset()
+    yield
+    KeyCache.reset()
+
+
+@pytest.fixture(params=["cold", "hit"])
+def fused(request):
+    """Which fused-device residency variant the scenario forces: 'cold'
+    (no cached entry — the slab pipeline builds + registers inline) or
+    'hit' (the key lane is pre-built, the merge probes the cache)."""
+    return request.param
+
+
+UP = MergeClause("update", assignments=None)
+INS = MergeClause("insert", assignments=None)
+DEL = MergeClause("delete")
+ALIAS = dict(source_alias="s", target_alias="t")
+
+
+def _seed_table(path, *, composite=False, with_null_target=False, files=3):
+    """Multi-file target with negative + positive int64 keys and payload
+    columns; optionally a second key component / NULL target keys."""
+    log = DeltaLog.for_table(str(path))
+    rng = np.random.RandomState(11)
+    per = 40
+    for i in range(files):
+        lo = -40 + i * per
+        keys = np.arange(lo, lo + per, dtype=np.int64)
+        k = pa.array(keys)
+        if with_null_target and i == 1:
+            py = keys.tolist()
+            py[3] = None  # one NULL target key per middle file
+            k = pa.array(py, pa.int64())
+        cols = {
+            "k": k,
+            "v": pa.array(rng.rand(per)),
+            "tag": pa.array([f"r{j}" for j in keys]),
+        }
+        if composite:
+            cols["k2"] = pa.array((keys % 7).astype(np.int64))
+        WriteIntoDelta(log, "append", pa.table(cols)).run()
+    return log
+
+
+def _rows(log, keys=("k",)):
+    from delta_tpu.exec.scan import scan_to_table
+
+    t = scan_to_table(log.update())
+    return sorted(t.to_pylist(), key=lambda r: tuple(
+        (r[c] is None, r[c]) for c in list(keys) + ["tag", "v"]))
+
+
+def _run(log, source, cond, matched, not_matched, mode):
+    with conf.set_temporarily(**{
+        "delta.tpu.merge.devicePath.mode": mode,
+        "delta.tpu.deletionVectors.enabled": True,
+        "delta.tpu.merge.keyCache.enabled": mode != "off",
+    }):
+        cmd = MergeIntoCommand(log, source, cond, matched, not_matched,
+                               **ALIAS)
+        cmd.run()
+    return cmd
+
+
+def _prebuild(log, cond, target_cols, source_cols):
+    """Build the table's resident key lane using the merge's own resolved
+    key signature (what the background build would have produced)."""
+    probe = MergeIntoCommand(log, pa.table({c: pa.array([], pa.int64())
+                                            for c in source_cols}),
+                             cond, [UP], [INS], **ALIAS)
+    resolved = probe._resolve(probe.condition, target_cols, source_cols)
+    equi, _ = probe._split_equi_keys(resolved)
+    t_exprs = [t for t, _ in equi]
+    sig = MergeIntoCommand._key_signature(t_exprs)
+    key_cols = [c for c in target_cols
+                if c.lower() in {r.lower() for t, _ in equi
+                                 for r in ir.references(t)}]
+    e = KeyCache.instance().get(log.update(), sig, key_cols, t_exprs)
+    assert e is not None
+    return e
+
+
+def _identity_case(tmp_path, fused, source, cond, matched, not_matched,
+                   *, composite=False, with_null_target=False,
+                   expect_path=None, keys=("k",)):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    log_a = _seed_table(a, composite=composite,
+                        with_null_target=with_null_target)
+    shutil.copytree(a, b)
+    log_b = DeltaLog.for_table(b)
+    tcols = [f.name for f in log_a.update().metadata.schema.fields]
+    scols = source.column_names
+    if fused == "hit":
+        _prebuild(log_a, cond, tcols, scols)
+    cmd_a = _run(log_a, source, cond, matched, not_matched, "force")
+    cmd_b = _run(log_b, source, cond, matched, not_matched, "off")
+    assert cmd_a._device_join is not None, "fused path did not engage"
+    assert cmd_a._join_path == (
+        expect_path or ("resident" if fused == "hit" else "device-cold"))
+    assert cmd_b._device_join is None
+    for k in sorted(set(cmd_a.metrics) & set(cmd_b.metrics)):
+        if k.endswith("TimeMs"):
+            continue  # wall-clock differs by construction
+        assert cmd_a.metrics[k] == cmd_b.metrics[k], k
+    assert _rows(log_a, keys) == _rows(log_b, keys)
+    return cmd_a, cmd_b
+
+
+# -- the matrix -------------------------------------------------------------
+
+
+def _upsert_source():
+    rng = np.random.RandomState(3)
+    keys = np.concatenate([
+        np.arange(-10, 20, 3, dtype=np.int64),        # hits incl. negatives
+        np.arange(500, 520, dtype=np.int64),          # misses -> inserts
+    ])
+    return pa.table({
+        "k": pa.array(keys),
+        "v": pa.array(rng.rand(len(keys))),
+        "tag": pa.array([f"s{i}" for i in range(len(keys))]),
+    })
+
+
+def test_matched_and_not_matched_upsert(tmp_path, fused):
+    """The headline shape: star upsert, hits + misses, DV mode."""
+    cmd_a, _ = _identity_case(
+        tmp_path, fused, _upsert_source(), "t.k = s.k", [UP], [INS])
+    assert cmd_a.metrics["numTargetRowsUpdated"] == 10
+    assert cmd_a.metrics["numTargetRowsInserted"] == 20
+
+
+def test_matched_only_with_clause_conditions(tmp_path, fused):
+    """UPDATE/DELETE with conditions referencing both sides; no inserts."""
+    src = _upsert_source()
+    _identity_case(
+        tmp_path, fused, src, "t.k = s.k",
+        [MergeClause("update", condition="s.v >= 0.5", assignments=None),
+         MergeClause("delete")],
+        [])
+
+
+def test_insert_only_duplicate_sources(tmp_path, fused):
+    """Insert-only fast path: duplicate source keys are legal (left-anti),
+    and the fused probe fetches only the head (no pair download)."""
+    keys = np.array([5, 5, 700, 700, 701, -3], np.int64)
+    src = pa.table({
+        "k": pa.array(keys),
+        "v": pa.array(np.linspace(0, 1, len(keys))),
+        "tag": pa.array([f"d{i}" for i in range(len(keys))]),
+    })
+    cmd_a, _ = _identity_case(
+        tmp_path, fused, src, "t.k = s.k", [], [INS])
+    # 5 and -3 exist; one insert per miss ROW (700, 700, 701)
+    assert cmd_a.metrics["numTargetRowsInserted"] == 3
+
+
+def test_null_source_and_target_keys_sentinel(tmp_path, fused):
+    """SQL NULL semantics under sentinel encoding: NULL source keys never
+    match (they insert), NULL target keys never match (they stay)."""
+    src = pa.table({
+        "k": pa.array([7, None, None, 900], pa.int64()),
+        "v": pa.array([0.1, 0.2, 0.3, 0.4]),
+        "tag": pa.array(["n0", "n1", "n2", "n3"]),
+    })
+    cmd_a, _ = _identity_case(
+        tmp_path, fused, src, "t.k = s.k", [UP], [INS],
+        with_null_target=True)
+    assert cmd_a.metrics["numTargetRowsUpdated"] == 1   # only k=7
+    assert cmd_a.metrics["numTargetRowsInserted"] == 3  # 2 NULLs + 900
+
+
+def test_composite_packed_keys(tmp_path, fused):
+    """Two-component equi keys pack into one int64 lane (hi<<32|lo) with
+    negative components; identity incl. per-component NULLs."""
+    keys = np.array([-5, 2, 9, 9, 333], np.int64)
+    src = pa.table({
+        "k": pa.array(keys),
+        "k2": pa.array([(-5) % 7, 2 % 7, 9 % 7, 6, 1], pa.int64()),
+        "v": pa.array(np.linspace(0, 1, len(keys))),
+        "tag": pa.array([f"c{i}" for i in range(len(keys))]),
+    })
+    cmd_a, _ = _identity_case(
+        tmp_path, fused, src, "t.k = s.k AND t.k2 = s.k2", [UP], [INS],
+        composite=True, keys=("k", "k2"))
+    # (9, 6) and (333, 1) miss; (-5), (2), (9 % 7) hit
+    assert cmd_a.metrics["numTargetRowsUpdated"] == 3
+    assert cmd_a.metrics["numTargetRowsInserted"] == 2
+
+
+def test_multi_match_error_parity(tmp_path, fused):
+    """Duplicate source matches for one target row must raise on BOTH
+    executors (reference `MergeIntoCommand.scala:351-365`)."""
+    src = pa.table({
+        "k": pa.array([4, 4], pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+        "tag": pa.array(["m0", "m1"]),
+    })
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    log_a = _seed_table(a)
+    shutil.copytree(a, b)
+    log_b = DeltaLog.for_table(b)
+    if fused == "hit":
+        _prebuild(log_a, "t.k = s.k", ["k", "v", "tag"], src.column_names)
+    with pytest.raises(DeltaUnsupportedOperationError, match="multiple source"):
+        _run(log_a, src, "t.k = s.k", [UP], [INS], "force")
+    with pytest.raises(DeltaUnsupportedOperationError, match="multiple source"):
+        _run(log_b, src, "t.k = s.k", [UP], [INS], "off")
+    # single unconditional DELETE legally multi-matches on both
+    cmd_a = _run(log_a, src, "t.k = s.k", [DEL], [], "force")
+    cmd_b = _run(log_b, src, "t.k = s.k", [DEL], [], "off")
+    assert cmd_a.metrics["numTargetRowsDeleted"] == 1
+    assert cmd_b.metrics["numTargetRowsDeleted"] == 1
+    assert _rows(log_a) == _rows(log_b)
+
+
+def test_second_round_over_deletion_vectors(tmp_path, fused):
+    """Round 2 merges into the DV-carrying files round 1 produced: the cold
+    slab build must scatter DV-filtered decodes into physical layout, the
+    hit path must advance through the DV diff."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    log_a = _seed_table(a)
+    shutil.copytree(a, b)
+    log_b = DeltaLog.for_table(b)
+    if fused == "hit":
+        _prebuild(log_a, "t.k = s.k", ["k", "v", "tag"], ["k", "v", "tag"])
+    src1 = _upsert_source()
+    _run(log_a, src1, "t.k = s.k", [UP], [INS], "force")
+    _run(log_b, src1, "t.k = s.k", [UP], [INS], "off")
+    if fused == "cold":
+        KeyCache.reset()  # round 2 cold-builds over DV'd files
+    src2 = pa.table({
+        "k": pa.array([-10, 2, 505, 999], pa.int64()),
+        "v": pa.array([9.0, 8.0, 7.0, 6.0]),
+        "tag": pa.array(["z0", "z1", "z2", "z3"]),
+    })
+    cmd_a = _run(log_a, src2, "t.k = s.k", [UP], [INS], "force")
+    cmd_b = _run(log_b, src2, "t.k = s.k", [UP], [INS], "off")
+    assert cmd_a._device_join is not None
+    assert cmd_a.metrics["numTargetRowsUpdated"] == 3  # -10, 2, 505
+    assert cmd_a.metrics["numTargetRowsInserted"] == 1
+    assert cmd_b.metrics["numTargetRowsUpdated"] == 3
+    assert _rows(log_a) == _rows(log_b)
+
+
+def test_post_optimize_merge_parity(tmp_path, fused):
+    """ISSUE 6 small-fix regression: OPTIMIZE between merges bumps the
+    key-cache epoch; the next fused merge must rebuild (never probe the
+    pre-rewrite slab) and stay row-identical to the host."""
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    log_a = _seed_table(a)
+    shutil.copytree(a, b)
+    log_b = DeltaLog.for_table(b)
+    _prebuild(log_a, "t.k = s.k", ["k", "v", "tag"], ["k", "v", "tag"])
+    OptimizeCommand(log_a, min_file_size=1 << 30).run()
+    OptimizeCommand(log_b, min_file_size=1 << 30).run()
+    assert KeyCache.instance().peek(log_a.log_path,
+                                    "[\"Column('k')\"]") is None \
+        or not KeyCache.instance()._entries, \
+        "epoch bump must drop the pre-rewrite entry"
+    if fused == "hit":
+        _prebuild(log_a, "t.k = s.k", ["k", "v", "tag"], ["k", "v", "tag"])
+    src = _upsert_source()
+    cmd_a = _run(log_a, src, "t.k = s.k", [UP], [INS], "force")
+    cmd_b = _run(log_b, src, "t.k = s.k", [UP], [INS], "off")
+    assert cmd_a._device_join is not None
+    assert _rows(log_a) == _rows(log_b)

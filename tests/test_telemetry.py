@@ -667,8 +667,9 @@ def test_every_gauge_name_is_cataloged():
 
 def test_obs_counters_are_cataloged():
     """Counters bumped from obs/ and the obs-feed namespaces (maintenance.*,
-    storage.retry.*, faults.*, commit.conflicts/.reconciled) must be
-    registered in obs/metric_names.py COUNTERS."""
+    storage.retry.*, faults.*, merge.device.*, merge.keyCache.*,
+    commit.conflicts/.reconciled) must be registered in
+    obs/metric_names.py COUNTERS."""
     from delta_tpu.obs import metric_names
 
     stray = []
@@ -676,7 +677,8 @@ def test_obs_counters_are_cataloged():
         in_obs = rel.startswith("obs")
         for name in _const_calls(tree, "bump_counter"):
             obs_feed = (name.startswith(("obs.", "maintenance.",
-                                         "storage.retry.", "faults."))
+                                         "storage.retry.", "faults.",
+                                         "merge.device.", "merge.keyCache."))
                         or name in ("commit.conflicts", "commit.reconciled"))
             if (in_obs or obs_feed) and name not in metric_names.COUNTERS:
                 stray.append(f"{rel}: {name}")
