@@ -1335,10 +1335,41 @@ class ConfigDeadline(BaseException):
     (the same reasoning that made PR 5's SimulatedCrash a BaseException)."""
 
 
+def _parse_argv(argv):
+    """(only, compare_path, threshold): positional config selector plus the
+    regression-gate flags (``--compare BENCH_rN.json`` diffs this run
+    against a prior round via tools/bench_diff and exits non-zero on
+    regression past ``--compare-threshold`` percent)."""
+    only = compare = None
+    threshold = 20.0
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == "--compare":
+            if not args:
+                sys.exit("bench.py: --compare requires a BENCH_*.json path")
+            compare = args.pop(0)
+        elif a == "--compare-threshold":
+            if not args:
+                sys.exit("bench.py: --compare-threshold requires a percent")
+            try:
+                threshold = float(args.pop(0))
+            except ValueError:
+                sys.exit("bench.py: --compare-threshold must be numeric")
+        elif a.startswith("-"):
+            # a typo'd gate flag must NOT fall through to the config
+            # selector — it would match no config, run nothing, and pass
+            # the regression gate vacuously
+            sys.exit(f"bench.py: unknown flag {a!r}")
+        else:
+            only = a
+    return only, compare, threshold
+
+
 def main():
     import signal
 
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    only, compare_path, compare_threshold = _parse_argv(sys.argv[1:])
     workdir = tempfile.mkdtemp(prefix="delta_tpu_bench_")
     # priority order: the headline and the device-win configs land first,
     # so a driver-side timeout still records the story; the long auxiliary
@@ -1379,6 +1410,19 @@ def main():
     default_deadline = float(os.environ.get("BENCH_CONFIG_DEADLINE_S", "480"))
     per_config_deadline = {"2": 900.0, "2x": 540.0, "8": 600.0}
     t_start = time.perf_counter()
+    # deadline forensics: configs run with the flight recorder armed, so a
+    # SIGALRM unwinding through the open span stack leaves an incident file
+    # (spans + counters at the moment of the breach) — a timed-out config
+    # is a diagnosable artifact, not just `"skipped": true` in the JSON
+    from delta_tpu.obs import flight_recorder
+    from delta_tpu.utils.config import conf as _conf
+
+    flight_recorder.install()
+    incident_dir = os.environ.get(
+        "BENCH_INCIDENT_DIR",
+        str(_conf.get("delta.tpu.obs.incidentDir")
+            or os.path.join(os.getcwd(), "bench_incidents")),
+    )
     def run_with_telemetry(fn):
         """Per-config isolation: reset the registry, run, attach a compact
         internal-metrics snapshot (top counters + phase-histogram summaries)
@@ -1391,10 +1435,13 @@ def main():
         try:
             if isinstance(out, dict):
                 # skip-rate counters always ride along: BENCH rounds track
-                # row-group pruning effectiveness next to latency
+                # row-group pruning effectiveness next to latency; router
+                # audit + device-memory gauges carry the new cost-model
+                # ledger per round
                 out["telemetry"] = telemetry.bench_snapshot(
                     include=("scan.rowgroups", "scan.bytes.skipped",
-                             "footerCache", "table.health"),
+                             "footerCache", "table.health", "router",
+                             "device.hbm"),
                 )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass
@@ -1422,15 +1469,40 @@ def main():
             t_cfg = time.perf_counter()
             signal.alarm(max(int(deadline), 1))
             try:
-                results[k] = run_with_telemetry(fn)
-            except ConfigDeadline:
-                results[k] = {
-                    "metric": f"config_{k}", "value": -1, "unit": "skipped",
-                    "vs_baseline": 0,
-                    "note": f"skipped: per-config deadline {deadline:.0f}s "
-                            f"breached after "
-                            f"{time.perf_counter() - t_cfg:.0f}s",
-                }
+                with _conf.set_temporarily(
+                    **{"delta.tpu.obs.incidentDir": incident_dir}
+                ):
+                    try:
+                        results[k] = run_with_telemetry(fn)
+                    except ConfigDeadline as dexc:
+                        # the alarm unwound through the config's open spans
+                        # with the recorder armed: an incident file already
+                        # exists (fullest stack, deduped on the exception);
+                        # a deadline outside any span records one here
+                        inc = None
+                        if not getattr(dexc, "_delta_incident_recorded",
+                                       False):
+                            from delta_tpu.utils.telemetry import UsageEvent
+
+                            ev = UsageEvent(
+                                f"bench.config.{k}.deadline",
+                                int(time.time() * 1000),
+                                tags={"config": k},
+                                data={"deadlineS": deadline},
+                            )
+                            inc = flight_recorder.record_incident(ev, dexc)
+                        else:
+                            files = flight_recorder.incident_files(
+                                incident_dir)
+                            inc = files[-1] if files else None
+                        results[k] = {
+                            "metric": f"config_{k}", "value": -1,
+                            "unit": "skipped", "vs_baseline": 0,
+                            "note": f"skipped: per-config deadline "
+                                    f"{deadline:.0f}s breached after "
+                                    f"{time.perf_counter() - t_cfg:.0f}s",
+                            "incident": inc,
+                        }
             except Exception as e:  # record-and-continue: rc stays 0 and
                 # every other config's artifact is still driver-captured
                 results[k] = {
@@ -1445,6 +1517,23 @@ def main():
         shutil.rmtree(workdir, ignore_errors=True)
     emitted["done"] = True
     _emit(results)
+    if compare_path:
+        # mechanical regression gate (satellite): diff this run against a
+        # prior round's JSON and fail the process on regression, so perf
+        # claims in PRs are checkable instead of prose
+        import json as _json
+
+        from tools.bench_diff import compare
+
+        with open(compare_path, encoding="utf-8") as f:
+            prior = _json.load(f)
+        regressions = compare(results, prior, compare_threshold)
+        for r in regressions:
+            print(f"REGRESSION: {r.describe()}", file=sys.stderr)
+        if regressions:
+            sys.exit(3)
+        print(f"bench gate OK vs {compare_path} "
+              f"(threshold {compare_threshold:g}%)", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -330,10 +330,23 @@ class MergeIntoCommand:
             return self.delta_log.with_new_transaction(self._body)
 
     def _body(self, txn) -> int:
+        # self-calibrating cost model: install any persisted constant
+        # overrides BEFORE routing, so a fresh process routes with what the
+        # last one learned (no-op unless router.calibration.enabled)
+        from delta_tpu.obs import calibration
+
+        calibration.apply_state(self.delta_log.log_path)
         # reset per-execution state: a re-run that takes the host or empty
         # path must not consume a previous run's device-join flags
         self._device_join = None
         self._resident_candidate = None
+        # (target rows, source rows) the join actually saw — the router
+        # audit's workload sizes (obs/router_audit); slab rows when a
+        # device probe ran (the probe's real n is the slab, not the
+        # possibly-pruned decode)
+        self._audit_units = None
+        self._audit_eligible = False
+        self._audit_slab_rows = None
         # 'resident' (HBM cache hit) | 'device-cold' (fused slab build) |
         # 'device-upload' (mesh all-gather kernel) | 'host'
         self._join_path = "host"
@@ -571,6 +584,11 @@ class MergeIntoCommand:
             and src.num_rows > 0
         )
         device_eligible = base_eligible
+        # audit: whether a device route even existed for this condition
+        # shape — a structurally host-only merge is audited without a
+        # device alternative (no hindsight miss against a route that
+        # could not have run)
+        self._audit_eligible = base_eligible
         if device_eligible and mode == "auto":
             # pre-decode routing check from AddFile stats row counts: on a
             # slow link even the *optimistic* plan (int32 keys) loses to the
@@ -596,15 +614,15 @@ class MergeIntoCommand:
                         kernel_rows=rows,
                         shards=len(jax.devices()),
                     ).device_s
-                if device_s > rows * link.HOST_JOIN_S_PER_ROW:
+                host_est_s = rows * link.constant("HOST_JOIN_S_PER_ROW")
+                self._router.setdefault("deviceEstS", round(device_s, 3))
+                self._router.setdefault("hostEstS", round(host_est_s, 3))
+                if device_s > host_est_s:
                     device_eligible = False
                     from delta_tpu.utils.telemetry import bump_counter
 
                     bump_counter("merge.device.declined")
-                    self._router.update(
-                        reason="cold-estimate", deviceEstS=round(device_s, 3),
-                        hostEstS=round(rows * link.HOST_JOIN_S_PER_ROW, 3),
-                    )
+                    self._router.update(reason="cold-estimate")
 
         # DV-mode matched clauses mark physical rows deleted — every scan
         # that can end up as the phase-2 tables must carry positions
@@ -721,6 +739,7 @@ class MergeIntoCommand:
             target = empty
         else:
             target = pa.concat_tables(pieces, promote_options="permissive")
+        self._audit_units = (target.num_rows, src.num_rows)
 
         def empty_pairs() -> pa.Table:
             # empty pair table with the full combined (target + source) schema
@@ -928,15 +947,15 @@ class MergeIntoCommand:
                 # the device copy was evicted / regrown: the probe would
                 # synchronously re-ship the whole slab first — charge it
                 device_s += p.upload_s(entry.capacity * 9)
-            host_s = ((n + m) * link.HOST_JOIN_S_PER_ROW
-                      + n * link.HOST_KEY_DECODE_S_PER_ROW)
+            host_s = ((n + m) * link.constant("HOST_JOIN_S_PER_ROW")
+                      + n * link.constant("HOST_KEY_DECODE_S_PER_ROW"))
+            self._router["deviceEstS"] = round(device_s, 3)
+            self._router["hostEstS"] = round(host_s, 3)
             if device_s > host_s:
                 from delta_tpu.utils.telemetry import bump_counter
 
                 bump_counter("merge.device.declined")
-                self._router.update(
-                    reason="resident-estimate", deviceEstS=round(device_s, 3),
-                    hostEstS=round(host_s, 3))
+                self._router.update(reason="resident-estimate")
                 return None
         probe = entry.probe_async(
             s_keys, s_ok, expected_version=txn.snapshot.version,
@@ -944,6 +963,7 @@ class MergeIntoCommand:
         )
         if probe is None:
             return None
+        self._audit_slab_rows = entry.num_rows
         return entry, probe, s_keys, s_ok
 
     def _launch_slab_pipeline(self, txn, candidates, src, equi, target_cols,
@@ -996,21 +1016,33 @@ class MergeIntoCommand:
         def on_ready(i, add, tab):
             q.put((add, tab))
 
+        # carry the MERGE span chain into the uploader thread so each slab
+        # upload shows as a `delta.merge.slabUpload` span on its own trace
+        # lane under `delta.dml.merge` — the decode/upload overlap the
+        # router assumes, finally visible in export_chrome_trace
+        from delta_tpu.utils import telemetry
+
+        upload_ctx = telemetry.span_context()
+
         def uploader():
             # device dispatches are async: this thread mostly queues
             # transfers, which the transfer engine overlaps with the
             # decode pool still running on the other files
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                add, tab = item
-                try:
-                    pos = tab.column(POSITION_COL).to_numpy(
-                        zero_copy_only=False)
-                    builder.add_file(add, tab, pos)
-                except Exception:
-                    builder.failed = builder.failed or "slab append failed"
+            with telemetry.adopt_span_context(upload_ctx):
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    add, tab = item
+                    try:
+                        with telemetry.record_operation(
+                                "delta.merge.slabUpload",
+                                {"file": add.path, "rows": tab.num_rows}):
+                            pos = tab.column(POSITION_COL).to_numpy(
+                                zero_copy_only=False)
+                            builder.add_file(add, tab, pos)
+                    except Exception:
+                        builder.failed = builder.failed or "slab append failed"
 
         th = threading_mod.Thread(target=uploader, daemon=True,
                                   name="merge-slab-upload")
@@ -1044,6 +1076,7 @@ class MergeIntoCommand:
         if probe is None:
             self._router.setdefault("reason", "no-sentinel-room")
             return None, key_pieces
+        self._audit_slab_rows = entry.num_rows
         return (entry, probe, s_keys, s_ok), key_pieces
 
     def _finalize_resident(self, resident, candidates, tgt_tables, target,
@@ -1099,7 +1132,8 @@ class MergeIntoCommand:
         """One `delta.merge.router` event per MERGE — the production-table
         observable behind the bench's `auto_used_device` field — plus the
         `merge.device.*` counters the /metrics endpoint and flight recorder
-        surface."""
+        surface, and the router AUDIT record pricing the decision against
+        the measured phase durations (obs/router_audit)."""
         from delta_tpu.utils.telemetry import bump_counter, record_event
 
         decision = self._join_path
@@ -1116,6 +1150,84 @@ class MergeIntoCommand:
         record_event(
             "delta.merge.router", data,
             path=self.delta_log.data_path,
+        )
+        self._emit_audit(decision)
+
+    def _emit_audit(self, decision: str) -> None:
+        """Record the routed join in the audit ledger: predicted phase
+        costs (through ``link.constant``, so calibration feeds back into
+        what is being judged) vs the measured ``key_decode + join`` wall
+        time — plus the attributable throughput samples the EWMA calibrator
+        refits from. Empty joins (no candidates / empty source) have no
+        measured join phase and are not audited."""
+        if "join_ms" not in self.phase_ms or self._audit_units is None:
+            return
+        if not conf.get_bool("delta.tpu.telemetry.enabled", True):
+            return  # blackout: no audit, and no link probe just to price one
+        from delta_tpu.obs import router_audit
+        from delta_tpu.parallel import link
+
+        n, m = self._audit_units
+        # the device probe's real workload is the SLAB, not the (possibly
+        # row-group-pruned / DV-filtered) decode — audit and calibrate the
+        # prediction the router actually made
+        n_dev = (self._audit_slab_rows
+                 if self._audit_slab_rows is not None else n)
+        actual_s = (self.phase_ms.get("key_decode_ms", 0.0)
+                    + self.phase_ms["join_ms"]) / 1000.0
+        key_decode_s = self.phase_ms.get("key_decode_ms", 0.0) / 1000.0
+        join_s = self.phase_ms["join_ms"] / 1000.0
+        # host prediction needs only the throughput constants; the device
+        # prediction (and its link.profile() probe) is computed ONLY when a
+        # device route structurally existed — a devicePath-off deployment
+        # never pays the probe just to price a route it cannot take
+        predicted_map = {
+            "host": ((n + m) * link.constant("HOST_JOIN_S_PER_ROW")
+                     + n * link.constant("HOST_KEY_DECODE_S_PER_ROW")),
+        }
+        # key the device prediction under the route actually taken (or the
+        # generic "device" when the host won), so a miss reads as "the
+        # rejected ROUTE's prediction beat what ran"
+        device_key = "device" if decision == "host" else decision
+        if self._audit_eligible:
+            # the router may have recorded the estimate it ACTUALLY compared
+            # (resident-hit economics, cold price, or the mesh estimator) —
+            # a hindsight miss must judge that prediction, not a recomputed
+            # one from a different cost model (e.g. a warm-cache decline
+            # re-priced as a cold slab build could never read as a miss)
+            recorded = self._router.get("deviceEstS")
+            if recorded is not None:
+                predicted_map[device_key] = float(recorded)
+            else:
+                try:
+                    p = link.profile()
+                    predicted_map[device_key] = (
+                        link.resident_probe_device_s(n_dev, m, p)
+                        if decision == "resident"
+                        else link.cold_merge_device_s(n_dev, m, p))
+                except Exception:  # noqa: BLE001 — pricing must not fail DML
+                    pass
+        # throughput samples for the calibrator — only cleanly attributable
+        # phases: the host join/decode rates, and the resident probe's
+        # EFFECTIVE per-row rate (fixed dispatch floor subtracted; link
+        # terms folded in, which self-corrects the same prediction above)
+        samples = []
+        if decision == "host":
+            if join_s > 0 and (n + m) > 0:
+                samples.append(("HOST_JOIN_S_PER_ROW", n + m, join_s))
+            if key_decode_s > 0 and n > 0:
+                samples.append(("HOST_KEY_DECODE_S_PER_ROW", n, key_decode_s))
+        elif decision == "resident" and (n_dev + m) > 0:
+            eff = join_s + key_decode_s - link.RESIDENT_PROBE_FIXED_S
+            if eff > 0:
+                samples.append(("RESIDENT_PROBE_S_PER_ROW", n_dev + m, eff))
+        router_audit.record_audit(
+            "merge.join", self.delta_log.data_path, decision,
+            predicted_map,
+            actual_s,
+            units={"targetRows": n, "sourceRows": m, "slabRows": n_dev},
+            samples=samples, log_path=self.delta_log.log_path,
+            phases={k: round(v, 1) for k, v in self.phase_ms.items()},
         )
 
     def _maybe_build_resident_keys(self) -> None:
@@ -1214,7 +1326,8 @@ class MergeIntoCommand:
         if str(conf.get("delta.tpu.merge.devicePath.mode", "auto")) == "auto":
             from delta_tpu.parallel import link
 
-            budget_s = (len(t_keys) + len(s_keys)) * link.HOST_JOIN_S_PER_ROW
+            budget_s = (len(t_keys) + len(s_keys)) \
+                * link.constant("HOST_JOIN_S_PER_ROW")
         mesh = state_mesh() if len(jax.devices()) > 1 else None
         return join_kernel.inner_join_async(
             t_keys, t_ok, s_keys, s_ok, mesh=mesh, budget_s=budget_s

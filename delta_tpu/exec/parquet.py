@@ -208,6 +208,13 @@ def read_parquet_files(
         return [read_one(p) for p in abs_paths]
     from concurrent.futures import ThreadPoolExecutor
 
+    from delta_tpu.utils import telemetry
+
     workers = min(len(abs_paths), os.cpu_count() or 4)
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(read_one, abs_paths))
+    # propagate the caller's span context into the pool: any span or event
+    # a decode emits parents under the calling operation instead of
+    # starting an orphan trace root in the worker thread
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="delta-parquet-read"
+    ) as pool:
+        return list(pool.map(telemetry.propagated(read_one), abs_paths))

@@ -390,17 +390,30 @@ def read_files_as_table(
         )
     jobs = [(i, add, hint) for i, (add, hint) in enumerate(
         zip(files, pos_hints if pos_hints else [None] * len(files)))]
+    def decode_one(job):
+        # one span per file decode: with the span context propagated into
+        # the pool workers these parent under `delta.scan.read` (and the
+        # enclosing command span) on each worker's own trace lane — the
+        # decode half of the decode/compute overlap, visible in
+        # export_chrome_trace instead of orphaned
+        with telemetry.record_operation(
+            "delta.scan.decode", {"file": job[1].path}
+        ):
+            return read_one(job)
+
     with telemetry.record_operation(
         "delta.scan.read", {"numFiles": len(files)}
     ) as rev:
         if len(jobs) == 1:
-            pieces = [read_one(jobs[0])]
+            pieces = [decode_one(jobs[0])]
         else:
             from concurrent.futures import ThreadPoolExecutor
 
             workers = min(len(jobs), os.cpu_count() or 4)
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                pieces = list(pool.map(read_one, jobs))
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="delta-scan-decode"
+            ) as pool:
+                pieces = list(pool.map(telemetry.propagated(decode_one), jobs))
         if rg_stats:
             rg_total = sum(s[0] for s in rg_stats)
             rg_pruned = sum(s[1] for s in rg_stats)

@@ -245,6 +245,11 @@ def write_files(
         return [write_one(j) for j in jobs]
     from concurrent.futures import ThreadPoolExecutor
 
+    from delta_tpu.utils import telemetry
+
     workers = min(len(jobs), os.cpu_count() or 4)
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(write_one, jobs))
+    # span-context propagation: per-file write counters/events parent under
+    # the enclosing command span instead of orphan worker roots
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="delta-parquet-write") as pool:
+        return list(pool.map(telemetry.propagated(write_one), jobs))

@@ -114,9 +114,18 @@ def _run_all_parts(n: int, write_part) -> None:
     subset of parts on disk. Deterministic all-or-each-tried behavior
     matters for crash consistency: what a failed multi-part checkpoint
     leaves behind must not depend on thread scheduling (and one slow part's
-    transient error shouldn't silently cancel its siblings mid-write)."""
-    with ThreadPoolExecutor(max_workers=min(n, 16)) as ex:
-        futures = [ex.submit(write_part, i) for i in range(n)]
+    transient error shouldn't silently cancel its siblings mid-write).
+
+    Part writers run under the submitting context's span chain
+    (`telemetry.propagated`): their IO spans/events parent under the
+    enclosing ``delta.checkpoint`` span on per-worker trace lanes instead
+    of orphan roots."""
+    from delta_tpu.utils import telemetry
+
+    with ThreadPoolExecutor(max_workers=min(n, 16),
+                            thread_name_prefix="delta-ckpt-part") as ex:
+        wrapped = telemetry.propagated(write_part)
+        futures = [ex.submit(wrapped, i) for i in range(n)]
         errors_ = [f.exception() for f in futures]  # waits for every part
     failed = [e for e in errors_ if e is not None]
     for e in failed:

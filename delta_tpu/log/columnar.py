@@ -391,8 +391,13 @@ def decode_checkpoint_parts(store: LogStore, paths: Sequence[str]) -> List[pa.Ta
         return [_one(p) for p in paths]
     from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=min(len(paths), 16)) as ex:
-        return list(ex.map(_one, paths))
+    from delta_tpu.utils import telemetry
+
+    with ThreadPoolExecutor(max_workers=min(len(paths), 16),
+                            thread_name_prefix="delta-ckpt-decode") as ex:
+        # span-context propagation: the store-read counters/events these
+        # workers emit parent under the enclosing snapshot/checkpoint span
+        return list(ex.map(telemetry.propagated(_one), paths))
 
 
 def decode_json_commits(

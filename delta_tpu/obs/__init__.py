@@ -6,6 +6,9 @@
 * :mod:`delta_tpu.obs.server` — ``/metrics`` ``/healthz`` ``/events``
   ``/trace`` ``/doctor`` HTTP endpoint (opt-in)
 * :mod:`delta_tpu.obs.flight_recorder` — incident files on operation failure
+* :mod:`delta_tpu.obs.router_audit` — routed decisions priced vs measured
+* :mod:`delta_tpu.obs.calibration` — EWMA re-fit of the link cost constants
+* :mod:`delta_tpu.obs.hbm_ledger` — device-memory accounting + soft budget
 * :mod:`delta_tpu.obs.metric_names` — the single catalog of metric names
 
 Importing this package installs the (inert-until-configured) flight-recorder

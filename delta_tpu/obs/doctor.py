@@ -267,6 +267,42 @@ def _dim_tombstones(snapshot, live_bytes: int) -> HealthDimension:
     )
 
 
+def _dim_device() -> HealthDimension:
+    """Device residency pressure (8th dimension): the process-wide HBM
+    ledger (`obs/hbm_ledger`) against the ``delta.tpu.device.hbmBudgetBytes``
+    soft budget. Process-wide by nature — the caches are shared across
+    tables — but reported per doctor call so the operator diagnosing THIS
+    table sees what device memory its merges/scans compete with. Remedy
+    EVICT: shrink the budgets (``delta.tpu.keyCache.maxBytes`` /
+    ``delta.tpu.stateCache.maxBytes``) or disable the key cache
+    (``delta.tpu.merge.keyCache.enabled=false``); `hbm_ledger.maybe_relieve`
+    applies the LRU pressure immediately."""
+    from delta_tpu.obs import hbm_ledger
+
+    t = hbm_ledger.totals()
+    budget = hbm_ledger.budget_bytes()
+    used = t["total"]
+    pressure = (used / budget) if budget else 0.0
+    sev = "ok"
+    if budget:
+        if used > budget:
+            sev = "critical"
+        elif pressure >= 0.8:
+            sev = "warn"
+    return HealthDimension(
+        "device", sev,
+        {"hbmBytes": used, "keyCacheBytes": t["keyCache"],
+         "stateCacheBytes": t["stateCache"], "scratchBytes": t["scratch"],
+         "budgetBytes": budget or 0, "pressure": round(pressure, 4)},
+        remedy="EVICT" if sev != "ok" else None,
+        detail=f"{used} device bytes resident "
+               f"(keyCache {t['keyCache']}, stateCache {t['stateCache']}, "
+               f"scratch {t['scratch']})"
+               + (f" against a {budget}-byte soft budget" if budget
+                  else "; no delta.tpu.device.hbmBudgetBytes budget set"),
+    )
+
+
 def _dim_protocol(snapshot) -> HealthDimension:
     p = snapshot.protocol
     features = sorted(set(p.reader_features or ()) | set(p.writer_features or ()))
@@ -319,6 +355,7 @@ def doctor(table, snapshot=None, publish_gauges: bool = True) -> TableHealthRepo
             _dim_partition(files, snap.metadata.partition_columns),
             _dim_tombstones(snap, live_bytes),
             _dim_protocol(snap),
+            _dim_device(),
         ]
         severity = max((d.severity for d in dims), key=SEVERITY_RANK.get)
         report = TableHealthReport(

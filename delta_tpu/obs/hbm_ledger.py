@@ -1,0 +1,146 @@
+"""Device-memory ledger — HBM accounting for the engine's resident state.
+
+PR 6 put three kinds of engine state in HBM: the MERGE key-cache slabs
+(`ops/key_cache`), the scan-planning state cache (`ops/state_cache`), and
+transient join scratch (probe source uploads). None of it was measured —
+an operator diagnosing device OOM had no number, and nothing connected the
+two caches' independent byte budgets. This module is the single ledger:
+
+* each component's live device bytes, published as
+  ``device.hbm.{keyCache,stateCache,scratch}Bytes`` gauges (gated on
+  ``delta.tpu.telemetry.enabled``; the internal tallies always run —
+  budget enforcement must survive a telemetry blackout);
+* a process-wide soft budget ``delta.tpu.device.hbmBudgetBytes`` (unset =
+  unlimited).  When set, the KeyCache's LRU eviction prices itself against
+  ``budget - stateCache - scratch`` (:func:`key_cache_allowance`) so growth
+  anywhere turns into eviction *pressure* instead of OOM — soft: a
+  transient slab mid-build may overshoot until it registers;
+* the numbers behind the doctor's 8th dimension ("device residency
+  pressure", `obs/doctor._dim_device`) with its EVICT remedy.
+
+Accounting is delta-based at the residency transitions (device arrays
+built / dropped), so the ledger needs no walk of either cache.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Optional
+
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+__all__ = ["Account", "adjust", "totals", "budget_bytes",
+           "key_cache_allowance", "over_budget", "maybe_relieve", "reset"]
+
+_LOCK = threading.Lock()
+_BYTES: Dict[str, int] = {"keyCache": 0, "stateCache": 0, "scratch": 0}
+
+# gauge names are constants from the obs/metric_names catalog — mapped here
+# so every component publishes through a registered name
+_GAUGE = {
+    "keyCache": "device.hbm.keyCacheBytes",
+    "stateCache": "device.hbm.stateCacheBytes",
+    "scratch": "device.hbm.scratchBytes",
+}
+
+
+def adjust(component: str, delta_bytes: int) -> None:
+    """Add ``delta_bytes`` (may be negative) to a component's ledger entry.
+    Callers are the residency transitions themselves (alloc/upload = +,
+    drop/free = -); the ledger clamps at zero so a double-free can never
+    drive the total negative."""
+    with _LOCK:
+        _BYTES[component] = max(0, _BYTES[component] + int(delta_bytes))
+        value = _BYTES[component]
+    if conf.get_bool("delta.tpu.telemetry.enabled", True):
+        telemetry.set_gauge(_GAUGE[component], value)
+
+
+class Account:
+    """Delta-based residency accounting for ONE device-resident object —
+    the shared pattern behind `ops/key_cache.ResidentJoinKeys` and
+    `ops/state_cache.ResidentState`: idempotent :meth:`on` at the
+    residency transition (with a gc-finalizer backstop, so an object that
+    dies resident still returns its bytes), :meth:`off` at the drop.
+    Callers hold their own entry lock; the ledger lock stays a leaf."""
+
+    __slots__ = ("component", "bytes", "_final")
+
+    def __init__(self, component: str):
+        self.component = component
+        self.bytes = 0
+        self._final = None
+
+    def on(self, owner, nbytes: int) -> None:
+        if self.bytes:
+            return
+        self.bytes = int(nbytes)
+        adjust(self.component, self.bytes)
+        # the callback must not reference `owner` (it would never collect):
+        # module function + captured scalars only
+        self._final = weakref.finalize(owner, adjust, self.component,
+                                       -self.bytes)
+
+    def off(self) -> None:
+        if not self.bytes:
+            return
+        adjust(self.component, -self.bytes)
+        self.bytes = 0
+        if self._final is not None:
+            self._final.detach()
+            self._final = None
+
+
+def totals() -> Dict[str, int]:
+    """Current per-component bytes plus their sum under ``"total"``."""
+    with _LOCK:
+        out = dict(_BYTES)
+    out["total"] = sum(out.values())
+    return out
+
+
+def budget_bytes() -> Optional[int]:
+    """The configured soft budget, or None (unlimited)."""
+    b = conf.get("delta.tpu.device.hbmBudgetBytes")
+    try:
+        return int(b) if b is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def key_cache_allowance() -> Optional[int]:
+    """How many HBM bytes the KeyCache may hold under the soft budget:
+    ``budget - stateCache - scratch`` (floored at 0), or None when no budget
+    is set. `ops/key_cache.KeyCache._evict` takes the min of this and its
+    own ``delta.tpu.keyCache.maxBytes``."""
+    budget = budget_bytes()
+    if budget is None:
+        return None
+    with _LOCK:
+        other = _BYTES["stateCache"] + _BYTES["scratch"]
+    return max(0, budget - other)
+
+
+def over_budget() -> bool:
+    budget = budget_bytes()
+    return budget is not None and totals()["total"] > budget
+
+
+def maybe_relieve() -> bool:
+    """Apply eviction pressure when over the soft budget: run the KeyCache's
+    LRU eviction under the (now tighter) allowance. Returns True when
+    pressure was applied. Never called with cache/entry locks held."""
+    if not over_budget():
+        return False
+    from delta_tpu.ops.key_cache import KeyCache
+
+    KeyCache.instance()._evict(keep=None)
+    return True
+
+
+def reset() -> None:
+    """Zero the ledger (tests; the caches re-account as they re-build)."""
+    with _LOCK:
+        for k in _BYTES:
+            _BYTES[k] = 0

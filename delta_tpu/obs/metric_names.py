@@ -4,16 +4,20 @@ The AST lint in ``tests/test_telemetry.py`` enforces that (a) every string
 constant passed to ``set_gauge`` anywhere in ``delta_tpu/`` appears in
 :data:`GAUGES`, (b) every counter bumped from ``delta_tpu/obs/`` (and the
 maintenance/conflict counters wired for the doctor) appears in
-:data:`COUNTERS`, and (c) each ``obs/`` module's ``__all__`` matches
-:data:`PUBLIC_API` — so dashboards and the doctor never chase stringly-typed
-drift: a renamed gauge fails the suite, not a Grafana panel.
+:data:`COUNTERS`, (c) the INVERSE pass — every constant-string
+``bump_counter`` / ``observe`` call site engine-wide resolves to
+:data:`COUNTERS` ∪ :data:`ENGINE_COUNTERS` / :data:`HISTOGRAMS` — so no
+metric can ship un-cataloged, and (d) each ``obs/`` module's ``__all__``
+matches :data:`PUBLIC_API` — so dashboards and the doctor never chase
+stringly-typed drift: a renamed gauge fails the suite, not a Grafana panel.
 
 ``table.health.*`` gauges are emitted by :func:`delta_tpu.obs.doctor.doctor`
 (labeled by table path) and validated against this catalog at publish time.
 """
 from __future__ import annotations
 
-__all__ = ["GAUGES", "COUNTERS", "PUBLIC_API", "health_gauge"]
+__all__ = ["GAUGES", "COUNTERS", "ENGINE_COUNTERS", "HISTOGRAMS",
+           "PUBLIC_API", "health_gauge"]
 
 #: Every labeled gauge the engine publishes.
 GAUGES = frozenset({
@@ -39,6 +43,20 @@ GAUGES = frozenset({
     "table.health.tombstones.bytes",
     "table.health.protocol.minReader",
     "table.health.protocol.minWriter",
+    # -- doctor: device residency pressure (obs/doctor._dim_device) ------
+    "table.health.device.hbmBytes",
+    "table.health.device.keyCacheBytes",
+    "table.health.device.stateCacheBytes",
+    "table.health.device.scratchBytes",
+    "table.health.device.budgetBytes",
+    "table.health.device.pressure",
+    # -- device-memory ledger (obs/hbm_ledger, process-wide) -------------
+    "device.hbm.keyCacheBytes",
+    "device.hbm.stateCacheBytes",
+    "device.hbm.scratchBytes",
+    # -- router audit + calibration (obs/router_audit, obs/calibration) --
+    "router.missRate",
+    "router.calibration",        # label: constant
     # -- streaming consumer lag (streaming/source.py, label: path) -------
     "streaming.source.backlogFiles",
     "streaming.source.backlogBytes",
@@ -71,6 +89,62 @@ COUNTERS = frozenset({
     "merge.keyCache.builds",      # cold key-lane builds (inline or bg)
     "merge.keyCache.advances",    # incremental log-tail applications
     "merge.keyCache.invalidations",  # entries dropped by a rewrite epoch bump
+    # -- router audit ledger + calibrator (obs/router_audit, obs/calibration)
+    "router.audits",              # one per routed decision recorded
+    "router.misses",              # hindsight: rejected route predicted faster
+    "router.calibration.updates",  # EWMA samples folded into the state
+})
+
+#: Every OTHER counter the engine bumps by constant name — the inverse lint
+#: (tests/test_telemetry.py) fails on any ``bump_counter`` call site whose
+#: name is in neither this set nor :data:`COUNTERS`. Dynamic families
+#: (``logstore.{op}.calls``/``.bytes``) are f-strings and out of lint scope.
+ENGINE_COUNTERS = frozenset({
+    "checkpoint.parts",
+    "checkpoint.actions",
+    "checkpoint.written",
+    "commit.total",
+    "commit.retries",
+    "convert.stats.fromFooter",
+    "convert.stats.fromDecode",
+    "footerCache.hits",
+    "footerCache.misses",
+    "footerCache.evictions",
+    "log.update.installed",
+    "log.update.unchanged",
+    "parquet.files.written",
+    "parquet.bytes.written",
+    "parquet.rows.written",
+    "scan.files.read",
+    "scan.bytes.read",
+    "scan.bytes.skipped",
+    "scan.rowgroups.total",
+    "scan.rowgroups.pruned",
+    "scan.rowgroups.lateSkipped",
+    "stateCache.builds",
+    "stateCache.plan.resident",
+    "stateCache.plan.fallback.lowering",
+    "stateCache.plan.fallback.noentry",
+    "stateCache.plan.fallback.version",
+    "stateCache.scan.resident",
+    "stateCache.scan.fallback.lowering",
+    "stateCache.scan.fallback.noentry",
+    "stateCache.scan.fallback.version",
+    "stateExport.statsLanes.struct",
+    "stateExport.statsLanes.json",
+    "stateExport.statsLanes.mixed",
+    "stateExport.statsLanes.us",
+    "streaming.sink.batches",
+})
+
+#: Every histogram observed by constant name (``telemetry.observe``).
+HISTOGRAMS = frozenset({
+    "delta.checkpoint.duration_ms",
+    "delta.commit.duration_ms",
+    "delta.streaming.sink.batch_ms",
+    "delta.streaming.source.batch_ms",
+    "router.predicted_ms",
+    "router.actual_ms",
 })
 
 #: Public surface of each obs module, lint-matched against its ``__all__``.
@@ -83,7 +157,15 @@ PUBLIC_API = {
     "server": ("ObsServer", "start_server", "stop_server"),
     "flight_recorder": ("install", "uninstall", "record_incident",
                         "incident_files"),
-    "metric_names": ("GAUGES", "COUNTERS", "PUBLIC_API", "health_gauge"),
+    "metric_names": ("GAUGES", "COUNTERS", "ENGINE_COUNTERS", "HISTOGRAMS",
+                     "PUBLIC_API", "health_gauge"),
+    "router_audit": ("RouterAudit", "record_audit", "recent_audits",
+                     "clear_audits", "audit_stats"),
+    "calibration": ("enabled", "ingest", "state_path", "load_state",
+                    "save_state", "apply_state", "current_state", "reset"),
+    "hbm_ledger": ("Account", "adjust", "totals", "budget_bytes",
+                   "key_cache_allowance", "over_budget", "maybe_relieve",
+                   "reset"),
 }
 
 

@@ -13,6 +13,9 @@ Route          Payload
                save and load at https://ui.perfetto.dev
 ``/doctor``    ``?path=/data/tbl`` → the table-health report JSON
                (:func:`delta_tpu.obs.doctor.doctor`)
+``/router``    router audit ledger: miss stats, installed calibration
+               overrides, and the last N audit records (``?limit=N``,
+               default 32) — see :mod:`delta_tpu.obs.router_audit`
 =============  ==============================================================
 
 Nothing listens unless :func:`start_server` is called (port argument or
@@ -83,10 +86,27 @@ class _Handler(BaseHTTPRequestHandler):
                 from delta_tpu.obs.doctor import doctor
 
                 self._json(doctor(path).to_dict())
+            elif route == "/router":
+                from delta_tpu.obs import calibration, router_audit
+                from delta_tpu.parallel import link
+
+                try:
+                    limit = int(q.get("limit", [32])[0])
+                except (TypeError, ValueError):
+                    limit = 32
+                self._json({
+                    "stats": router_audit.audit_stats(),
+                    "calibration": {
+                        "enabled": calibration.enabled(),
+                        "constants": link.calibrated_constants(),
+                        "state": calibration.current_state(),
+                    },
+                    "audits": router_audit.recent_audits(limit),
+                })
             else:
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
-                                       "/trace", "/doctor"]}, 404)
+                                       "/trace", "/doctor", "/router"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

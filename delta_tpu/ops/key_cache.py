@@ -449,6 +449,12 @@ class ResidentJoinKeys:
         self._sort_stale = True
         self._lock = threading.RLock()
         self.last_used = 0.0
+        # device-memory accounting (gc-backstopped so a transient
+        # SlabBuilder slab or popped cache entry that dies resident still
+        # returns its bytes)
+        from delta_tpu.obs.hbm_ledger import Account
+
+        self._hbm = Account("keyCache")
 
     # -- batched device updates ------------------------------------------
     #
@@ -514,8 +520,9 @@ class ResidentJoinKeys:
                 # re-upload the slab — every few commits.
                 from delta_tpu.ops.join_kernel import _bucket
 
-                self.capacity = max(_bucket(int(self.num_rows * 1.25)), 1024)
                 self._dev = None
+                self._hbm.off()  # before capacity changes: bytes were old-cap
+                self.capacity = max(_bucket(int(self.num_rows * 1.25)), 1024)
                 return True
             if self._pending is not None:
                 self._pending["rows"].append(
@@ -603,6 +610,7 @@ class ResidentJoinKeys:
     def drop_device(self) -> None:
         with self._lock:
             self._dev = None
+            self._hbm.off()
 
     def alloc_device(self) -> None:
         """Pre-size the device arrays WITHOUT uploading the host mirrors —
@@ -621,6 +629,7 @@ class ResidentJoinKeys:
                     "valid": jnp.zeros(self.capacity, bool),
                 }
             self._sort_stale = True
+            self._hbm.on(self, self.device_bytes)
 
     def ensure_resident(self) -> None:
         """Ship the mirrors to HBM in bounded tiles (the uploads queue on
@@ -666,6 +675,7 @@ class ResidentJoinKeys:
                 jax.block_until_ready((dk, dv))
             self._dev = {"keys": dk, "valid": dv}
             self._sort_stale = True
+            self._hbm.on(self, self.device_bytes)
 
     def _ensure_sorted(self) -> None:
         """Dispatch the slab sort if the sorted view is stale (caller holds
@@ -827,6 +837,23 @@ class ResidentJoinKeys:
         s_in = np.full(cap_s, s_sent, s_enc.dtype)
         s_in[:m] = s_enc
         state: dict = {}
+        from delta_tpu.obs import hbm_ledger
+        from delta_tpu.utils import telemetry
+
+        # transient probe scratch (the uploaded source lane) in the HBM
+        # ledger while the probe is in flight; released on the staging
+        # thread, which always runs to completion
+        scratch_bytes = int(s_in.nbytes)
+        hbm_ledger.adjust("scratch", scratch_bytes)
+        # scratch growth applies eviction pressure immediately (no cache or
+        # entry lock held at this point; this probe's arrays are pinned in
+        # `dev`, so even self-eviction cannot break the in-flight probe)
+        hbm_ledger.maybe_relieve()
+        # carry the caller's open span chain (the MERGE command span) into
+        # the staging thread: the probe's device pipeline then shows up in
+        # `export_chrome_trace` on its own thread lane, parented under
+        # `delta.dml.merge`, instead of as an orphan root
+        probe_ctx = telemetry.span_context()
 
         def launch():
             # the whole device pipeline runs on this staging thread so every
@@ -834,23 +861,31 @@ class ResidentJoinKeys:
             # overlaps the caller's host-side Parquet decode; finalize only
             # joins the thread and fetches the compacted pairs
             try:
-                with enable_x64():
-                    head_dev, t_match_dev, s_first_dev = _probe_sorted_kernel()(
-                        dev["sorted_keys"], dev["sorted_valid"],
-                        jnp.asarray(np.int32(n)), jax.device_put(s_in),
-                    )
-                    head = np.asarray(head_dev)  # blocks until kernel done
-                    state["head"] = head
-                    _multi, overflow, mc, _s = _decode_head(head, cap_s, m)
-                    if overflow or insert_only or mc == 0:
-                        return
-                    out_cap = _next_pow2(mc, floor=64)
-                    state["pairs_dev"] = _pair_compact_kernel()(
-                        t_match_dev, s_first_dev, dev["perm"], out_cap)
+                with telemetry.adopt_span_context(probe_ctx), \
+                        telemetry.record_operation(
+                            "delta.merge.deviceProbe",
+                            {"slabRows": int(n), "sourceRows": int(m),
+                             "insertOnly": insert_only}):
+                    with enable_x64():
+                        head_dev, t_match_dev, s_first_dev = _probe_sorted_kernel()(
+                            dev["sorted_keys"], dev["sorted_valid"],
+                            jnp.asarray(np.int32(n)), jax.device_put(s_in),
+                        )
+                        head = np.asarray(head_dev)  # blocks until kernel done
+                        state["head"] = head
+                        _multi, overflow, mc, _s = _decode_head(head, cap_s, m)
+                        if overflow or insert_only or mc == 0:
+                            return
+                        out_cap = _next_pow2(mc, floor=64)
+                        state["pairs_dev"] = _pair_compact_kernel()(
+                            t_match_dev, s_first_dev, dev["perm"], out_cap)
             except BaseException as e:
                 state["err"] = e
+            finally:
+                hbm_ledger.adjust("scratch", -scratch_bytes)
 
-        th = threading.Thread(target=launch, daemon=True)
+        th = threading.Thread(target=launch, daemon=True,
+                              name="merge-device-probe")
         th.start()
 
         def finalize() -> PhysicalProbe:
@@ -1095,8 +1130,10 @@ class KeyCache:
     def invalidate(self, log_path: str) -> None:
         with self._lock:
             for k in [k for k in self._entries if k[0] == log_path]:
-                self._entries.pop(k, None)
+                e = self._entries.pop(k, None)
                 self._build_locks.pop(k, None)
+                if e is not None:
+                    e.drop_device()  # return its bytes to the HBM ledger
 
     def epoch(self, log_path: str) -> int:
         with self._lock:
@@ -1118,6 +1155,7 @@ class KeyCache:
                 e = self._entries.pop(k)
                 e.version = _POISON_VERSION
                 self._build_locks.pop(k, None)
+                e.drop_device()  # return its bytes to the HBM ledger
         if stale:
             bump_counter("merge.keyCache.invalidations", len(stale))
 
@@ -1306,6 +1344,14 @@ class KeyCache:
 
     def _evict(self, keep) -> None:
         budget = int(conf.get("delta.tpu.keyCache.maxBytes", 1 << 30))
+        # the process-wide device-memory soft budget (obs/hbm_ledger): the
+        # key cache yields to state-cache lanes and in-flight scratch, so
+        # growth anywhere becomes LRU pressure here instead of OOM
+        from delta_tpu.obs import hbm_ledger
+
+        allowance = hbm_ledger.key_cache_allowance()
+        if allowance is not None:
+            budget = min(budget, allowance)
         with self._lock:
             resident = [(k, e) for k, e in self._entries.items() if e.is_resident]
             total = sum(e.device_bytes for _, e in resident)
@@ -1318,11 +1364,12 @@ class KeyCache:
                 total -= e.device_bytes
             max_entries = int(conf.get("delta.tpu.keyCache.maxEntries", 8))
             if len(self._entries) > max_entries:
-                for k, _e in sorted(self._entries.items(),
-                                    key=lambda kv: kv[1].last_used):
+                for k, e in sorted(self._entries.items(),
+                                   key=lambda kv: kv[1].last_used):
                     if k == keep:
                         continue
                     self._entries.pop(k, None)
                     self._build_locks.pop(k, None)
+                    e.drop_device()  # return its bytes to the HBM ledger
                     if len(self._entries) <= max_entries:
                         break

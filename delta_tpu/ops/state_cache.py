@@ -441,6 +441,10 @@ class ResidentState:
         self._dev = None  # lazily-built device arrays
         self._lock = threading.RLock()
         self.last_used = 0.0
+        # device-memory accounting (obs/hbm_ledger: gc-backstopped)
+        from delta_tpu.obs.hbm_ledger import Account
+
+        self._hbm = Account("stateCache")
 
     # -- device residency -------------------------------------------------
 
@@ -461,6 +465,7 @@ class ResidentState:
             "maxs": jnp.asarray(maxs),
             "alive": jnp.asarray(alive),
         }
+        self._hbm.on(self, self.device_bytes)
 
     @property
     def device_bytes(self) -> int:
@@ -479,6 +484,7 @@ class ResidentState:
     def drop_device(self) -> None:
         with self._lock:
             self._dev = None
+            self._hbm.off()
 
     # -- incremental tail apply ------------------------------------------
 
@@ -639,6 +645,7 @@ class ResidentState:
               else np.asarray(k, np.int64))
         if len(ks) != n:
             raise ValueError(f"per-range k length {len(ks)} != {n} ranges")
+        priced = None
         with self._lock:
             if expected_version is not None and self.version != expected_version:
                 return None
@@ -658,36 +665,85 @@ class ResidentState:
             hi = np.stack([ranges[i].hi for i in real_ix])
             real_ks = ks[real_ix]
             if use_device is None:
-                use_device = self._device_profitable(len(real_ix))
+                use_device, priced = self._route_plan(len(real_ix))
+            import time as _time
+
+            t0 = _time.perf_counter_ns()
             results = (self._plan_device(lo, hi, real_ks) if use_device
                        else self._plan_host(lo, hi, real_ks))
+            plan_s = (_time.perf_counter_ns() - t0) / 1e9
             via = "device" if use_device else "host-resident"
             for j, i in enumerate(real_ix):
                 results[j].via = via
                 out[i] = results[j]
-            return out  # type: ignore[return-value]
+        # router audit OUTSIDE the entry lock: the ledger (and, with
+        # calibration enabled, its state-file read-modify-write) must not
+        # serialize concurrent planners or a tail apply. Only AUTO-routed
+        # batches audit — a pinned mode made no priceable decision (and the
+        # disabled/forced paths never pay the link probe just to price one).
+        if priced is not None:
+            from delta_tpu.obs import router_audit
 
-    def _device_profitable(self, m: int) -> bool:
-        if not conf.get_bool("delta.tpu.stateCache.devicePlan.enabled", True):
-            return False
-        mode = conf.get("delta.tpu.stateCache.devicePlan.mode", "auto")
-        if mode == "force":
-            return True
-        if mode == "off":
-            return False
+            device_s, host_s, cells, device_fixed_s = priced
+            # per-cell calibrator sample with the predictor's FIXED terms
+            # (dispatch latency, bitmap download, cold upload) subtracted
+            # first — the prediction re-adds them, so a sample that folded
+            # them in would double-count the overhead and overpredict the
+            # device forever
+            if use_device:
+                eff = plan_s - device_fixed_s
+                samples = ([("DEVICE_PRUNE_S_PER_CELL", cells, eff)]
+                           if eff > 0 else [])
+            else:
+                samples = [("HOST_PRUNE_S_PER_CELL", cells, plan_s)]
+            router_audit.record_audit(
+                "scan.plan", self.log_path, via,
+                {"device": device_s, "host-resident": host_s}, plan_s,
+                units={"cells": cells, "queries": len(real_ix)},
+                samples=samples, log_path=self.log_path,
+                # once per planned query: the calibrator state-file write
+                # must be interval-throttled, not per-plan
+                calibration_flush=False,
+            )
+        return out  # type: ignore[return-value]
+
+    def _price_plan(self, m: int) -> Tuple[float, float, int, float]:
+        """The router's cost model for planning ``m`` range queries against
+        this entry: (device_s, host_s, cells, device_fixed_s) where
+        ``device_fixed_s`` is the cell-count-independent part of the device
+        price (dispatch + download + cold upload) — what the calibrator must
+        subtract from a measured sample before fitting the per-cell rate.
+        Constants read through ``link.constant`` so calibration feeds
+        back."""
         from delta_tpu.parallel import link
 
         cells = m * self.num_rows * max(len(self.columns), 1)
-        host_s = cells * link.HOST_PRUNE_S_PER_CELL
+        host_s = cells * link.constant("HOST_PRUNE_S_PER_CELL")
         p = link.profile()
         down_bytes = m * max(self.capacity // BLOCK // 8, 1)
-        device_s = (2 * p.latency_s + p.download_s(down_bytes)
-                    + cells * link.DEVICE_PRUNE_S_PER_CELL)
+        fixed_s = 2 * p.latency_s + p.download_s(down_bytes)
         if self._dev is None:
             # cold build ships the full lanes once; amortized over later
             # queries, but charge it to this call for honest routing
-            device_s += p.upload_s(self.device_bytes)
-        return device_s < host_s
+            fixed_s += p.upload_s(self.device_bytes)
+        device_s = fixed_s + cells * link.constant("DEVICE_PRUNE_S_PER_CELL")
+        return device_s, host_s, cells, fixed_s
+
+    def _route_plan(self, m: int):
+        """(use_device, priced) for ``m`` range queries: the enabled/mode
+        short-circuits run BEFORE any pricing, so a disabled or pinned
+        deployment never pays the link probe — and gets no audit record,
+        since no priceable decision was made. ``priced`` is the
+        (device_s, host_s, cells) tuple in auto mode, else None."""
+        if not conf.get_bool("delta.tpu.stateCache.devicePlan.enabled", True):
+            return False, None
+        mode = conf.get("delta.tpu.stateCache.devicePlan.mode", "auto")
+        if mode == "force":
+            return True, None
+        if mode == "off":
+            return False, None
+        priced = self._price_plan(m)
+        return priced[0] < priced[1], priced
 
     def _plan_host(self, lo: np.ndarray, hi: np.ndarray,
                    ks: np.ndarray) -> List[PlanResult]:
@@ -984,8 +1040,10 @@ class DeviceStateCache:
 
     def invalidate(self, log_path: str) -> None:
         with self._lock:
-            self._entries.pop(log_path, None)
+            e = self._entries.pop(log_path, None)
             self._build_locks.pop(log_path, None)
+            if e is not None:
+                e.drop_device()  # return its bytes to the HBM ledger
 
     def _lookup(self, key: str, snapshot):
         """Registry-lock lookup. Returns (entry_or_None, verdict): 'hit',
@@ -1058,10 +1116,19 @@ class DeviceStateCache:
                 if e is None:
                     return None
                 with self._lock:
+                    old = self._entries.get(key)
+                    if old is not None and old is not e:
+                        old.drop_device()  # rebuilt: old entry's HBM returns
                     self._entries[key] = e
             e.last_used = tick
             with self._lock:
                 self._evict_over_budget(keep=key)
+            # state-cache growth can push the PROCESS-WIDE device budget
+            # over: apply key-cache LRU pressure now (no entry/registry
+            # lock held here), not at the next merge
+            from delta_tpu.obs import hbm_ledger
+
+            hbm_ledger.maybe_relieve()
             return e
 
     def _evict_over_budget(self, keep: str) -> None:
@@ -1080,11 +1147,12 @@ class DeviceStateCache:
         # sizable — drop whole tables LRU beyond maxEntries
         max_entries = int(conf.get("delta.tpu.stateCache.maxEntries", 16))
         if len(self._entries) > max_entries:
-            for p, _e in sorted(self._entries.items(),
-                                key=lambda kv: kv[1].last_used):
+            for p, e in sorted(self._entries.items(),
+                               key=lambda kv: kv[1].last_used):
                 if p == keep:
                     continue
                 self._entries.pop(p, None)
                 self._build_locks.pop(p, None)
+                e.drop_device()  # return its bytes to the HBM ledger
                 if len(self._entries) <= max_entries:
                     break

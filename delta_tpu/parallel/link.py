@@ -37,6 +37,8 @@ __all__ = [
     "RESIDENT_PROBE_FIXED_S", "RESIDENT_FINALIZE_S_PER_ROW",
     "RESIDENT_PAIR_S_PER_ROW", "DEVICE_SORT_S_PER_ROW",
     "resident_probe_device_s", "cold_merge_device_s",
+    "CALIBRATABLE", "constant", "set_calibrated", "calibrated_constants",
+    "clear_calibrated",
 ]
 
 _PROBE_BYTES = 1 << 20  # 1 MB
@@ -76,6 +78,56 @@ RESIDENT_PAIR_S_PER_ROW = 1.0e-7
 DEVICE_SORT_S_PER_ROW = 5.0e-8
 
 
+# -- self-calibration --------------------------------------------------------
+#
+# The per-row/per-cell constants above were fit on ONE bench machine; on
+# different hardware the router silently prices the wrong side. The router
+# audit ledger (`obs/router_audit`) measures every routed decision against
+# its prediction, and the EWMA calibrator (`obs/calibration`) re-fits these
+# constants from observed samples — opt-in via
+# ``delta.tpu.router.calibration.enabled`` — by installing overrides here.
+# Cost functions and routers read the constants through :func:`constant`, so
+# a calibrated value takes effect everywhere at once.
+
+#: Constant names the calibrator may override.
+CALIBRATABLE = frozenset({
+    "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW", "HOST_PRUNE_S_PER_CELL",
+    "DEVICE_PRUNE_S_PER_CELL", "HOST_KEY_DECODE_S_PER_ROW",
+    "RESIDENT_PROBE_S_PER_ROW", "RESIDENT_PAIR_S_PER_ROW",
+    "DEVICE_SORT_S_PER_ROW",
+})
+
+_calibrated: dict = {}
+
+
+def constant(name: str) -> float:
+    """The live value of a cost-model constant: the calibrated override when
+    one is installed, else the module default."""
+    v = _calibrated.get(name)
+    return v if v is not None else globals()[name]
+
+
+def set_calibrated(name: str, value: float) -> None:
+    """Install a calibrated override (``obs/calibration``). Rejects unknown
+    names and non-positive values — a bad sample must not poison routing."""
+    if name not in CALIBRATABLE:
+        raise ValueError(f"{name!r} is not a calibratable link constant")
+    value = float(value)
+    if not value > 0.0:
+        raise ValueError(f"calibrated {name} must be positive, got {value}")
+    _calibrated[name] = value
+
+
+def calibrated_constants() -> dict:
+    """The installed overrides (empty when running on module defaults)."""
+    return dict(_calibrated)
+
+
+def clear_calibrated() -> None:
+    """Back to module defaults (tests, `calibration.reset`)."""
+    _calibrated.clear()
+
+
 def resident_probe_device_s(n: int, m: int, p: "LinkProfile") -> float:
     """The router's cost model for one steady-state resident MERGE probe
     (n resident target rows, m source rows) on the FUSED path: source
@@ -91,9 +143,9 @@ def resident_probe_device_s(n: int, m: int, p: "LinkProfile") -> float:
     return (
         p.upload_s(m * 4)
         + p.download_s(m // 8 + 6)
-        + (n + m) * RESIDENT_PROBE_S_PER_ROW
+        + (n + m) * constant("RESIDENT_PROBE_S_PER_ROW")
         + p.download_s(est_pairs * 8)
-        + est_pairs * RESIDENT_PAIR_S_PER_ROW
+        + est_pairs * constant("RESIDENT_PAIR_S_PER_ROW")
         + RESIDENT_PROBE_FIXED_S
         + 3 * p.latency_s
     )
@@ -108,7 +160,7 @@ def cold_merge_device_s(n: int, m: int, p: "LinkProfile") -> float:
     not charge a hot table for an upload it will skip."""
     return (
         p.upload_s(n * 4)
-        + n * DEVICE_SORT_S_PER_ROW
+        + n * constant("DEVICE_SORT_S_PER_ROW")
         + resident_probe_device_s(n, m, p)
     )
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
@@ -212,5 +264,6 @@ def estimate_device_s(
     up_s = p.upload_s(up_bytes)
     down_s = p.download_s(down_bytes)
     dispatch_s = 3 * p.latency_s  # put + exec + fetch round trips
-    kernel_s = (kernel_rows / max(shards, 1)) * KERNEL_S_PER_ROW + dispatch_s
+    kernel_s = (kernel_rows / max(shards, 1)) * constant("KERNEL_S_PER_ROW") \
+        + dispatch_s
     return Estimate(up_s + down_s + kernel_s, up_s, down_s, kernel_s)

@@ -74,6 +74,33 @@ class SqlConf:
         # Resident key-cache budgets (ops/key_cache.KeyCache._evict).
         "delta.tpu.keyCache.maxBytes": 1 << 30,
         "delta.tpu.keyCache.maxEntries": 8,
+        # Process-wide soft budget over EVERY device-resident byte the
+        # engine holds (key-cache slabs + state-cache lanes + join scratch,
+        # obs/hbm_ledger). When set, the KeyCache's LRU eviction prices
+        # itself against budget - stateCache - scratch, so growth anywhere
+        # becomes eviction pressure instead of OOM. None = unlimited.
+        "delta.tpu.device.hbmBudgetBytes": None,
+        # Router audit ledger (obs/router_audit): last N routed decisions
+        # kept for the HTTP /router route.
+        "delta.tpu.router.auditKeep": 256,
+        # Self-calibrating cost model (obs/calibration): EWMA re-fit of the
+        # parallel/link.py throughput constants from the audit ledger's
+        # measured samples. Off by default — routing then runs on the
+        # shipped constants.
+        "delta.tpu.router.calibration.enabled": False,
+        # Where calibration state persists. None = next to the log of the
+        # table that produced the samples (<log dir>/.router_calibration
+        # .json, local paths only); set for object-store tables or to share
+        # one state file across tables on the same hardware.
+        "delta.tpu.router.calibration.statePath": None,
+        # EWMA blend weight of each new sample (0.01..1.0].
+        "delta.tpu.router.calibration.alpha": 0.2,
+        # Samples a constant needs before its calibrated value overrides
+        # the shipped default (guards against one noisy first merge).
+        "delta.tpu.router.calibration.minSamples": 3,
+        # Hot-path (scan planner) ingests throttle the state-file write to
+        # at most one per this interval; merges always flush.
+        "delta.tpu.router.calibration.flushIntervalMs": 2000,
         # Link profile overrides (MB/s). Unset = probe once per process.
         "delta.tpu.link.uploadMBps": None,
         "delta.tpu.link.downloadMBps": None,

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import functools
 import itertools
 import json
 import logging
@@ -58,7 +59,7 @@ __all__ = [
     "prometheus_text", "metrics_snapshot", "bench_snapshot",
     "export_chrome_trace", "current_span", "add_span_data", "reset_all",
     "HISTOGRAM_BUCKETS", "span_stack_snapshot", "add_failure_hook",
-    "remove_failure_hook",
+    "remove_failure_hook", "span_context", "adopt_span_context", "propagated",
 ]
 
 
@@ -240,6 +241,64 @@ def span_stack_snapshot() -> List[Dict[str, Any]]:
                 "error": ev.error,
             })
     return out
+
+
+# -- cross-thread span propagation -------------------------------------------
+#
+# Contextvars isolate each thread's span stack — correct for concurrent
+# writers, wrong for the engine's OWN worker threads: a Parquet decode pool,
+# a checkpoint part writer, or the MERGE staging/uploader threads would each
+# start an orphan span root, and the decode/compute overlap the router
+# assumes becomes invisible in `export_chrome_trace`. The carrier pattern
+# fixes it: capture the submitting context's open span chain at submit time
+# (`span_context` / `propagated`), restore it inside the worker
+# (`adopt_span_context`), and the worker's spans parent under the submitting
+# operation while keeping their own thread lane in the trace.
+
+
+def span_context() -> Tuple[int, ...]:
+    """The open span chain of THIS context as an opaque carrier — capture at
+    task-submit time, hand to the worker thread, restore with
+    :func:`adopt_span_context`."""
+    return _SPAN_STACK.get()
+
+
+@contextlib.contextmanager
+def adopt_span_context(carrier: Tuple[int, ...]) -> Iterator[None]:
+    """Run the body under ``carrier`` (a :func:`span_context` capture): spans
+    opened inside parent under the carrier's innermost span instead of
+    starting an orphan root in the worker thread."""
+    token = _SPAN_STACK.set(tuple(carrier))
+    try:
+        yield
+    finally:
+        _SPAN_STACK.reset(token)
+
+
+def propagated(fn):
+    """Wrap ``fn`` so it executes under the CURRENT context's span chain —
+    the one-liner for thread pools::
+
+        pool.map(telemetry.propagated(read_one), jobs)
+
+    The capture happens NOW (at wrap time, i.e. task submit), not when the
+    worker runs. Zero-overhead: with telemetry disabled or no span open,
+    ``fn`` is returned unchanged."""
+    if not _enabled():
+        return fn
+    carrier = _SPAN_STACK.get()
+    if not carrier:
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        token = _SPAN_STACK.set(carrier)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _SPAN_STACK.reset(token)
+
+    return wrapper
 
 
 def add_failure_hook(fn) -> None:

@@ -1,0 +1,107 @@
+"""Bench regression gate (ISSUE 7 satellite): tools/bench_diff compares two
+BENCH_*.json rounds with direction-aware percentage thresholds, and bench.py
+grows a --compare mode wired to it. Pure-logic tests — no bench run."""
+import json
+
+import pytest
+
+from tools.bench_diff import (DEFAULT_THRESHOLD_PCT, Regression, compare,
+                              compare_files, main)
+
+
+def _round(configs):
+    head = next(iter(configs.values()))
+    return {"metric": head["metric"], "value": head["value"],
+            "unit": head["unit"], "vs_baseline": head.get("vs_baseline", 1),
+            "all": configs}
+
+
+def _cfg(metric, value, unit):
+    return {"metric": metric, "value": value, "unit": unit, "vs_baseline": 1}
+
+
+def test_throughput_regression_detected():
+    prior = _round({"2": _cfg("merge", 1.0, "GB/s")})
+    cur = _round({"2": _cfg("merge", 0.7, "GB/s")})  # 30% slower
+    [r] = compare(cur, prior, threshold_pct=20)
+    assert r.config == "2" and r.delta_pct == pytest.approx(30.0)
+    assert "worse" in r.describe()
+    # within threshold: clean
+    assert compare(cur, prior, threshold_pct=35) == []
+    # improvement is never a regression
+    assert compare(prior, cur, threshold_pct=20) == []
+
+
+def test_latency_units_regress_when_value_grows():
+    prior = _round({"3": _cfg("point_query", 100.0, "ms")})
+    worse = _round({"3": _cfg("point_query", 150.0, "ms")})
+    better = _round({"3": _cfg("point_query", 60.0, "ms")})
+    [r] = compare(worse, prior, threshold_pct=20)
+    assert r.delta_pct == pytest.approx(50.0)
+    assert compare(better, prior, threshold_pct=20) == []
+
+
+def test_skipped_error_and_missing_configs_are_ignored():
+    prior = _round({
+        "2": _cfg("merge", 1.0, "GB/s"),
+        "7": _cfg("probe", 100.0, "ms"),
+        "8": {"metric": "config_8", "value": -1, "unit": "skipped",
+              "vs_baseline": 0},
+    })
+    cur = _round({
+        "2": {"metric": "config_2", "value": -1, "unit": "error",
+              "vs_baseline": 0, "note": "boom"},
+        "8": _cfg("probe8", 5.0, "ms"),       # prior skipped: no baseline
+        "9": _cfg("new_config", 1.0, "s"),    # config only in current
+        # config 7 absent from current entirely
+    })
+    assert compare(cur, prior) == []
+
+
+def test_unit_change_makes_config_incomparable():
+    prior = _round({"5": _cfg("replay", 500.0, "ms")})
+    cur = _round({"5": _cfg("replay", 10.0, "commits/s")})
+    assert compare(cur, prior, threshold_pct=1) == []
+
+
+def test_bare_config_map_shape_accepted():
+    # bench.py passes its raw results dict (no "all" wrapper)
+    prior = {"2": _cfg("merge", 1.0, "GB/s")}
+    cur = {"2": _cfg("merge", 0.5, "GB/s")}
+    [r] = compare(cur, prior, threshold_pct=20)
+    assert r.delta_pct == pytest.approx(50.0)
+
+
+def test_compare_files_and_cli(tmp_path):
+    prior_p = tmp_path / "BENCH_prior.json"
+    cur_p = tmp_path / "BENCH_cur.json"
+    prior_p.write_text(json.dumps(_round({"4": _cfg("tail", 100.0, "commits/s")})))
+    cur_p.write_text(json.dumps(_round({"4": _cfg("tail", 50.0, "commits/s")})))
+    [r] = compare_files(str(cur_p), str(prior_p))
+    assert isinstance(r, Regression) and r.delta_pct == pytest.approx(50.0)
+    assert main([str(prior_p), str(cur_p)]) == 3          # regression: rc 3
+    assert main([str(cur_p), str(prior_p)]) == 0          # improvement: rc 0
+    assert main([str(prior_p), str(cur_p), "--threshold", "60"]) == 0
+    assert DEFAULT_THRESHOLD_PCT == 20.0
+
+
+def test_bench_argv_parsing():
+    from bench import _parse_argv
+
+    assert _parse_argv([]) == (None, None, 20.0)
+    assert _parse_argv(["2"]) == ("2", None, 20.0)
+    assert _parse_argv(["--compare", "BENCH_r06.json"]) == (
+        None, "BENCH_r06.json", 20.0)
+    assert _parse_argv(["2x", "--compare", "b.json",
+                        "--compare-threshold", "35"]) == ("2x", "b.json", 35.0)
+    # malformed flags exit with a usage message, not a traceback
+    with pytest.raises(SystemExit):
+        _parse_argv(["--compare"])
+    with pytest.raises(SystemExit):
+        _parse_argv(["--compare-threshold"])
+    with pytest.raises(SystemExit):
+        _parse_argv(["--compare-threshold", "abc"])
+    # a typo'd flag must not silently become the config selector (it would
+    # run zero configs and pass the gate vacuously)
+    with pytest.raises(SystemExit):
+        _parse_argv(["--compare-thresold", "25", "--compare", "b.json"])

@@ -588,3 +588,54 @@ def test_concurrent_resident_merges_chaos(tmp_path):
             assert got[base + rnd * 2] == float(base + rnd), (base, rnd)
             assert got[1000 + base + rnd] == float(base + rnd) + 0.5
     assert t.num_rows == 200 + 6  # 200 original + 3 inserts per thread
+
+
+# -- device-memory soft budget (ISSUE 7: obs/hbm_ledger pressure) ------------
+
+
+def test_hbm_budget_pressure_evicts_lru_first():
+    """With delta.tpu.device.hbmBudgetBytes set, KeyCache eviction prices
+    itself against budget - stateCache - scratch and drops device copies in
+    LRU order — least-recently-used entries lose residency first, the MRU
+    survivor keeps it, and scratch growth tightens the allowance further."""
+    import gc
+
+    from delta_tpu.obs import hbm_ledger
+    from delta_tpu.ops.key_cache import ResidentJoinKeys
+
+    gc.collect()
+    hbm_ledger.reset()
+    cache = KeyCache.instance()
+    entries = []
+    for i in range(3):
+        e = ResidentJoinKeys(f"/hbm-log-{i}", "mid", 0, "sig", ["k"])
+        e.h_keys = np.arange(10, dtype=np.int64)
+        e.h_valid = np.ones(10, bool)
+        e.h_nullok = np.ones(10, bool)
+        e.h_min, e.h_max = 0, 9
+        e.num_rows = 10
+        e.ensure_resident()
+        assert cache.register(e), f"entry {i} failed to register"
+        entries.append(e)
+    per_entry = entries[0].device_bytes
+    assert hbm_ledger.totals()["keyCache"] == 3 * per_entry
+    # budget fits ONE entry (plus slack): the two least-recently-registered
+    # lose their device copies, the most recent keeps residency
+    with conf.set_temporarily(**{
+        "delta.tpu.device.hbmBudgetBytes": per_entry + per_entry // 2,
+    }):
+        cache._evict(keep=None)
+        assert [e.is_resident for e in entries] == [False, False, True]
+        assert hbm_ledger.totals()["keyCache"] == per_entry
+        # scratch pressure shrinks the allowance below one entry: the last
+        # resident copy goes too (host mirrors keep serving)
+        hbm_ledger.adjust("scratch", per_entry)
+        cache._evict(keep=None)
+        assert [e.is_resident for e in entries] == [False, False, False]
+        assert hbm_ledger.totals()["keyCache"] == 0
+        hbm_ledger.adjust("scratch", -per_entry)
+    # without a budget the default keyCache.maxBytes (1 GiB) evicts nothing
+    entries[0].ensure_resident()
+    cache._evict(keep=None)
+    assert entries[0].is_resident
+    hbm_ledger.reset()

@@ -685,6 +685,79 @@ def test_obs_counters_are_cataloged():
     assert not stray, f"counters missing from obs/metric_names.COUNTERS: {stray}"
 
 
+def test_every_counter_and_histogram_is_cataloged():
+    """Inverse catalog pass: every constant-string ``bump_counter`` /
+    ``observe`` call site anywhere in delta_tpu/ must resolve to the
+    obs/metric_names catalog (COUNTERS ∪ ENGINE_COUNTERS / HISTOGRAMS) — a
+    new metric cannot ship un-cataloged. Dynamic f-string families
+    (logstore.{op}.*) are out of lint scope by construction."""
+    from delta_tpu.obs import metric_names
+
+    known_counters = metric_names.COUNTERS | metric_names.ENGINE_COUNTERS
+    stray = []
+    for rel, tree in _walk_engine_trees():
+        for name in _const_calls(tree, "bump_counter"):
+            if name not in known_counters:
+                stray.append(f"{rel}: bump_counter({name!r})")
+        for name in _const_calls(tree, "observe"):
+            if name not in metric_names.HISTOGRAMS:
+                stray.append(f"{rel}: observe({name!r})")
+    assert not stray, (
+        f"metric call sites missing from obs/metric_names.py: {stray}"
+    )
+
+
+def test_catalog_counter_sets_are_disjoint():
+    from delta_tpu.obs import metric_names
+
+    overlap = metric_names.COUNTERS & metric_names.ENGINE_COUNTERS
+    assert not overlap, f"counters cataloged twice: {sorted(overlap)}"
+
+
+# -- cross-thread span propagation -------------------------------------------
+
+
+def test_span_context_propagates_into_pool_workers():
+    """propagated() captures the submitter's open span chain: worker-thread
+    spans parent under it (on their own thread lanes) instead of starting
+    orphan roots."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def work(i):
+        with telemetry.record_operation("delta.test.prop.child") as w:
+            pass
+        return w
+
+    with telemetry.record_operation("delta.test.prop") as parent:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            children = list(pool.map(telemetry.propagated(work), range(4)))
+    assert all(c.parent_id == parent.span_id for c in children)
+    assert any(c.thread_id != parent.thread_id for c in children)
+    # the submitter's own stack is untouched by the workers
+    assert telemetry.span_context() == ()
+
+
+def test_adopt_span_context_restores_on_exit():
+    with telemetry.record_operation("delta.test.adopt") as parent:
+        carrier = telemetry.span_context()
+    assert carrier == (parent.span_id,)
+    with telemetry.adopt_span_context(carrier):
+        telemetry.record_event("delta.test.adopt.point")
+    assert telemetry.span_context() == ()
+    [pt] = telemetry.recent_events("delta.test.adopt.point")
+    assert pt.parent_id == parent.span_id
+
+
+def test_propagated_is_identity_with_no_span_or_blackout():
+    def f(x):
+        return x
+
+    assert telemetry.propagated(f) is f  # no open span: nothing to carry
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        with telemetry.record_operation("delta.test.dark"):
+            assert telemetry.propagated(f) is f  # blackout: zero overhead
+
+
 def test_obs_public_api_matches_catalog():
     """Each obs module's ``__all__`` must equal its PUBLIC_API entry — a new
     entry point (or a rename) has to land in the catalog too."""

@@ -1,0 +1,134 @@
+"""Bench regression gate — diff two BENCH_*.json rounds mechanically.
+
+``bench.py`` prints one JSON line per round: the headline metric plus an
+``all`` map of per-config results (``{"metric", "value", "unit",
+"vs_baseline", ...}``). This module compares the current round against a
+prior one with percentage thresholds and reports every regression, so a
+perf claim in a PR is a checkable assertion instead of prose:
+
+    python tools/bench_diff.py BENCH_r06.json BENCH_r07.json --threshold 25
+    python bench.py --compare BENCH_r06.json        # gate a live run
+
+Direction is unit-aware: latency-like units (``s``, ``ms``) regress when
+the value GROWS; throughput-like units (``GB/s``, ``commits/s``, ...)
+regress when it SHRINKS. Skipped/errored configs (``value < 0`` or unit
+``skipped``/``error``) are excluded on either side — a config that timed
+out is a budget problem, not a perf regression — and configs present in
+only one round are ignored (the set evolves across PRs). Exit status: 0
+clean, 3 when any regression crossed the threshold.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Regression", "compare", "compare_files", "main"]
+
+#: Units where a SMALLER value is better.
+LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns"})
+
+DEFAULT_THRESHOLD_PCT = 20.0
+
+
+@dataclass
+class Regression:
+    """One config whose headline metric moved past the threshold the wrong
+    way (positive ``delta_pct`` = that much worse)."""
+
+    config: str
+    metric: str
+    unit: str
+    prior: float
+    current: float
+    delta_pct: float
+
+    def describe(self) -> str:
+        return (f"config {self.config} ({self.metric}): "
+                f"{self.prior:g} -> {self.current:g} {self.unit} "
+                f"({self.delta_pct:+.1f}% worse)")
+
+
+def _configs(round_json: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """The per-config map from either a full bench line ({"all": {...}}) or
+    a bare config map."""
+    allc = round_json.get("all")
+    if isinstance(allc, dict):
+        return allc
+    # a bare single-config record (bench.py <only> mode) or a config map
+    if "value" in round_json and "metric" in round_json:
+        return {"_only": round_json}
+    return {k: v for k, v in round_json.items() if isinstance(v, dict)}
+
+
+def _comparable(entry: Any) -> Optional[Dict[str, Any]]:
+    if not isinstance(entry, dict):
+        return None
+    value = entry.get("value")
+    unit = str(entry.get("unit", ""))
+    if not isinstance(value, (int, float)) or value < 0:
+        return None  # -1 = skipped/error sentinel
+    if unit in ("skipped", "error"):
+        return None
+    return entry
+
+
+def compare(current: Dict[str, Any], prior: Dict[str, Any],
+            threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> List[Regression]:
+    """Regressions of ``current`` vs ``prior`` past ``threshold_pct``.
+    Only configs present and comparable in BOTH rounds participate; a unit
+    change between rounds makes the config incomparable (ignored)."""
+    cur_map, prior_map = _configs(current), _configs(prior)
+    out: List[Regression] = []
+    for key in sorted(cur_map.keys() & prior_map.keys()):
+        cur = _comparable(cur_map[key])
+        old = _comparable(prior_map[key])
+        if cur is None or old is None:
+            continue
+        if str(cur.get("unit")) != str(old.get("unit")):
+            continue
+        unit = str(cur.get("unit", ""))
+        cur_v, old_v = float(cur["value"]), float(old["value"])
+        if old_v == 0:
+            continue
+        if unit in LOWER_IS_BETTER:
+            worse_pct = (cur_v - old_v) / old_v * 100.0
+        else:
+            worse_pct = (old_v - cur_v) / old_v * 100.0
+        if worse_pct > threshold_pct:
+            out.append(Regression(
+                config=key, metric=str(cur.get("metric", "")), unit=unit,
+                prior=old_v, current=cur_v, delta_pct=worse_pct,
+            ))
+    return out
+
+
+def compare_files(current_path: str, prior_path: str,
+                  threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> List[Regression]:
+    with open(current_path, encoding="utf-8") as f:
+        current = json.load(f)
+    with open(prior_path, encoding="utf-8") as f:
+        prior = json.load(f)
+    return compare(current, prior, threshold_pct)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("prior", help="prior round JSON (e.g. BENCH_r06.json)")
+    ap.add_argument("current", help="current round JSON")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD_PCT,
+                    help="regression threshold in percent (default 20)")
+    args = ap.parse_args(argv)
+    regressions = compare_files(args.current, args.prior, args.threshold)
+    if not regressions:
+        print(f"OK: no config regressed past {args.threshold:g}%")
+        return 0
+    for r in regressions:
+        print(f"REGRESSION: {r.describe()}")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
