@@ -134,6 +134,12 @@ def bench_overwrite_read(workdir):
     from delta_tpu.obs.doctor import doctor
 
     doctor(path)
+    # run the workload-journal advisor once: journal.* counters land in the
+    # snapshot and the --compare gate prices journaling overhead on the
+    # scan path of THIS config against the prior round
+    from delta_tpu.obs.advisor import advise
+
+    advise(path)
     return {
         "metric": "overwrite_plus_filtered_read_2M_rows",
         "value": round(eng_s, 3),
@@ -1323,6 +1329,9 @@ def _reset_engine_state():
         DeltaLog.clear_cache()
         KeyCache.reset()
         DeviceStateCache.reset()
+        from delta_tpu.obs import journal
+
+        journal.reset()
     except Exception:
         pass
 
@@ -1441,7 +1450,7 @@ def main():
                 out["telemetry"] = telemetry.bench_snapshot(
                     include=("scan.rowgroups", "scan.bytes.skipped",
                              "footerCache", "table.health", "router",
-                             "device.hbm"),
+                             "device.hbm", "journal", "advisor"),
                 )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass

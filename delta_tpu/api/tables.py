@@ -247,6 +247,19 @@ class DeltaTable:
 
         return _doctor(self.delta_log, snapshot=self._snapshot())
 
+    def advise(self, limit: Optional[int] = None):
+        """Layout advisor: aggregate this table's persistent workload
+        journal (scans, commits, DML routing — `delta_tpu/obs/journal.py`)
+        into ranked, evidence-backed recommendations (Z-ORDER/partition
+        column candidates, checkpoint-interval and row-group tuning,
+        calibration/HBM-budget hints). The longitudinal counterpart of
+        :meth:`doctor`; degrades to an explicit ``status="no history"``
+        report when nothing has been journaled. Beyond the reference — see
+        `delta_tpu/obs/advisor.py`."""
+        from delta_tpu.obs.advisor import advise as _advise
+
+        return _advise(self.delta_log, snapshot=self._snapshot(), limit=limit)
+
     def restore_to_version(self, version: int) -> Dict[str, int]:
         """Roll the table back to ``version`` as a NEW commit (history is
         preserved). Beyond the reference — modern Delta's RESTORE TABLE."""

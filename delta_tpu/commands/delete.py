@@ -56,6 +56,15 @@ class DeleteCommand:
         )
         txn.report_metrics(**self.metrics)
         version = txn.commit(actions, op)
+        # workload journal: DML entry (mode + rewrite metrics) for the
+        # layout advisor (buffered; inert under blackout)
+        from delta_tpu.obs import journal as journal_mod
+
+        journal_mod.record_dml(
+            self.delta_log.log_path, "delete",
+            mode="rewrite" if self._rewrote_files else "dv-or-remove",
+            version=version, metrics=dict(self.metrics),
+        )
         if self._rewrote_files:
             # survivors rewritten into new files: bump the resident
             # key-cache epoch (ops/key_cache.py) — plain removes and DV

@@ -1151,19 +1151,32 @@ class MergeIntoCommand:
             "delta.merge.router", data,
             path=self.delta_log.data_path,
         )
-        self._emit_audit(decision)
+        audit = self._emit_audit(decision)
+        # workload journal: the routed decision + audit verdict persist so
+        # the advisor can trend the key-cache hit trajectory across
+        # processes (buffered; inert under blackout / journal disabled)
+        from delta_tpu.obs import journal as journal_mod
 
-    def _emit_audit(self, decision: str) -> None:
+        journal_mod.record_dml(
+            self.delta_log.log_path, "merge", decision=decision,
+            router={k: v for k, v in data.items() if k != "decision"},
+            audit=({"miss": audit.miss, "actualMs": round(audit.actual_ms, 3),
+                    "predictedMs": dict(audit.predicted_ms)}
+                   if audit is not None else None),
+        )
+
+    def _emit_audit(self, decision: str):
         """Record the routed join in the audit ledger: predicted phase
         costs (through ``link.constant``, so calibration feeds back into
         what is being judged) vs the measured ``key_decode + join`` wall
         time — plus the attributable throughput samples the EWMA calibrator
         refits from. Empty joins (no candidates / empty source) have no
-        measured join phase and are not audited."""
+        measured join phase and are not audited. Returns the recorded
+        audit (or None) so the journal's dml entry can carry the verdict."""
         if "join_ms" not in self.phase_ms or self._audit_units is None:
-            return
+            return None
         if not conf.get_bool("delta.tpu.telemetry.enabled", True):
-            return  # blackout: no audit, and no link probe just to price one
+            return None  # blackout: no audit, and no link probe to price one
         from delta_tpu.obs import router_audit
         from delta_tpu.parallel import link
 
@@ -1221,7 +1234,7 @@ class MergeIntoCommand:
             eff = join_s + key_decode_s - link.RESIDENT_PROBE_FIXED_S
             if eff > 0:
                 samples.append(("RESIDENT_PROBE_S_PER_ROW", n_dev + m, eff))
-        router_audit.record_audit(
+        return router_audit.record_audit(
             "merge.join", self.delta_log.data_path, decision,
             predicted_map,
             actual_s,

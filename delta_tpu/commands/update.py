@@ -143,6 +143,15 @@ class UpdateCommand:
         txn.report_metrics(**self.metrics)
         op = ops.Update(predicate=self.condition.sql() if self.condition else None)
         version = txn.commit(removes + adds + cdc_actions, op)
+        # workload journal: DML entry (mode + rewrite metrics) for the
+        # layout advisor (buffered; inert under blackout)
+        from delta_tpu.obs import journal as journal_mod
+
+        journal_mod.record_dml(
+            self.delta_log.log_path, "update",
+            mode="dv" if use_dv else "rewrite", version=version,
+            metrics=dict(self.metrics),
+        )
         if not use_dv and removes:
             # whole-file rewrite (not a DV mark): bump the resident
             # key-cache epoch — stale slabs must never serve a

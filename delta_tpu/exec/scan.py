@@ -622,7 +622,21 @@ def scan_to_table(
                         "read": (t2 - t1) // 1_000_000,
                         "filter": (t3 - t2) // 1_000_000,
                     }
-                    sev.data["scanReport"] = rep.to_dict()
+                    rep_dict = rep.to_dict()
+                    sev.data["scanReport"] = rep_dict
+                    # workload journal: the same report dict plus the
+                    # normalized predicate fingerprint (computed on the
+                    # journal writer thread) persists to
+                    # <table>/_delta_log/_journal so the layout advisor can
+                    # aggregate across processes (buffered; inert when the
+                    # journal or telemetry is disabled)
+                    from delta_tpu.obs import journal as journal_mod
+
+                    journal_mod.record_scan(
+                        snapshot.delta_log.log_path, report_dict=rep_dict,
+                        predicate=(ir.and_all(residual) if residual else None),
+                        partition_cols=snapshot.metadata.partition_columns,
+                    )
             scan_ok = True
             return table
     finally:

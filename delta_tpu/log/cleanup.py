@@ -62,6 +62,16 @@ def cleanup_expired_logs(delta_log, snapshot) -> int:
 
     swept = sweep_tmp_orphans(delta_log, now)
 
+    # workload-journal segments age out on the same cadence as the rest of
+    # the metadata cleanup (they are also swept inline at rotation, but a
+    # table that STOPPED journaling must still shed its history — so the
+    # sweep runs even when journaling is currently disabled; it is a no-op
+    # listdir when the directory doesn't exist)
+    from delta_tpu.obs import journal as journal_mod
+
+    if "://" not in delta_log.log_path:
+        journal_mod.sweep(journal_mod.journal_dir(delta_log.log_path))
+
     last_ckpt = ckpt_mod.read_last_checkpoint(delta_log.store, delta_log.log_path)
     if last_ckpt is None:
         return swept

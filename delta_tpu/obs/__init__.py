@@ -6,6 +6,8 @@
 * :mod:`delta_tpu.obs.server` — ``/metrics`` ``/healthz`` ``/events``
   ``/trace`` ``/doctor`` HTTP endpoint (opt-in)
 * :mod:`delta_tpu.obs.flight_recorder` — incident files on operation failure
+* :mod:`delta_tpu.obs.journal` — persistent per-table workload journal
+* :mod:`delta_tpu.obs.advisor` — longitudinal layout advisor over the journal
 * :mod:`delta_tpu.obs.router_audit` — routed decisions priced vs measured
 * :mod:`delta_tpu.obs.calibration` — EWMA re-fit of the link cost constants
 * :mod:`delta_tpu.obs.hbm_ledger` — device-memory accounting + soft budget
@@ -15,6 +17,7 @@ Importing this package installs the (inert-until-configured) flight-recorder
 failure hook; everything else is pull-by-call.
 """
 from delta_tpu.obs import flight_recorder as _flight_recorder
+from delta_tpu.obs.advisor import AdvisorReport, advise
 from delta_tpu.obs.doctor import TableHealthReport, doctor
 from delta_tpu.obs.scan_report import ScanReport, last_scan_report
 from delta_tpu.obs.server import ObsServer, start_server, stop_server
@@ -23,5 +26,5 @@ _flight_recorder.install()
 
 __all__ = [
     "doctor", "TableHealthReport", "ScanReport", "last_scan_report",
-    "ObsServer", "start_server", "stop_server",
+    "ObsServer", "start_server", "stop_server", "advise", "AdvisorReport",
 ]

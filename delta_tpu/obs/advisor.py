@@ -1,0 +1,521 @@
+"""Layout advisor — the longitudinal counterpart of the point-in-time doctor.
+
+`obs/doctor` reads the CURRENT snapshot and ranks debt; this module reads
+the persistent workload journal (`obs/journal`) and answers the question the
+doctor cannot: *given the queries this table actually serves, what layout
+should it have?* "Only Aggressive Elephants are Fast Elephants" (PAPERS.md)
+shows metadata-layer layout tuning is safe and decisive once a workload
+trace exists to drive it; "Optimal Predicate Pushdown Synthesis" needs
+exactly the evidence collected here — which predicate shapes never pruned
+and why — to know where rewrite synthesis (ROADMAP item 5) pays off.
+
+:func:`advise` aggregates journal history into **workload facts** (hot
+columns by filter frequency, predicates that never pruned split by reason,
+partition-access skew, commit-contention windows, the MERGE key-cache hit
+trajectory) and emits ranked, evidence-backed :class:`Recommendation`\\ s:
+
+* ``ZORDER`` / ``PARTITION`` — a frequently-filtered non-layout column
+  whose scans almost never prune (cited: filter count, pruning miss rate);
+* ``ROW_GROUP_SIZE`` — prunable predicates over files with ~1 row group
+  each (nothing for the second tier to skip);
+* ``CHECKPOINT_INTERVAL`` — sustained commit traffic with scan planning
+  dominated by log-tail replay;
+* ``COMMIT_CONTENTION`` — retry-heavy commit windows (scopes the
+  group-commit work, ROADMAP item 3);
+* ``CALIBRATION`` / ``HBM_BUDGET`` — router hindsight misses, or repeated
+  cold device uploads that a larger resident key-cache budget would absorb.
+
+Surfaced as ``DeltaTable.advise()``, the HTTP ``/advisor`` route, and
+``tools/journal_dump.py --advise``. With the journal inert (telemetry
+blackout or ``delta.tpu.journal.enabled=false``) or empty, the report
+degrades to an explicit ``status="no history"`` — never a fabricated
+recommendation.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from delta_tpu.obs import journal as journal_mod
+from delta_tpu.utils import telemetry
+
+__all__ = ["Recommendation", "AdvisorReport", "advise"]
+
+# thresholds — like the doctor's, deliberately simple and visible
+ZORDER_MIN_FILTERS = 3
+ZORDER_MIN_MISS_RATE = 0.5
+PARTITION_MIN_FILTERS = 5
+PARTITION_EQ_FRACTION = 0.8
+ROW_GROUPS_PER_FILE_FLOOR = 1.5
+CHECKPOINT_MIN_COMMITS = 20
+CHECKPOINT_PLANNING_MS = 50.0
+CONTENTION_MIN_COMMITS = 10
+CONTENTION_RETRY_FRACTION = 0.2
+CONTENTION_WINDOW_MS = 60_000
+CALIBRATION_MIN_AUDITS = 5
+CALIBRATION_MISS_RATE = 0.3
+HBM_MIN_COLD_MERGES = 3
+HBM_MAX_HIT_RATE = 0.25
+
+
+@dataclass
+class Recommendation:
+    """One ranked, evidence-backed layout/tuning suggestion."""
+
+    kind: str          # ZORDER | PARTITION | ROW_GROUP_SIZE | ...
+    target: str        # column name or conf key
+    score: float       # ranking weight (higher = stronger evidence)
+    action: str        # the concrete command / conf change
+    detail: str
+    evidence: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "target": self.target,
+            "score": round(self.score, 3),
+            "action": self.action,
+            "detail": self.detail,
+            "evidence": dict(self.evidence),
+        }
+
+
+@dataclass
+class AdvisorReport:
+    path: str
+    version: int
+    generated_at_ms: int
+    status: str                       # "ok" | "no history"
+    entries: int                      # journal entries aggregated
+    facts: Dict[str, Any] = field(default_factory=dict)
+    recommendations: List[Recommendation] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "version": self.version,
+            "generatedAt": self.generated_at_ms,
+            "status": self.status,
+            "entries": self.entries,
+            "facts": dict(self.facts),
+            "recommendations": [r.to_dict() for r in self.recommendations],
+            "doctor": "point-in-time debt: DeltaTable.doctor() / "
+                      "GET /doctor?path=<table>",
+        }
+
+
+# ---------------------------------------------------------------------------
+# Fact extraction
+# ---------------------------------------------------------------------------
+
+
+def _scan_pruned(report: Dict[str, Any]) -> bool:
+    """Did pruning have any effect the filtered columns can take credit
+    for? The STATS tier is measured downstream of partition pruning
+    (``filesPruned`` counts BOTH tiers — on a partitioned table every scan
+    would look 'pruned' and mask a column whose min/max stats never fire).
+    A scan partition-pruned to zero files counts as pruned: no file
+    survived for the stats tier to be tested against."""
+    base = report.get("filesAfterPartition")
+    if base is None:
+        base = report.get("filesTotal") or 0
+    if base == 0 and (report.get("filesTotal") or 0) > 0:
+        return True  # the partition tier excluded everything
+    stats_files = max(0, base - (report.get("filesScanned") or 0))
+    return bool(stats_files or report.get("rowGroupsPruned")
+                or report.get("rowGroupsLateSkipped"))
+
+
+def _column_facts(scans: List[dict]) -> Dict[str, Dict[str, Any]]:
+    """Per-column filter frequency + pruning outcomes, from the scan
+    fingerprints. A scan 'missed' for a column when it filtered on the
+    column and nothing was pruned at either tier. Scans over zero-file
+    (empty) tables are neutral — pruning could not possibly have fired,
+    so they must not fabricate miss evidence."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in scans:
+        fp = e.get("fingerprint")
+        rep = e.get("report") or {}
+        if not fp or (rep.get("filesTotal") or 0) <= 0:
+            continue
+        pruned = _scan_pruned(rep)
+        eq_cols = set()
+        part_cols = set()
+        prunable = set(fp.get("prunableColumns") or ())
+        for c in fp.get("conjuncts") or ():
+            if c.get("shape", "").startswith(("eq(", "in(")):
+                eq_cols.update(c.get("columns") or ())
+            if c.get("partition"):
+                part_cols.update(c.get("columns") or ())
+        for col in fp.get("columns") or ():
+            f = out.setdefault(col, {"filters": 0, "misses": 0, "eq": 0,
+                                     "prunable": 0, "partitionFilters": 0})
+            f["filters"] += 1
+            if not pruned:
+                f["misses"] += 1
+            if col in eq_cols:
+                f["eq"] += 1
+            if col in prunable:
+                f["prunable"] += 1
+            if col in part_cols:
+                f["partitionFilters"] += 1
+    for f in out.values():
+        f["missRate"] = round(f["misses"] / f["filters"], 4)
+        f["eqFraction"] = round(f["eq"] / f["filters"], 4)
+    return out
+
+
+def _never_pruned(scans: List[dict]) -> List[Dict[str, Any]]:
+    """Predicate fingerprints whose scans NEVER pruned, with the reason:
+    residual-only shapes can't prune without rewrite synthesis; prunable
+    shapes that never fired point at layout (clustering), not semantics."""
+    by_key: Dict[str, Dict[str, Any]] = {}
+    for e in scans:
+        fp = e.get("fingerprint")
+        if not fp or not fp.get("key"):
+            continue
+        if ((e.get("report") or {}).get("filesTotal") or 0) <= 0:
+            continue  # empty-table scan: no pruning evidence either way
+        conjuncts = fp.get("conjuncts") or ()
+        g = by_key.setdefault(fp["key"], {
+            "fingerprint": fp["key"], "scans": 0, "pruned": 0,
+            "columns": fp.get("columns") or [],
+            "prunable": bool(fp.get("prunableColumns")),
+            "partition": bool(conjuncts) and all(
+                c.get("partition") for c in conjuncts),
+        })
+        g["scans"] += 1
+        if _scan_pruned(e.get("report") or {}):
+            g["pruned"] += 1
+    out = []
+    for g in by_key.values():
+        if g["pruned"]:
+            continue
+        if g["partition"]:
+            # the filter IS pushed down (partition tier, exact) — blaming
+            # clustering or rewrite synthesis would both be wrong
+            g["reason"] = (
+                "partition: pushed down at the partition tier but its "
+                "values never excluded a partition — check the value "
+                "distribution / partitioning scheme")
+        elif g["prunable"]:
+            g["reason"] = (
+                "layout: shape is min/max-evaluable but stats never "
+                "excluded anything — the filtered columns are not "
+                "clustered")
+        else:
+            g["reason"] = (
+                "shape: not min/max-evaluable — only predicate rewrite "
+                "synthesis (ROADMAP item 5) could push it down")
+        g.pop("pruned")
+        out.append(g)
+    return sorted(out, key=lambda g: -g["scans"])
+
+
+def _partition_skew(scans: List[dict]) -> Dict[str, Any]:
+    ratios = []
+    for e in scans:
+        rep = e.get("report") or {}
+        total = rep.get("filesTotal") or 0
+        if total > 0:
+            after = rep.get("filesAfterPartition")
+            # 0 survivors is perfect pruning, not missing data
+            ratios.append((after if after is not None else total) / total)
+    if not ratios:
+        return {"scans": 0}
+    half = len(ratios) // 2 or 1
+    return {
+        "scans": len(ratios),
+        "meanPartitionSurvival": round(sum(ratios) / len(ratios), 4),
+        "recentPartitionSurvival": round(
+            sum(ratios[-half:]) / len(ratios[-half:]), 4),
+    }
+
+
+def _commit_facts(commits: List[dict]) -> Dict[str, Any]:
+    total = len(commits)
+    retried = conflicts = reconciled = contended_n = 0
+    windows: Counter = Counter()
+    for e in commits:
+        stats = e.get("stats") or {}
+        attempts = int(stats.get("attempts") or 1)
+        outcome = e.get("outcome", "committed")
+        # each entry counts ONCE toward the fraction — a conflict that also
+        # retried must not inflate it
+        contended = attempts > 1 or outcome == "conflict"
+        if contended:
+            contended_n += 1
+        if attempts > 1:
+            retried += 1
+        if outcome == "conflict":
+            conflicts += 1
+        if outcome == "reconciledWin":
+            reconciled += 1
+        if contended and e.get("ts"):
+            windows[int(e["ts"]) // CONTENTION_WINDOW_MS] += 1
+    hot = [{"windowStart": w * CONTENTION_WINDOW_MS, "contendedCommits": n}
+           for w, n in windows.most_common(8) if n >= 2]
+    return {
+        "commits": total,
+        "retried": retried,
+        "conflicts": conflicts,
+        "reconciled": reconciled,
+        "retryFraction": round(contended_n / total, 4) if total else 0.0,
+        "contentionWindows": hot,
+    }
+
+
+def _key_cache_facts(dmls: List[dict]) -> Dict[str, Any]:
+    merges = [e for e in dmls if e.get("op") == "merge"]
+    decisions = [e.get("decision") for e in merges if e.get("decision")]
+    if not decisions:
+        return {"merges": 0}
+    hits = sum(1 for d in decisions if d == "resident")
+    cold = sum(1 for d in decisions if d in ("device-cold", "device-upload"))
+    half = len(decisions) // 2 or 1
+    recent = decisions[-half:]
+    return {
+        "merges": len(decisions),
+        "cacheHits": hits,
+        "coldDeviceMerges": cold,
+        "hitRate": round(hits / len(decisions), 4),
+        "recentHitRate": round(
+            sum(1 for d in recent if d == "resident") / len(recent), 4),
+        "decisions": dict(Counter(decisions)),
+    }
+
+
+def _router_facts(routers: List[dict]) -> Dict[str, Any]:
+    audits = [e.get("audit") or {} for e in routers]
+    misses = sum(1 for a in audits if a.get("miss"))
+    return {
+        "audits": len(audits),
+        "misses": misses,
+        "missRate": round(misses / len(audits), 4) if audits else 0.0,
+    }
+
+
+def _row_group_facts(scans: List[dict]) -> Dict[str, Any]:
+    """Row groups per scanned file — over predicated scans only.
+    ``rowGroupsTotal`` is populated only when the scan consulted footers
+    (a predicate or position hint); folding in unpredicated full-table
+    scans (rowGroupsTotal=0, filesScanned>0) dilutes the ratio toward 0
+    and fabricates a ROW_GROUP_SIZE recommendation."""
+    rg = files = 0
+    for e in scans:
+        rep = e.get("report") or {}
+        groups = rep.get("rowGroupsTotal") or 0
+        if groups <= 0:
+            continue
+        rg += groups
+        files += rep.get("filesScanned") or 0
+    return {
+        "rowGroupsPerScannedFile": round(rg / files, 3) if files else 0.0,
+        "filesScanned": files,
+    }
+
+
+def _planning_ms(scans: List[dict]) -> float:
+    vals = sorted((e.get("report") or {}).get("phaseMs", {}).get("planning", 0)
+                  for e in scans)
+    return float(vals[len(vals) // 2]) if vals else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Recommendation synthesis
+# ---------------------------------------------------------------------------
+
+
+def _recommend(facts: Dict[str, Any],
+               partition_cols: List[str]) -> List[Recommendation]:
+    recs: List[Recommendation] = []
+    pcols = {c.lower() for c in partition_cols}
+    scans_seen = facts.get("scans", 0)
+
+    for col, f in (facts.get("columns") or {}).items():
+        # skip columns that are the partition layout NOW, and columns whose
+        # journaled evidence was all partition-tier filters (recorded when
+        # the column WAS a partition column — e.g. before a repartition):
+        # partition pruning already pushes those down exactly
+        if col in pcols or f["partitionFilters"] >= f["filters"]:
+            continue
+        if (f["filters"] >= ZORDER_MIN_FILTERS
+                and f["missRate"] >= ZORDER_MIN_MISS_RATE
+                and f["prunable"] > 0):
+            recs.append(Recommendation(
+                kind="ZORDER", target=col,
+                score=f["filters"] * f["missRate"],
+                action=f"table.optimize().execute_z_order_by('{col}')",
+                detail=f"'{col}' was filtered in {f['filters']} of "
+                       f"{scans_seen} journaled scans but pruning missed "
+                       f"{f['missRate']:.0%} of them — the column is not in "
+                       "the table's layout; Z-ORDER clustering would make "
+                       "its min/max stats selective",
+                evidence={"filterCount": f["filters"],
+                          "pruningMissRate": f["missRate"],
+                          "scansConsidered": scans_seen},
+            ))
+        if (f["filters"] >= PARTITION_MIN_FILTERS
+                and f["eqFraction"] >= PARTITION_EQ_FRACTION
+                and f["missRate"] >= ZORDER_MIN_MISS_RATE):
+            recs.append(Recommendation(
+                kind="PARTITION", target=col,
+                score=f["filters"] * f["eqFraction"] * 0.8,
+                action=f"repartition by '{col}' (equality-dominated filter)",
+                detail=f"'{col}' is equality/IN-filtered in "
+                       f"{f['eqFraction']:.0%} of its {f['filters']} "
+                       "journaled filters — a partition (or primary Z-ORDER) "
+                       "column candidate",
+                evidence={"filterCount": f["filters"],
+                          "eqFraction": f["eqFraction"],
+                          "pruningMissRate": f["missRate"]},
+            ))
+
+    rgf = facts.get("rowGroups") or {}
+    col_facts = facts.get("columns") or {}
+    any_prunable_miss = any(
+        f["prunable"] > 0 and f["missRate"] >= ZORDER_MIN_MISS_RATE
+        for f in col_facts.values())
+    if (rgf.get("filesScanned", 0) > 0 and any_prunable_miss
+            and 0 < rgf.get("rowGroupsPerScannedFile", 0.0)
+            < ROW_GROUPS_PER_FILE_FLOOR):
+        recs.append(Recommendation(
+            kind="ROW_GROUP_SIZE", target="delta.tpu.write.rowGroupRows",
+            score=2.0,
+            action="rewrite hot files (OPTIMIZE) with bounded row groups — "
+                   "check delta.tpu.write.rowGroupRows",
+            detail=f"scanned files average "
+                   f"{rgf['rowGroupsPerScannedFile']:.2f} row groups each: "
+                   "the second pruning tier has nothing to skip inside them",
+            evidence=dict(rgf),
+        ))
+
+    cf = facts.get("commits") or {}
+    planning_p50 = facts.get("planningP50Ms", 0.0)
+    if (cf.get("commits", 0) >= CHECKPOINT_MIN_COMMITS
+            and planning_p50 >= CHECKPOINT_PLANNING_MS):
+        recs.append(Recommendation(
+            kind="CHECKPOINT_INTERVAL", target="delta.checkpointInterval",
+            score=planning_p50 / CHECKPOINT_PLANNING_MS,
+            action="lower delta.checkpointInterval (or run CHECKPOINT)",
+            detail=f"{cf['commits']} journaled commits with scan planning "
+                   f"p50 at {planning_p50:.0f} ms — the log tail is being "
+                   "replayed on the read path",
+            evidence={"commits": cf["commits"],
+                      "planningP50Ms": planning_p50},
+        ))
+    if (cf.get("commits", 0) >= CONTENTION_MIN_COMMITS
+            and cf.get("retryFraction", 0.0) >= CONTENTION_RETRY_FRACTION):
+        recs.append(Recommendation(
+            kind="COMMIT_CONTENTION", target="",
+            score=cf["retryFraction"] * 10.0,
+            action="batch concurrent writers (group commit, ROADMAP item 3) "
+                   "or stagger their schedules",
+            detail=f"{cf['retryFraction']:.0%} of {cf['commits']} journaled "
+                   f"commits retried or conflicted; "
+                   f"{len(cf.get('contentionWindows') or [])} contention "
+                   "window(s) recorded",
+            evidence={"commits": cf["commits"],
+                      "retryFraction": cf["retryFraction"],
+                      "contentionWindows": cf.get("contentionWindows") or []},
+        ))
+
+    rf = facts.get("router") or {}
+    if (rf.get("audits", 0) >= CALIBRATION_MIN_AUDITS
+            and rf.get("missRate", 0.0) >= CALIBRATION_MISS_RATE):
+        recs.append(Recommendation(
+            kind="CALIBRATION", target="delta.tpu.router.calibration.enabled",
+            score=rf["missRate"] * 8.0,
+            action="set delta.tpu.router.calibration.enabled=true",
+            detail=f"the router's hindsight miss rate over "
+                   f"{rf['audits']} journaled audits is "
+                   f"{rf['missRate']:.0%} — the shipped cost constants do "
+                   "not match this hardware; enable the EWMA calibrator",
+            evidence=dict(rf),
+        ))
+
+    kf = facts.get("keyCache") or {}
+    if (kf.get("coldDeviceMerges", 0) >= HBM_MIN_COLD_MERGES
+            and kf.get("hitRate", 1.0) <= HBM_MAX_HIT_RATE):
+        recs.append(Recommendation(
+            kind="HBM_BUDGET", target="delta.tpu.keyCache.maxBytes",
+            score=float(kf["coldDeviceMerges"]),
+            action="raise delta.tpu.keyCache.maxBytes / "
+                   "delta.tpu.device.hbmBudgetBytes so merge key slabs stay "
+                   "resident",
+            detail=f"{kf['coldDeviceMerges']} of {kf['merges']} journaled "
+                   f"device merges rebuilt the key slab cold (hit rate "
+                   f"{kf['hitRate']:.0%}) — the resident key cache is being "
+                   "evicted between merges",
+            evidence=dict(kf),
+        ))
+
+    recs.sort(key=lambda r: -r.score)
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def advise(table, snapshot=None, limit: Optional[int] = None) -> AdvisorReport:
+    """Aggregate a table's workload journal into facts + ranked
+    recommendations. ``table`` is a DeltaTable, DeltaLog, or path (like
+    :func:`~delta_tpu.obs.doctor.doctor`). Reads the journal from disk —
+    a fresh process sees everything earlier processes recorded. ``limit``
+    restricts to the last N journal entries."""
+    from delta_tpu.log.deltalog import DeltaLog
+
+    if isinstance(table, str):
+        delta_log = DeltaLog.for_table(table)
+    else:
+        delta_log = getattr(table, "delta_log", table)
+    with telemetry.record_operation("delta.utility.advise",
+                                    path=delta_log.data_path):
+        telemetry.bump_counter("advisor.runs")
+        now = delta_log.clock()
+        if not journal_mod.enabled(delta_log.log_path):
+            return AdvisorReport(
+                path=delta_log.data_path, version=-1, generated_at_ms=now,
+                status="no history", entries=0,
+                facts={"reason": "journal disabled (telemetry blackout or "
+                                 "delta.tpu.journal.enabled=false)"},
+            )
+        journal_mod.flush(delta_log.log_path)
+        entries = journal_mod.read_entries(delta_log.log_path, limit=limit)
+        if not entries:
+            return AdvisorReport(
+                path=delta_log.data_path, version=-1, generated_at_ms=now,
+                status="no history", entries=0,
+                facts={"reason": "no journal entries recorded yet"},
+            )
+        snap = snapshot if snapshot is not None else delta_log.update()
+        scans = [e for e in entries if e.get("kind") == "scan"]
+        commits = [e for e in entries if e.get("kind") == "commit"]
+        dmls = [e for e in entries if e.get("kind") == "dml"]
+        routers = [e for e in entries if e.get("kind") == "router"]
+        facts: Dict[str, Any] = {
+            "scans": len(scans),
+            "columns": _column_facts(scans),
+            "neverPruned": _never_pruned(scans),
+            "partition": _partition_skew(scans),
+            "commits": _commit_facts(commits),
+            "keyCache": _key_cache_facts(dmls),
+            "router": _router_facts(routers),
+            "rowGroups": _row_group_facts(scans),
+            "planningP50Ms": _planning_ms(scans),
+        }
+        recs = _recommend(facts, list(snap.metadata.partition_columns))
+        if recs:
+            telemetry.bump_counter("advisor.recommendations", len(recs))
+        telemetry.add_span_data(
+            entries=len(entries), recommendations=len(recs),
+            topKind=recs[0].kind if recs else None,
+        )
+        return AdvisorReport(
+            path=delta_log.data_path, version=snap.version,
+            generated_at_ms=now, status="ok", entries=len(entries),
+            facts=facts, recommendations=recs,
+        )

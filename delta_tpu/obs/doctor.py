@@ -105,6 +105,11 @@ class TableHealthReport:
             "numFiles": self.num_files,
             "sizeInBytes": self.size_in_bytes,
             "dimensions": [d.to_dict() for d in self.dimensions],
+            # the doctor is point-in-time; the workload journal's advisor
+            # answers the longitudinal question (what layout do the queries
+            # this table ACTUALLY serves need) — see obs/advisor.py
+            "advisor": "longitudinal layout advice: DeltaTable.advise() / "
+                       "GET /advisor?path=<table>",
         }
 
 

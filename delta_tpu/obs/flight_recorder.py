@@ -103,6 +103,20 @@ def record_incident(ev, exc: BaseException) -> Optional[str]:
         "pid": os.getpid(),
         "thread": threading.current_thread().name,
     }
+    # what the query was DOING, not just the span stack: the scan report
+    # in flight on this context (e.g. a bench-deadline breach mid-scan) and
+    # the last router-audit record, when they exist
+    try:
+        from delta_tpu.obs import router_audit, scan_report
+
+        rep = scan_report.current_report()
+        if rep is not None:
+            incident["scanReport"] = rep.to_dict()
+        audit = router_audit.last_audit()
+        if audit is not None:
+            incident["routerAudit"] = audit.to_dict()
+    except Exception:  # noqa: BLE001 — the recorder must never raise
+        pass
     os.makedirs(directory, exist_ok=True)
     name = f"incident-{ev.timestamp_ms:013d}-{seq:06d}-{_sanitize(ev.op_type)}.json"
     path = os.path.join(directory, name)

@@ -1,0 +1,564 @@
+"""Persistent per-table workload journal — longitudinal observability.
+
+Doctor (`obs/doctor`) and the router audit ledger (`obs/router_audit`) are
+point-in-time and per-process: when the process exits, every scan report,
+commit stat, and routing decision is gone, and nothing can answer "what
+layout does this table need for the queries it *actually* serves". This
+module persists that evidence: one compact JSONL entry per operation,
+batched into size/age-bounded segment files under
+``<table>/_delta_log/_journal/`` and LRU-swept like the tmp-orphan sweep
+(`log/cleanup.sweep_tmp_orphans`).
+
+Entry kinds
+===========
+
+``scan``
+    The per-query :class:`~delta_tpu.obs.scan_report.ScanReport` plus a
+    normalized **predicate fingerprint** — columns referenced, per-conjunct
+    op shapes with literals abstracted (``eq(v,?)``), and the
+    prunable-vs-residual split (which conjuncts the shared skipping rewrite
+    used by ``exec/rowgroups`` can lower to min/max stats, and which can
+    only run as residual filters).
+``commit``
+    CommitStats (`txn/transaction`) plus the conflict/reconcile outcome and
+    retry count — the raw material for contention-window analysis.
+``dml``
+    One entry per routed DML command (MERGE/UPDATE/DELETE): the router
+    decision and the audit verdict when one was recorded.
+``router``
+    Every `obs/router_audit` record (merge joins AND scan-planning picks),
+    so predicted-vs-actual routing history survives the audit ring.
+
+Hooks live in ``exec/scan.py``, ``txn/transaction.py``, ``commands/*`` and
+``obs/router_audit.py``; each hook is a dict append under a lock — the IO
+runs on a dedicated ``delta-journal-writer`` daemon thread (or inline in
+:func:`flush`), never on the operation's thread. Fully inert under a
+telemetry blackout (``delta.tpu.telemetry.enabled=false``) or with
+``delta.tpu.journal.enabled=false``: zero bytes are written. Object-store
+tables (``scheme://`` paths) skip journaling like `obs/calibration` skips
+state files — the journal is plain local-file IO by design.
+
+`obs/advisor` aggregates the journal into workload facts and ranked layout
+recommendations; ``tools/journal_dump.py`` prints it offline.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+__all__ = ["enabled", "journal_dir", "predicate_fingerprint", "record_scan",
+           "record_commit", "record_dml", "record_router", "flush",
+           "read_entries", "sweep", "reset"]
+
+SEGMENT_PREFIX = "journal-"
+SEGMENT_SUFFIX = ".jsonl"
+
+# per-table buffers keyed by journal dir; entries are ready-to-write dicts
+_LOCK = threading.Lock()
+_BUFFERS: Dict[str, List[Dict[str, Any]]] = {}
+_OLDEST: Dict[str, float] = {}  # monotonic time of each buffer's oldest entry
+# active segment per journal dir: (path, bytes_written) — files are opened
+# in append mode per batch, never held open
+_ACTIVE: Dict[str, Tuple[str, int]] = {}
+_SWEPT: set = set()  # dirs swept at least once this process
+_SEQ = 0
+# IO serialization: the writer thread and synchronous flush() never
+# interleave lines within a segment
+_IO_LOCK = threading.Lock()
+_WRITER: Optional[threading.Thread] = None
+_WAKE = threading.Event()
+_ATEXIT = False  # final synchronous drain registered (once per process)
+
+#: hard cap per table buffer — a stalled writer degrades to dropped entries
+#: (counted), never to unbounded memory
+MAX_BUFFERED = 4096
+
+
+def enabled(log_path: Optional[str] = None) -> bool:
+    """Journaling is on: the journal conf AND telemetry are enabled, and the
+    table's log lives on a local filesystem (``scheme://`` paths skip it)."""
+    if not conf.get_bool("delta.tpu.journal.enabled", True):
+        return False
+    if not conf.get_bool("delta.tpu.telemetry.enabled", True):
+        return False
+    if log_path is not None and "://" in log_path:
+        return False
+    return True
+
+
+def journal_dir(log_path: str) -> str:
+    """The segment directory for a table's ``_delta_log`` path."""
+    return os.path.join(log_path, "_journal")
+
+
+def _segment_bytes() -> int:
+    try:
+        n = int(conf.get("delta.tpu.journal.segmentBytes", 1 << 20))
+    except (TypeError, ValueError):
+        n = 1 << 20
+    return n if n > 0 else 1 << 20
+
+
+def _max_bytes() -> int:
+    try:
+        n = int(conf.get("delta.tpu.journal.maxBytes", 16 << 20))
+    except (TypeError, ValueError):
+        n = 16 << 20
+    return n if n > 0 else 16 << 20
+
+
+def _retention_ms() -> int:
+    try:
+        n = int(conf.get("delta.tpu.journal.retentionMs", 7 * 86_400_000))
+    except (TypeError, ValueError):
+        n = 7 * 86_400_000
+    return n
+
+
+def _flush_entries() -> int:
+    try:
+        n = int(conf.get("delta.tpu.journal.flushEntries", 64))
+    except (TypeError, ValueError):
+        n = 64
+    return n if n > 0 else 64
+
+
+def _flush_interval_s() -> float:
+    try:
+        ms = float(conf.get("delta.tpu.journal.flushIntervalMs", 2000))
+    except (TypeError, ValueError):
+        ms = 2000.0
+    return max(ms, 100.0) / 1000.0
+
+
+# ---------------------------------------------------------------------------
+# Predicate fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _shape(expr) -> str:
+    """Normalized op shape of an IR expression: class names lowered, column
+    names kept (lowercased), literals abstracted to ``?`` — so ``v = 5`` and
+    ``v = 9`` share the fingerprint ``eq(v,?)`` while ``price * qty > 1000``
+    keeps its arithmetic structure (``gt(mul(price,qty),?)``)."""
+    from delta_tpu.expr import ir
+
+    if isinstance(expr, ir.Column):
+        return expr.name.lower()
+    if isinstance(expr, ir.Literal):
+        return "?"
+    name = type(expr).__name__.lower()
+    kids = ",".join(_shape(c) for c in expr.children)
+    return f"{name}({kids})"
+
+
+def _can_exclude(rewritten) -> bool:
+    """Can the skipping rewrite of a conjunct ever evaluate to False — i.e.
+    actually exclude a row group? ``skipping_predicate`` returns
+    ``Literal(None)`` (= keep) for unsupported shapes, but And/Or recurse,
+    so an unsupported disjunction comes back as ``Or(NULL, NULL)``, not a
+    bare NULL root. Three-valued logic: an OR excludes only when BOTH
+    branches can, an AND through either; a constant leaf never depends on
+    stats, so clustering can't make it selective."""
+    from delta_tpu.expr import ir
+
+    if isinstance(rewritten, ir.Literal):
+        return False
+    if isinstance(rewritten, ir.And):
+        return _can_exclude(rewritten.left) or _can_exclude(rewritten.right)
+    if isinstance(rewritten, ir.Or):
+        return _can_exclude(rewritten.left) and _can_exclude(rewritten.right)
+    return True
+
+
+def predicate_fingerprint(predicate, partition_cols: Iterable[str] = ()
+                          ) -> Optional[Dict[str, Any]]:
+    """Normalize a predicate into its workload fingerprint: referenced
+    columns, per-conjunct op shapes, and the prunable-vs-residual split —
+    a conjunct is *prunable* when the shared skipping rewrite
+    (``ops.pruning.skipping_predicate``, the same one ``exec/rowgroups``
+    evaluates against footer stats) lowers it to something min/max-evaluable;
+    otherwise it can only run as a residual filter and no amount of
+    clustering will ever let it skip data."""
+    if predicate is None:
+        return None
+    from delta_tpu.expr import ir
+    from delta_tpu.ops.pruning import skipping_predicate
+
+    pcols = frozenset(c.lower() for c in partition_cols)
+    conjuncts = []
+    prunable_cols: set = set()
+    residual_cols: set = set()
+    for c in ir.split_conjuncts(predicate):
+        cols = sorted({r.lower() for r in ir.references(c)})
+        try:
+            prunable = _can_exclude(skipping_predicate(c, pcols))
+        except Exception:  # noqa: BLE001 — fingerprinting must not fail a scan
+            prunable = False
+        (prunable_cols if prunable else residual_cols).update(cols)
+        conjuncts.append({
+            "shape": _shape(c),
+            "columns": cols,
+            "prunable": prunable,
+            "partition": bool(cols) and all(col in pcols for col in cols),
+        })
+    return {
+        "columns": sorted({col for c in conjuncts for col in c["columns"]}),
+        "conjuncts": conjuncts,
+        "prunableColumns": sorted(prunable_cols),
+        "residualColumns": sorted(residual_cols - prunable_cols),
+        "key": "&".join(sorted(c["shape"] for c in conjuncts)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Recording hooks
+# ---------------------------------------------------------------------------
+
+
+def _record(log_path: str, entry: Dict[str, Any]) -> bool:
+    """Buffer one entry for ``log_path``'s journal; the write happens on the
+    writer thread (or a synchronous :func:`flush`). Returns False when the
+    journal is inert for this table. Never raises: the commit hook runs
+    AFTER version N is durably on disk and the conflict hook sits on the
+    exception path — a journaling failure (e.g. ``Thread.start`` at
+    interpreter shutdown) must not misreport a landed commit as failed or
+    mask the conflict being raised."""
+    if not enabled(log_path):
+        return False
+    try:
+        entry.setdefault("ts", int(time.time() * 1000))
+        jdir = journal_dir(log_path)
+        wake = False
+        with _LOCK:
+            buf = _BUFFERS.setdefault(jdir, [])
+            if len(buf) >= MAX_BUFFERED:
+                telemetry.bump_counter("journal.entriesDropped")
+                return False
+            if not buf:
+                _OLDEST[jdir] = time.monotonic()
+            buf.append(entry)
+            if len(buf) >= _flush_entries():
+                wake = True
+        _ensure_writer()
+        if wake:
+            _WAKE.set()
+        return True
+    except Exception:  # noqa: BLE001 — best-effort, never fail the caller
+        telemetry.logger.debug("journal record failed", exc_info=True)
+        return False
+
+
+def record_scan(log_path: str, report=None, predicate=None,
+                partition_cols: Iterable[str] = (),
+                report_dict: Optional[Dict[str, Any]] = None) -> None:
+    """Journal one completed scan: the ScanReport plus the normalized
+    predicate fingerprint (hook: ``exec/scan.scan_to_table``). The hot path
+    pays only a dict append: callers pass the ``report_dict`` they already
+    serialized for the span, and the fingerprint (an IR walk + the skipping
+    rewrite per conjunct) is deferred to the writer thread — predicate IR
+    expressions are immutable, so walking them off-thread is safe."""
+    if not enabled(log_path):
+        return
+    _record(log_path, {
+        "kind": "scan",
+        "report": (report_dict if report_dict is not None
+                   else report.to_dict()),
+        "_fingerprint_input": (predicate, tuple(partition_cols)),
+    })
+
+
+def record_commit(log_path: str, stats: Dict[str, Any],
+                  outcome: str = "committed") -> None:
+    """Journal one commit attempt's CommitStats + outcome (``committed``,
+    ``reconciledWin``, or ``conflict`` for a genuine logical conflict) —
+    hook: ``txn/transaction.OptimisticTransaction``."""
+    if not enabled(log_path):
+        return
+    _record(log_path, {"kind": "commit", "outcome": outcome,
+                       "stats": dict(stats)})
+
+
+def record_dml(log_path: str, op: str, **payload: Any) -> None:
+    """Journal one DML command: the router decision + audit verdict for
+    MERGE, mode + metrics for UPDATE/DELETE (hooks: ``commands/*``)."""
+    if not enabled(log_path):
+        return
+    _record(log_path, {"kind": "dml", "op": op, **payload})
+
+
+def record_router(log_path: str, audit: Dict[str, Any]) -> None:
+    """Journal one router audit record (hook: ``obs/router_audit``)."""
+    if not enabled(log_path):
+        return
+    _record(log_path, {"kind": "router", "audit": dict(audit)})
+
+
+# ---------------------------------------------------------------------------
+# Writer thread + segment IO
+# ---------------------------------------------------------------------------
+
+
+def _ensure_writer() -> None:
+    global _WRITER, _ATEXIT
+    if _WRITER is not None and _WRITER.is_alive():
+        return
+    with _LOCK:
+        if _WRITER is not None and _WRITER.is_alive():
+            return
+        if not _ATEXIT:
+            # a short-lived process (scan + commit + exit inside the flush
+            # interval) must not lose its buffered entries with the daemon
+            # writer: drain synchronously at interpreter exit
+            atexit.register(_final_flush)
+            _ATEXIT = True
+        _WRITER = threading.Thread(target=_writer_loop, daemon=True,
+                                   name="delta-journal-writer")
+        _WRITER.start()
+
+
+def _final_flush() -> None:  # pragma: no cover — exercised via subprocess test
+    try:
+        _drain(aged_only=False)
+    except Exception:  # noqa: BLE001 — exiting anyway
+        pass
+
+
+def _writer_loop() -> None:  # pragma: no cover — exercised via flush() too
+    while True:
+        _WAKE.wait(timeout=_flush_interval_s())
+        _WAKE.clear()
+        try:
+            _drain(aged_only=True)
+        except Exception:  # noqa: BLE001 — journaling must never kill the thread
+            telemetry.logger.debug("journal writer flush failed", exc_info=True)
+
+
+def _take_batches(aged_only: bool,
+                  only_dir: Optional[str]) -> List[Tuple[str, List[dict]]]:
+    now = time.monotonic()
+    interval = _flush_interval_s()
+    limit = _flush_entries()
+    out = []
+    with _LOCK:
+        for jdir in list(_BUFFERS):
+            if only_dir is not None and jdir != only_dir:
+                continue
+            buf = _BUFFERS[jdir]
+            if not buf:
+                continue
+            aged = now - _OLDEST.get(jdir, now) >= interval
+            if aged_only and not (aged or len(buf) >= limit):
+                continue
+            out.append((jdir, buf))
+            _BUFFERS[jdir] = []
+            _OLDEST.pop(jdir, None)
+    return out
+
+
+def _drain(aged_only: bool = False, only_dir: Optional[str] = None) -> int:
+    """Take buffered batches and write them. The WHOLE cycle (take + write)
+    runs under ``_IO_LOCK``: a concurrent :func:`flush` blocks until any
+    in-flight writer batch is on disk before taking its own, so
+    read-after-flush sees every entry recorded before the call and batches
+    land in take order (``read_entries``'s oldest-first contract)."""
+    written = 0
+    with _IO_LOCK:
+        for jdir, entries in _take_batches(aged_only, only_dir):
+            written += _write_batch(jdir, entries)
+    return written
+
+
+def _next_segment(jdir: str) -> str:
+    global _SEQ
+    with _LOCK:
+        _SEQ += 1
+        seq = _SEQ
+    name = f"{SEGMENT_PREFIX}{int(time.time() * 1000):013d}-" \
+           f"{os.getpid()}-{seq:06d}{SEGMENT_SUFFIX}"
+    return os.path.join(jdir, name)
+
+
+def _write_batch(jdir: str, entries: List[dict]) -> int:
+    """Append one batch as JSONL, rotating the active segment at the size
+    bound and sweeping the directory on rotation. Deferred work entries
+    carry (the scan fingerprint) happens HERE, on the writer thread, not on
+    the operation's thread. Callers hold ``_IO_LOCK`` (via :func:`_drain`).
+    Best-effort: an unwritable directory drops the batch (counted), never
+    fails the caller."""
+    lines = []
+    for e in entries:
+        fp_in = e.pop("_fingerprint_input", None)
+        if fp_in is not None:
+            try:
+                e["fingerprint"] = predicate_fingerprint(fp_in[0], fp_in[1])
+            except Exception:  # noqa: BLE001 — never lose the report over it
+                e["fingerprint"] = None
+        try:
+            lines.append(json.dumps(e, separators=(",", ":"), default=str))
+        except (TypeError, ValueError):
+            continue
+    if not lines:
+        return 0
+    # byte accounting must match what lands on disk (non-ASCII escapes via
+    # default=str can still multi-byte), or rotation and the sweep disagree
+    data = ("\n".join(lines) + "\n").encode("utf-8")
+    seg_limit = _segment_bytes()
+    rotated = False
+    try:
+        os.makedirs(jdir, exist_ok=True)
+        active = _ACTIVE.get(jdir)
+        if active is None or active[1] >= seg_limit \
+                or not os.path.exists(active[0]):
+            if jdir not in _SWEPT or active is not None:
+                sweep(jdir)
+            active = (_next_segment(jdir), 0)
+            rotated = True
+        with open(active[0], "ab") as f:
+            f.write(data)
+        _ACTIVE[jdir] = (active[0], active[1] + len(data))
+    except OSError:
+        telemetry.bump_counter("journal.entriesDropped", len(lines))
+        return 0
+    if rotated:
+        # counted only once the file actually exists — an unwritable dir
+        # re-enters the rotation branch every batch and must not inflate it
+        telemetry.bump_counter("journal.segments.written")
+    telemetry.bump_counter("journal.entries", len(lines))
+    telemetry.bump_counter("journal.bytes.written", len(data))
+    return len(lines)
+
+
+def flush(log_path: Optional[str] = None) -> int:
+    """Synchronously write every buffered entry (for one table's log path,
+    or all); returns entries written. The advisor and tests call this —
+    steady-state writes stay on the writer thread."""
+    only = journal_dir(log_path) if log_path is not None else None
+    return _drain(aged_only=False, only_dir=only)
+
+
+def sweep(jdir: str) -> int:
+    """Bound the journal directory: segments older than
+    ``delta.tpu.journal.retentionMs`` are deleted, then oldest-first until
+    the total is within ``delta.tpu.journal.maxBytes`` — the same
+    aged-orphan discipline as ``log/cleanup.sweep_tmp_orphans``."""
+    _SWEPT.add(jdir)
+    try:
+        names = sorted(n for n in os.listdir(jdir)
+                       if n.startswith(SEGMENT_PREFIX)
+                       and n.endswith(SEGMENT_SUFFIX))
+    except OSError:
+        return 0
+    cutoff = time.time() - _retention_ms() / 1000.0
+    max_total = _max_bytes()
+    stats = []
+    for n in names:
+        p = os.path.join(jdir, n)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        stats.append((p, st.st_size, st.st_mtime))
+    total = sum(s[1] for s in stats)
+    deleted = 0
+    active = _ACTIVE.get(jdir)
+    active_path = active[0] if active is not None else None
+    # a process appends only to ITS newest segment (names embed the
+    # creating pid), so the possibly-active set is one segment per pid —
+    # size pressure spares those while RECENTLY written (deleting a live
+    # concurrent writer's file mid-append would lose already-flushed
+    # entries ahead of policy; a live writer touches its segment at least
+    # every flush interval, so anything grace-stale belongs to a dead pid
+    # and stays fair game — one immune segment per CI/cron run would make
+    # the maxBytes cap unenforceable). Age expiry spares nothing: a table
+    # that stopped journaling must shed its final segment too — except
+    # this process's own active file (tests run with tiny retention
+    # windows while entries are still buffered for it).
+    newest_per_pid: Dict[str, str] = {}
+    for p, _size, _mtime in stats:  # name-sorted oldest → newest
+        parts = os.path.basename(p).split("-")
+        newest_per_pid[parts[2] if len(parts) >= 4 else ""] = p
+    maybe_active = set(newest_per_pid.values())
+    now = time.time()
+    grace = max(60.0, 10 * _flush_interval_s())
+    for p, size, mtime in stats:
+        if p == active_path:
+            continue
+        spared = p in maybe_active and now - mtime <= grace
+        if mtime <= cutoff or (total > max_total and not spared):
+            try:
+                os.remove(p)
+                deleted += 1
+                total -= size
+            except OSError:
+                continue
+    if deleted:
+        telemetry.bump_counter("journal.segments.swept", deleted)
+    return deleted
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+
+
+def read_entries(log_path: str, kinds: Optional[Iterable[str]] = None,
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Parse every journal segment for a table, oldest entry first.
+    Segment-name order (names embed the creation epoch) is only a first
+    pass — two processes journaling the same table interleave in time while
+    each appends to its OWN active segment, so entries are stable-sorted by
+    their recorded ``ts`` (within-segment order kept on ties). Malformed
+    lines are skipped — a torn tail write must never poison the history.
+    ``kinds`` filters entry kinds; ``limit`` keeps the LAST N entries (a
+    genuine recent window, thanks to the sort)."""
+    jdir = journal_dir(log_path)
+    try:
+        names = sorted(n for n in os.listdir(jdir)
+                       if n.startswith(SEGMENT_PREFIX)
+                       and n.endswith(SEGMENT_SUFFIX))
+    except OSError:
+        return []
+    want = frozenset(kinds) if kinds is not None else None
+    out: List[Dict[str, Any]] = []
+    for n in names:
+        try:
+            with open(os.path.join(jdir, n), encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(e, dict):
+                        continue
+                    if want is None or e.get("kind") in want:
+                        out.append(e)
+        except OSError:
+            continue
+    out.sort(key=lambda e: e.get("ts") or 0)  # stable: ties keep file order
+    if limit is not None and limit >= 0:
+        # out[-0:] would be the WHOLE list — limit=0 means "no entries"
+        out = out[-limit:] if limit > 0 else []
+    return out
+
+
+def reset() -> None:
+    """Drop in-memory buffers and active-segment bookkeeping (tests, bench
+    per-config isolation). On-disk segments are left alone — delete the
+    ``_journal`` directory to forget a table's history."""
+    with _LOCK:
+        _BUFFERS.clear()
+        _OLDEST.clear()
+    with _IO_LOCK:
+        _ACTIVE.clear()
+        _SWEPT.clear()

@@ -17,7 +17,7 @@ stringly-typed drift: a renamed gauge fails the suite, not a Grafana panel.
 from __future__ import annotations
 
 __all__ = ["GAUGES", "COUNTERS", "ENGINE_COUNTERS", "HISTOGRAMS",
-           "PUBLIC_API", "health_gauge"]
+           "PUBLIC_API", "DESCRIPTIONS", "health_gauge"]
 
 #: Every labeled gauge the engine publishes.
 GAUGES = frozenset({
@@ -93,6 +93,14 @@ COUNTERS = frozenset({
     "router.audits",              # one per routed decision recorded
     "router.misses",              # hindsight: rejected route predicted faster
     "router.calibration.updates",  # EWMA samples folded into the state
+    # -- workload journal + layout advisor (obs/journal, obs/advisor) -----
+    "journal.entries",            # entries written to journal segments
+    "journal.bytes.written",      # JSONL bytes appended
+    "journal.segments.written",   # segment files opened
+    "journal.segments.swept",     # segments deleted by the size/age sweep
+    "journal.entriesDropped",     # buffer cap hit or unwritable directory
+    "advisor.runs",               # advise() invocations
+    "advisor.recommendations",    # recommendations emitted across runs
 })
 
 #: Every OTHER counter the engine bumps by constant name — the inverse lint
@@ -158,14 +166,137 @@ PUBLIC_API = {
     "flight_recorder": ("install", "uninstall", "record_incident",
                         "incident_files"),
     "metric_names": ("GAUGES", "COUNTERS", "ENGINE_COUNTERS", "HISTOGRAMS",
-                     "PUBLIC_API", "health_gauge"),
+                     "PUBLIC_API", "DESCRIPTIONS", "health_gauge"),
     "router_audit": ("RouterAudit", "record_audit", "recent_audits",
-                     "clear_audits", "audit_stats"),
+                     "clear_audits", "audit_stats", "last_audit"),
     "calibration": ("enabled", "ingest", "state_path", "load_state",
                     "save_state", "apply_state", "current_state", "reset"),
     "hbm_ledger": ("Account", "adjust", "totals", "budget_bytes",
                    "key_cache_allowance", "over_budget", "maybe_relieve",
                    "reset"),
+    "journal": ("enabled", "journal_dir", "predicate_fingerprint",
+                "record_scan", "record_commit", "record_dml",
+                "record_router", "flush", "read_entries", "sweep", "reset"),
+    "advisor": ("Recommendation", "AdvisorReport", "advise"),
+}
+
+
+#: One-line description per catalog entry, emitted as ``# HELP`` lines in
+#: the Prometheus exposition (``telemetry.prometheus_text``) so scrapers
+#: classify and document every series. The lint in ``tests/test_telemetry``
+#: requires a non-empty description for EVERY catalog name — a new metric
+#: cannot ship undocumented.
+DESCRIPTIONS = {
+    # gauges — doctor
+    "table.health.severity": "Worst doctor dimension severity (0 ok, 1 warn, 2 critical).",
+    "table.health.files.count": "Live data files in the current snapshot.",
+    "table.health.files.bytes": "Live data bytes in the current snapshot.",
+    "table.health.checkpoint.commitsSince": "Commits replayed after the last checkpoint on a cold build.",
+    "table.health.checkpoint.tailBytes": "Log-tail bytes re-read per snapshot update.",
+    "table.health.checkpoint.tailFiles": "Log-tail commit files after the last checkpoint.",
+    "table.health.smallFiles.count": "Files below the OPTIMIZE compaction floor.",
+    "table.health.smallFiles.bytes": "Bytes held in small files.",
+    "table.health.smallFiles.estReduction": "Estimated file-count reduction OPTIMIZE would achieve.",
+    "table.health.dv.files": "Files carrying deletion vectors.",
+    "table.health.dv.deletedRows": "Rows soft-deleted via deletion vectors.",
+    "table.health.dv.deletedPct": "Soft-deleted fraction of the table's physical rows.",
+    "table.health.dv.filesPastPurge": "Files past the per-file PURGE threshold.",
+    "table.health.stats.coveragePct": "Fraction of files carrying min/max stats.",
+    "table.health.stats.parsedPct": "Fraction of files whose stats parse cleanly.",
+    "table.health.partition.count": "Distinct partitions in the snapshot.",
+    "table.health.partition.gini": "Byte-skew Gini coefficient across partitions.",
+    "table.health.tombstones.count": "Removed files awaiting retention expiry.",
+    "table.health.tombstones.bytes": "Bytes held by tombstoned files.",
+    "table.health.protocol.minReader": "Table protocol minimum reader version.",
+    "table.health.protocol.minWriter": "Table protocol minimum writer version.",
+    "table.health.device.hbmBytes": "Device-resident bytes attributed while diagnosing this table.",
+    "table.health.device.keyCacheBytes": "Key-cache slab bytes resident on device.",
+    "table.health.device.stateCacheBytes": "State-cache lane bytes resident on device.",
+    "table.health.device.scratchBytes": "Transient probe-scratch bytes resident on device.",
+    "table.health.device.budgetBytes": "Configured soft HBM budget (0 = unlimited).",
+    "table.health.device.pressure": "Resident bytes over the soft budget (fraction).",
+    # gauges — device ledger / router / streaming / maintenance
+    "device.hbm.keyCacheBytes": "Process-wide key-cache bytes resident on device.",
+    "device.hbm.stateCacheBytes": "Process-wide state-cache bytes resident on device.",
+    "device.hbm.scratchBytes": "Process-wide transient scratch bytes resident on device.",
+    "router.missRate": "Fraction of routed decisions where a rejected route predicted faster.",
+    "router.calibration": "Installed calibrated value per link constant.",
+    "streaming.source.backlogFiles": "Committed files not yet served to the streaming consumer.",
+    "streaming.source.backlogBytes": "Committed bytes not yet served to the streaming consumer.",
+    "streaming.source.lastBatchVersionLag": "Table versions between the last served batch and the head.",
+    "table.maintenance.lastOptimizeVersion": "Table version written by the last OPTIMIZE.",
+    "table.maintenance.lastVacuumTimestamp": "Wall-clock ms of the last VACUUM.",
+    # counters — obs layer
+    "obs.incidents.written": "Flight-recorder incident files written.",
+    "obs.server.requests": "HTTP requests served by the obs endpoint.",
+    "commit.conflicts": "Commits aborted on a genuine logical conflict.",
+    "maintenance.optimize.filesCompacted": "Files removed by OPTIMIZE compaction.",
+    "maintenance.optimize.filesWritten": "Files written by OPTIMIZE compaction.",
+    "maintenance.vacuum.filesDeleted": "Unreferenced files deleted by VACUUM.",
+    "maintenance.vacuum.bytesReclaimed": "Bytes reclaimed by VACUUM.",
+    "storage.retry.attempts": "Transient-failure retry sleeps across all stores.",
+    "storage.retry.exhausted": "Retry policies that gave up and surfaced the error.",
+    "faults.injected": "Deterministic fault-injector activations.",
+    "commit.reconciled": "Ambiguous commit outcomes resolved via the txnId token.",
+    "merge.device.engaged": "MERGEs whose join pairs came from a device join.",
+    "merge.device.declined": "MERGEs where the cost model chose the host join.",
+    "merge.device.cacheHit": "Device MERGEs served from an HBM-resident key lane.",
+    "merge.keyCache.builds": "Cold resident key-lane builds.",
+    "merge.keyCache.advances": "Incremental log-tail applications to a key lane.",
+    "merge.keyCache.invalidations": "Key-cache entries dropped by a rewrite epoch bump.",
+    "router.audits": "Routed decisions recorded in the audit ledger.",
+    "router.misses": "Audits where a rejected route's prediction beat the actual.",
+    "router.calibration.updates": "EWMA samples folded into the calibration state.",
+    "journal.entries": "Workload-journal entries written to segments.",
+    "journal.bytes.written": "JSONL bytes appended to journal segments.",
+    "journal.segments.written": "Journal segment files opened.",
+    "journal.segments.swept": "Journal segments deleted by the size/age sweep.",
+    "journal.entriesDropped": "Journal entries dropped (buffer cap or unwritable dir).",
+    "advisor.runs": "Layout-advisor invocations.",
+    "advisor.recommendations": "Recommendations emitted by the advisor.",
+    # counters — engine
+    "checkpoint.parts": "Checkpoint part files written.",
+    "checkpoint.actions": "Actions serialized into checkpoints.",
+    "checkpoint.written": "Checkpoints completed.",
+    "commit.total": "Commits attempted through the transaction pipeline.",
+    "commit.retries": "Extra commit attempts after lost races.",
+    "convert.stats.fromFooter": "CONVERT stats derived from Parquet footers.",
+    "convert.stats.fromDecode": "CONVERT stats derived via full decode fallback.",
+    "footerCache.hits": "Parquet footer cache hits.",
+    "footerCache.misses": "Parquet footer cache misses (footer parsed).",
+    "footerCache.evictions": "Parquet footers evicted by the LRU bound.",
+    "log.update.installed": "Log updates that installed a newer snapshot.",
+    "log.update.unchanged": "Log updates that found no new commits.",
+    "parquet.files.written": "Parquet data files written.",
+    "parquet.bytes.written": "Parquet bytes written.",
+    "parquet.rows.written": "Rows written to Parquet files.",
+    "scan.files.read": "Data files decoded by scans.",
+    "scan.bytes.read": "Compressed bytes of files decoded by scans.",
+    "scan.bytes.skipped": "Uncompressed bytes skipped by row-group pruning.",
+    "scan.rowgroups.total": "Row groups considered by the second pruning tier.",
+    "scan.rowgroups.pruned": "Row groups skipped via footer stats.",
+    "scan.rowgroups.lateSkipped": "Row groups skipped by late materialization.",
+    "stateCache.builds": "Device state-cache lane builds.",
+    "stateCache.plan.resident": "Scan plans served from resident lanes.",
+    "stateCache.plan.fallback.lowering": "Scan plans that could not lower to ranges.",
+    "stateCache.plan.fallback.noentry": "Scan plans with no resident entry.",
+    "stateCache.plan.fallback.version": "Scan plans whose entry advanced past the snapshot.",
+    "stateCache.scan.resident": "File prunes served from resident lanes.",
+    "stateCache.scan.fallback.lowering": "File prunes that could not lower to ranges.",
+    "stateCache.scan.fallback.noentry": "File prunes with no resident entry.",
+    "stateCache.scan.fallback.version": "File prunes whose entry advanced past the snapshot.",
+    "stateExport.statsLanes.struct": "Checkpoint rows decoded from typed struct stats.",
+    "stateExport.statsLanes.json": "Checkpoint rows decoded via per-row JSON stats.",
+    "stateExport.statsLanes.mixed": "Checkpoint segments mixing struct and JSON stats.",
+    "stateExport.statsLanes.us": "Checkpoint stats decoded with microsecond timestamps.",
+    "streaming.sink.batches": "Micro-batches written by the streaming sink.",
+    # histograms
+    "delta.checkpoint.duration_ms": "Checkpoint write latency (ms).",
+    "delta.commit.duration_ms": "Commit pipeline latency (ms).",
+    "delta.streaming.sink.batch_ms": "Streaming sink addBatch latency (ms).",
+    "delta.streaming.source.batch_ms": "Streaming source getBatch latency (ms).",
+    "router.predicted_ms": "Router-predicted cost of the chosen route (ms).",
+    "router.actual_ms": "Measured cost of the chosen route (ms).",
 }
 
 

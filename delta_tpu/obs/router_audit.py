@@ -33,7 +33,7 @@ from delta_tpu.utils import telemetry
 from delta_tpu.utils.config import conf
 
 __all__ = ["RouterAudit", "record_audit", "recent_audits", "clear_audits",
-           "audit_stats"]
+           "audit_stats", "last_audit"]
 
 
 @dataclass
@@ -120,12 +120,27 @@ def record_audit(op: str, path: str, decision: str,
                           op=op, decision=decision)
     telemetry.observe("router.actual_ms", actual_ms, op=op, decision=decision)
     telemetry.record_event("delta.router.audit", audit.to_dict(), path=path)
+    # workload journal: the audit outlives the in-memory ring, so routing
+    # hindsight (miss rate over weeks, not minutes) feeds the advisor's
+    # calibration recommendation (buffered; inert when journaling is off)
+    if log_path is not None:
+        from delta_tpu.obs import journal as journal_mod
+
+        journal_mod.record_router(log_path, audit.to_dict())
     if samples:
         from delta_tpu.obs import calibration
 
         calibration.ingest(samples, log_path=log_path,
                            flush=calibration_flush)
     return audit
+
+
+def last_audit() -> Optional[RouterAudit]:
+    """The most recently recorded audit, if any — embedded into
+    flight-recorder incidents so a failure shows what the router last
+    decided, not just the span stack."""
+    with _LOCK:
+        return _AUDITS[-1] if _AUDITS else None
 
 
 def recent_audits(limit: int = 32) -> List[Dict[str, Any]]:

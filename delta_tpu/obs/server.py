@@ -16,6 +16,9 @@ Route          Payload
 ``/router``    router audit ledger: miss stats, installed calibration
                overrides, and the last N audit records (``?limit=N``,
                default 32) — see :mod:`delta_tpu.obs.router_audit`
+``/advisor``   ``?path=/data/tbl`` → the workload-journal layout advisor
+               report (:func:`delta_tpu.obs.advisor.advise`); ``?limit=N``
+               restricts to the last N journal entries
 =============  ==============================================================
 
 Nothing listens unless :func:`start_server` is called (port argument or
@@ -86,6 +89,18 @@ class _Handler(BaseHTTPRequestHandler):
                 from delta_tpu.obs.doctor import doctor
 
                 self._json(doctor(path).to_dict())
+            elif route == "/advisor":
+                path = q.get("path", [None])[0]
+                if not path:
+                    self._json({"error": "missing ?path=<table path>"}, 400)
+                    return
+                try:
+                    limit = int(q.get("limit", [None])[0] or 0) or None
+                except (TypeError, ValueError):
+                    limit = None  # like /router: a typo'd limit isn't a 500
+                from delta_tpu.obs.advisor import advise
+
+                self._json(advise(path, limit=limit).to_dict())
             elif route == "/router":
                 from delta_tpu.obs import calibration, router_audit
                 from delta_tpu.parallel import link
@@ -106,7 +121,8 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
-                                       "/trace", "/doctor", "/router"]}, 404)
+                                       "/trace", "/doctor", "/router",
+                                       "/advisor"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

@@ -360,6 +360,17 @@ class OptimisticTransaction:
                 "delta.commit.duration_ms", self.stats.commit_duration_ms,
                 path=self.delta_log.data_path,
             )
+            # workload journal: CommitStats + the reconcile outcome persist
+            # across processes so the advisor can find contention windows
+            # (buffered; inert under blackout / journal disabled)
+            from delta_tpu.obs import journal as journal_mod
+
+            journal_mod.record_commit(
+                self.delta_log.log_path, stats_data,
+                outcome=("reconciledWin"
+                         if getattr(self, "_reconcile_outcome", None) is True
+                         else "committed"),
+            )
             return version
 
     # -- commit internals ------------------------------------------------
@@ -523,6 +534,7 @@ class OptimisticTransaction:
                     token = None
             won = token is not None and token == getattr(self, "_commit_token", None)
         outcome = {True: "won", False: "lost", None: "not_landed"}[won]
+        self._reconcile_outcome = won
         telemetry.bump_counter("commit.reconciled")
         telemetry.record_event(
             "delta.commit.reconcile",
@@ -556,6 +568,17 @@ class OptimisticTransaction:
                     # the failing span stack from there. Other exceptions
                     # (bugs, interrupts) propagate uncounted.
                     telemetry.bump_counter("commit.conflicts")
+                    # the commit dies here, so journal the aborted attempt
+                    # now — contention analysis needs the failures too
+                    from delta_tpu.obs import journal as journal_mod
+
+                    journal_mod.record_commit(
+                        self.delta_log.log_path,
+                        {"readVersion": self.read_version,
+                         "attempts": self.stats.attempts,
+                         "conflictVersion": next_attempt},
+                        outcome="conflict",
+                    )
                     raise
                 next_attempt += 1
             cev.data["winningCommits"] = next_attempt - failed_version

@@ -135,6 +135,23 @@ class SqlConf:
         "delta.tpu.obs.incidentKeep": 20,
         # Last N ring-buffer events snapshotted into each incident file.
         "delta.tpu.obs.incidentEvents": 64,
+        # Persistent per-table workload journal (obs/journal): one JSONL
+        # entry per scan/commit/DML/router decision, batched into segment
+        # files under <table>/_delta_log/_journal/ for the layout advisor
+        # (obs/advisor). Inert under a telemetry blackout either way;
+        # object-store (scheme://) tables never journal.
+        "delta.tpu.journal.enabled": True,
+        # Active segment rotates past this many bytes.
+        "delta.tpu.journal.segmentBytes": 1 << 20,
+        # Total on-disk bound per table; oldest segments swept first.
+        "delta.tpu.journal.maxBytes": 16 << 20,
+        # Segments older than this are swept regardless of the size bound.
+        "delta.tpu.journal.retentionMs": 7 * 86_400_000,
+        # Buffered entries flush to disk at this count or age, whichever
+        # comes first — the IO runs on the journal writer thread, never on
+        # the operation's thread.
+        "delta.tpu.journal.flushEntries": 64,
+        "delta.tpu.journal.flushIntervalMs": 2000,
         # Streaming backlog gauges walk at most this many pending files past
         # each batch end (a deeply lagging consumer must not re-read its
         # whole remaining log tail per micro-batch; the published count is a

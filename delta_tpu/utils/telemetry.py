@@ -487,26 +487,54 @@ def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def _metric_descriptions() -> Dict[str, str]:
+    """One-line ``# HELP`` text per cataloged metric name (lazy import —
+    the obs layer sits above telemetry; a broken catalog must never break
+    exposition)."""
+    try:
+        from delta_tpu.obs.metric_names import DESCRIPTIONS
+
+        return DESCRIPTIONS
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def prometheus_text() -> str:
     """Prometheus text-format exposition of every counter, gauge, and
-    histogram (stable ordering — scrape-diff friendly)."""
+    histogram (stable ordering — scrape-diff friendly). Cataloged names
+    (``obs/metric_names.DESCRIPTIONS``) get a ``# HELP`` line so scrapers
+    classify and document each series; ``# TYPE`` is emitted once per metric
+    name (label sets of one gauge/histogram share their header)."""
     with _LOCK:
         ctrs = sorted(_COUNTERS.items())
         gags = sorted(_GAUGES.items())
         hists = sorted(_HISTOGRAMS.items(), key=lambda kv: kv[0])
         hist_rows = [(k, list(h.counts), h.sum, h.count) for k, h in hists]
+    descs = _metric_descriptions()
     lines: List[str] = []
+
+    def _header(name: str, pn: str, kind: str, seen: set) -> None:
+        if name in seen:
+            return
+        seen.add(name)
+        if name in descs:
+            lines.append(f"# HELP {pn} {descs[name]}")
+        lines.append(f"# TYPE {pn} {kind}")
+
+    seen_ctr: set = set()
     for name, value in ctrs:
         pn = _prom_name(name) + "_total"
-        lines.append(f"# TYPE {pn} counter")
+        _header(name, pn, "counter", seen_ctr)
         lines.append(f"{pn} {value}")
+    seen_g: set = set()
     for (name, labels), value in gags:
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} gauge")
+        _header(name, pn, "gauge", seen_g)
         lines.append(f"{pn}{_prom_labels(labels)} {_fmt(value)}")
+    seen_h: set = set()
     for (name, labels), counts, total, count in hist_rows:
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} histogram")
+        _header(name, pn, "histogram", seen_h)
         cum = 0
         for bound, c in zip(HISTOGRAM_BUCKETS, counts):
             cum += c
@@ -599,6 +627,11 @@ def bench_snapshot(top: int = 12,
 
 # -- Chrome trace-event export (Perfetto / chrome://tracing) -----------------
 
+#: default thread names (Thread-12, ThreadPoolExecutor-0_3, MainThread is
+#: kept — it IS informative); engine pools override these on a recycled tid
+_GENERIC_THREAD = re.compile(r"(Thread-\d+.*|ThreadPoolExecutor-\d+_\d+)")
+
+
 def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
     """Export the event ring buffer as Chrome trace-event JSON.
 
@@ -627,10 +660,22 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
         ]
     rows: List[Dict[str, Any]] = []
     seen_tids: Dict[int, str] = {}
+
+    def _note_tid(tid: int, tname: str) -> None:
+        # prefer an engine-named lane (delta-scan-decode_3, merge-slab-
+        # upload, delta-journal-writer, ...) over a generic Thread-N: the
+        # OS recycles thread ids across pool generations, and the named
+        # pools are what make a multi-lane trace readable in Perfetto
+        name = tname or str(tid)
+        cur = seen_tids.get(tid)
+        if cur is None:
+            seen_tids[tid] = name
+        elif _GENERIC_THREAD.fullmatch(cur) and not _GENERIC_THREAD.fullmatch(name):
+            seen_tids[tid] = name
+
     for ev in events:
         tid = ev.thread_id or 0
-        if tid not in seen_tids:
-            seen_tids[tid] = ev.thread_name or str(tid)
+        _note_tid(tid, ev.thread_name)
         args: Dict[str, Any] = {}
         if ev.tags:
             args.update(ev.tags)
@@ -659,8 +704,7 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
         rows.append(row)
     for (op_type, tid, tname, tags, data, error,
          span_id, parent_id, start_us, dur) in open_clamped:
-        if tid not in seen_tids:
-            seen_tids[tid] = tname or str(tid)
+        _note_tid(tid, tname)
         args = dict(tags)
         args.update(data)
         if error:
@@ -673,6 +717,13 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
             "name": op_type, "cat": "delta", "pid": pid, "tid": tid,
             "ts": start_us, "ph": "X", "dur": dur, "args": args,
         })
+    # metadata rows: the process lane plus one thread_name per tid, so the
+    # named pools (delta-scan-decode, merge-slab-upload, merge-device-probe,
+    # delta-ckpt-part, ...) render as labeled lanes instead of bare tids
+    rows.append({
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "delta-tpu"},
+    })
     for tid, tname in seen_tids.items():
         rows.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,

@@ -269,6 +269,10 @@ def test_prometheus_text_golden():
                   2048, 4096, 8192, 16384, 32768, 65536)
     )
     expected = (
+        # cataloged metrics carry a # HELP line from metric_names.DESCRIPTIONS;
+        # ad-hoc names (delta.cache.bytes, delta.op.ms) get TYPE only
+        "# HELP commit_total_total "
+        "Commits attempted through the transaction pipeline.\n"
         "# TYPE commit_total_total counter\n"
         "commit_total_total 3\n"
         "# TYPE delta_cache_bytes gauge\n"
@@ -280,6 +284,21 @@ def test_prometheus_text_golden():
         'delta_op_ms_count{path="/t"} 2\n'
     )
     assert text == expected
+
+
+def test_prometheus_type_emitted_once_per_metric_name():
+    """Label sets of one gauge share a single # HELP/# TYPE header —
+    Prometheus parsers reject duplicate TYPE lines for a name."""
+    telemetry.reset_all()
+    telemetry.set_gauge("router.missRate", 0.25)
+    telemetry.set_gauge("table.health.severity", 1, path="/a")
+    telemetry.set_gauge("table.health.severity", 2, path="/b")
+    text = telemetry.prometheus_text()
+    assert text.count("# TYPE table_health_severity gauge") == 1
+    assert text.count("# HELP table_health_severity ") == 1
+    assert 'table_health_severity{path="/a"} 1' in text
+    assert 'table_health_severity{path="/b"} 2' in text
+    assert "# HELP router_missRate " in text
 
 
 def test_prometheus_escapes_label_values():
@@ -714,6 +733,26 @@ def test_catalog_counter_sets_are_disjoint():
     assert not overlap, f"counters cataloged twice: {sorted(overlap)}"
 
 
+def test_every_catalog_entry_has_a_description():
+    """Exposition lint: every cataloged metric must carry a non-empty
+    one-line DESCRIPTIONS entry (the /metrics # HELP text), and
+    DESCRIPTIONS must not accumulate entries for metrics that no longer
+    exist — the catalog and its documentation move together."""
+    from delta_tpu.obs import metric_names
+
+    cataloged = (metric_names.GAUGES | metric_names.COUNTERS
+                 | metric_names.ENGINE_COUNTERS | metric_names.HISTOGRAMS)
+    missing = sorted(
+        n for n in cataloged
+        if not str(metric_names.DESCRIPTIONS.get(n, "")).strip()
+    )
+    assert not missing, f"catalog entries without a # HELP description: {missing}"
+    stale = sorted(set(metric_names.DESCRIPTIONS) - cataloged)
+    assert not stale, f"DESCRIPTIONS for un-cataloged metrics: {stale}"
+    for name, desc in metric_names.DESCRIPTIONS.items():
+        assert "\n" not in desc, f"multi-line HELP for {name}"
+
+
 # -- cross-thread span propagation -------------------------------------------
 
 
@@ -735,6 +774,38 @@ def test_span_context_propagates_into_pool_workers():
     assert any(c.thread_id != parent.thread_id for c in children)
     # the submitter's own stack is untouched by the workers
     assert telemetry.span_context() == ()
+
+
+def test_chrome_trace_emits_process_and_pool_thread_metadata():
+    """Named worker-pool lanes render labeled in Perfetto: the export
+    carries a process_name metadata row, and a tid whose first event came
+    from a generic Thread-N later adopts the engine pool's name."""
+    import os as _os
+    from concurrent.futures import ThreadPoolExecutor
+
+    telemetry.clear_events()
+
+    def work(i):
+        with telemetry.record_operation("delta.test.pool.child"):
+            pass
+
+    with telemetry.record_operation("delta.test.pool"):
+        with ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="delta-scan-decode"
+        ) as pool:
+            list(pool.map(telemetry.propagated(work), range(4)))
+    trace = telemetry.export_chrome_trace()
+    meta = [r for r in trace["traceEvents"] if r.get("ph") == "M"]
+    procs = [r for r in meta if r["name"] == "process_name"]
+    assert procs and procs[0]["args"]["name"] == "delta-tpu"
+    assert procs[0]["pid"] == _os.getpid()
+    tnames = {r["tid"]: r["args"]["name"] for r in meta
+              if r["name"] == "thread_name"}
+    assert any(n.startswith("delta-scan-decode") for n in tnames.values())
+    # every span row's tid has a thread_name metadata row
+    for r in trace["traceEvents"]:
+        if r.get("ph") == "X":
+            assert r["tid"] in tnames
 
 
 def test_adopt_span_context_restores_on_exit():

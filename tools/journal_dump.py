@@ -1,0 +1,77 @@
+"""Offline workload-journal inspector.
+
+Prints a table's persisted journal (`delta_tpu/obs/journal.py` — one JSONL
+entry per scan/commit/DML/router decision under
+``<table>/_delta_log/_journal/``) without touching the engine's hot paths,
+or runs the layout advisor over it::
+
+    python tools/journal_dump.py /data/tbl                  # all entries
+    python tools/journal_dump.py /data/tbl --kind scan      # one kind
+    python tools/journal_dump.py /data/tbl --limit 20       # last N
+    python tools/journal_dump.py /data/tbl --summary        # counts per kind
+    python tools/journal_dump.py /data/tbl --advise         # advisor report
+
+Entries print one JSON object per line (pipe into ``jq``); ``--advise`` and
+``--summary`` print one indented JSON document.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("table", help="table data path (the dir holding _delta_log)")
+    ap.add_argument("--kind", choices=["scan", "commit", "dml", "router"],
+                    help="only entries of this kind")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="last N entries (after kind filtering)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print per-kind counts + segment stats instead of entries")
+    ap.add_argument("--advise", action="store_true",
+                    help="run the layout advisor and print its report")
+    args = ap.parse_args(argv)
+
+    from delta_tpu.obs import journal
+
+    log_path = os.path.join(args.table.rstrip("/"), "_delta_log")
+    if args.advise:
+        from delta_tpu.obs.advisor import advise
+
+        print(json.dumps(advise(args.table, limit=args.limit).to_dict(),
+                         indent=1, default=str))
+        return 0
+
+    entries = journal.read_entries(
+        log_path, kinds=[args.kind] if args.kind else None, limit=args.limit
+    )
+    if args.summary:
+        jdir = journal.journal_dir(log_path)
+        try:
+            segs = [n for n in sorted(os.listdir(jdir))
+                    if n.startswith(journal.SEGMENT_PREFIX)]
+            seg_bytes = sum(os.path.getsize(os.path.join(jdir, n)) for n in segs)
+        except OSError:
+            segs, seg_bytes = [], 0
+        print(json.dumps({
+            "table": args.table,
+            "journalDir": jdir,
+            "segments": len(segs),
+            "bytes": seg_bytes,
+            "entries": len(entries),
+            "byKind": dict(Counter(e.get("kind", "?") for e in entries)),
+        }, indent=1))
+        return 0
+    for e in entries:
+        print(json.dumps(e, separators=(",", ":"), default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
