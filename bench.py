@@ -1306,6 +1306,164 @@ def bench_resident_probe(workdir):
     }
 
 
+# -- config 9: sustained-contention commit path (group commit) ---------------
+
+
+def bench_commit_contention(workdir):
+    """Config 9: K writer threads x M commits each against one table —
+    mostly blind appends plus a conflicting-DML fraction (non-blind
+    read-then-add txns) — three interleaved trials of grouping + async
+    incremental checkpointing OFF (the baseline leg) then ON, latency
+    samples pooled per leg. Records throughput and pooled p50/p99 commit
+    latency per leg; headline = p99 commit-latency improvement (higher is
+    better). The ungrouped leg pays the per-writer list/read-tail/CAS
+    cycle and the every-10th-commit synchronous checkpoint stall that
+    ISSUE 9 targets."""
+    import threading
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands import operations as ops_mod
+    from delta_tpu.log import checkpointer
+    from delta_tpu.protocol.actions import AddFile, Metadata
+    from delta_tpu.schema.types import LongType, StructType
+    from delta_tpu.utils import errors as errors_mod
+    from delta_tpu.utils.config import conf
+
+    K = int(os.environ.get("BENCH_CONTENTION_WRITERS", "16"))
+    M = int(os.environ.get("BENCH_CONTENTION_COMMITS", "40"))
+    conflict_every = 5  # every 5th commit per writer is non-blind
+
+    schema = StructType().add("id", LongType()).add("v", LongType())
+
+    # contention is a LOCK/LISTING/BATCHING phenomenon: on a shared CI
+    # filesystem (virtio-9p here) other tenants' fsync bursts inject
+    # multi-second stalls into random commits of either leg, swamping the
+    # leg comparison with noise that has nothing to do with the commit
+    # path. A RAM-backed dir keeps the measured tail the engine's own.
+    base = workdir
+    if os.access("/dev/shm", os.W_OK):
+        base = tempfile.mkdtemp(prefix="delta_tpu_bench_c9_", dir="/dev/shm")
+
+    def _leg(name, grouped):
+        path = os.path.join(base, f"c9_{name}")
+        log = DeltaLog.for_table(path)
+        txn = log.start_transaction()
+        txn.update_metadata(Metadata(schema_string=schema.to_json()))
+        txn.commit([], ops_mod.ManualUpdate())
+
+        latencies = [[] for _ in range(K)]
+        conflicts = [0] * K
+        barrier = threading.Barrier(K + 1)
+
+        def writer(w):
+            barrier.wait()
+            for i in range(M):
+                try:
+                    t = log.start_transaction()
+                    add = AddFile(
+                        f"w{w}-{i:05d}.parquet", {}, 4096, 1, True,
+                        stats='{"numRecords":128,"minValues":{"id":0},'
+                              '"maxValues":{"id":127},"nullCount":{"id":0}}',
+                    )
+                    if i % conflict_every == conflict_every - 1:
+                        t.filter_files()  # records the read: non-blind txn
+                    # time the commit() call only — the list/read-tail/
+                    # conflict-check/CAS cycle grouping amortizes; the
+                    # read-side snapshot listing in start_transaction is
+                    # identical in both legs and would only dilute the leg
+                    # comparison with shared noise
+                    t0 = time.perf_counter()
+                    t.commit([add], ops_mod.Write("Append"))
+                    latencies[w].append(time.perf_counter() - t0)
+                except errors_mod.DeltaConcurrentModificationException:
+                    conflicts[w] += 1
+
+        overrides = {
+            "delta.tpu.commit.group.enabled": grouped,
+            "delta.tpu.commit.group.maxWaitMs": 3,
+            "delta.tpu.checkpoint.async": grouped,
+            "delta.tpu.checkpoint.incremental": grouped,
+        }
+        with conf.set_temporarily(**overrides):
+            threads = [threading.Thread(target=writer, args=(w,))
+                       for w in range(K)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            # async builds drain OUTSIDE the timed window: that is the
+            # design (they are off the commit's critical path), but the
+            # work must still complete inside this config's deadline
+            checkpointer.flush()
+        return {"lats": [x for per in latencies for x in per],
+                "conflicts": sum(conflicts), "wall_s": wall}
+
+    def _pooled(runs):
+        """Aggregate one leg's interleaved trials: percentiles over the
+        POOLED latency samples (a single trial's p99 rides on ~3 tail
+        samples and is noisy on a shared box; pooling triples the tail),
+        throughput over the summed walls."""
+        lats = sorted(x for r in runs for x in r["lats"])
+        ok = len(lats)
+        wall = sum(r["wall_s"] for r in runs)
+
+        def _pct(p):
+            return lats[min(ok - 1, int(p * ok))] * 1000 if ok else -1.0
+
+        def _trial_p99(r):
+            s = sorted(r["lats"])
+            return round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1000, 2) \
+                if s else -1.0
+
+        return {
+            "commits_ok": ok,
+            "conflicts": sum(r["conflicts"] for r in runs),
+            "wall_s": round(wall, 3),
+            "throughput_cps": round(ok / wall, 1) if wall > 0 else -1.0,
+            "p50_ms": round(_pct(0.50), 2),
+            "p99_ms": round(_pct(0.99), 2),
+            "trial_p99_ms": [_trial_p99(r) for r in runs],
+        }
+
+    # three interleaved off/on trials: interleaving decorrelates machine
+    # drift from the leg comparison, pooling stabilizes the tail estimate
+    try:
+        trials = [(_leg(f"off{i}", grouped=False),
+                   _leg(f"on{i}", grouped=True))
+                  for i in range(3)]
+    finally:
+        if base is not workdir:
+            shutil.rmtree(base, ignore_errors=True)
+    ungrouped = _pooled([t[0] for t in trials])
+    grouped = _pooled([t[1] for t in trials])
+    speedup = (round(ungrouped["p99_ms"] / grouped["p99_ms"], 2)
+               if grouped["p99_ms"] > 0 else -1.0)
+    return {
+        "metric": f"commit_p99_speedup_grouped_vs_ungrouped_{K}w",
+        "value": speedup,
+        "unit": "x",
+        "vs_baseline": speedup,
+        "baseline": "same workload, grouping + async checkpointing off",
+        "writers": K,
+        "commits_per_writer": M,
+        "conflict_fraction": round(1.0 / conflict_every, 2),
+        "ungrouped": ungrouped,
+        "grouped": grouped,
+        # sub-metrics the --compare gate walks direction-aware
+        # (tools/bench_diff): p99 regresses when it GROWS, throughput when
+        # it SHRINKS
+        "gate": {
+            "grouped_p99_ms": {"value": grouped["p99_ms"], "unit": "ms"},
+            "grouped_throughput": {"value": grouped["throughput_cps"],
+                                   "unit": "commits/s"},
+            "p99_speedup": {"value": speedup, "unit": "x"},
+        },
+    }
+
+
 def _emit(results):
     headline = results.get("2") or next(iter(results.values()))
     print(json.dumps({
@@ -1332,6 +1490,9 @@ def _reset_engine_state():
         from delta_tpu.obs import journal
 
         journal.reset()
+        from delta_tpu.log import checkpointer
+
+        checkpointer.reset()
     except Exception:
         pass
 
@@ -1385,6 +1546,7 @@ def main():
     # scale configs (2x, 7) run last under the soft budget below
     configs = {
         "2": lambda: bench_merge_upsert(workdir),
+        "9": lambda: bench_commit_contention(workdir),
         "6": lambda: bench_hot_plan(workdir),
         "6p": lambda: bench_hot_plan(workdir, partitioned=True),
         "8": lambda: bench_resident_probe(workdir),
@@ -1417,7 +1579,7 @@ def main():
     # deadline skips-and-records any config that would blow it
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
     default_deadline = float(os.environ.get("BENCH_CONFIG_DEADLINE_S", "480"))
-    per_config_deadline = {"2": 900.0, "2x": 540.0, "8": 600.0}
+    per_config_deadline = {"2": 900.0, "2x": 540.0, "8": 600.0, "9": 420.0}
     t_start = time.perf_counter()
     # deadline forensics: configs run with the flight recorder armed, so a
     # SIGALRM unwinding through the open span stack leaves an incident file

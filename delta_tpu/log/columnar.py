@@ -42,7 +42,8 @@ from delta_tpu.storage.logstore import LogStore
 from delta_tpu.utils.arrow import one_chunk
 from delta_tpu.utils.errors import DeltaIllegalStateError
 
-__all__ = ["SegmentColumns", "decode_segment", "decode_json_commits", "decode_checkpoint_parts"]
+__all__ = ["SegmentColumns", "decode_segment", "decode_json_commits",
+           "decode_checkpoint_parts", "extend_segment_columns"]
 
 
 def _json_schema() -> pa.Schema:
@@ -333,6 +334,120 @@ class SegmentColumns:
                 arr = arr.combine_chunks()
             result[c] = arr
         return result
+
+
+def extend_segment_columns(base: SegmentColumns,
+                           tail: SegmentColumns) -> SegmentColumns:
+    """Append ``tail``'s rows (a decoded run of newer delta commits) after
+    ``base``'s — the columnar tail-apply behind incremental checkpoints
+    (``log/checkpointer``). Row order is base-then-tail, which preserves
+    the replay-order invariant (row index = replay sequence), so
+    ``winner_mask``/``replay`` over the result equal a fresh
+    :func:`decode_segment` of the concatenated sources; the path dictionary
+    is ``base``'s with the tail's unseen entries appended (first-appearance
+    order preserved) — O(tail), the base rows are never re-hashed.
+    Neither input is mutated (``base`` may be a long-lived cached state)."""
+    if tail.num_rows == 0 and not tail.other_actions:
+        return base
+    n_base, n_tail = base.num_rows, tail.num_rows
+    total = n_base + n_tail
+    other = list(base.other_actions) + list(tail.other_actions)
+    if total == 0:
+        return SegmentColumns(
+            path_dict=pa.array([], pa.string()),
+            path_id=np.empty(0, np.int32),
+            is_add=np.empty(0, bool),
+            size=np.empty(0, np.int64),
+            modification_time=np.empty(0, np.int64),
+            deletion_timestamp=np.empty(0, np.int64),
+            stats=None,
+            other_actions=other,
+            batches=[],
+        )
+
+    # Path dictionary: keep base's intact and map only the tail's entries
+    # into it (unseen entries append, preserving first-appearance order —
+    # decode_segment dictionaries are dictionary_encode products, so both
+    # inputs are first-appearance ordered and the merge equals a fresh
+    # decode's dictionary). O(tail), never re-hashing the base rows: the
+    # incremental checkpoint build stays O(delta) on a large table.
+    if n_tail:
+        idx = pc.index_in(tail.path_dict, value_set=base.path_dict)
+        mapped = idx.fill_null(-1).to_numpy(zero_copy_only=False).astype(
+            np.int64, copy=False)
+        unseen = mapped < 0
+        n_new = int(unseen.sum())
+        if n_new:
+            mapped[unseen] = len(base.path_dict) + np.arange(n_new)
+            path_dict = pa.concat_arrays([
+                base.path_dict,
+                one_chunk(tail.path_dict.filter(pa.array(unseen)))])
+        else:
+            path_dict = base.path_dict
+        tail_ids = mapped[tail.path_id].astype(np.int32, copy=False)
+    else:
+        path_dict = base.path_dict
+        tail_ids = np.empty(0, np.int32)
+
+    # batches are shallow-copied with shifted offsets: the decoded tables /
+    # line buffers are immutable and shared, only the placement changes
+    batches = list(base.batches)
+    for b in tail.batches:
+        batches.append(_Batch(
+            kind=b.kind, row_offset=b.row_offset + n_base,
+            num_rows=b.num_rows, lines=b.lines, line_index=b.line_index,
+            table=b.table, table_index=b.table_index,
+        ))
+
+    def _np_concat(a, b):
+        return np.concatenate([a, b])
+
+    def _str_chunks(ca, n: int):
+        if n == 0:
+            return []
+        if ca is None:
+            return [pa.nulls(n, pa.string())]
+        return list(ca.chunks) if isinstance(ca, pa.ChunkedArray) else [ca]
+
+    stats_chunks = _str_chunks(base.stats, n_base) + _str_chunks(tail.stats, n_tail)
+    stats = pa.chunked_array(stats_chunks, type=pa.string()) if stats_chunks else None
+
+    # stats_parsed: rows from the side lacking the struct column contribute
+    # typed nulls (same alignment rule as decode_segment); disagreeing
+    # struct types disable the column
+    sp = None
+    sp_types = {c.type for c in (base.stats_parsed, tail.stats_parsed)
+                if c is not None}
+    if len(sp_types) == 1:
+        sp_t = next(iter(sp_types))
+
+        def _sp_chunks(ca, n: int):
+            if n == 0:
+                return []
+            if ca is None:
+                return [pa.nulls(n, sp_t)]
+            return list(ca.chunks) if isinstance(ca, pa.ChunkedArray) else [ca]
+
+        chunks = _sp_chunks(base.stats_parsed, n_base) + _sp_chunks(
+            tail.stats_parsed, n_tail)
+        if chunks:
+            sp = pa.chunked_array(chunks, type=sp_t)
+
+    return SegmentColumns(
+        path_dict=path_dict,
+        path_id=_np_concat(base.path_id, tail_ids).astype(
+            np.int32, copy=False),
+        is_add=_np_concat(base.is_add, tail.is_add),
+        size=_np_concat(base.size, tail.size),
+        modification_time=_np_concat(base.modification_time,
+                                     tail.modification_time),
+        deletion_timestamp=_np_concat(base.deletion_timestamp,
+                                      tail.deletion_timestamp),
+        stats=stats,
+        other_actions=other,
+        batches=batches,
+        stats_parsed=sp,
+    )
 
 
 def _canonicalize(paths, out_of_line: bool) -> pa.Array:

@@ -106,8 +106,13 @@ class DeltaLog:
         self.lock = threading.RLock()
         self.clock = clock or (lambda: int(time.time() * 1000))
         self._snapshot: Optional[Snapshot] = None
+        self._group_coordinator = None  # lazily built (txn/group_commit)
         self._last_update_ms: int = 0
         self._update_lock = threading.Lock()
+        # monotonic instant the most recent COMPLETED listing began —
+        # drives update coalescing in _do_update (a waiter adopts a result
+        # whose listing started after the waiter arrived)
+        self._last_listing_start: float = float("-inf")
         self._refresh_future = None  # in-flight async stale-ok refresh
         self._refresh_lock = threading.Lock()
         # checkpoint versions that failed to decode (Snapshot._columnar
@@ -231,9 +236,29 @@ class DeltaLog:
     def _do_update(self) -> Snapshot:
         from delta_tpu.utils import telemetry
 
+        t_arrive = time.monotonic()
         with self._update_lock, telemetry.record_operation(
             "delta.log.update", path=self.data_path
         ) as uev:
+            # COALESCE a listing convoy: if the lock-holder ahead of us
+            # completed a listing that STARTED after we arrived, its result
+            # reflects every commit durable before our call — re-listing
+            # would tell us nothing newer than another racer could. Under K
+            # contending writers this collapses K queued listings into one.
+            # Sequential semantics are untouched: a listing started BEFORE
+            # our arrival never satisfies the check, so update() after a
+            # commit always re-lists.
+            if (
+                self._snapshot is not None
+                and self._last_listing_start >= t_arrive
+            ):
+                uev.data["result"] = "coalesced"
+                telemetry.bump_counter("log.update.coalesced")
+                return self._snapshot
+            # published only when the listing COMPLETES (both return paths
+            # below) — a failed listing must not let waiters adopt a result
+            # staler than the check promises
+            listing_start = time.monotonic()
             previous = self._snapshot
             start_ckpt = None
             last = ckpt_mod.read_last_checkpoint(self.store, self.log_path)
@@ -247,6 +272,7 @@ class DeltaLog:
                 snap: Snapshot = InitialSnapshot(self)
             elif previous is not None and previous.segment == segment:
                 self._last_update_ms = self.clock()
+                self._last_listing_start = listing_start
                 uev.data["result"] = "unchanged"
                 telemetry.bump_counter("log.update.unchanged")
                 return previous
@@ -270,6 +296,7 @@ class DeltaLog:
                         )
             self._snapshot = snap
             self._last_update_ms = self.clock()
+            self._last_listing_start = listing_start
             uev.data.update(result="installed", version=snap.version)
             telemetry.bump_counter("log.update.installed")
             return snap
@@ -312,6 +339,20 @@ class DeltaLog:
 
         self.update()
         return OptimisticTransaction(self)
+
+    @property
+    def group_coordinator(self):
+        """This log's group-commit coordinator (``txn/group_commit``),
+        created on first use — a table never grouped pays nothing."""
+        gc = self._group_coordinator
+        if gc is None:
+            with self.lock:
+                if self._group_coordinator is None:
+                    from delta_tpu.txn.group_commit import GroupCommitCoordinator
+
+                    self._group_coordinator = GroupCommitCoordinator(self)
+                gc = self._group_coordinator
+        return gc
 
     def with_new_transaction(self, thunk):
         """Run ``thunk(txn)`` with the active-transaction ambient set

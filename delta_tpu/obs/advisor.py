@@ -237,6 +237,8 @@ def _commit_facts(commits: List[dict]) -> Dict[str, Any]:
     total = len(commits)
     retried = conflicts = reconciled = contended_n = 0
     windows: Counter = Counter()
+    batch_sizes: List[int] = []
+    queue_waits: List[float] = []
     for e in commits:
         stats = e.get("stats") or {}
         attempts = int(stats.get("attempts") or 1)
@@ -254,9 +256,20 @@ def _commit_facts(commits: List[dict]) -> Dict[str, Any]:
             reconciled += 1
         if contended and e.get("ts"):
             windows[int(e["ts"]) // CONTENTION_WINDOW_MS] += 1
+        # group-commit evidence: grouped commits journal their measured
+        # batch size and coordinator queue wait (txn/group_commit)
+        if stats.get("batchSize") is not None:
+            try:
+                bs = int(stats["batchSize"])
+                qw = float(stats.get("queueWaitMs") or 0.0)
+            except (TypeError, ValueError):
+                pass  # malformed entry: skip BOTH so the lists stay paired
+            else:
+                batch_sizes.append(bs)
+                queue_waits.append(qw)
     hot = [{"windowStart": w * CONTENTION_WINDOW_MS, "contendedCommits": n}
            for w, n in windows.most_common(8) if n >= 2]
-    return {
+    out = {
         "commits": total,
         "retried": retried,
         "conflicts": conflicts,
@@ -264,6 +277,18 @@ def _commit_facts(commits: List[dict]) -> Dict[str, Any]:
         "retryFraction": round(contended_n / total, 4) if total else 0.0,
         "contentionWindows": hot,
     }
+    if batch_sizes:
+        waits = sorted(queue_waits)
+
+        def _pct(p: float) -> float:
+            return waits[min(len(waits) - 1, int(p * len(waits)))]
+
+        out["groupedCommits"] = len(batch_sizes)
+        out["meanBatchSize"] = round(sum(batch_sizes) / len(batch_sizes), 2)
+        out["maxBatchSize"] = max(batch_sizes)
+        out["queueWaitP50Ms"] = round(_pct(0.50), 3)
+        out["queueWaitP99Ms"] = round(_pct(0.99), 3)
+    return out
 
 
 def _key_cache_facts(dmls: List[dict]) -> Dict[str, Any]:
@@ -407,19 +432,41 @@ def _recommend(facts: Dict[str, Any],
         ))
     if (cf.get("commits", 0) >= CONTENTION_MIN_COMMITS
             and cf.get("retryFraction", 0.0) >= CONTENTION_RETRY_FRACTION):
-        recs.append(Recommendation(
-            kind="COMMIT_CONTENTION", target="",
-            score=cf["retryFraction"] * 10.0,
-            action="batch concurrent writers (group commit, ROADMAP item 3) "
-                   "or stagger their schedules",
-            detail=f"{cf['retryFraction']:.0%} of {cf['commits']} journaled "
-                   f"commits retried or conflicted; "
-                   f"{len(cf.get('contentionWindows') or [])} contention "
-                   "window(s) recorded",
-            evidence={"commits": cf["commits"],
-                      "retryFraction": cf["retryFraction"],
-                      "contentionWindows": cf.get("contentionWindows") or []},
-        ))
+        if cf.get("groupedCommits"):
+            # group commit is already on: cite the measured coordinator
+            # evidence (journaled batchSize/queueWaitMs from the grouped
+            # commits themselves) instead of inferring from time buckets
+            recs.append(Recommendation(
+                kind="COMMIT_CONTENTION", target="delta.tpu.commit.group",
+                score=cf["retryFraction"] * 10.0,
+                action="raise delta.tpu.commit.group.{maxBatch,maxWaitMs} "
+                       "or stagger writer schedules",
+                detail=f"{cf['retryFraction']:.0%} of {cf['commits']} "
+                       f"journaled commits retried or conflicted despite "
+                       f"grouping (mean batch {cf['meanBatchSize']}, queue "
+                       f"wait p99 {cf['queueWaitP99Ms']:.1f} ms)",
+                evidence={"commits": cf["commits"],
+                          "retryFraction": cf["retryFraction"],
+                          "groupedCommits": cf["groupedCommits"],
+                          "meanBatchSize": cf["meanBatchSize"],
+                          "maxBatchSize": cf["maxBatchSize"],
+                          "queueWaitP50Ms": cf["queueWaitP50Ms"],
+                          "queueWaitP99Ms": cf["queueWaitP99Ms"]},
+            ))
+        else:
+            recs.append(Recommendation(
+                kind="COMMIT_CONTENTION", target="delta.tpu.commit.group.enabled",
+                score=cf["retryFraction"] * 10.0,
+                action="set delta.tpu.commit.group.enabled=true (group "
+                       "commit) or stagger writer schedules",
+                detail=f"{cf['retryFraction']:.0%} of {cf['commits']} journaled "
+                       f"commits retried or conflicted; "
+                       f"{len(cf.get('contentionWindows') or [])} contention "
+                       "window(s) recorded",
+                evidence={"commits": cf["commits"],
+                          "retryFraction": cf["retryFraction"],
+                          "contentionWindows": cf.get("contentionWindows") or []},
+            ))
 
     rf = facts.get("router") or {}
     if (rf.get("audits", 0) >= CALIBRATION_MIN_AUDITS

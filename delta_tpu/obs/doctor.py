@@ -141,13 +141,24 @@ def _dim_checkpoint(snapshot) -> HealthDimension:
     elif (commits_since > CHECKPOINT_WARN_COMMITS
           or tail_bytes > CHECKPOINT_WARN_TAIL_BYTES):
         sev = "warn"
+    detail = (f"{commits_since} commits replay after the last checkpoint "
+              f"({tail_bytes} tail bytes)")
+    if sev != "ok":
+        from delta_tpu.utils.config import conf as _conf
+
+        if not _conf.get_bool("delta.tpu.checkpoint.async", False):
+            # a long tail under sustained write traffic usually means the
+            # synchronous interval checkpoint can't keep up with (or is
+            # being skipped by) the writers — the async builder keeps the
+            # tail short without stalling commits
+            detail += ("; consider delta.tpu.checkpoint.async=true "
+                       "(+ .incremental) under sustained write traffic")
     return HealthDimension(
         "checkpoint", sev,
         {"commitsSince": commits_since, "tailBytes": tail_bytes,
          "tailFiles": len(seg.deltas)},
         remedy="CHECKPOINT" if sev != "ok" else None,
-        detail=f"{commits_since} commits replay after the last checkpoint "
-               f"({tail_bytes} tail bytes)",
+        detail=detail,
     )
 
 

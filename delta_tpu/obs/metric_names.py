@@ -111,6 +111,8 @@ ENGINE_COUNTERS = frozenset({
     "checkpoint.parts",
     "checkpoint.actions",
     "checkpoint.written",
+    "checkpoint.incremental.built",
+    "checkpoint.incremental.fallback",
     "commit.total",
     "commit.retries",
     "convert.stats.fromFooter",
@@ -118,6 +120,7 @@ ENGINE_COUNTERS = frozenset({
     "footerCache.hits",
     "footerCache.misses",
     "footerCache.evictions",
+    "log.update.coalesced",
     "log.update.installed",
     "log.update.unchanged",
     "parquet.files.written",
@@ -147,6 +150,8 @@ ENGINE_COUNTERS = frozenset({
 
 #: Every histogram observed by constant name (``telemetry.observe``).
 HISTOGRAMS = frozenset({
+    "commit.group.batchSize",
+    "commit.queueWaitMs",
     "delta.checkpoint.duration_ms",
     "delta.commit.duration_ms",
     "delta.streaming.sink.batch_ms",
@@ -258,6 +263,8 @@ DESCRIPTIONS = {
     "checkpoint.parts": "Checkpoint part files written.",
     "checkpoint.actions": "Actions serialized into checkpoints.",
     "checkpoint.written": "Checkpoints completed.",
+    "checkpoint.incremental.built": "Checkpoints built incrementally from a cached base plus tail.",
+    "checkpoint.incremental.fallback": "Incremental checkpoint builds that fell back to full reconstruction.",
     "commit.total": "Commits attempted through the transaction pipeline.",
     "commit.retries": "Extra commit attempts after lost races.",
     "convert.stats.fromFooter": "CONVERT stats derived from Parquet footers.",
@@ -265,6 +272,7 @@ DESCRIPTIONS = {
     "footerCache.hits": "Parquet footer cache hits.",
     "footerCache.misses": "Parquet footer cache misses (footer parsed).",
     "footerCache.evictions": "Parquet footers evicted by the LRU bound.",
+    "log.update.coalesced": "Log updates served by a concurrent racer's just-completed listing.",
     "log.update.installed": "Log updates that installed a newer snapshot.",
     "log.update.unchanged": "Log updates that found no new commits.",
     "parquet.files.written": "Parquet data files written.",
@@ -291,6 +299,8 @@ DESCRIPTIONS = {
     "stateExport.statsLanes.us": "Checkpoint stats decoded with microsecond timestamps.",
     "streaming.sink.batches": "Micro-batches written by the streaming sink.",
     # histograms
+    "commit.group.batchSize": "Transactions written per group-commit batch.",
+    "commit.queueWaitMs": "Time a grouped commit waited in the coordinator queue (ms).",
     "delta.checkpoint.duration_ms": "Checkpoint write latency (ms).",
     "delta.commit.duration_ms": "Commit pipeline latency (ms).",
     "delta.streaming.sink.batch_ms": "Streaming sink addBatch latency (ms).",

@@ -58,6 +58,7 @@ __all__ = [
     "FaultPlan",
     "FaultInjectingLogStore",
     "ALL_KINDS",
+    "fire",
     "maybe_wrap",
     "plan_from_conf",
     "reset_plan_cache",
@@ -101,6 +102,15 @@ _POINT_KINDS: Dict[str, Tuple[str, ...]] = {
     "write.lastCheckpoint": ("transient", "stale_last_checkpoint"),
     "write.crc": ("transient",),
     "write.other": ("transient", "slow"),
+    # engine-level points (fired via :func:`fire`, not through a store op):
+    # the group-commit leader's write loop draws once per batch member
+    # BEFORE that member's log-entry create — a crash here dies between
+    # batch members, leaving a prefix of the batch durable; the async
+    # checkpoint writer draws once per build request, pre-build (genuinely
+    # TORN builds come from the write.checkpoint store point firing inside
+    # the build's part writes — fire() has no partial-write to tear).
+    "txn.groupLoop": ("transient", "crash_before_publish", "slow"),
+    "checkpoint.asyncBuild": ("transient", "crash_before_publish", "slow"),
 }
 
 
@@ -269,6 +279,28 @@ def _parse_spec(spec: str) -> FaultPlan:
         else:
             raise ValueError(f"Unknown fault-plan key {key!r} in {spec!r}")
     return FaultPlan(**kw)  # type: ignore[arg-type]
+
+
+def fire(point: str, name: str = "") -> None:
+    """Engine-level fault point — for code paths that are not a single
+    store operation (the group-commit leader loop, the async checkpoint
+    builder). Consults the session's active plan directly and raises the
+    drawn fault; a no-op when no plan is installed (zero overhead: one
+    conf read). Crash kinds raise :class:`SimulatedCrash`; ``transient``
+    raises :class:`TransientIOError`; ``slow`` sleeps."""
+    plan = plan_from_conf()
+    if plan is None:
+        return
+    d = plan.draw(point, name)
+    if d is None:
+        return
+    kind, _ = d
+    if kind == "slow":
+        time.sleep(plan.slow_ms / 1000.0)
+        return
+    if kind == "transient":
+        raise TransientIOError(f"injected transient at {point}")
+    raise SimulatedCrash(point)
 
 
 def maybe_wrap(store: LogStore) -> LogStore:

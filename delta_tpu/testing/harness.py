@@ -63,12 +63,22 @@ class TortureReport:
 class TortureHarness:
     def __init__(self, path: str, seed: int, plan: Optional[FaultPlan] = None,
                  rate: float = 0.08, kinds=ALL_KINDS,
-                 max_step_s: float = 60.0):
+                 max_step_s: float = 60.0,
+                 group_commit: bool = False,
+                 async_checkpoint: bool = False):
         self.path = path
         self.seed = seed
         self.plan = plan or FaultPlan(seed=seed, rate=rate, kinds=kinds)
         self.rng = random.Random(seed)
         self.max_step_s = max_step_s
+        # high-traffic commit path (ISSUE 9): run the same workload through
+        # the group-commit coordinator and/or the async incremental
+        # checkpointer. NOTE: with the async builder the checkpoint fault
+        # draws key on different files run-over-run (request coalescing is
+        # timing-dependent), so per_point determinism is only a witness for
+        # the default synchronous configuration.
+        self.group_commit = group_commit
+        self.async_checkpoint = async_checkpoint
         self.report = TortureReport()
         # ledger: batch id -> ("present" | "deleted", [ids])
         self.batches: Dict[int, Tuple[str, List[int]]] = {}
@@ -218,7 +228,20 @@ class TortureHarness:
             raise
 
     def _op_checkpoint(self) -> None:
-        self._log.checkpoint()
+        from delta_tpu.utils.config import conf
+
+        if conf.get_bool("delta.tpu.checkpoint.async", False):
+            # run the async builder's build path ON THIS THREAD (not
+            # request+flush — the daemon could drain the request first and
+            # swallow the injected crash), so a crash mid-build surfaces to
+            # the driver deterministically, exactly like a process death
+            # during a background checkpoint would
+            from delta_tpu.log import checkpointer
+
+            checkpointer.build_checkpoint(
+                self._log, self._log.update().version)
+        else:
+            self._log.checkpoint()
 
     def _op_optimize(self) -> None:
         from delta_tpu.api.tables import DeltaTable
@@ -329,6 +352,13 @@ class TortureHarness:
 
         if self._log is None:
             self.create_table()
+        extra = {}
+        if self.group_commit:
+            extra["delta.tpu.commit.group.enabled"] = True
+            extra["delta.tpu.commit.group.maxWaitMs"] = 0
+        if self.async_checkpoint:
+            extra["delta.tpu.checkpoint.async"] = True
+            extra["delta.tpu.checkpoint.incremental"] = True
         with conf.set_temporarily(
             delta__tpu__faults__plan=self.plan,
             delta__tpu__storage__retry__baseDelayMs=1,
@@ -336,6 +366,7 @@ class TortureHarness:
             delta__tpu__storage__retry__deadlineMs=5_000,
             # small parts => multi-part checkpoints => torn checkpoints real
             delta__tpu__checkpointPartSize=8,
+            **extra,
         ):
             # re-wrap under the plan now that it is installed
             self._log = self._fresh_log()
@@ -353,7 +384,11 @@ class TortureHarness:
 
 def run_torture(path: str, seed: int, steps: int,
                 rate: float = 0.08, kinds=ALL_KINDS,
-                check_every: int = 10) -> TortureReport:
+                check_every: int = 10,
+                group_commit: bool = False,
+                async_checkpoint: bool = False) -> TortureReport:
     """One-call torture run: fresh harness, seeded plan, invariants on."""
-    h = TortureHarness(path, seed, rate=rate, kinds=kinds)
+    h = TortureHarness(path, seed, rate=rate, kinds=kinds,
+                       group_commit=group_commit,
+                       async_checkpoint=async_checkpoint)
     return h.run(steps, check_every=check_every)

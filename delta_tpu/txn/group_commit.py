@@ -1,0 +1,403 @@
+"""Group-commit coordinator: batch concurrent commits into one tail pass.
+
+Ungrouped, each of K concurrent writers pays its own read-tail →
+conflict-check → CAS cycle against the log (``doCommitRetryIteratively``,
+``OptimisticTransaction.scala:610-642``, mirrored by
+``txn/transaction._do_commit_retry``): under contention that costs O(K²)
+tail reads plus a retry storm, all serialized on the in-process commit
+lock. This module amortizes the cycle: concurrent ``commit()`` calls on one
+:class:`~delta_tpu.log.deltalog.DeltaLog` enqueue their **prepared** action
+lists; the first enqueuer becomes the *leader*, lingers briefly
+(``delta.tpu.commit.group.maxWaitMs``) for the queue to fill, then drains a
+batch (``delta.tpu.commit.group.maxBatch``) and, holding the commit lock:
+
+1. reads the log tail **once** — every winning commit between the oldest
+   member's read version and the head, each file fetched exactly once into
+   a shared tail snapshot;
+2. conflict-checks each member against that snapshot *and against the
+   batchmates already assigned earlier versions* (the same
+   ``txn/conflicts.check_for_conflicts`` matrix — intra-batch conflicts
+   surface exactly as they would have had the members raced ungrouped);
+3. writes surviving members as **consecutive versions** in one pass — each
+   still an atomic create-if-absent, so cross-process exclusion is
+   unchanged; per-member ``commitInfo.txnId`` tokens reconcile ambiguous
+   creates exactly as in the ungrouped path.
+
+Losers of an *external* race (another process claimed a version mid-batch)
+do not each re-read the tail: the leader extends its tail snapshot by just
+the new commits and re-attempts the remaining members at bumped versions.
+
+Failure semantics: a member whose conflict check fails gets that exception
+(its batchmates are unaffected); an ordinary per-member write failure is
+that member's alone; a ``BaseException`` (:class:`SimulatedCrash`,
+KeyboardInterrupt — process-death class) aborts the whole batch: the
+prefix already written is durable, members whose create landed resolve as
+committed (the coordinator knows — a false failure would invite a
+duplicate re-commit from a caller surviving the interrupt), and every
+unfinished member observes the crash — the crash-between-batch-members
+case the torture harness replays. The fault injector draws at ``txn.groupLoop`` once per member
+before its create.
+
+Default off (``delta.tpu.commit.group.enabled``); with it off,
+``transaction.commit`` never constructs a coordinator and the commit path
+is byte-identical to the ungrouped engine.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from delta_tpu.protocol import filenames
+from delta_tpu.protocol.actions import Action, actions_from_lines
+from delta_tpu.storage import faults as faults_mod
+from delta_tpu.txn import conflicts as conflicts_mod
+from delta_tpu.txn import transaction as transaction_mod
+from delta_tpu.utils.config import conf
+from delta_tpu.utils import errors
+from delta_tpu.utils import retries as retries_mod
+from delta_tpu.utils import telemetry
+
+__all__ = ["GroupCommitCoordinator", "group_commit_enabled"]
+
+
+def group_commit_enabled() -> bool:
+    return conf.get_bool("delta.tpu.commit.group.enabled", False)
+
+
+@dataclass
+class _Pending:
+    """One queued transaction: the prepared full action list (CommitInfo
+    first — blind-append detection and the txnId token are already baked
+    in) plus the slots the leader fills."""
+
+    txn: Any
+    actions: List[Action]
+    enqueued: float = field(default_factory=time.monotonic)
+    done: bool = False
+    version: Optional[int] = None
+    exc: Optional[BaseException] = None
+    batch_size: int = 0
+    queue_wait_ms: float = 0.0
+    attempts: int = 1
+    conflict_check_ms: float = 0.0
+
+
+class GroupCommitCoordinator:
+    """Per-DeltaLog queue + leader election. Thread-safe; one instance per
+    :class:`DeltaLog` (lazily created, see ``DeltaLog.group_coordinator``)."""
+
+    #: persistent tail entries kept after a batch (commit files are
+    #: immutable, so entries never go stale; bound keeps memory O(1))
+    _TAIL_KEEP = 512
+
+    def __init__(self, delta_log):
+        self.delta_log = delta_log
+        self._cv = threading.Condition()
+        self._queue: List[_Pending] = []
+        self._leader_active = False
+        #: version -> decoded actions, SHARED ACROSS BATCHES: members' read
+        #: versions lag by about a round, so successive batches' windows
+        #: overlap heavily — without this each batch re-reads ~K files the
+        #: previous batch already fetched. Only the (single) leader touches
+        #: it, under the commit lock.
+        self._tail: Dict[int, List[Action]] = {}
+
+    # -- public ----------------------------------------------------------
+
+    def commit(self, txn, actions: List[Action]) -> int:
+        """Enqueue ``txn``'s prepared actions and block until a leader (
+        possibly this thread) resolves them; returns the committed version
+        or raises the member's failure."""
+        p = _Pending(txn=txn, actions=list(actions))
+        with self._cv:
+            self._queue.append(p)
+            self._cv.notify_all()
+        try:
+            while True:
+                with self._cv:
+                    if p.done:
+                        break
+                    if self._leader_active:
+                        # a crashed leader marks its whole in-flight batch
+                        # done; entries it never drained are re-led by the
+                        # next volunteer (possibly this thread, next
+                        # iteration)
+                        self._cv.wait(0.05)
+                        continue
+                    self._leader_active = True
+                try:
+                    self._lead(p)
+                finally:
+                    with self._cv:
+                        self._leader_active = False
+                        self._cv.notify_all()
+        except BaseException:
+            # the caller is abandoning (KeyboardInterrupt while waiting or
+            # leading): an entry still in the queue must NOT be committed
+            # by a successor leader after the caller observed failure — the
+            # app would retry and double-commit. An entry already drained
+            # into a leader's in-flight batch stays: its outcome is
+            # genuinely ambiguous, exactly like any interrupted commit
+            # (per-txn txnId reconciliation covers a retry).
+            with self._cv:
+                if not p.done:
+                    try:
+                        self._queue.remove(p)
+                    except ValueError:
+                        pass
+            raise
+        if p.exc is not None:
+            raise p.exc
+        assert p.version is not None
+        return p.version
+
+    # -- leader ----------------------------------------------------------
+
+    def _max_batch(self) -> int:
+        try:
+            n = int(conf.get("delta.tpu.commit.group.maxBatch", 32))
+        except (TypeError, ValueError):
+            n = 32
+        return max(n, 1)
+
+    def _max_wait_s(self) -> float:
+        try:
+            ms = float(conf.get("delta.tpu.commit.group.maxWaitMs", 2))
+        except (TypeError, ValueError):
+            ms = 2.0
+        return max(ms, 0.0) / 1000.0
+
+    def _lead(self, p: _Pending) -> None:
+        """Drain batches until the CALLER's own entry resolves, then hand
+        leadership off (a waiting member volunteers the moment
+        ``_leader_active`` clears). Draining until the queue is empty
+        instead would pin the first volunteer serving everyone else's
+        batches under sustained traffic — its own commit latency balloons
+        to the whole burst's duration."""
+        max_batch = self._max_batch()
+        deadline = time.monotonic() + self._max_wait_s()
+        with self._cv:
+            # accumulation window: give racing writers a moment to join
+            while len(self._queue) < max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+        while not p.done:
+            batch: List[_Pending] = []
+            try:
+                with self._cv:
+                    batch = self._queue[:max_batch]
+                    del self._queue[: len(batch)]
+                if not batch:
+                    return
+                self._run_batch(batch)
+            except BaseException as e:
+                # process-death class (SimulatedCrash, KeyboardInterrupt):
+                # handled HERE, around the whole drain+run window, so an
+                # interrupt landing between the drain and _run_batch's
+                # body cannot strand drained members unresolved (their
+                # callers would spin forever). Members whose create
+                # already landed resolve as COMMITTED — the coordinator
+                # knows they succeeded, and reporting them failed would
+                # invite a duplicate re-commit from a caller that survives
+                # the interrupt; every unfinished member observes the
+                # crash. The LEADER's own thread still re-raises — it is
+                # the crashed context (exactly the ungrouped window).
+                for q in batch:
+                    if not q.done:
+                        if q.version is None:
+                            q.exc = e
+                        q.done = True
+                raise
+            finally:
+                if batch:
+                    with self._cv:
+                        self._cv.notify_all()
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        dl = self.delta_log
+        t_lead = time.monotonic()
+        for p in batch:
+            p.batch_size = len(batch)
+            p.queue_wait_ms = (t_lead - p.enqueued) * 1000.0
+        telemetry.observe("commit.group.batchSize", len(batch),
+                          path=dl.data_path)
+        with dl.lock:
+            # ONE tail read for the whole batch: every winning commit
+            # since the oldest member's snapshot, each file fetched
+            # once — across batches too (persistent cache)
+            tail = self._tail
+            min_read = min(p.txn.read_version for p in batch)
+            attempt = self._load_tail(tail, min_read + 1)
+            attempt = max(attempt,
+                          max(p.txn.read_version for p in batch) + 1)
+            for p in batch:
+                try:
+                    attempt = self._commit_member(p, attempt, tail) + 1
+                except Exception as e:  # noqa: BLE001 — member-scoped
+                    p.exc = e
+            if len(tail) > self._TAIL_KEEP:
+                for v in sorted(tail)[: len(tail) - self._TAIL_KEEP]:
+                    del tail[v]
+        # ONE snapshot install for the whole batch, BEFORE the members
+        # wake: their _post_commit reuses it instead of K re-listings.
+        # A LISTING install, deliberately not a segment extension (the
+        # reference's postCommitSnapshot): the listing rebases the
+        # segment onto the freshest async-written checkpoint, and a
+        # measured attempt at extension showed the longer synthetic
+        # tail costs more in state materialization than the listing
+        # saves
+        try:
+            dl.update()
+        except Exception:  # noqa: BLE001 — members re-list themselves
+            pass
+        for p in batch:
+            p.done = True
+        with self._cv:
+            self._cv.notify_all()
+
+    def _load_tail(self, tail: Dict[int, List[Action]],
+                   from_version: int) -> int:
+        """Extend ``tail`` with every commit >= ``from_version``; returns
+        the next free version. One listing bounds the window; each commit
+        file is read at most once across the batch (and across re-loads
+        after an external race); a read-probe past the listed head guards
+        against lagged listings."""
+        dl = self.delta_log
+        head = from_version - 1
+        prefix = f"{dl.log_path}/{filenames.check_version_prefix(from_version)}"
+        try:
+            for fs in dl.store.list_from(prefix):
+                if filenames.is_delta_file(fs.name):
+                    head = max(head, filenames.delta_version(fs.name))
+        except FileNotFoundError:
+            pass
+        v = from_version
+        while True:
+            if v not in tail:
+                path = f"{dl.log_path}/{filenames.delta_file(v)}"
+                try:
+                    tail[v] = actions_from_lines(dl.store.read_iter(path))
+                except FileNotFoundError:
+                    # end of tail — or a listed-but-unreadable mid-window
+                    # hole (listing/read disagreement): either way stop
+                    # here; if the hole was real, the member's create at v
+                    # collides and _winning's direct read resolves it
+                    return v
+            v += 1
+            if v > head:
+                # beyond the listing: keep probing (listing may lag writes)
+                path = f"{dl.log_path}/{filenames.delta_file(v)}"
+                if v in tail:
+                    head = v
+                    continue
+                try:
+                    tail[v] = actions_from_lines(dl.store.read_iter(path))
+                    head = v
+                    v += 1
+                except FileNotFoundError:
+                    return v
+
+    def _commit_member(self, p: _Pending, attempt: int,
+                       tail: Dict[int, List[Action]]) -> int:
+        """Conflict-check and write one member at ``attempt`` (bumping past
+        external race winners); returns the version it landed at. On a
+        logical conflict the member's exception propagates (counted and
+        journaled exactly like the ungrouped retry path). The member's
+        actions join ``tail`` so later batchmates conflict-check against
+        them — the intra-batch check."""
+        txn = p.txn
+        dl = self.delta_log
+        max_attempts = conf.get("delta.tpu.maxCommitAttempts")
+
+        def _winning(v: int) -> List[Action]:
+            # normally served from the shared snapshot; a version _load_tail
+            # could list but not read (listing/read disagreement, or cleanup
+            # expiring a very old window) is fetched directly — and if it is
+            # genuinely unreadable the member fails as an ordinary conflict,
+            # never an opaque KeyError
+            actions = tail.get(v)
+            if actions is None:
+                path = f"{dl.log_path}/{filenames.delta_file(v)}"
+                try:
+                    actions = actions_from_lines(dl.store.read_iter(path))
+                except FileNotFoundError:
+                    raise errors.concurrent_write_exception()
+                tail[v] = actions
+            return actions
+
+        def _check_window(lo: int, hi: int) -> None:
+            # keep the txn's attempt count current BEFORE checking: a
+            # conflict abort journals stats.attempts via
+            # _note_logical_conflict, and the advisor's contention evidence
+            # must see the real grouped retry count, not the initial 1
+            txn.stats.attempts = p.attempts
+            t0 = time.monotonic()
+            try:
+                for v in range(lo, hi):
+                    try:
+                        conflicts_mod.check_for_conflicts(txn, v, _winning(v))
+                    except errors.DeltaConcurrentModificationException:
+                        txn._note_logical_conflict(v)
+                        raise
+            finally:
+                p.conflict_check_ms += (time.monotonic() - t0) * 1000.0
+
+        _check_window(txn.read_version + 1, attempt)
+        while True:
+            if p.attempts > max_attempts:
+                # same bound as the ungrouped loop — the leader must not
+                # spin forever holding the commit lock
+                raise transaction_mod.max_attempts_exceeded(p.attempts)
+            # fault point: the leader's write loop, once per member, before
+            # the create — a crash here dies between batch members
+            faults_mod.fire("txn.groupLoop", filenames.delta_file(attempt))
+            try:
+                txn._write_commit(attempt, p.actions)
+            except FileExistsError:
+                # external writer claimed this version: extend the tail by
+                # just the new commits, re-check, re-attempt — the batch
+                # re-enters at bumped versions instead of unwinding to K
+                # independent tail re-reads
+                p.attempts += 1
+                nxt = self._load_tail(tail, attempt)
+                if nxt == attempt:
+                    raise errors.concurrent_write_exception()
+                _check_window(attempt, nxt)
+                attempt = nxt
+                continue
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not retries_mod.is_transient(e):
+                    raise
+                outcome = txn._reconcile_ambiguous_commit(attempt, e)
+                if outcome is True:
+                    break
+                if outcome is False:
+                    p.attempts += 1
+                    # the reconcile read already fetched and decoded the
+                    # winner at `attempt` (it seeds the txn's tail cache):
+                    # reuse it instead of a second store read
+                    cached = getattr(txn, "_tail_cache", None)
+                    if cached and attempt in cached:
+                        tail.setdefault(attempt, cached[attempt])
+                    nxt = self._load_tail(tail, attempt)
+                    _check_window(attempt, max(nxt, attempt + 1))
+                    attempt = max(nxt, attempt + 1)
+                    continue
+                time.sleep(transaction_mod.commit_backoff_s(p.attempts))
+                p.attempts += 1
+                continue
+            else:
+                break
+        tail[attempt] = list(p.actions)
+        p.version = attempt
+        txn._group_meta = {
+            "batchSize": p.batch_size,
+            "queueWaitMs": p.queue_wait_ms,
+            "attempts": p.attempts,
+            "conflictCheckMs": p.conflict_check_ms,
+        }
+        return attempt

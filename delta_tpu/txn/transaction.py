@@ -48,6 +48,20 @@ _active_txn: "contextvars.ContextVar[Optional[OptimisticTransaction]]" = context
 )
 
 
+def commit_backoff_s(attempts: int) -> float:
+    """Backoff before re-attempting a provably-not-landed ambiguous create —
+    one policy, shared by the ungrouped retry loop and the group-commit
+    leader (``txn/group_commit``)."""
+    return min(0.05 * (2 ** min(attempts, 6)), 2.0)
+
+
+def max_attempts_exceeded(attempts: int) -> "errors.DeltaIllegalStateError":
+    """The maxCommitAttempts exhaustion error, shared with the grouped path."""
+    return errors.DeltaIllegalStateError(
+        f"This commit has failed as it has been tried {attempts - 1} times but did not succeed."
+    )
+
+
 @dataclass
 class CommitStats:
     """Telemetry emitted per commit (``OptimisticTransaction.scala:45-71``)."""
@@ -316,7 +330,23 @@ class OptimisticTransaction:
 
             commit_start = self.delta_log.clock()
             with record_operation("delta.commit.write", path=self.delta_log.data_path) as wev:
-                version = self._do_commit_retry(full_actions)
+                from delta_tpu.txn.group_commit import group_commit_enabled
+
+                if group_commit_enabled():
+                    # group commit: enqueue the prepared actions; a leader
+                    # amortizes the tail read / conflict check / CAS across
+                    # the batch (txn/group_commit.py). Off (the default),
+                    # this branch is never taken and the path below is the
+                    # unmodified ungrouped pipeline.
+                    version = self.delta_log.group_coordinator.commit(
+                        self, full_actions)
+                    gm = getattr(self, "_group_meta", None)
+                    if gm is not None:
+                        self.stats.attempts = gm["attempts"]
+                        self.stats.phase_durations_ms["conflictCheck"] = int(
+                            gm["conflictCheckMs"])
+                else:
+                    version = self._do_commit_retry(full_actions)
             # conflictCheck runs inside the retry loop (so its span nests
             # under write); report the write phase NET of it, keeping the
             # phases additive: prepare+conflictCheck+write+postCommit ≈ commit
@@ -349,6 +379,16 @@ class OptimisticTransaction:
             op_metrics = self._final_metrics(op)
             if op_metrics:
                 stats_data["opMetrics"] = op_metrics
+            gm = getattr(self, "_group_meta", None)
+            if gm is not None:
+                # grouped commits carry their batch evidence into the stats
+                # event AND the journal entry below, so the advisor's
+                # COMMIT_CONTENTION verdict cites measured queue waits and
+                # batch sizes instead of inferring from time buckets
+                stats_data["batchSize"] = gm["batchSize"]
+                stats_data["queueWaitMs"] = round(gm["queueWaitMs"], 3)
+                telemetry.observe("commit.queueWaitMs", gm["queueWaitMs"],
+                                  path=self.delta_log.data_path)
             commit_ev.data.update(stats_data)
             telemetry.record_event(
                 "delta.commit.stats", stats_data, path=self.delta_log.data_path
@@ -463,9 +503,7 @@ class OptimisticTransaction:
                 attempts += 1
                 self.stats.attempts = attempts
                 if attempts > max_attempts:
-                    raise errors.DeltaIllegalStateError(
-                        f"This commit has failed as it has been tried {attempts - 1} times but did not succeed."
-                    )
+                    raise max_attempts_exceeded(attempts)
                 try:
                     self._write_commit(attempt_version, actions)
                     return attempt_version
@@ -492,7 +530,7 @@ class OptimisticTransaction:
                         # maxCommitAttempts reconciliations.
                         import time as _time
 
-                        _time.sleep(min(0.05 * (2 ** min(attempts, 6)), 2.0))
+                        _time.sleep(commit_backoff_s(attempts))
 
     def _write_commit(self, version: int, actions: List[Action]) -> None:
         path = f"{self.delta_log.log_path}/{filenames.delta_file(version)}"
@@ -533,6 +571,16 @@ class OptimisticTransaction:
                 except (ValueError, AttributeError):
                     token = None
             won = token is not None and token == getattr(self, "_commit_token", None)
+            if won is False:
+                # a lost race re-enters _check_and_retry at exactly this
+                # version: seed the tail cache so the file isn't re-read
+                try:
+                    tail = getattr(self, "_tail_cache", None)
+                    if tail is None:
+                        tail = self._tail_cache = {}
+                    tail[version] = actions_from_lines(lines)
+                except Exception:  # noqa: BLE001 — cache only, never fatal
+                    pass
         outcome = {True: "won", False: "lost", None: "not_landed"}[won]
         self._reconcile_outcome = won
         telemetry.bump_counter("commit.reconciled")
@@ -548,39 +596,61 @@ class OptimisticTransaction:
         )
         return won
 
+    def _note_logical_conflict(self, conflict_version: int) -> None:
+        """A genuine logical conflict (not just a lost race): count it and
+        journal the aborted attempt — contention analysis needs the
+        failures too. Shared by the ungrouped retry loop and the group-
+        commit leader (``txn/group_commit``)."""
+        telemetry.bump_counter("commit.conflicts")
+        from delta_tpu.obs import journal as journal_mod
+
+        journal_mod.record_commit(
+            self.delta_log.log_path,
+            {"readVersion": self.read_version,
+             "attempts": self.stats.attempts,
+             "conflictVersion": conflict_version},
+            outcome="conflict",
+        )
+
     def _check_and_retry(self, failed_version: int, actions: List[Action]) -> int:
         """Replay winning commits through the conflict checker
-        (``checkForConflicts``); returns the next version to attempt."""
+        (``checkForConflicts``); returns the next version to attempt.
+
+        Tail actions are cached per transaction (``_tail_cache``): across an
+        N-attempt retry each winning commit file is read ONCE — a version
+        already fetched by a previous attempt, by the ambiguous-commit
+        reconciliation read, or by the group-commit leader's shared tail
+        snapshot is served from the cache instead of re-read."""
         with record_operation("delta.commit.retry.conflictCheck", path=self.delta_log.data_path) as cev:
+            tail = getattr(self, "_tail_cache", None)
+            if tail is None:
+                tail = self._tail_cache = {}
             next_attempt = failed_version
             while True:
-                path = f"{self.delta_log.log_path}/{filenames.delta_file(next_attempt)}"
-                try:
-                    winning = actions_from_lines(self.delta_log.store.read_iter(path))
-                except FileNotFoundError:
-                    break
+                winning = tail.get(next_attempt)
+                if winning is None:
+                    path = f"{self.delta_log.log_path}/{filenames.delta_file(next_attempt)}"
+                    try:
+                        winning = actions_from_lines(self.delta_log.store.read_iter(path))
+                    except FileNotFoundError:
+                        break
+                    tail[next_attempt] = winning
                 try:
                     conflicts_mod.check_for_conflicts(self, next_attempt, winning)
                 except errors.DeltaConcurrentModificationException:
-                    # a genuine logical conflict (not just a lost race):
-                    # count it, and let the error unwind through the open
-                    # conflictCheck span — the obs flight recorder snapshots
-                    # the failing span stack from there. Other exceptions
-                    # (bugs, interrupts) propagate uncounted.
-                    telemetry.bump_counter("commit.conflicts")
-                    # the commit dies here, so journal the aborted attempt
-                    # now — contention analysis needs the failures too
-                    from delta_tpu.obs import journal as journal_mod
-
-                    journal_mod.record_commit(
-                        self.delta_log.log_path,
-                        {"readVersion": self.read_version,
-                         "attempts": self.stats.attempts,
-                         "conflictVersion": next_attempt},
-                        outcome="conflict",
-                    )
+                    # let the error unwind through the open conflictCheck
+                    # span — the obs flight recorder snapshots the failing
+                    # span stack from there. Other exceptions (bugs,
+                    # interrupts) propagate uncounted.
+                    self._note_logical_conflict(next_attempt)
                     raise
                 next_attempt += 1
+            # checked windows never overlap (the next one starts at
+            # next_attempt), so consumed entries are dead weight: evict them
+            # and keep the cache O(1) across a long retry storm instead of
+            # accumulating every winning commit's actions for the txn's life
+            for v in [v for v in tail if v < next_attempt]:
+                del tail[v]
             cev.data["winningCommits"] = next_attempt - failed_version
             if next_attempt == failed_version:
                 # The write failed but the file doesn't exist: storage lied about
@@ -596,17 +666,38 @@ class OptimisticTransaction:
 
     def _post_commit(self, version: int) -> None:
         """Checkpointing, checksum, hooks (scala:582-594, 880-915)."""
-        snapshot = self.delta_log.update_after_commit(version)
+        snapshot = None
+        if getattr(self, "_group_meta", None) is not None:
+            # grouped: the leader installed one post-batch snapshot for the
+            # whole batch — reuse it instead of K per-member re-listings.
+            # Consequence: the version-checksum guard below only fires for
+            # the batch-final member, so intermediate versions get no .crc
+            # — the same advisory skip the ungrouped path takes whenever a
+            # racing writer advances the snapshot past the committed
+            # version (validators treat a missing .crc as nothing to check)
+            snap = self.delta_log.unsafe_volatile_snapshot
+            if snap is not None and snap.version >= version:
+                snapshot = snap
+        if snapshot is None:
+            snapshot = self.delta_log.update_after_commit(version)
         if snapshot.version == version:
             self.delta_log.write_checksum_for(snapshot)
         interval = DeltaConfigs.CHECKPOINT_INTERVAL.from_metadata(self.metadata)
         if version != 0 and version % interval == 0:
-            try:
-                self.delta_log.checkpoint(
-                    snapshot if snapshot.version == version else self.delta_log.get_snapshot_at(version)
-                )
-            except Exception:  # noqa: BLE001 — checkpointing must not fail the commit
-                logger.warning("Post-commit checkpoint at version %s failed", version, exc_info=True)
+            if conf.get_bool("delta.tpu.checkpoint.async", False):
+                # off the committing writer's critical path: the background
+                # checkpoint daemon builds it (incrementally when
+                # delta.tpu.checkpoint.incremental is on)
+                from delta_tpu.log import checkpointer
+
+                checkpointer.request_checkpoint(self.delta_log, version)
+            else:
+                try:
+                    self.delta_log.checkpoint(
+                        snapshot if snapshot.version == version else self.delta_log.get_snapshot_at(version)
+                    )
+                except Exception:  # noqa: BLE001 — checkpointing must not fail the commit
+                    logger.warning("Post-commit checkpoint at version %s failed", version, exc_info=True)
         for hook in self.post_commit_hooks:
             try:
                 hook.run(self, version, snapshot)

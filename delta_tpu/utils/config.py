@@ -38,6 +38,32 @@ class SqlConf:
         "delta.tpu.snapshotPartitions": 8,
         # ≈ DELTA_MAX_RETRY_COMMIT_ATTEMPTS (DeltaSQLConf.scala:182)
         "delta.tpu.maxCommitAttempts": 10_000_000,
+        # Group commit (txn/group_commit): concurrent commit() calls on one
+        # DeltaLog enqueue; a leader drains the queue, reads the log tail
+        # ONCE, conflict-checks the batch (against the tail AND each other)
+        # and writes members as consecutive versions — amortizing the
+        # per-writer list/read-tail/CAS cycle under contention. Default OFF:
+        # with it off the commit path is byte-identical to the ungrouped
+        # engine (regression-tested).
+        "delta.tpu.commit.group.enabled": False,
+        # Max transactions one leader writes per batch drain.
+        "delta.tpu.commit.group.maxBatch": 32,
+        # How long a new leader lingers for the queue to fill before
+        # draining (the classic group-commit accumulation window).
+        "delta.tpu.commit.group.maxWaitMs": 2,
+        # Asynchronous interval checkpointing (log/checkpointer): the
+        # every-Nth-commit checkpoint (`delta.checkpointInterval`) is
+        # enqueued to a background daemon instead of stalling the
+        # committing writer on an O(table) synchronous write. Default OFF.
+        "delta.tpu.checkpoint.async": False,
+        # Incremental checkpoint builds (log/checkpointer): checkpoint N is
+        # built from the cached reconciled columns of checkpoint M plus a
+        # decode of ONLY the tail commits M+1..N, instead of re-decoding
+        # the whole base checkpoint. Falls back to full reconstruction (and
+        # re-seeds the cache) on any gap/overflow. Default OFF.
+        "delta.tpu.checkpoint.incremental": False,
+        # Cached incremental bases kept across tables (LRU).
+        "delta.tpu.checkpoint.incremental.maxTables": 8,
         # ≈ DELTA_CHECKPOINT_PART_SIZE — actions per checkpoint part
         "delta.tpu.checkpointPartSize": 1_000_000,
         # ≈ MERGE_INSERT_ONLY_ENABLED

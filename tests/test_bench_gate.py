@@ -105,3 +105,38 @@ def test_bench_argv_parsing():
     # run zero configs and pass the gate vacuously)
     with pytest.raises(SystemExit):
         _parse_argv(["--compare-thresold", "25", "--compare", "b.json"])
+
+
+def test_gate_submetrics_walked_direction_aware():
+    """ISSUE 9 satellite: a config's `gate` map of named sub-metrics (the
+    contention config's per-leg p99 / throughput) is gated with the same
+    direction-aware thresholds, reported as <config>.gate.<name>."""
+    def with_gate(p99, tput, speedup):
+        c = _cfg("commit_p99_speedup", speedup, "x")
+        c["gate"] = {
+            "grouped_p99_ms": {"value": p99, "unit": "ms"},
+            "grouped_throughput": {"value": tput, "unit": "commits/s"},
+        }
+        return c
+
+    prior = _round({"9": with_gate(10.0, 200.0, 3.0)})
+    # p99 grows 50% (latency: worse), throughput up (better), headline flat
+    cur = _round({"9": with_gate(15.0, 250.0, 3.0)})
+    [r] = compare(cur, prior, threshold_pct=20)
+    assert r.config == "9.gate.grouped_p99_ms"
+    assert r.metric == "grouped_p99_ms"
+    assert r.delta_pct == pytest.approx(50.0)
+
+    # throughput collapse flags too; p99 improvement does not
+    cur2 = _round({"9": with_gate(5.0, 100.0, 3.0)})
+    [r2] = compare(cur2, prior, threshold_pct=20)
+    assert r2.config == "9.gate.grouped_throughput"
+
+    # headline regression still reported alongside gate entries
+    cur3 = _round({"9": with_gate(10.0, 200.0, 1.0)})
+    [r3] = compare(cur3, prior, threshold_pct=20)
+    assert r3.config == "9"
+
+    # a gate entry missing from either round is simply not compared
+    cur4 = _round({"9": _cfg("commit_p99_speedup", 3.0, "x")})
+    assert compare(cur4, prior, threshold_pct=20) == []

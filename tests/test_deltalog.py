@@ -271,3 +271,50 @@ def test_stale_ok_without_limit_stays_synchronous(tmp_table):
     v = log.update().version
     WriteIntoDelta(log, "append", pa.table({"a": np.arange(3)})).run()
     assert log.update(stale_ok=True).version == v + 1
+
+
+def test_update_coalescing_adopts_concurrent_listing(tmp_table):
+    """A waiter queued on the update lock whose arrival predates the
+    completion of a listing that STARTED after it arrived adopts that
+    result instead of re-listing — a K-writer convoy costs one listing.
+    Sequential update() calls still always re-list (a listing started
+    BEFORE the caller's arrival never satisfies the adoption check)."""
+    import threading
+
+    from delta_tpu.utils import telemetry
+
+    log = bootstrap(tmp_table, n_commits=2)
+    log.update()
+
+    lists = {"n": 0}
+    orig = log.store.list_from
+
+    def counting_list(path):
+        lists["n"] += 1
+        return orig(path)
+
+    log.store.list_from = counting_list
+    before = telemetry.counters("log").get("log.update.coalesced", 0)
+
+    barrier = threading.Barrier(6)
+    results = []
+
+    def racer():
+        barrier.wait()
+        results.append(log.update().version)
+
+    threads = [threading.Thread(target=racer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [1] * 6
+    # the first lock-holder lists; every waiter that arrived before that
+    # listing finished adopts it (allow a straggler that arrived late)
+    assert lists["n"] <= 2
+    assert telemetry.counters("log").get("log.update.coalesced", 0) >= before + 4
+
+    # sequential calls are never coalesced: an external commit is always
+    # observed by the very next update()
+    commit_manually(log, 2, [add("f-2-0")])
+    assert log.update().version == 2

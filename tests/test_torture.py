@@ -94,3 +94,53 @@ def test_harness_ledger_matches_manual_bookkeeping(tmp_path):
                  .column("id").to_pylist())
     assert got == sorted(h._expected_ids())
     assert h.report.crashes == 0 and h.report.faults_injected == 0
+
+
+# -- high-traffic commit path (ISSUE 9): group commit + async checkpoints ----
+
+
+def test_torture_grouped_async_fixed_seed_subset(tmp_path):
+    """The PR 5 tier-1 workload, same seed, with the group-commit
+    coordinator AND async incremental checkpointing on: every invariant
+    (no committed row lost/duplicated, snapshot constructible, txnId
+    reconciliation) holds under the same fault pressure, and the new
+    engine-level fault points draw."""
+    report = run_torture(str(tmp_path / "t"), seed=TIER1_SEED, steps=60,
+                         rate=0.08, group_commit=True, async_checkpoint=True)
+    assert report.steps == 60
+    assert report.faults_injected >= 10
+    assert len(report.fault_kinds) >= 3
+    assert report.invariant_checks >= 6
+    assert report.op_counts.get("append", 0) >= 10
+    assert report.max_step_s < 60.0
+    # the coordinator's write loop is a real fault point in this mode:
+    # every grouped member draws at txn.groupLoop before its create
+    assert any(k.startswith("txn.groupLoop|") for k in report.per_point)
+
+
+def test_torture_grouped_crash_diet_recovers(tmp_path):
+    """Crash-kind-only plan (same seed as the ungrouped diet) with grouping
+    + async checkpointing: crash mid-batch / between batch members / torn
+    incremental checkpoint all recover through the standard path."""
+    report = run_torture(
+        str(tmp_path / "t"), seed=11, steps=30, rate=0.25,
+        kinds=("crash_before_publish", "crash_after_publish",
+               "torn_checkpoint", "stale_last_checkpoint"),
+        group_commit=True, async_checkpoint=True,
+    )
+    assert report.crashes >= 3
+    assert report.recoveries >= report.crashes
+
+
+@pytest.mark.slow
+def test_torture_grouped_acceptance(tmp_path):
+    """Long grouped+async run at the PR 5 acceptance seed: sustained fault
+    pressure across every kind with the coordinator and the incremental
+    builder in the loop."""
+    h = TortureHarness(str(tmp_path / "t"), seed=424242, rate=0.12,
+                       group_commit=True, async_checkpoint=True)
+    r = h.run(steps=400, check_every=10)
+    assert r.faults_injected >= 150, r.fault_kinds
+    assert len(r.fault_kinds) >= 6, r.fault_kinds
+    assert r.crashes >= 10
+    assert r.max_step_s < 60.0

@@ -75,33 +75,57 @@ def _comparable(entry: Any) -> Optional[Dict[str, Any]]:
     return entry
 
 
+def _worse_pct(unit: str, cur_v: float, old_v: float) -> Optional[float]:
+    """Direction-aware regression percentage (positive = worse). Latency
+    units regress when the value grows; throughput/ratio units when it
+    shrinks. None when the prior value can't anchor a percentage."""
+    if old_v == 0:
+        return None
+    if unit in LOWER_IS_BETTER:
+        return (cur_v - old_v) / old_v * 100.0
+    return (old_v - cur_v) / old_v * 100.0
+
+
 def compare(current: Dict[str, Any], prior: Dict[str, Any],
             threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> List[Regression]:
     """Regressions of ``current`` vs ``prior`` past ``threshold_pct``.
     Only configs present and comparable in BOTH rounds participate; a unit
-    change between rounds makes the config incomparable (ignored)."""
+    change between rounds makes the config incomparable (ignored).
+
+    Besides the headline metric, a config may carry a ``gate`` map of named
+    sub-metrics (``{"p99_ms": {"value": ..., "unit": "ms"}, ...}`` — e.g.
+    the contention config's per-leg p99 latency): each sub-metric present
+    and comparable in both rounds is gated with the same direction-aware
+    threshold, reported as ``<config>.gate.<name>``."""
     cur_map, prior_map = _configs(current), _configs(prior)
     out: List[Regression] = []
+
+    def _gate_one(key: str, metric: str, cur: Dict[str, Any],
+                  old: Dict[str, Any]) -> None:
+        if str(cur.get("unit")) != str(old.get("unit")):
+            return
+        unit = str(cur.get("unit", ""))
+        cur_v, old_v = float(cur["value"]), float(old["value"])
+        worse = _worse_pct(unit, cur_v, old_v)
+        if worse is not None and worse > threshold_pct:
+            out.append(Regression(
+                config=key, metric=metric, unit=unit,
+                prior=old_v, current=cur_v, delta_pct=worse,
+            ))
+
     for key in sorted(cur_map.keys() & prior_map.keys()):
         cur = _comparable(cur_map[key])
         old = _comparable(prior_map[key])
         if cur is None or old is None:
             continue
-        if str(cur.get("unit")) != str(old.get("unit")):
-            continue
-        unit = str(cur.get("unit", ""))
-        cur_v, old_v = float(cur["value"]), float(old["value"])
-        if old_v == 0:
-            continue
-        if unit in LOWER_IS_BETTER:
-            worse_pct = (cur_v - old_v) / old_v * 100.0
-        else:
-            worse_pct = (old_v - cur_v) / old_v * 100.0
-        if worse_pct > threshold_pct:
-            out.append(Regression(
-                config=key, metric=str(cur.get("metric", "")), unit=unit,
-                prior=old_v, current=cur_v, delta_pct=worse_pct,
-            ))
+        _gate_one(key, str(cur.get("metric", "")), cur, old)
+        gate_cur, gate_old = cur.get("gate"), old.get("gate")
+        if isinstance(gate_cur, dict) and isinstance(gate_old, dict):
+            for gk in sorted(gate_cur.keys() & gate_old.keys()):
+                gc, go = _comparable(gate_cur[gk]), _comparable(gate_old[gk])
+                if gc is None or go is None:
+                    continue
+                _gate_one(f"{key}.gate.{gk}", gk, gc, go)
     return out
 
 
