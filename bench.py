@@ -1686,6 +1686,29 @@ def main():
                 _reset_engine_state()
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+    # the static-analysis gate rides the bench artifact (ISSUE 10): finding
+    # counts land as a config entry (unit "findings" is lower-is-better in
+    # tools/bench_diff, so --compare fails a round that grew findings) and
+    # as the cataloged analysis.findings gauge in the telemetry snapshot
+    try:
+        from delta_tpu import analysis as _analysis
+        from delta_tpu.utils import telemetry as _telemetry
+
+        _report = _analysis.analyze_repo()
+        _analysis.publish_metrics(_report)
+        results["analysis"] = {
+            "metric": "analysis_findings", "value": len(_report.findings),
+            "unit": "findings", "vs_baseline": 0,
+            "counts": _report.counts(),
+            "waived": len(_report.suppressed),
+            "baselined": len(_report.baselined),
+            "telemetry": _telemetry.bench_snapshot(include=("analysis",)),
+        }
+    except Exception as e:  # noqa: BLE001 — the gate must not eat the bench
+        results["analysis"] = {
+            "metric": "analysis_findings", "value": -1, "unit": "error",
+            "vs_baseline": 0, "note": f"{type(e).__name__}: {e}"[:300],
+        }
     emitted["done"] = True
     _emit(results)
     if compare_path:

@@ -1,0 +1,295 @@
+"""Analysis core: findings, sources, suppressions, baseline, pass driver.
+
+The model mirrors what Delta's Scala compiler + scalastyle gave the
+reference for free (see PARITY.md): a *finding* is a (rule, file, message)
+triple anchored to a line; a finding is silenced either by an inline
+waiver — ``# delta-lint: ignore[rule] -- justification`` — which is a
+reviewed, greppable annotation at the site, or by the checked-in baseline
+(``tools/analyze_baseline.json``) which holds accepted pre-existing debt
+keyed WITHOUT line numbers so ordinary edits don't churn it.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "SourceFile", "AnalysisContext", "AnalysisPass",
+    "AnalysisReport", "run_passes", "apply_suppressions", "load_baseline",
+    "baseline_payload", "analyze_repo", "repo_root", "default_baseline_path",
+]
+
+#: package the engine analyzes by default, relative to the repo root
+DEFAULT_PACKAGE = "delta_tpu"
+
+BASELINE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``baseline_key`` deliberately omits the line
+    number: accepted debt survives unrelated edits above it, and a *new*
+    instance of an identical (rule, file, message) triple is absorbed only
+    up to the baselined count."""
+
+    rule: str
+    path: str  # repo-relative posix path, e.g. "delta_tpu/obs/journal.py"
+    line: int
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+_SUPPRESS_RE = re.compile(r"#\s*delta-lint:\s*ignore\[([^\]]*)\]")
+
+
+class SourceFile:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text, filename=self.rel)
+        self.lines = text.splitlines()
+        #: line number -> frozenset of suppressed rule names ("*" = all)
+        self.suppressions: Dict[int, frozenset] = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> Dict[int, frozenset]:
+        out: Dict[int, frozenset] = {}
+        pending: List[frozenset] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                before = line[: m.start()].strip()
+                if before:  # trailing comment: applies to THIS line
+                    out[i] = out.get(i, frozenset()) | rules
+                else:  # standalone comment line: applies to the next code line
+                    pending.append(rules)
+                continue
+            stripped = line.strip()
+            if pending and stripped and not stripped.startswith("#"):
+                acc = frozenset().union(*pending)
+                out[i] = out.get(i, frozenset()) | acc
+                pending = []
+        return out
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+
+class AnalysisContext:
+    """The file set one analysis run sees. Built from a directory tree
+    (normal runs) or from in-memory sources (the fixture suite)."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files: List[SourceFile] = sorted(files, key=lambda f: f.rel)
+        self._by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def from_dir(cls, root: str, package: str = DEFAULT_PACKAGE
+                 ) -> "AnalysisContext":
+        files = []
+        pkg_dir = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root)
+                with open(path, encoding="utf-8") as f:
+                    files.append(SourceFile(rel, f.read()))
+        return cls(files)
+
+    @classmethod
+    def from_sources(cls, sources: Mapping[str, str]) -> "AnalysisContext":
+        return cls([SourceFile(rel, text) for rel, text in sources.items()])
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel.replace(os.sep, "/"))
+
+    def find_suffix(self, suffix: str) -> Optional[SourceFile]:
+        """The unique file whose path ends with ``suffix`` (posix), if any."""
+        suffix = suffix.replace(os.sep, "/")
+        matches = [f for f in self.files if f.rel.endswith(suffix)]
+        return matches[0] if len(matches) == 1 else None
+
+
+class AnalysisPass:
+    """Base class for a pass. ``rules`` names every rule the pass can emit —
+    the CLI rule table and the suppression/baseline vocabulary."""
+
+    name: str = ""
+    description: str = ""
+    rules: Tuple[str, ...] = ()
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def run_passes(ctx: AnalysisContext,
+               passes: Iterable[AnalysisPass]) -> List[Finding]:
+    """Raw findings from ``passes`` over ``ctx``, deterministically ordered.
+    Suppressions and the baseline are NOT applied here."""
+    out: List[Finding] = []
+    for p in passes:
+        out.extend(p.run(ctx))
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def apply_suppressions(ctx: AnalysisContext, findings: Iterable[Finding]
+                       ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (kept, suppressed) per the inline waivers."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        sf = ctx.get(f.path)
+        if sf is not None and sf.is_suppressed(f.line, f.rule):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """The baseline as ``{baseline_key: accepted_count}``; {} when absent."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    findings = data.get("findings", {}) if isinstance(data, dict) else {}
+    out: Dict[str, int] = {}
+    if isinstance(findings, dict):
+        for k, v in findings.items():
+            try:
+                out[str(k)] = max(int(v), 0)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def baseline_payload(findings: Iterable[Finding]) -> Dict[str, object]:
+    """The JSON payload ``--update-baseline`` writes for ``findings``."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.baseline_key] = counts.get(f.baseline_key, 0) + 1
+    return {
+        "version": BASELINE_VERSION,
+        "comment": "Accepted pre-existing findings; regenerate with "
+                   "`python tools/analyze.py --update-baseline`.",
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+
+
+@dataclass
+class AnalysisReport:
+    """One full run: what's new, what the waivers/baseline absorbed."""
+
+    findings: List[Finding]          # new (fail the run)
+    suppressed: List[Finding]        # inline-waived
+    baselined: List[Finding]         # absorbed by the baseline file
+    stale_baseline: List[str]        # baseline keys nothing matched anymore
+    files_analyzed: int
+    passes_run: Tuple[str, ...]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "clean": self.clean,
+            "filesAnalyzed": self.files_analyzed,
+            "passes": list(self.passes_run),
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "staleBaseline": list(self.stale_baseline),
+        }
+
+
+def _apply_baseline(findings: List[Finding], baseline: Dict[str, int]
+                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    absorbed: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.baseline_key, 0) > 0:
+            remaining[f.baseline_key] -= 1
+            absorbed.append(f)
+        else:
+            new.append(f)
+    # ANY leftover count is surplus: it would silently absorb a future new
+    # identical violation, so the operator is told to regenerate
+    stale = sorted(k for k, v in remaining.items() if v > 0)
+    return new, absorbed, stale
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this file's package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), "tools", "analyze_baseline.json")
+
+
+def analyze_repo(root: Optional[str] = None,
+                 passes: Optional[Iterable[AnalysisPass]] = None,
+                 baseline_path: Optional[str] = None,
+                 ctx: Optional[AnalysisContext] = None) -> AnalysisReport:
+    """Run the engine end to end: collect sources, run passes, apply inline
+    waivers then the baseline. ``baseline_path=''`` skips the baseline."""
+    from delta_tpu.analysis.passes import all_passes
+
+    root = root or repo_root()
+    if ctx is None:
+        ctx = AnalysisContext.from_dir(root)
+    chosen = list(passes) if passes is not None else all_passes()
+    raw = run_passes(ctx, chosen)
+    kept, suppressed = apply_suppressions(ctx, raw)
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    new, absorbed, stale = _apply_baseline(kept, baseline)
+    # a rule-filtered run must not call OTHER rules' accepted debt surplus —
+    # only entries this run's passes could have matched are judged stale
+    covered = {r for p in chosen for r in p.rules}
+    stale = [k for k in stale if k.split("|", 1)[0] in covered]
+    return AnalysisReport(
+        findings=new, suppressed=suppressed, baselined=absorbed,
+        stale_baseline=stale, files_analyzed=len(ctx.files),
+        passes_run=tuple(p.name for p in chosen),
+    )

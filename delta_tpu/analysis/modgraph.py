@@ -1,0 +1,501 @@
+"""Per-module structural facts shared by the analysis passes.
+
+Builds, for one :class:`~delta_tpu.analysis.core.SourceFile`:
+
+* the set of **locks** — module globals and ``self.*`` attributes assigned
+  a ``threading.Lock/RLock/Condition`` — with canonical ids that unify
+  cross-module references (``dl.lock`` in ``txn/group_commit.py`` and
+  ``self.lock`` in ``log/deltalog.py`` both canonicalize to
+  ``DeltaLog.lock`` via the global attribute index);
+* a **function index** (module functions, methods, nested defs) with
+  module-local call resolution;
+* per-function **events** from a held-lock-tracking walk: calls, lock
+  entries, and mutations of shared state (module globals / self
+  attributes), each annotated with the locks lexically held;
+* **thread entry points**: ``Thread(target=...)`` targets and
+  ``pool.submit/map`` callables (unwrapping ``telemetry.propagated``);
+* an **effective-held** fixpoint: a private helper called only under a
+  lock inherits that lock (how ``journal._write_batch`` — "callers hold
+  ``_IO_LOCK``" — is seen as guarded without an annotation).
+
+Everything here is heuristic and syntactic; the passes compensate with
+inline waivers for the residue. Known imprecision: ``.acquire()`` /
+``.release()`` pairs are not tracked (the engine uses ``with``), and call
+resolution never crosses module boundaries.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from delta_tpu.analysis.core import AnalysisContext, SourceFile
+
+__all__ = ["GlobalLockIndex", "ModuleGraph", "FunctionUnit", "CallEvent",
+           "EnterEvent", "MutateEvent", "terminal_name", "call_name",
+           "shallow_walk", "global_lock_index", "module_graph"]
+
+
+def _cache(ctx: AnalysisContext) -> dict:
+    cache = getattr(ctx, "_modgraph_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(ctx, "_modgraph_cache", cache)
+    return cache
+
+
+def global_lock_index(ctx: AnalysisContext) -> "GlobalLockIndex":
+    """The context's lock index, built once and shared across passes."""
+    cache = _cache(ctx)
+    if "index" not in cache:
+        cache["index"] = GlobalLockIndex(ctx)
+    return cache["index"]
+
+
+def module_graph(ctx: AnalysisContext, sf: SourceFile) -> "ModuleGraph":
+    """One ModuleGraph per file per context — the held-lock walk and the
+    effective-held fixpoint are the engine's dominant cost, so every
+    concurrency pass shares them instead of rebuilding."""
+    cache = _cache(ctx)
+    if sf.rel not in cache:
+        cache[sf.rel] = ModuleGraph(sf, global_lock_index(ctx))
+    return cache[sf.rel]
+
+
+def shallow_walk(root: ast.AST):
+    """``ast.walk`` that does not descend into nested function/class/lambda
+    bodies — those are separate analysis units. The root itself may be a
+    function node; only *nested* definitions are skipped."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+#: attribute names that read as locks even when we never saw the ctor
+_LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|cv|cond|mutex)$", re.IGNORECASE)
+
+#: methods that mutate their receiver in place
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "add", "remove", "discard", "pop",
+    "popitem", "clear", "update", "setdefault", "appendleft",
+})
+
+
+def terminal_name(expr: ast.expr) -> Optional[str]:
+    """The last identifier of a Name/Attribute chain (``a.b.c`` -> ``c``)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return terminal_name(call.func)
+
+
+@dataclass
+class FunctionUnit:
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: Optional[str] = None
+    parent: Optional[str] = None  # enclosing function qualname (nested defs)
+
+
+@dataclass
+class CallEvent:
+    node: ast.Call
+    held: Tuple[str, ...]
+    resolved: Optional[str]  # module-local qualname, when resolvable
+
+
+@dataclass
+class EnterEvent:
+    lock: str
+    held_before: Tuple[str, ...]
+    node: ast.AST
+
+
+@dataclass
+class MutateEvent:
+    key: str  # canonical shared-state id
+    held: Tuple[str, ...]
+    node: ast.AST
+    kind: str  # "assign" | "augassign" | "method"
+
+
+@dataclass
+class FunctionFacts:
+    calls: List[CallEvent] = field(default_factory=list)
+    enters: List[EnterEvent] = field(default_factory=list)
+    mutations: List[MutateEvent] = field(default_factory=list)
+
+
+class GlobalLockIndex:
+    """Cross-file index: lock attribute name -> owning ``Class.attr`` ids.
+    Lets ``other.lock`` canonicalize to ``DeltaLog.lock`` when exactly one
+    analyzed class owns a lock attribute of that name."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.attr_owners: Dict[str, Set[str]] = {}
+        for sf in ctx.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for attr in _class_lock_attrs(node):
+                    self.attr_owners.setdefault(attr, set()).add(
+                        f"{node.name}.{attr}")
+
+    def canonical_attr(self, attr: str) -> Optional[str]:
+        owners = self.attr_owners.get(attr)
+        if owners is None:
+            return f"@{attr}" if _LOCKISH_RE.search(attr) else None
+        if len(owners) == 1:
+            return next(iter(owners))
+        return f"@{attr}"
+
+
+def _is_lock_ctor(value: ast.expr) -> bool:
+    return (isinstance(value, ast.Call)
+            and terminal_name(value.func) in LOCK_CTORS)
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Lock attributes of a class: ``self.X = Lock()`` in any method plus
+    ``X = Lock()`` in the class body."""
+    out: Set[str] = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.add(t.attr)
+    return out
+
+
+class ModuleGraph:
+    def __init__(self, sf: SourceFile, index: GlobalLockIndex):
+        self.sf = sf
+        self.index = index
+        self.module_locks: Dict[str, str] = {}   # name -> canonical id
+        self.class_locks: Dict[str, Set[str]] = {}
+        self.module_globals: Set[str] = set()
+        self.functions: Dict[str, FunctionUnit] = {}
+        self.facts: Dict[str, FunctionFacts] = {}
+        self._collect_module_level()
+        self._collect_functions()
+        for qn in self.functions:
+            self.facts[qn] = self._walk_function(qn)
+        self.effective: Dict[str, FrozenSet[str]] = self._effective_held()
+
+    # -- collection -------------------------------------------------------
+
+    def _collect_module_level(self) -> None:
+        for stmt in self.sf.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        if _is_lock_ctor(stmt.value):
+                            self.module_locks[t.id] = \
+                                f"{self.sf.rel}::{t.id}"
+                        else:
+                            self.module_globals.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                if stmt.value is not None and _is_lock_ctor(stmt.value):
+                    self.module_locks[stmt.target.id] = \
+                        f"{self.sf.rel}::{stmt.target.id}"
+                else:
+                    self.module_globals.add(stmt.target.id)
+            elif isinstance(stmt, ast.ClassDef):
+                self.class_locks[stmt.name] = _class_lock_attrs(stmt)
+        # names declared `global` anywhere also count as module state
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, ast.Global):
+                for n in node.names:
+                    if n not in self.module_locks:
+                        self.module_globals.add(n)
+
+    def _collect_functions(self) -> None:
+        def visit(body: Sequence[ast.stmt], cls: Optional[str],
+                  parent: Optional[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = (f"{parent}.<locals>.{stmt.name}" if parent
+                          else f"{cls}.{stmt.name}" if cls else stmt.name)
+                    self.functions[qn] = FunctionUnit(qn, stmt, cls, parent)
+                    visit(stmt.body, cls, qn)
+                elif isinstance(stmt, ast.ClassDef):
+                    # classes nested in functions/classes too: their methods
+                    # (HTTP handler classes defined inline) must not escape
+                    # the crash-safety/lock-discipline view
+                    nested = (f"{parent}.<locals>.{stmt.name}" if parent
+                              else f"{cls}.{stmt.name}" if cls
+                              else stmt.name)
+                    visit(stmt.body, nested, None)
+
+        visit(self.sf.tree.body, None, None)
+
+    # -- lock / state canonicalization -----------------------------------
+
+    def lock_id(self, expr: ast.expr, cls: Optional[str]) -> Optional[str]:
+        """Canonical lock id for an expression used as ``with <expr>:``."""
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                if cls and attr in self.class_locks.get(cls, ()):
+                    return f"{cls}.{attr}"
+                return self.index.canonical_attr(attr)
+            # receiver is another object (dl.lock, conf._lock, cls attr)
+            return self.index.canonical_attr(attr)
+        return None
+
+    def _state_key(self, expr: ast.expr, unit: FunctionUnit
+                   ) -> Optional[str]:
+        """Canonical shared-state id for a mutation target base: a module
+        global or a ``self`` attribute (locks themselves excluded)."""
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Name):
+            if (expr.id in self.module_globals
+                    and expr.id not in self.module_locks):
+                return f"{self.sf.rel}::{expr.id}"
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and unit.cls):
+            if expr.attr in self.class_locks.get(unit.cls, ()):
+                return None
+            return f"{self.sf.rel}::{unit.cls}.{expr.attr}"
+        return None
+
+    # -- call resolution --------------------------------------------------
+
+    def resolve_call(self, call: ast.Call, unit: FunctionUnit
+                     ) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self._resolve_name(f.id, unit)
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and unit.cls):
+            qn = f"{unit.cls}.{f.attr}"
+            return qn if qn in self.functions else None
+        return None
+
+    def _resolve_name(self, name: str, unit: FunctionUnit) -> Optional[str]:
+        # nested defs of the enclosing function chain shadow module scope
+        scope = unit.qualname
+        while scope:
+            qn = f"{scope}.<locals>.{name}"
+            if qn in self.functions:
+                return qn
+            scope = self.functions[scope].parent if scope in self.functions \
+                else None
+        if name in self.functions:
+            return name
+        if unit.cls and f"{unit.cls}.{name}" in self.functions:
+            return f"{unit.cls}.{name}"
+        return None
+
+    def resolve_callable_expr(self, expr: ast.expr, unit: FunctionUnit
+                              ) -> Optional[str]:
+        """Resolve a callable-valued expression (a ``target=`` kwarg, a
+        ``pool.submit`` argument), unwrapping ``telemetry.propagated(f)``."""
+        if (isinstance(expr, ast.Call)
+                and terminal_name(expr.func) == "propagated" and expr.args):
+            expr = expr.args[0]
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(expr.id, unit)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and unit.cls):
+            qn = f"{unit.cls}.{expr.attr}"
+            return qn if qn in self.functions else None
+        return None
+
+    # -- held-lock walk ---------------------------------------------------
+
+    def _walk_function(self, qualname: str) -> FunctionFacts:
+        unit = self.functions[qualname]
+        facts = FunctionFacts()
+
+        def walk(stmts: Sequence[ast.stmt], held: Tuple[str, ...]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested defs are separate units
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    inner = held
+                    for item in stmt.items:
+                        lid = self.lock_id(item.context_expr, unit.cls)
+                        self._scan_exprs([item.context_expr], inner, unit,
+                                         facts)
+                        if lid is not None:
+                            facts.enters.append(
+                                EnterEvent(lid, inner, item.context_expr))
+                            if lid not in inner:
+                                inner = inner + (lid,)
+                    walk(stmt.body, inner)
+                    continue
+                self._scan_stmt(stmt, held, unit, facts)
+                for _name, sub in ast.iter_fields(stmt):
+                    for blocks in _stmt_bodies(sub):
+                        walk(blocks, held)
+        walk(unit.node.body, ())
+        return facts
+
+    def _scan_stmt(self, stmt: ast.stmt, held: Tuple[str, ...],
+                   unit: FunctionUnit, facts: FunctionFacts) -> None:
+        # mutations: assignment / augassign targets over shared state
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                key = self._state_key(t, unit)
+                if key is not None:
+                    facts.mutations.append(MutateEvent(
+                        key, held, stmt,
+                        "subscript" if isinstance(t, ast.Subscript)
+                        else "assign"))
+        elif isinstance(stmt, ast.AugAssign):
+            key = self._state_key(stmt.target, unit)
+            if key is not None:
+                facts.mutations.append(
+                    MutateEvent(key, held, stmt, "augassign"))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            key = self._state_key(stmt.target, unit)
+            if key is not None:
+                facts.mutations.append(MutateEvent(key, held, stmt, "assign"))
+        self._scan_exprs(
+            [n for n in ast.iter_child_nodes(stmt)
+             if isinstance(n, ast.expr)], held, unit, facts)
+
+    def _scan_exprs(self, exprs: Sequence[ast.expr], held: Tuple[str, ...],
+                    unit: FunctionUnit, facts: FunctionFacts) -> None:
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                facts.calls.append(CallEvent(
+                    node, held, self.resolve_call(node, unit)))
+                # mutating method call on shared state
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in MUTATOR_METHODS):
+                    key = self._state_key(f.value, unit)
+                    if key is not None:
+                        facts.mutations.append(
+                            MutateEvent(key, held, node, "method"))
+
+    # -- thread entry points ----------------------------------------------
+
+    def thread_entries(self) -> Dict[str, str]:
+        """``{qualname: how}`` for functions handed to another thread:
+        ``Thread(target=...)`` / ``pool.submit(f)`` / ``pool.map(f, ...)``."""
+        out: Dict[str, str] = {}
+        for qn, facts in self.facts.items():
+            unit = self.functions[qn]
+            for ev in facts.calls:
+                name = call_name(ev.node)
+                if name == "Thread":
+                    for kw in ev.node.keywords:
+                        if kw.arg == "target":
+                            t = self.resolve_callable_expr(kw.value, unit)
+                            if t:
+                                out.setdefault(t, "Thread target")
+                elif name in ("submit", "map") and ev.node.args:
+                    recv = terminal_name(ev.node.func.value) \
+                        if isinstance(ev.node.func, ast.Attribute) else None
+                    if recv and re.search(r"pool|executor|ex\b", recv,
+                                          re.IGNORECASE):
+                        t = self.resolve_callable_expr(ev.node.args[0], unit)
+                        if t:
+                            out.setdefault(t, f"pool.{name} callable")
+        return out
+
+    def reachable_from(self, roots: Sequence[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            qn = stack.pop()
+            if qn in seen:
+                continue
+            seen.add(qn)
+            for ev in self.facts[qn].calls:
+                if ev.resolved and ev.resolved not in seen:
+                    stack.append(ev.resolved)
+        return seen
+
+    # -- effective held locks (caller-context propagation) ----------------
+
+    def _effective_held(self) -> Dict[str, FrozenSet[str]]:
+        """Locks a function can assume held on EVERY entry: the intersection,
+        over all module-local call sites, of locks lexically held at the
+        site plus the caller's own effective set. Public functions (no
+        leading underscore on the terminal name) and thread entry points
+        assume nothing — they are callable from anywhere."""
+        entries = set(self.thread_entries())
+        sites: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+        for qn, facts in self.facts.items():
+            for ev in facts.calls:
+                if ev.resolved:
+                    sites.setdefault(ev.resolved, []).append((qn, ev.held))
+        universe = frozenset(
+            lid for f in self.facts.values() for e in f.enters
+            for lid in (e.lock,))
+        eff: Dict[str, FrozenSet[str]] = {}
+        for qn in self.functions:
+            simple = qn.rsplit(".", 1)[-1]
+            if (qn in entries or not simple.startswith("_")
+                    or qn not in sites):
+                eff[qn] = frozenset()
+            else:
+                eff[qn] = universe
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for qn, qsites in sites.items():
+                if eff.get(qn) == frozenset() and (
+                        qn in entries
+                        or not qn.rsplit(".", 1)[-1].startswith("_")):
+                    continue
+                new = None
+                for caller, held in qsites:
+                    s = frozenset(held) | eff.get(caller, frozenset())
+                    new = s if new is None else (new & s)
+                new = new if new is not None else frozenset()
+                if new != eff.get(qn):
+                    eff[qn] = new
+                    changed = True
+            if not changed:
+                break
+        return eff
+
+
+def _stmt_bodies(field_val) -> List[List[ast.stmt]]:
+    """The statement-list fields of one field value (body/orelse/finalbody/
+    handler bodies), so the walker recurses without double-visiting."""
+    out: List[List[ast.stmt]] = []
+    if isinstance(field_val, list):
+        stmts = [n for n in field_val if isinstance(n, ast.stmt)]
+        if stmts:
+            out.append(stmts)
+        for n in field_val:
+            if isinstance(n, ast.ExceptHandler):
+                out.append(list(n.body))
+    return out

@@ -1,0 +1,132 @@
+"""Config-registry pass: every ``delta.tpu.*`` key resolves to the registry.
+
+``SqlConf.get`` silently returns the call-site default for an unknown key,
+so a typo'd key (``delta.tpu.snapshot.stalenessLimit`` vs
+``…stalenessLimitMs``) reads as "feature off" forever with no error. Two
+rules close the loop against the ``_DEFAULTS`` registry in
+``delta_tpu/utils/config.py``:
+
+``config-unregistered``
+    A constant ``delta.tpu.*`` key passed to ``conf.get``/``conf.get_bool``
+    that is not in ``SqlConf._DEFAULTS``. (The dynamic
+    ``delta.tpu.properties.defaults.*`` family is exempt.)
+``config-dead``
+    A registered key that no analyzed code reads — either the feature it
+    gated was removed, or its reader typo'd the key and this is the other
+    half of an ``config-unregistered`` pair. Keys covered by a dynamic
+    f-string read prefix (``f"delta.tpu.keyCache.{x}"``) are exempt.
+
+The registry is read from the analyzed AST, not imported — fixtures can
+supply a synthetic ``utils/config.py``. When no registry file is in the
+context the pass is silent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from delta_tpu.analysis.core import AnalysisContext, AnalysisPass, Finding
+from delta_tpu.analysis.modgraph import terminal_name
+
+__all__ = ["ConfigRegistryPass"]
+
+PREFIX = "delta.tpu."
+
+#: key families constructed at runtime inside utils/config.py itself
+ALWAYS_DYNAMIC = ("delta.tpu.properties.defaults.",)
+
+_CONF_RECEIVERS = frozenset({"conf", "_conf"})
+_CONF_METHODS = frozenset({"get", "get_bool"})
+
+
+def _registry_from(sf) -> Optional[Dict[str, int]]:
+    """``{key: lineno}`` of the ``_DEFAULTS`` dict literal, if present."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "_DEFAULTS"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        out: Dict[str, int] = {}
+        for k in node.value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+        return out
+    return None
+
+
+def _is_conf_read(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _CONF_METHODS):
+        return False
+    recv = terminal_name(f.value)
+    return recv in _CONF_RECEIVERS
+
+
+class ConfigRegistryPass(AnalysisPass):
+    name = "config-registry"
+    description = ("constant delta.tpu.* conf reads must resolve to the "
+                   "SqlConf registry; registered keys must have readers")
+    rules = ("config-unregistered", "config-dead")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        reg_file = ctx.find_suffix("utils/config.py")
+        registry = _registry_from(reg_file) if reg_file is not None else None
+        if registry is None:
+            return []
+        const_reads: List[Tuple[str, str, int]] = []  # (key, rel, line)
+        dynamic_prefixes: Set[str] = set(ALWAYS_DYNAMIC)
+        for sf in ctx.files:
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and _is_conf_read(node)):
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str):
+                    if arg.value.startswith(PREFIX):
+                        const_reads.append((arg.value, sf.rel, node.lineno))
+                elif isinstance(arg, ast.JoinedStr):
+                    # an f-string READ (conf.get(f"delta.tpu.family.{x}"))
+                    # shields its constant prefix from config-dead; an
+                    # f-string anywhere else (log messages) must NOT
+                    prefix = ""
+                    for part in arg.values:
+                        if isinstance(part, ast.Constant) and isinstance(
+                                part.value, str):
+                            prefix = part.value
+                        break
+                    # a bare "delta.tpu." prefix (conf.get(f"delta.tpu.{x}"))
+                    # would shield EVERY registered key and silently neuter
+                    # config-dead — require at least one family segment
+                    if prefix.startswith(PREFIX) and len(prefix) > len(PREFIX):
+                        dynamic_prefixes.add(prefix)
+        out: List[Finding] = []
+        read_keys = {k for k, _r, _l in const_reads}
+        for key, rel, line in const_reads:
+            if key in registry:
+                continue
+            if any(key.startswith(p) for p in ALWAYS_DYNAMIC):
+                continue
+            out.append(Finding(
+                "config-unregistered", rel, line,
+                f"conf key '{key}' is not registered in "
+                f"SqlConf._DEFAULTS (utils/config.py) — a typo here "
+                f"silently returns the call-site default"))
+        for key, line in sorted(registry.items()):
+            if key in read_keys:
+                continue
+            if any(key.startswith(p) for p in dynamic_prefixes):
+                continue
+            out.append(Finding(
+                "config-dead", reg_file.rel, line,
+                f"registered conf key '{key}' is never read by the "
+                f"engine — dead knob or a typo'd reader elsewhere"))
+        return out

@@ -1,0 +1,254 @@
+"""Crash-safety pass: SimulatedCrash must pierce, tmp files must not orphan.
+
+The fault injector (``storage/faults.py``) raises :class:`SimulatedCrash`
+— a ``BaseException`` — at named fault points so that no ``except
+Exception`` recovery path can "survive" a process death. Three rules keep
+that contract reviewable:
+
+``crash-except``
+    An ``except Exception`` handler whose try body reaches a fault surface
+    (a LogStore op, a ``faults.fire(...)`` point, or a module-local call
+    that transitively does). ``SimulatedCrash`` pierces such a handler by
+    construction — the flag forces each site to be a *reviewed* decision
+    (waiver or baseline) that its cleanup is crash-safe, instead of
+    silence. New fault-adjacent swallowing can't ship unnoticed.
+``crash-swallow``
+    ``except BaseException`` (or bare ``except:``) that neither re-raises
+    nor stores/forwards the exception: a ``SimulatedCrash`` would be
+    swallowed and the "dead" context would keep running — the
+    crash-between-batch-members class PR 9's review caught by hand.
+``crash-tmpfile``
+    A ``*.tmp`` staging path that is written without a ``try/finally``
+    unlinking it (the PR 5 orphan class): any exception between staging
+    and publish strands the temp file for the cleanup sweep to find.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from delta_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding)
+from delta_tpu.analysis.modgraph import (ModuleGraph, module_graph,
+                                         shallow_walk, terminal_name)
+from delta_tpu.analysis.passes.lock_discipline import (STORE_OPS,
+                                                       _receiver_chain)
+
+__all__ = ["CrashSafetyPass"]
+
+_TMP_RE = re.compile(r"\.tmp\b")
+
+
+def _fault_surface_desc(call: ast.Call) -> Optional[str]:
+    """Non-None when ``call`` is directly a fault surface: a LogStore op on
+    a store-ish receiver, or an engine fault point ``fire("...")``."""
+    f = call.func
+    name = terminal_name(f)
+    if name == "fire" and call.args and isinstance(
+            call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str):
+        return f"faults.fire({call.args[0].value!r})"
+    if isinstance(f, ast.Attribute) and f.attr in STORE_OPS:
+        chain = _receiver_chain(f.value)
+        if any("store" in part.lower() for part in chain):
+            return f"store.{f.attr}"
+    return None
+
+
+class CrashSafetyPass(AnalysisPass):
+    name = "crash-safety"
+    description = ("except-Exception on fault-point paths, swallowed "
+                   "BaseException, tmp files without finally-cleanup")
+    rules = ("crash-except", "crash-swallow", "crash-tmpfile")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in ctx.files:
+            g = module_graph(ctx, sf)
+            surface = self._fault_surfaces(g)
+            for qn, unit in g.functions.items():
+                out.extend(self._handler_findings(g, qn, surface))
+                out.extend(self._tmpfile_findings(g, qn))
+        return out
+
+    # -- fault-surface summary -------------------------------------------
+
+    def _fault_surfaces(self, g: ModuleGraph) -> Dict[str, Optional[str]]:
+        """qualname -> a fault-surface description if the function (or a
+        module-local transitive callee) touches one, else None."""
+        direct: Dict[str, Optional[str]] = {}
+        for qn, facts in g.facts.items():
+            desc = None
+            for ev in facts.calls:
+                desc = _fault_surface_desc(ev.node)
+                if desc:
+                    break
+            direct[qn] = desc
+        # transitive closure (bounded fixpoint)
+        summary = dict(direct)
+        for _ in range(len(g.functions) + 1):
+            changed = False
+            for qn, facts in g.facts.items():
+                if summary[qn]:
+                    continue
+                for ev in facts.calls:
+                    if ev.resolved and summary.get(ev.resolved):
+                        callee = ev.resolved.rsplit(".", 1)[-1]
+                        summary[qn] = f"via {callee}: {summary[ev.resolved]}"
+                        changed = True
+                        break
+            if not changed:
+                break
+        return summary
+
+    # -- except handlers --------------------------------------------------
+
+    def _try_surface(self, g: ModuleGraph, unit, body: List[ast.stmt],
+                     summary: Dict[str, Optional[str]]) -> Optional[str]:
+        """First fault-surface description reachable from ``body``."""
+        for stmt in body:
+            for node in shallow_walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = _fault_surface_desc(node)
+                if desc:
+                    return desc
+                resolved = g.resolve_call(node, unit)
+                if resolved and summary.get(resolved):
+                    callee = resolved.rsplit(".", 1)[-1]
+                    return f"via {callee}: {summary[resolved]}"
+        return None
+
+    @staticmethod
+    def _catches(handler: ast.ExceptHandler, name: str) -> bool:
+        t = handler.type
+        if t is None:
+            return name == "BaseException"  # bare except == BaseException
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        return any(terminal_name(n) == name for n in names)
+
+    _LOG_METHODS = frozenset({"debug", "info", "warning", "error",
+                              "exception", "critical", "log"})
+
+    @classmethod
+    def _handler_propagates(cls, handler: ast.ExceptHandler) -> bool:
+        """True when the handler re-raises or stores/forwards the caught
+        exception (``raise``, ``fut.set_exception(e)``, ``state['err'] =
+        e``) — the crash still reaches someone. Merely LOGGING the bound
+        name (``logger.warning("%s", e)``) is not propagation, and a
+        ``raise`` inside a nested def executes later, not here."""
+        bound = handler.name
+        logged_loads = set()
+        for node in shallow_walk(handler):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in cls._LOG_METHODS:
+                for sub in node.args + [kw.value for kw in node.keywords]:
+                    for n in ast.walk(sub):
+                        logged_loads.add(id(n))
+        for node in shallow_walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if bound is None:
+                continue
+            if isinstance(node, ast.Name) and node.id == bound \
+                    and isinstance(node.ctx, ast.Load) \
+                    and id(node) not in logged_loads:
+                return True
+        return False
+
+    def _handler_findings(self, g: ModuleGraph, qn: str,
+                          summary: Dict[str, Optional[str]]) -> List[Finding]:
+        unit = g.functions[qn]
+        out: List[Finding] = []
+        for node in shallow_walk(unit.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if self._catches(handler, "BaseException"):
+                    if not self._handler_propagates(handler):
+                        out.append(Finding(
+                            "crash-swallow", g.sf.rel, handler.lineno,
+                            f"handler in {qn} catches BaseException and "
+                            f"continues — a SimulatedCrash (process death) "
+                            f"would be swallowed"))
+                    continue
+                if not self._catches(handler, "Exception"):
+                    continue
+                desc = self._try_surface(g, unit, node.body, summary)
+                if desc is None:
+                    continue
+                out.append(Finding(
+                    "crash-except", g.sf.rel, handler.lineno,
+                    f"'except Exception' in {qn} around fault-point IO "
+                    f"({desc}) — SimulatedCrash pierces this handler; its "
+                    f"cleanup must be crash-safe"))
+        return out
+
+    # -- tmp files --------------------------------------------------------
+
+    def _tmpfile_findings(self, g: ModuleGraph, qn: str) -> List[Finding]:
+        unit = g.functions[qn]
+        tmp_names: Dict[str, int] = {}
+        for node in shallow_walk(unit.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            has_tmp = any(
+                isinstance(v, ast.Constant) and isinstance(v.value, str)
+                and _TMP_RE.search(v.value)
+                for v in ast.walk(node.value))
+            if not has_tmp:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tmp_names.setdefault(t.id, node.lineno)
+        if not tmp_names:
+            return []
+        cleaned = self._finally_cleaned(unit.node)
+        out: List[Finding] = []
+        for name, line in sorted(tmp_names.items(), key=lambda kv: kv[1]):
+            if name in cleaned:
+                continue
+            if not self._is_written(unit.node, name):
+                continue
+            out.append(Finding(
+                "crash-tmpfile", g.sf.rel, line,
+                f"tmp file '{name}' in {qn} is written without a "
+                f"try/finally unlink — an exception between staging and "
+                f"publish strands an orphan (PR 5 class)"))
+        return out
+
+    @staticmethod
+    def _finally_cleaned(fn: ast.AST) -> Set[str]:
+        """Names passed to ``os.unlink``/``os.remove`` inside any
+        ``finally:`` block (or except handler) of ``fn``."""
+        out: Set[str] = set()
+        for node in shallow_walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            regions: List[ast.stmt] = list(node.finalbody)
+            for h in node.handlers:
+                regions.extend(h.body)
+            for stmt in regions:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and terminal_name(
+                            sub.func) in ("unlink", "remove"):
+                        for arg in sub.args:
+                            if isinstance(arg, ast.Name):
+                                out.add(arg.id)
+        return out
+
+    @staticmethod
+    def _is_written(fn: ast.AST, name: str) -> bool:
+        """Is ``name`` used as a write target: ``open(name, ...)`` or an
+        argument to a ``write*``/``link`` call?"""
+        for node in shallow_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = terminal_name(node.func)
+            if callee == "open" or (callee or "").startswith("write") \
+                    or callee == "link":
+                if any(isinstance(a, ast.Name) and a.id == name
+                       for a in node.args):
+                    return True
+        return False

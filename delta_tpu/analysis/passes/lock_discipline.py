@@ -1,0 +1,259 @@
+"""Lock-discipline pass: the concurrency contracts PRs 6-9 grew by hand.
+
+Three rules over the :class:`~delta_tpu.analysis.modgraph.ModuleGraph`
+facts:
+
+``lock-guard``
+    State shared between a daemon-thread entry point (``Thread(target=…)``,
+    ``pool.submit/map`` callables) and foreground paths must be mutated
+    under a lock everywhere. A mutation site's *effective* locks are those
+    lexically held plus the caller-context fixpoint (a private helper whose
+    every module-local call site holds ``_IO_LOCK`` inherits it), so
+    "callers hold the lock" conventions are seen without annotations.
+``lock-blocking``
+    No blocking call while a lock is held: LogStore IO (``store.read`` /
+    ``write_bytes`` / ``list_from`` …), ``time.sleep``, ``Thread.join``,
+    ``Future.result``, ``queue.get/put`` and raw ``open()``. The group
+    commit leader's deliberate read-the-tail-once-under-the-commit-lock
+    design carries inline waivers — the point is that each such hold is a
+    *reviewed* decision.
+``lock-order``
+    Lock-acquisition-order cycles across the canonical lock graph
+    (``_IO_LOCK``/``_LOCK`` module locks, ``DeltaLog.lock`` /
+    ``_update_lock`` class locks, coordinator condition vars). An edge
+    A→B means B was entered while A was held; any strongly connected
+    component of ≥2 locks is a potential deadlock.
+
+Scope limits (by design, see modgraph): call resolution is module-local,
+``.acquire()`` pairs are not tracked, and a function called both with and
+without a lock held resolves to "no lock assumed".
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from delta_tpu.analysis.core import (AnalysisContext, AnalysisPass, Finding)
+from delta_tpu.analysis.modgraph import (ModuleGraph, module_graph,
+                                         terminal_name)
+
+__all__ = ["LockDisciplinePass"]
+
+STORE_OPS = frozenset({"read", "read_iter", "read_bytes", "write",
+                       "write_bytes", "list_from", "exists", "delete",
+                       "mkdirs"})
+
+_THREADISH_RE = re.compile(r"(?:^th$|^t\d*$|thread|worker|writer|proc)",
+                           re.IGNORECASE)
+_QUEUEISH_RE = re.compile(r"(?:^q$|queue)", re.IGNORECASE)
+
+
+def _receiver_chain(expr: ast.expr) -> List[str]:
+    out: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        out.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        out.append(expr.id)
+    return out
+
+
+def blocking_desc(call: ast.Call) -> Optional[str]:
+    """A short description when ``call`` is a known blocking primitive."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return "open()" if f.id == "open" else None
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr = f.attr
+    recv = terminal_name(f.value)
+    if attr == "sleep" and recv is not None and recv.lstrip("_") == "time":
+        return "time.sleep"
+    if attr == "join" and recv is not None and _THREADISH_RE.search(recv):
+        return "Thread.join"
+    if attr == "result":
+        return "Future.result"
+    if attr in STORE_OPS:
+        chain = _receiver_chain(f.value)
+        if any("store" in part.lower() for part in chain):
+            return f"store.{attr}"
+    if attr in ("get", "put") and recv is not None \
+            and _QUEUEISH_RE.search(recv):
+        return f"queue.{attr}"
+    return None
+
+
+class LockDisciplinePass(AnalysisPass):
+    name = "lock-discipline"
+    description = ("cross-thread mutation guards, blocking calls under "
+                   "locks, lock-order cycles")
+    rules = ("lock-guard", "lock-blocking", "lock-order")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        #: global lock-order edges: (from, to) -> witness (path, line)
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for sf in ctx.files:
+            g = module_graph(ctx, sf)
+            out.extend(self._guard_findings(g))
+            out.extend(self._blocking_findings(g))
+            self._collect_edges(g, edges)
+        out.extend(self._order_findings(edges))
+        return out
+
+    # -- lock-guard -------------------------------------------------------
+
+    def _guard_findings(self, g: ModuleGraph) -> List[Finding]:
+        entries = g.thread_entries()
+        if not entries:
+            return []
+        background = g.reachable_from(list(entries))
+        #: key -> list of (qualname, MutateEvent, effective_locks)
+        sites: Dict[str, List[Tuple[str, object, frozenset]]] = {}
+        for qn, facts in g.facts.items():
+            simple = qn.rsplit(".", 1)[-1]
+            if simple in ("__init__", "__new__"):
+                continue  # construction precedes sharing
+            eff = g.effective.get(qn, frozenset())
+            for ev in facts.mutations:
+                sites.setdefault(ev.key, []).append(
+                    (qn, ev, frozenset(ev.held) | eff))
+        out: List[Finding] = []
+        entry_desc = ", ".join(sorted(
+            q.rsplit(".", 1)[-1] for q in entries))
+        for key, slist in sorted(sites.items()):
+            bg = [s for s in slist if s[0] in background]
+            fg = [s for s in slist if s[0] not in background]
+            if not bg or not fg:
+                continue  # not cross-thread within this module
+            common = None
+            for _qn, _ev, eff in slist:
+                common = eff if common is None else (common & eff)
+            if common:
+                continue  # one lock guards every site
+            short = key.split("::", 1)[-1]
+            unguarded = [s for s in slist if not s[2]]
+            if unguarded:
+                # the problem sites are the ones holding nothing
+                for qn, ev, _eff in unguarded:
+                    out.append(Finding(
+                        "lock-guard", g.sf.rel, ev.node.lineno,
+                        f"'{short}' is mutated without a lock in {qn} but "
+                        f"is shared with daemon thread(s) ({entry_desc})"))
+            else:
+                # every site holds SOME lock, but no lock is common to all:
+                # the two threads still race (the ISSUE's 'without a common
+                # lock' case)
+                for qn, ev, eff in slist:
+                    locks = ", ".join(sorted(eff))
+                    out.append(Finding(
+                        "lock-guard", g.sf.rel, ev.node.lineno,
+                        f"'{short}' is mutated under {locks} in {qn} but "
+                        f"other sites use a different lock — no common "
+                        f"lock across threads ({entry_desc})"))
+        return out
+
+    # -- lock-blocking ----------------------------------------------------
+
+    def _blocking_findings(self, g: ModuleGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for qn, facts in g.facts.items():
+            eff = g.effective.get(qn, frozenset())
+            for ev in facts.calls:
+                desc = blocking_desc(ev.node)
+                if desc is None:
+                    continue
+                held = frozenset(ev.held) | eff
+                if not held:
+                    continue
+                locks = ", ".join(sorted(held))
+                out.append(Finding(
+                    "lock-blocking", g.sf.rel, ev.node.lineno,
+                    f"blocking call {desc} in {qn} while holding {locks}"))
+        return out
+
+    # -- lock-order -------------------------------------------------------
+
+    def _collect_edges(self, g: ModuleGraph,
+                       edges: Dict[Tuple[str, str], Tuple[str, int]]) -> None:
+        for qn, facts in g.facts.items():
+            eff = g.effective.get(qn, frozenset())
+            for ev in facts.enters:
+                held = frozenset(ev.held_before) | eff
+                for outer in held:
+                    if outer != ev.lock:
+                        edges.setdefault(
+                            (outer, ev.lock),
+                            (g.sf.rel, getattr(ev.node, "lineno", 1)))
+
+    def _order_findings(self, edges: Dict[Tuple[str, str], Tuple[str, int]]
+                        ) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        out: List[Finding] = []
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            # anchor the finding at the first witness edge inside the cycle
+            witness = min(
+                (edges[e] for e in edges
+                 if e[0] in scc and e[1] in scc),
+                key=lambda w: (w[0], w[1]))
+            out.append(Finding(
+                "lock-order", witness[0], witness[1],
+                "lock-acquisition-order cycle between "
+                + " <-> ".join(cyc)))
+        return out
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan's strongly connected components, iterative."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in idx:
+            continue
+        work: List[Tuple[str, iter]] = [(root, iter(sorted(graph[root])))]
+        idx[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in idx:
+                    idx[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], idx[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                comp: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out

@@ -1,0 +1,127 @@
+"""Metric-catalog pass — migrated from ``tests/test_telemetry.py``.
+
+Every constant-string metric call site engine-wide must resolve to the
+``obs/metric_names.py`` catalog, so dashboards never chase stringly-typed
+drift. Coverage is identical to the old test-embedded lints:
+
+``metric-uncataloged``
+    * a ``set_gauge`` name missing from ``GAUGES``;
+    * a ``bump_counter`` name from ``obs/`` or the obs-feed namespaces
+      (``obs.``/``maintenance.``/``storage.retry.``/``faults.``/
+      ``merge.device.``/``merge.keyCache.`` and
+      ``commit.conflicts``/``commit.reconciled``) missing from
+      ``COUNTERS``;
+    * any other constant ``bump_counter`` name missing from
+      ``COUNTERS ∪ ENGINE_COUNTERS`` (the inverse pass);
+    * an ``observe`` name missing from ``HISTOGRAMS``.
+    Dynamic f-string families (``logstore.{op}.*``) are out of scope by
+    construction.
+``metric-overlap``
+    A counter cataloged in both ``COUNTERS`` and ``ENGINE_COUNTERS``.
+
+The catalog is read from the analyzed AST of ``obs/metric_names.py``
+(frozenset literals) — fixtures supply a synthetic one; with no catalog in
+context the pass is silent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from delta_tpu.analysis.core import AnalysisContext, AnalysisPass, Finding
+from delta_tpu.analysis.modgraph import terminal_name
+
+__all__ = ["MetricCatalogPass", "catalog_sets"]
+
+OBS_FEED_PREFIXES = ("obs.", "maintenance.", "storage.retry.", "faults.",
+                     "merge.device.", "merge.keyCache.")
+OBS_FEED_NAMES = ("commit.conflicts", "commit.reconciled")
+
+
+def catalog_sets(sf) -> Optional[Dict[str, Dict[str, int]]]:
+    """``{set_name: {entry: lineno}}`` for the frozenset catalogs in the
+    metric-names module, or None when none are present."""
+    out: Dict[str, Dict[str, int]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or t.id not in (
+                "GAUGES", "COUNTERS", "ENGINE_COUNTERS", "HISTOGRAMS"):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and terminal_name(v.func) == "frozenset" and v.args
+                and isinstance(v.args[0], ast.Set)):
+            continue
+        entries: Dict[str, int] = {}
+        for elt in v.args[0].elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                entries[elt.value] = elt.lineno
+        out[t.id] = entries
+    return out or None
+
+
+def _const_metric_calls(sf, fn_name: str) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) != fn_name or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+class MetricCatalogPass(AnalysisPass):
+    name = "metric-catalog"
+    description = ("constant-name set_gauge/bump_counter/observe call "
+                   "sites resolve to obs/metric_names.py")
+    rules = ("metric-uncataloged", "metric-overlap")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        cat_file = ctx.find_suffix("obs/metric_names.py")
+        sets = catalog_sets(cat_file) if cat_file is not None else None
+        if sets is None:
+            return []
+        gauges = frozenset(sets.get("GAUGES", {}))
+        counters = frozenset(sets.get("COUNTERS", {}))
+        engine_counters = frozenset(sets.get("ENGINE_COUNTERS", {}))
+        histograms = frozenset(sets.get("HISTOGRAMS", {}))
+        out: List[Finding] = []
+        for name in sorted(counters & engine_counters):
+            out.append(Finding(
+                "metric-overlap", cat_file.rel,
+                sets["COUNTERS"][name],
+                f"counter '{name}' is cataloged in both COUNTERS and "
+                f"ENGINE_COUNTERS"))
+        for sf in ctx.files:
+            in_obs = "/obs/" in f"/{sf.rel}"
+            for name, line in _const_metric_calls(sf, "set_gauge"):
+                if name not in gauges:
+                    out.append(Finding(
+                        "metric-uncataloged", sf.rel, line,
+                        f"gauge '{name}' is missing from "
+                        f"obs/metric_names.GAUGES"))
+            for name, line in _const_metric_calls(sf, "bump_counter"):
+                obs_feed = (name.startswith(OBS_FEED_PREFIXES)
+                            or name in OBS_FEED_NAMES)
+                if (in_obs or obs_feed) and name not in counters:
+                    out.append(Finding(
+                        "metric-uncataloged", sf.rel, line,
+                        f"obs-layer counter '{name}' is missing from "
+                        f"obs/metric_names.COUNTERS"))
+                elif name not in counters | engine_counters:
+                    out.append(Finding(
+                        "metric-uncataloged", sf.rel, line,
+                        f"counter '{name}' is missing from "
+                        f"obs/metric_names.py (COUNTERS/ENGINE_COUNTERS)"))
+            for name, line in _const_metric_calls(sf, "observe"):
+                if name not in histograms:
+                    out.append(Finding(
+                        "metric-uncataloged", sf.rel, line,
+                        f"histogram '{name}' is missing from "
+                        f"obs/metric_names.HISTOGRAMS"))
+        return out

@@ -1,0 +1,80 @@
+"""Metric-descriptions pass — migrated from ``tests/test_telemetry.py``.
+
+Every cataloged metric must carry a non-empty one-line ``DESCRIPTIONS``
+entry (the ``/metrics`` ``# HELP`` text), and ``DESCRIPTIONS`` must not
+accumulate entries for metrics that no longer exist — the catalog and its
+documentation move together.
+
+``metric-undocumented``      a catalog entry with no (or an empty) HELP line
+``metric-stale-description`` a DESCRIPTIONS entry for an un-cataloged name
+``metric-multiline-description``  a HELP text containing a newline (breaks
+                             the Prometheus exposition)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from delta_tpu.analysis.core import AnalysisContext, AnalysisPass, Finding
+from delta_tpu.analysis.passes.metric_catalog import catalog_sets
+
+__all__ = ["MetricDescriptionsPass"]
+
+
+def _descriptions(sf) -> Optional[Dict[str, Tuple[str, int]]]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or t.id != "DESCRIPTIONS":
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        out: Dict[str, Tuple[str, int]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                text = v.value if (isinstance(v, ast.Constant)
+                                   and isinstance(v.value, str)) else ""
+                out[k.value] = (text, k.lineno)
+        return out
+    return None
+
+
+class MetricDescriptionsPass(AnalysisPass):
+    name = "metric-descriptions"
+    description = ("every cataloged metric has a one-line # HELP "
+                   "description; none stale")
+    rules = ("metric-undocumented", "metric-stale-description",
+             "metric-multiline-description")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        cat_file = ctx.find_suffix("obs/metric_names.py")
+        if cat_file is None:
+            return []
+        sets = catalog_sets(cat_file)
+        descs = _descriptions(cat_file)
+        if sets is None or descs is None:
+            return []
+        cataloged: Dict[str, int] = {}
+        for entries in sets.values():
+            cataloged.update(entries)
+        out: List[Finding] = []
+        for name, line in sorted(cataloged.items()):
+            text = descs.get(name, ("", 0))[0]
+            if not text.strip():
+                out.append(Finding(
+                    "metric-undocumented", cat_file.rel, line,
+                    f"catalog entry '{name}' has no # HELP description in "
+                    f"obs/metric_names.DESCRIPTIONS"))
+        for name, (text, line) in sorted(descs.items()):
+            if name not in cataloged:
+                out.append(Finding(
+                    "metric-stale-description", cat_file.rel, line,
+                    f"DESCRIPTIONS entry '{name}' documents an "
+                    f"un-cataloged metric"))
+            elif "\n" in text:
+                out.append(Finding(
+                    "metric-multiline-description", cat_file.rel, line,
+                    f"DESCRIPTIONS entry '{name}' is multi-line — breaks "
+                    f"the Prometheus exposition"))
+        return out

@@ -1,0 +1,79 @@
+"""Telemetry-spans pass — migrated from ``tests/test_telemetry.py``.
+
+Every public command entry point in ``delta_tpu/commands/`` (a class
+``run()`` method, or a module-level function taking ``delta_log`` first)
+must open a ``delta.dml.*`` or ``delta.utility.*`` span via
+``record_operation`` — a new command cannot ship uninstrumented.
+
+``span-missing``
+    An entry point with no such span.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from delta_tpu.analysis.core import AnalysisContext, AnalysisPass, Finding
+
+__all__ = ["TelemetrySpansPass"]
+
+EXEMPT_MODULES = frozenset({"__init__.py", "operations.py", "dml_common.py"})
+
+
+def _record_operation_op_types(fn: ast.FunctionDef) -> List[str]:
+    """All constant op-type strings passed to record_operation inside
+    ``fn`` (including nested ``with`` bodies and helpers defined inline)."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            callee = call.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else callee.attr if isinstance(callee, ast.Attribute)
+                    else None)
+            if name != "record_operation" or not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append(arg.value)
+    return out
+
+
+class TelemetrySpansPass(AnalysisPass):
+    name = "telemetry-spans"
+    description = ("every command entry point opens a delta.dml.*/"
+                   "delta.utility.* span")
+    rules = ("span-missing",)
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in ctx.files:
+            parts = sf.rel.split("/")
+            if "commands" not in parts or parts[-1] in EXEMPT_MODULES:
+                continue
+            entry_points = []
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, ast.FunctionDef) \
+                                and sub.name == "run":
+                            entry_points.append((f"{node.name}.run", sub))
+                elif isinstance(node, ast.FunctionDef):
+                    if node.name.startswith("_"):
+                        continue
+                    args = [a.arg for a in node.args.args]
+                    if args and args[0] == "delta_log":
+                        entry_points.append((node.name, node))
+            for label, fn in entry_points:
+                ops = _record_operation_op_types(fn)
+                if not any(op.startswith(("delta.dml.", "delta.utility."))
+                           for op in ops):
+                    out.append(Finding(
+                        "span-missing", sf.rel, fn.lineno,
+                        f"command entry point {label} opens no "
+                        f"delta.dml.*/delta.utility.* span"))
+        return out

@@ -91,10 +91,18 @@ class Catalog:
             return
         os.makedirs(os.path.dirname(self._store_path) or ".", exist_ok=True)
         tmp = self._store_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"tables": self._tables, "claims": self._claims},
-                      f, indent=1, sort_keys=True)
-        os.replace(tmp, self._store_path)
+        try:
+            # delta-lint: ignore[lock-blocking] -- catalog persistence is a
+            # read-modify-write; the mutex must span the staged JSON write
+            with open(tmp, "w") as f:
+                json.dump({"tables": self._tables, "claims": self._claims},
+                          f, indent=1, sort_keys=True)
+            os.replace(tmp, self._store_path)
+        finally:
+            try:
+                os.unlink(tmp)  # no-op after a successful replace
+            except OSError:
+                pass
 
     def _claim_is_live(self, claim: Dict) -> bool:
         """Is an in-flight CREATE claim still owned by a live creator?

@@ -1045,7 +1045,7 @@ class MergeIntoCommand:
                         builder.failed = builder.failed or "slab append failed"
 
         th = threading_mod.Thread(target=uploader, daemon=True,
-                                  name="merge-slab-upload")
+                                  name="delta-merge-slab-upload")
         th.start()
         try:
             # full physical rows per file: no row-group pruning, positions
@@ -1279,7 +1279,8 @@ class MergeIntoCommand:
 
         import threading
 
-        threading.Thread(target=build, daemon=True, name="resident-keys-build").start()
+        threading.Thread(target=build, daemon=True,
+                         name="delta-merge-keys-build").start()
 
     def _launch_device_join(self, key_tab: pa.Table, src: pa.Table, equi):
         """Evaluate + coerce the join keys and launch the device membership

@@ -141,7 +141,9 @@ class VacuumCommand:
             except FileNotFoundError:
                 pass
             if top:
-                with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+                with ThreadPoolExecutor(
+                        max_workers=self.parallelism,
+                        thread_name_prefix="delta-vacuum-list") as pool:
                     list(pool.map(walk, top))
 
         to_delete: List[str] = []
@@ -180,7 +182,9 @@ class VacuumCommand:
 
         my_deletes = host_partition(sorted(to_delete))
         if my_deletes:
-            with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+            with ThreadPoolExecutor(
+                    max_workers=self.parallelism,
+                    thread_name_prefix="delta-vacuum-delete") as pool:
                 list(pool.map(rm, my_deletes))
 
         # drop now-empty partition dirs (deepest first)

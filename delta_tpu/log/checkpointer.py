@@ -142,7 +142,13 @@ def _writer_loop() -> None:  # pragma: no cover — exercised via flush() too
         _WAKE.clear()
         try:
             _drain(raise_errors=False)
-        except BaseException:  # noqa: BLE001 — the daemon must survive
+        # delta-lint: ignore[crash-except] -- deliberately narrowed from
+        # BaseException: SimulatedCrash now pierces and kills the daemon
+        except Exception:  # noqa: BLE001 — the daemon survives IO failures,
+            # but a BaseException (SimulatedCrash = process death,
+            # KeyboardInterrupt) kills this thread like a real crash would;
+            # the next request_checkpoint() revives a fresh writer — the
+            # crash-resume shape the torture harness replays
             logger.debug("async checkpoint drain failed", exc_info=True)
 
 
@@ -158,12 +164,15 @@ def _drain(raise_errors: bool) -> int:
             try:
                 build_checkpoint(delta_log, version)
                 built += 1
-            except BaseException:
+            except BaseException as e:
                 # a torn build (injected crash, IO failure) loses only the
                 # optimization; the base may no longer match what landed on
                 # disk, so forget it — the next build reconstructs fully
                 _drop_base(data_path)
-                if raise_errors:
+                if raise_errors or not isinstance(e, Exception):
+                    # a SimulatedCrash/KeyboardInterrupt mid-batch must
+                    # pierce even on the daemon path: swallowing it here
+                    # would let a "dead" writer keep draining the queue
                     raise
                 logger.warning("async checkpoint at version %s failed for %s",
                                version, data_path, exc_info=True)

@@ -71,6 +71,8 @@ def write_checksum(store: LogStore, log_path: str, version: int, checksum: Versi
         store.write(
             f"{log_path}/{filenames.checksum_file(version)}", [checksum.to_json()], overwrite=True
         )
+    # delta-lint: ignore[crash-except] -- best-effort overwrite-PUT: a pierced
+    # crash leaves no partial state and the .crc is advisory
     except Exception:  # noqa: BLE001 — checksum write must never fail a commit
         logger.warning("Failed to write checksum for version %s", version, exc_info=True)
 

@@ -183,6 +183,8 @@ class Snapshot:
             for f in segment.checkpoint_files:
                 pq.ParquetFile(io.BytesIO(self.store.read_bytes(f.path)))
             return True
+        # delta-lint: ignore[crash-except] -- read-only readability probe: no
+        # state to clean up; a pierced crash aborts the cold build as intended
         except Exception:
             return False
 

@@ -121,13 +121,24 @@ def load_state(path: str) -> Dict[str, Dict[str, float]]:
 def save_state(path: str, state: Dict[str, Dict[str, float]]) -> bool:
     """Atomic-enough JSON write (tmp + rename); best-effort — a read-only
     log dir downgrades persistence, never fails the operation."""
+    import uuid
+
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": _STATE_VERSION, "constants": state}, f,
-                      indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        # uuid-suffixed like logstore.write_bytes: _persist runs outside
+        # _LOCK, so concurrent savers must not share (and finally-unlink)
+        # one tmp name out from under each other
+        tmp = f"{path}.{uuid.uuid4().hex}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": _STATE_VERSION, "constants": state}, f,
+                          indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        finally:
+            try:
+                os.unlink(tmp)  # no-op after a successful replace
+            except OSError:
+                pass
         return True
     except OSError:
         return False

@@ -421,6 +421,8 @@ def _write_batch(jdir: str, entries: List[dict]) -> int:
                 sweep(jdir)
             active = (_next_segment(jdir), 0)
             rotated = True
+        # delta-lint: ignore[lock-blocking] -- _IO_LOCK is the journal's IO
+        # serialization lock; appending under it is its entire purpose
         with open(active[0], "ab") as f:
             f.write(data)
         _ACTIVE[jdir] = (active[0], active[1] + len(data))
@@ -449,7 +451,10 @@ def sweep(jdir: str) -> int:
     ``delta.tpu.journal.retentionMs`` are deleted, then oldest-first until
     the total is within ``delta.tpu.journal.maxBytes`` — the same
     aged-orphan discipline as ``log/cleanup.sweep_tmp_orphans``."""
-    _SWEPT.add(jdir)
+    # _SWEPT is shared with the writer daemon (_write_batch's rotation
+    # check) and sweep() is public API — mutate under the buffer lock
+    with _LOCK:
+        _SWEPT.add(jdir)
     try:
         names = sorted(n for n in os.listdir(jdir)
                        if n.startswith(SEGMENT_PREFIX)
@@ -559,6 +564,6 @@ def reset() -> None:
     with _LOCK:
         _BUFFERS.clear()
         _OLDEST.clear()
+        _SWEPT.clear()
     with _IO_LOCK:
         _ACTIVE.clear()
-        _SWEPT.clear()

@@ -64,6 +64,8 @@ GAUGES = frozenset({
     # -- maintenance recency (commands/optimize.py, vacuum.py) -----------
     "table.maintenance.lastOptimizeVersion",
     "table.maintenance.lastVacuumTimestamp",
+    # -- static analysis (analysis/__init__.publish_metrics, label: rule) -
+    "analysis.findings",
 })
 
 #: Counters introduced by the obs layer and its doctor feeds.
@@ -231,6 +233,7 @@ DESCRIPTIONS = {
     "streaming.source.lastBatchVersionLag": "Table versions between the last served batch and the head.",
     "table.maintenance.lastOptimizeVersion": "Table version written by the last OPTIMIZE.",
     "table.maintenance.lastVacuumTimestamp": "Wall-clock ms of the last VACUUM.",
+    "analysis.findings": "Non-baselined static-analysis findings per rule (tools/analyze.py).",
     # counters — obs layer
     "obs.incidents.written": "Flight-recorder incident files written.",
     "obs.server.requests": "HTTP requests served by the obs endpoint.",

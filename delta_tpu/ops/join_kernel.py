@@ -357,7 +357,8 @@ def inner_join_async(
 
     # uploads drop the GIL: stage transfer + dispatch off-thread so callers
     # overlap the device leg with host-side decode
-    th = threading.Thread(target=launch, daemon=True)
+    th = threading.Thread(target=launch, daemon=True,
+                          name="delta-join-upload")
     th.start()
 
     def finalize() -> JoinResult:

@@ -885,7 +885,7 @@ class ResidentJoinKeys:
                 hbm_ledger.adjust("scratch", -scratch_bytes)
 
         th = threading.Thread(target=launch, daemon=True,
-                              name="merge-device-probe")
+                              name="delta-merge-device-probe")
         th.start()
 
         def finalize() -> PhysicalProbe:

@@ -118,9 +118,15 @@ def write_deletion_vector(
     rel = f"deletion_vector_{uuid.uuid4()}.bin"
     abs_path = os.path.join(data_path, rel)
     tmp = abs_path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, abs_path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, abs_path)
+    finally:
+        try:
+            os.unlink(tmp)  # no-op after a successful replace
+        except OSError:
+            pass
     return DeletionVectorDescriptor(
         storage_type=STORAGE_FILE,
         path_or_inline_dv=rel,

@@ -416,6 +416,8 @@ class FaultInjectingLogStore(LogStore):
         if kind == "crash_before_publish":
             # what a died LocalLogStore.write leaves: staged temp, no publish
             parent, _, name = path.rpartition("/")
+            # delta-lint: ignore[crash-tmpfile] -- the orphan IS the fault being
+            # injected: it simulates what a died LocalLogStore.write leaves
             orphan = f"{parent}/.{name}.deadbeef{len(self.plan.injected):08x}.tmp"
             try:
                 self.base.write_bytes(orphan, data, overwrite=True)

@@ -452,10 +452,8 @@ def get_log_store(path: str = "") -> LogStore:
                 "or register a custom store for this scheme via "
                 "register_log_store()."
             )
-        dialect = conf.get(
-            "delta.tpu.storage.objectStore.dialect",
-            "gcs" if scheme == "gs" else "s3",
-        )
+        dialect = (conf.get("delta.tpu.storage.objectStore.dialect")
+                   or ("gcs" if scheme == "gs" else "s3"))
         cache_key = f"{scheme}|{endpoint}|{dialect}"
 
         def factory(endpoint=endpoint, dialect=dialect):

@@ -180,7 +180,9 @@ class ObjectStoreEmulator:
                 self._respond(204 if existed else 404)
 
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="delta-object-store-http")
 
     # -- lifecycle --------------------------------------------------------
 

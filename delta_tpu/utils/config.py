@@ -34,8 +34,6 @@ class SqlConf:
     conf.set_temporarily(...)`` for tests (≈ SQLConf + withSQLConf)."""
 
     _DEFAULTS: Dict[str, Any] = {
-        # ≈ DeltaSQLConf.DELTA_SNAPSHOT_PARTITIONS (replay shards on device)
-        "delta.tpu.snapshotPartitions": 8,
         # ≈ DELTA_MAX_RETRY_COMMIT_ATTEMPTS (DeltaSQLConf.scala:182)
         "delta.tpu.maxCommitAttempts": 10_000_000,
         # Group commit (txn/group_commit): concurrent commit() calls on one
@@ -66,10 +64,6 @@ class SqlConf:
         "delta.tpu.checkpoint.incremental.maxTables": 8,
         # ≈ DELTA_CHECKPOINT_PART_SIZE — actions per checkpoint part
         "delta.tpu.checkpointPartSize": 1_000_000,
-        # ≈ MERGE_INSERT_ONLY_ENABLED
-        "delta.tpu.merge.optimizeInsertOnlyMerge.enabled": True,
-        # ≈ MERGE_MATCHED_ONLY_ENABLED
-        "delta.tpu.merge.optimizeMatchedOnlyMerge.enabled": True,
         # Run the MERGE equi-join on device (ops/join_kernel) when the
         # condition is 1-2 integer equi-keys with no residual conjuncts
         # (composite keys pack into one int64 lane).
@@ -130,16 +124,29 @@ class SqlConf:
         # Link profile overrides (MB/s). Unset = probe once per process.
         "delta.tpu.link.uploadMBps": None,
         "delta.tpu.link.downloadMBps": None,
-        # ≈ DELTA_STATS_SKIPPING (DeltaSQLConf.scala:150) — we actually wire it
-        "delta.tpu.stats.skipping": True,
-        # ≈ DELTA_COLLECT_STATS — collect per-file min/max/nullCount on write
-        "delta.tpu.stats.collect": True,
+        # Non-equi MERGE pair-streaming tile budget: peak candidate pairs
+        # materialized per tile of the target x source grid.
+        "delta.tpu.merge.nonEquiPairBudget": 8_000_000,
+        # Device-resident state cache (ops/state_cache): keep decoded
+        # snapshot stat lanes HBM-resident across queries.
+        "delta.tpu.stateCache.enabled": True,
+        "delta.tpu.stateCache.maxBytes": 2 << 30,
+        "delta.tpu.stateCache.maxEntries": 16,
+        # Serve file-tier prunes from resident lanes (ops/pruning).
+        "delta.tpu.stateCache.serveScans": True,
+        # Plan scans on device from resident lanes; "auto" prices the
+        # device leg against the link profile, "force"/"off" override.
+        "delta.tpu.stateCache.devicePlan.enabled": True,
+        "delta.tpu.stateCache.devicePlan.mode": "auto",
         # ≈ DELTA_VACUUM_RETENTION_CHECK_ENABLED
         "delta.tpu.retentionDurationCheck.enabled": True,
         # ≈ DELTA_STATE_CORRUPTION_IS_FATAL
         "delta.tpu.state.corruptionIsFatal": True,
         # ≈ DELTA_ASYNC_UPDATE_STALENESS_TIME_LIMIT (DeltaSQLConf.scala:262)
         "delta.tpu.stalenessLimitMs": 0,
+        # Preferred spelling of the staleness bound (log/deltalog.update
+        # stale_ok path); None falls back to delta.tpu.stalenessLimitMs.
+        "delta.tpu.snapshot.stalenessLimitMs": None,
         # ≈ DELTA_SCHEMA_AUTO_MIGRATE (merge schema on write by default off)
         "delta.tpu.schema.autoMerge.enabled": False,
         # ≈ DELTA_HISTORY_METRICS_ENABLED
@@ -201,8 +208,6 @@ class SqlConf:
         # uncompressed (snappy on random int64 is 14x slower to decode for
         # ~10% size); or a codec name applied to all columns.
         "delta.tpu.write.compression": "auto",
-        # Device mesh axis name used by sharded kernels.
-        "delta.tpu.mesh.axis": "shards",
         # Second pruning tier inside the Parquet decode (exec/rowgroups):
         # footer row-group stats skip non-matching row groups, and predicate
         # columns decode first so remaining columns decode only for row
@@ -217,8 +222,6 @@ class SqlConf:
         # of the read tier above). Arrow's 1Mi default would leave most
         # files as a single group with nothing to skip. <= 0 = Arrow default.
         "delta.tpu.write.rowGroupRows": 131_072,
-        # Use the JAX device path for scan planning / pruning when possible.
-        "delta.tpu.device.pruning": True,
         # Below this many candidate files, stats skipping runs on the host
         # (one device round-trip costs more than the whole numpy pass).
         "delta.tpu.device.pruning.minFiles": 4096,
@@ -243,10 +246,24 @@ class SqlConf:
         # orphans (crashed writers) from _delta_log; younger files may be
         # in-flight writes and are kept.
         "delta.tpu.cleanup.tmpOrphanTtlMs": 3_600_000,
-        # ≈ DELTA_CONVERT_METADATA_CHECK_ENABLED and misc
-        "delta.tpu.import.batchSize.statsCollection": 50_000,
-        # partition-dir listing parallelism for vacuum/convert
-        "delta.tpu.parallelDelete.parallelism": 16,
+        # Named-table catalog (catalog/catalog.py): persistence path (None
+        # = in-memory only) and how long an in-flight foreign-host CREATE
+        # claim stays live before the name is forfeited.
+        "delta.tpu.catalog.path": None,
+        "delta.tpu.catalog.claimTimeoutMs": 600_000,
+        # Multi-host barrier/gather timeout (parallel/distributed).
+        "delta.tpu.distributed.timeoutMs": 600_000,
+        # DML writes per-file deletion vectors instead of rewriting files
+        # when the table enables them (commands/dml_common).
+        "delta.tpu.deletionVectors.enabled": True,
+        # Network object stores (storage/logstore): the HTTP endpoint for
+        # s3/gs schemes (required — no silent local fallback) and the
+        # conditional-PUT dialect (None = auto by scheme).
+        "delta.tpu.storage.objectStore.endpoint": None,
+        "delta.tpu.storage.objectStore.dialect": None,
+        # Persistent XLA compilation cache directory (utils/jaxcache).
+        # None = ~/.cache/delta_tpu/xla; empty string disables.
+        "delta.tpu.xla.cacheDir": None,
     }
 
     def __init__(self):

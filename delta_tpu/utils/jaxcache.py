@@ -31,10 +31,10 @@ def ensure_compilation_cache() -> None:
         try:
             from delta_tpu.utils.config import conf
 
-            cache_dir = conf.get(
-                "delta.tpu.xla.cacheDir",
-                os.path.join(os.path.expanduser("~"), ".cache", "delta_tpu", "xla"),
-            )
+            cache_dir = conf.get("delta.tpu.xla.cacheDir")
+            if cache_dir is None:  # None = auto; "" disables
+                cache_dir = os.path.join(
+                    os.path.expanduser("~"), ".cache", "delta_tpu", "xla")
             if not cache_dir:
                 return
             os.makedirs(cache_dir, exist_ok=True)

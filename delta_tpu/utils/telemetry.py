@@ -718,8 +718,9 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
             "ts": start_us, "ph": "X", "dur": dur, "args": args,
         })
     # metadata rows: the process lane plus one thread_name per tid, so the
-    # named pools (delta-scan-decode, merge-slab-upload, merge-device-probe,
-    # delta-ckpt-part, ...) render as labeled lanes instead of bare tids
+    # registered pools (delta-scan-decode, delta-merge-slab-upload,
+    # delta-merge-device-probe, delta-ckpt-part, ... — see
+    # analysis/passes/pool_naming.REGISTERED_POOLS) render as labeled lanes
     rows.append({
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
         "args": {"name": "delta-tpu"},

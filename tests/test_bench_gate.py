@@ -140,3 +140,28 @@ def test_gate_submetrics_walked_direction_aware():
     # a gate entry missing from either round is simply not compared
     cur4 = _round({"9": _cfg("commit_p99_speedup", 3.0, "x")})
     assert compare(cur4, prior, threshold_pct=20) == []
+
+
+def test_findings_unit_is_lower_is_better():
+    """The static-analysis gate (bench.py "analysis" entry): finding-count
+    growth is a regression, shrinkage is an improvement."""
+    prior = _round({"analysis": _cfg("analysis_findings", 1.0, "findings")})
+    worse = _round({"analysis": _cfg("analysis_findings", 2.0, "findings")})
+    [r] = compare(worse, prior, threshold_pct=20)
+    assert r.config == "analysis" and r.unit == "findings"
+    assert r.delta_pct == pytest.approx(100.0)
+    assert compare(prior, worse, threshold_pct=20) == []  # improvement
+
+
+def test_findings_regression_from_clean_zero_still_gates():
+    """0 -> N findings must trip the gate even though a zero prior cannot
+    anchor an ordinary percentage."""
+    clean = _round({"analysis": _cfg("analysis_findings", 0.0, "findings")})
+    dirty = _round({"analysis": _cfg("analysis_findings", 3.0, "findings")})
+    [r] = compare(dirty, clean, threshold_pct=20)
+    assert r.delta_pct == pytest.approx(300.0)
+    assert compare(clean, clean, threshold_pct=20) == []
+    # zero-prior latency configs keep the old no-anchor behavior
+    z = _round({"7": _cfg("probe", 0.0, "ms")})
+    nz = _round({"7": _cfg("probe", 5.0, "ms")})
+    assert compare(nz, z, threshold_pct=20) == []

@@ -709,3 +709,65 @@ def test_abandoned_waiter_removes_queued_entry(tmp_path):
     with pytest.raises(KeyboardInterrupt):
         coord.commit(txn, [_add("never.parquet")])
     assert coord._queue == []
+
+
+# -- crash-safety narrowing (ISSUE 10 satellite): the daemon path ------------
+
+
+def test_daemon_drain_crash_pierces_not_swallowed(tmp_path, monkeypatch):
+    """Regression for the narrowed daemon-path handlers: a SimulatedCrash
+    (process death) mid-batch must PIERCE the daemon drain — before the
+    narrowing, ``_drain(raise_errors=False)`` swallowed BaseException and a
+    "dead" writer kept draining the queue. An ordinary transient failure is
+    still absorbed (the daemon survives IO flakiness)."""
+    monkeypatch.setattr(checkpointer, "_ensure_writer", lambda: None)
+    log = _make_log(tmp_path / "t")
+    for i in range(3):
+        _append(log, f"f{i}.parquet")
+    plan = FaultPlan(seed=5, script=[("checkpoint.asyncBuild",
+                                      "crash_before_publish")])
+    with conf.set_temporarily(**{"delta.tpu.faults.plan": plan}):
+        checkpointer.request_checkpoint(log, 3)
+        with pytest.raises(SimulatedCrash):
+            checkpointer._drain(raise_errors=False)  # the daemon's own path
+    # a transient store error on the same path is absorbed, not raised
+    plan2 = FaultPlan(seed=5, script=[("checkpoint.asyncBuild", "transient")])
+    with conf.set_temporarily(**{"delta.tpu.faults.plan": plan2}):
+        checkpointer.request_checkpoint(log, 3)
+        assert checkpointer._drain(raise_errors=False) == 0
+    # neither failure wedged the queue: a fresh request builds clean
+    checkpointer.request_checkpoint(log, 3)
+    assert checkpointer.flush() == 1
+    assert log.store.exists(
+        f"{log.log_path}/{filenames.checkpoint_file_single(3)}")
+
+
+def test_daemon_thread_dies_on_crash_and_revives(tmp_path):
+    """The delta-ckpt-async daemon thread now dies on a SimulatedCrash like
+    the process it simulates; the next request revives a fresh writer — the
+    crash-resume shape, at thread granularity."""
+    log = _make_log(tmp_path / "t")
+    for i in range(3):
+        _append(log, f"f{i}.parquet")
+    plan = FaultPlan(seed=7, script=[("checkpoint.asyncBuild",
+                                      "crash_before_publish")])
+    with conf.set_temporarily(**{"delta.tpu.faults.plan": plan}):
+        checkpointer.request_checkpoint(log, 3)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            w = checkpointer._WRITER
+            if w is not None and not w.is_alive() \
+                    and not checkpointer.pending_requests():
+                break
+            time.sleep(0.02)
+        w = checkpointer._WRITER
+        assert w is not None and not w.is_alive(), \
+            "the daemon must die on a simulated process death"
+    # plan consumed; a new request spawns a fresh writer that completes
+    checkpointer.request_checkpoint(log, 3)
+    ckpt = f"{log.log_path}/{filenames.checkpoint_file_single(3)}"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not log.store.exists(ckpt):
+        time.sleep(0.02)
+    assert log.store.exists(ckpt)
+    assert checkpointer._WRITER.is_alive()

@@ -1,10 +1,11 @@
 """Usage-logging telemetry (SURVEY §5; ``metering/DeltaLogging.scala:50-109``):
 hierarchical spans (contextvar nesting, Chrome-trace export), the metrics
 registry (counters/gauges/log-bucket histograms, Prometheus exposition),
-CommitStats parity events, and the engine wiring — plus the static lint that
-keeps every command entry point instrumented.
+CommitStats parity events, and the engine wiring. (The AST lints that used
+to live here — command-entry-point instrumentation, the metric catalog and
+its DESCRIPTIONS — are now passes in the ``delta_tpu/analysis`` engine,
+exercised by ``tests/test_analysis.py`` and ``tools/analyze.py``.)
 """
-import ast
 import json
 import os
 import threading
@@ -572,187 +573,6 @@ def test_logstore_io_counters(tmp_table):
     assert io.get("logstore.list.calls", 0) >= 1
 
 
-# -- static lint: every command entry point is instrumented ------------------
-
-_COMMANDS_DIR = os.path.join(
-    os.path.dirname(__file__), "..", "delta_tpu", "commands"
-)
-_EXEMPT_MODULES = {"__init__.py", "operations.py", "dml_common.py"}
-
-
-def _record_operation_op_types(fn: ast.FunctionDef):
-    """All constant op-type strings passed to record_operation inside ``fn``."""
-    out = []
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.With):
-            continue
-        for item in node.items:
-            call = item.context_expr
-            if not isinstance(call, ast.Call):
-                continue
-            callee = call.func
-            name = (callee.id if isinstance(callee, ast.Name)
-                    else callee.attr if isinstance(callee, ast.Attribute)
-                    else None)
-            if name != "record_operation" or not call.args:
-                continue
-            arg = call.args[0]
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                out.append(arg.value)
-    return out
-
-
-def test_every_command_entry_point_runs_under_a_span():
-    """New commands can't ship uninstrumented: every public entry point in
-    delta_tpu/commands/ (a class ``run()`` or a module-level function taking
-    ``delta_log`` first) must open a ``delta.dml.*`` or ``delta.utility.*``
-    span via record_operation."""
-    missing = []
-    for fname in sorted(os.listdir(_COMMANDS_DIR)):
-        if not fname.endswith(".py") or fname in _EXEMPT_MODULES:
-            continue
-        path = os.path.join(_COMMANDS_DIR, fname)
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=fname)
-        entry_points = []
-        for node in tree.body:
-            if isinstance(node, ast.ClassDef):
-                for sub in node.body:
-                    if isinstance(sub, ast.FunctionDef) and sub.name == "run":
-                        entry_points.append((f"{fname}:{node.name}.run", sub))
-            elif isinstance(node, ast.FunctionDef):
-                if node.name.startswith("_"):
-                    continue
-                args = [a.arg for a in node.args.args]
-                if args and args[0] == "delta_log":
-                    entry_points.append((f"{fname}:{node.name}", node))
-        for label, fn in entry_points:
-            ops = _record_operation_op_types(fn)
-            if not any(op.startswith(("delta.dml.", "delta.utility."))
-                       for op in ops):
-                missing.append(label)
-    assert not missing, (
-        "command entry points without a delta.dml.*/delta.utility.* span: "
-        f"{missing}"
-    )
-
-
-# -- static lint: metric names + obs public API live in one catalog ----------
-
-_ENGINE_DIR = os.path.join(os.path.dirname(__file__), "..", "delta_tpu")
-
-
-def _const_calls(tree, fn_name):
-    """All constant-string first arguments of calls to ``fn_name``."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        callee = node.func
-        name = (callee.id if isinstance(callee, ast.Name)
-                else callee.attr if isinstance(callee, ast.Attribute)
-                else None)
-        if name != fn_name or not node.args:
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            out.append(arg.value)
-    return out
-
-
-def _walk_engine_trees():
-    for root, _dirs, files in os.walk(_ENGINE_DIR):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, _ENGINE_DIR)
-            with open(path, encoding="utf-8") as f:
-                yield rel, ast.parse(f.read(), filename=rel)
-
-
-def test_every_gauge_name_is_cataloged():
-    """Every ``set_gauge`` string constant engine-wide must be registered in
-    obs/metric_names.py GAUGES — no stringly-typed gauge drift."""
-    from delta_tpu.obs import metric_names
-
-    stray = []
-    for rel, tree in _walk_engine_trees():
-        for name in _const_calls(tree, "set_gauge"):
-            if name not in metric_names.GAUGES:
-                stray.append(f"{rel}: {name}")
-    assert not stray, f"gauges missing from obs/metric_names.GAUGES: {stray}"
-
-
-def test_obs_counters_are_cataloged():
-    """Counters bumped from obs/ and the obs-feed namespaces (maintenance.*,
-    storage.retry.*, faults.*, merge.device.*, merge.keyCache.*,
-    commit.conflicts/.reconciled) must be registered in
-    obs/metric_names.py COUNTERS."""
-    from delta_tpu.obs import metric_names
-
-    stray = []
-    for rel, tree in _walk_engine_trees():
-        in_obs = rel.startswith("obs")
-        for name in _const_calls(tree, "bump_counter"):
-            obs_feed = (name.startswith(("obs.", "maintenance.",
-                                         "storage.retry.", "faults.",
-                                         "merge.device.", "merge.keyCache."))
-                        or name in ("commit.conflicts", "commit.reconciled"))
-            if (in_obs or obs_feed) and name not in metric_names.COUNTERS:
-                stray.append(f"{rel}: {name}")
-    assert not stray, f"counters missing from obs/metric_names.COUNTERS: {stray}"
-
-
-def test_every_counter_and_histogram_is_cataloged():
-    """Inverse catalog pass: every constant-string ``bump_counter`` /
-    ``observe`` call site anywhere in delta_tpu/ must resolve to the
-    obs/metric_names catalog (COUNTERS ∪ ENGINE_COUNTERS / HISTOGRAMS) — a
-    new metric cannot ship un-cataloged. Dynamic f-string families
-    (logstore.{op}.*) are out of lint scope by construction."""
-    from delta_tpu.obs import metric_names
-
-    known_counters = metric_names.COUNTERS | metric_names.ENGINE_COUNTERS
-    stray = []
-    for rel, tree in _walk_engine_trees():
-        for name in _const_calls(tree, "bump_counter"):
-            if name not in known_counters:
-                stray.append(f"{rel}: bump_counter({name!r})")
-        for name in _const_calls(tree, "observe"):
-            if name not in metric_names.HISTOGRAMS:
-                stray.append(f"{rel}: observe({name!r})")
-    assert not stray, (
-        f"metric call sites missing from obs/metric_names.py: {stray}"
-    )
-
-
-def test_catalog_counter_sets_are_disjoint():
-    from delta_tpu.obs import metric_names
-
-    overlap = metric_names.COUNTERS & metric_names.ENGINE_COUNTERS
-    assert not overlap, f"counters cataloged twice: {sorted(overlap)}"
-
-
-def test_every_catalog_entry_has_a_description():
-    """Exposition lint: every cataloged metric must carry a non-empty
-    one-line DESCRIPTIONS entry (the /metrics # HELP text), and
-    DESCRIPTIONS must not accumulate entries for metrics that no longer
-    exist — the catalog and its documentation move together."""
-    from delta_tpu.obs import metric_names
-
-    cataloged = (metric_names.GAUGES | metric_names.COUNTERS
-                 | metric_names.ENGINE_COUNTERS | metric_names.HISTOGRAMS)
-    missing = sorted(
-        n for n in cataloged
-        if not str(metric_names.DESCRIPTIONS.get(n, "")).strip()
-    )
-    assert not missing, f"catalog entries without a # HELP description: {missing}"
-    stale = sorted(set(metric_names.DESCRIPTIONS) - cataloged)
-    assert not stale, f"DESCRIPTIONS for un-cataloged metrics: {stale}"
-    for name, desc in metric_names.DESCRIPTIONS.items():
-        assert "\n" not in desc, f"multi-line HELP for {name}"
-
-
 # -- cross-thread span propagation -------------------------------------------
 
 
@@ -831,12 +651,15 @@ def test_propagated_is_identity_with_no_span_or_blackout():
 
 def test_obs_public_api_matches_catalog():
     """Each obs module's ``__all__`` must equal its PUBLIC_API entry — a new
-    entry point (or a rename) has to land in the catalog too."""
+    entry point (or a rename) has to land in the catalog too. (A runtime
+    import check, not an AST lint — the AST lints moved to the
+    delta_tpu/analysis engine; see tests/test_analysis.py.)"""
     import importlib
 
     from delta_tpu.obs import metric_names
 
-    obs_dir = os.path.join(_ENGINE_DIR, "obs")
+    obs_dir = os.path.join(
+        os.path.dirname(__file__), "..", "delta_tpu", "obs")
     modules = sorted(
         f[:-3] for f in os.listdir(obs_dir)
         if f.endswith(".py") and f != "__init__.py"

@@ -1,0 +1,121 @@
+"""Static-analysis CLI — run the ``delta_tpu/analysis`` engine.
+
+    python tools/analyze.py                  # all passes, human output
+    python tools/analyze.py --json           # machine output (bench wiring)
+    python tools/analyze.py --rule lock-guard
+    python tools/analyze.py --update-baseline  # accept current findings
+    python tools/analyze.py --list-passes    # rule table
+
+Exit status: 0 clean (every finding waived inline or baselined), 1 when
+any non-baselined finding remains, 2 on usage errors. The baseline lives
+at ``tools/analyze_baseline.json``; inline waivers are
+``# delta-lint: ignore[rule] -- justification`` at the finding site.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from delta_tpu.analysis import (all_passes, analyze_repo,  # noqa: E402
+                                default_baseline_path, repo_root)
+from delta_tpu.analysis.core import (AnalysisContext,  # noqa: E402
+                                     apply_suppressions, baseline_payload,
+                                     run_passes)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="repository root (default: auto-detected)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to passes emitting this rule "
+                         "(repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/analyze_baseline"
+                         ".json); pass an empty string to disable")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show all non-waived "
+                         "findings)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current non-waived findings as the new "
+                         "baseline and exit 0")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass/rule table and exit")
+    args = ap.parse_args(argv)
+
+    passes = all_passes()
+    if args.list_passes:
+        for p in passes:
+            print(f"{p.name}: {p.description}")
+            for r in p.rules:
+                print(f"  - {r}")
+        return 0
+    if args.rule:
+        wanted = set(args.rule)
+        passes = [p for p in passes if wanted & set(p.rules)]
+        unknown = wanted - {r for p in all_passes() for r in p.rules}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    root = args.root or repo_root()
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    if args.no_baseline:
+        baseline_path = ""
+
+    if args.update_baseline:
+        if args.rule:
+            # a rule-filtered run would rewrite the baseline WITHOUT the
+            # other rules' accepted debt — silently un-baselining them
+            print("--update-baseline cannot be combined with --rule: the "
+                  "baseline always covers every pass", file=sys.stderr)
+            return 2
+        ctx = AnalysisContext.from_dir(root)
+        raw = run_passes(ctx, passes)
+        kept, _suppressed = apply_suppressions(ctx, raw)
+        target = baseline_path or default_baseline_path(root)
+        payload = baseline_payload(kept)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        with open(target, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"baseline updated: {target} "
+              f"({len(kept)} accepted finding(s))")
+        return 0
+
+    report = analyze_repo(root=root, passes=passes,
+                          baseline_path=baseline_path)
+    # findings that rode the baseline but might be filtered by --rule are
+    # already scoped: analyze_repo ran only the chosen passes
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=1, sort_keys=True))
+    else:
+        for f in report.findings:
+            print(f.format())
+        counts = report.counts()
+        summary = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+        print(f"{len(report.findings)} finding(s)"
+              + (f" ({summary})" if summary else "")
+              + f"; {len(report.suppressed)} waived inline, "
+              f"{len(report.baselined)} baselined, "
+              f"{report.files_analyzed} files, "
+              f"passes: {', '.join(report.passes_run)}")
+        for key in report.stale_baseline:
+            print(f"baseline surplus (accepted count exceeds current "
+                  f"findings — regenerate with --update-baseline): {key}",
+                  file=sys.stderr)
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

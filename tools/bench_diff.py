@@ -27,8 +27,9 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["Regression", "compare", "compare_files", "main"]
 
-#: Units where a SMALLER value is better.
-LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns"})
+#: Units where a SMALLER value is better. "findings" is the static-analysis
+#: gate (tools/analyze.py counts riding the bench artifact).
+LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns", "findings"})
 
 DEFAULT_THRESHOLD_PCT = 20.0
 
@@ -80,6 +81,10 @@ def _worse_pct(unit: str, cur_v: float, old_v: float) -> Optional[float]:
     units regress when the value grows; throughput/ratio units when it
     shrinks. None when the prior value can't anchor a percentage."""
     if old_v == 0:
+        if unit == "findings" and cur_v > 0:
+            # a count that was clean CAN anchor: each new finding reads as
+            # +100% so any sane threshold trips (0 -> N must never pass)
+            return 100.0 * cur_v
         return None
     if unit in LOWER_IS_BETTER:
         return (cur_v - old_v) / old_v * 100.0
