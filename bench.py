@@ -1493,6 +1493,9 @@ def _reset_engine_state():
         from delta_tpu.log import checkpointer
 
         checkpointer.reset()
+        from delta_tpu import autopilot
+
+        autopilot.reset()
     except Exception:
         pass
 

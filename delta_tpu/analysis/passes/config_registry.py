@@ -36,7 +36,7 @@ PREFIX = "delta.tpu."
 ALWAYS_DYNAMIC = ("delta.tpu.properties.defaults.",)
 
 _CONF_RECEIVERS = frozenset({"conf", "_conf"})
-_CONF_METHODS = frozenset({"get", "get_bool"})
+_CONF_METHODS = frozenset({"get", "get_bool", "get_int"})
 
 
 def _registry_from(sf) -> Optional[Dict[str, int]]:

@@ -45,6 +45,7 @@ REGISTERED_POOLS = frozenset({
     "delta-merge-keys-build",     # commands/merge.py background key build
     "delta-join-upload",          # ops/join_kernel.py async kernel launch
     "delta-object-store-http",    # storage/object_store_emulator.py server
+    "delta-autopilot",            # autopilot/daemon.py maintenance daemon
 })
 
 _CTOR_KW = {

@@ -1,0 +1,40 @@
+"""Autopilot — closed-loop maintenance scheduler (ROADMAP item 2).
+
+The advisor (`obs/advisor`) emits ranked, evidence-backed recommendations
+and the doctor (`obs/doctor`) names remedies; this package ACTS on them,
+unattended, under guardrails — the step that makes a fleet of tables
+operable without a human running OPTIMIZE/CHECKPOINT/VACUUM by hand.
+"Only Aggressive Elephants are Fast Elephants" (PAPERS.md) is the
+precedent: aggressive automatic layout/metadata maintenance is safe
+exactly when every failure path is as tested as the fast path — which the
+fault injector (PR 5), group commit (PR 9), and the static-analysis gates
+(PR 10) made true here first.
+
+* :mod:`~delta_tpu.autopilot.planner` — decide: doctor + advisor →
+  deduped, prioritized :class:`~delta_tpu.obs.actions.MaintenanceAction`
+  plan; quiet-window / cooldown / backoff guardrail inputs.
+* :mod:`~delta_tpu.autopilot.executor` — act: run one action under the
+  cost caps and the maintenance commit-attempts cap; build the
+  predicted-vs-realized audit.
+* :mod:`~delta_tpu.autopilot.daemon` — the loop: :func:`run_once` per
+  table, the ``delta-autopilot`` daemon thread, and :func:`status` for
+  the ``/autopilot`` HTTP route.
+
+Everything persists through the workload journal's action ledger (journal
+kind ``autopilot``), which `advise()` reads back — executed actions are
+cited with their realized deltas instead of being re-recommended during
+their cooldown. ``tools/journal_dump.py --autopilot`` prints the ledger.
+"""
+from delta_tpu.autopilot.daemon import (
+    Autopilot,
+    RunReport,
+    dry_run,
+    enabled,
+    last_runs,
+    reset,
+    run_once,
+    status,
+)
+
+__all__ = ["Autopilot", "RunReport", "run_once", "status", "enabled",
+           "dry_run", "last_runs", "reset"]

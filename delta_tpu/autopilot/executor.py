@@ -1,0 +1,214 @@
+"""Autopilot execution — run one :class:`~delta_tpu.obs.actions.
+MaintenanceAction` under guardrails and report what happened.
+
+Guardrails enforced HERE (the daemon owns scheduling-level ones):
+
+* **bytes cost cap** — OPTIMIZE/ZORDER/PURGE run with
+  ``max_rewrite_bytes`` (``delta.tpu.autopilot.maxBytesPerRun``): an
+  over-budget selection raises pre-IO and comes back as a ``skipped``
+  outcome, never a half-done rewrite.
+* **lose-to-foreground** — table-mutating actions commit under
+  :class:`~delta_tpu.txn.transaction.commit_attempts_cap`
+  (``delta.tpu.autopilot.maxCommitAttempts``): a maintenance commit that
+  keeps losing races aborts as ``abortedContention`` instead of
+  retry-storming against foreground writers.
+* **crash transparency** — only ``Exception`` is classified; a
+  :class:`~delta_tpu.storage.faults.SimulatedCrash` (BaseException, a real
+  process death in the torture harness) pierces to the caller, which has
+  already journaled the ``started`` ledger entry durably.
+
+The audit half: :func:`audit_metrics` names, per action kind, the doctor
+dimension + metric keys whose before/after delta measures the action's
+realized improvement (lower is better for every audited metric).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from delta_tpu.obs.actions import MaintenanceAction, spec
+from delta_tpu.utils import errors, telemetry
+
+__all__ = ["ExecutionResult", "execute", "audit_metrics", "build_audit"]
+
+
+@dataclass
+class ExecutionResult:
+    status: str                      # executed | skipped | failed | abortedContention
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    reason: str = ""
+    error: str = ""
+    duration_ms: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"status": self.status, "metrics": dict(self.metrics),
+               "durationMs": round(self.duration_ms, 3)}
+        if self.reason:
+            out["reason"] = self.reason
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+#: action kind → (doctor dimension, audited metric keys); every audited
+#: metric improves DOWNWARD (counts, staleness, pressure)
+_AUDIT = {
+    "OPTIMIZE": ("smallFiles", ("count", "estReduction")),
+    "CHECKPOINT": ("checkpoint", ("commitsSince", "tailBytes")),
+    "PURGE": ("dv", ("deletedPct", "filesPastPurge")),
+    "VACUUM": ("tombstones", ("count", "bytes")),
+    "EVICT": ("device", ("hbmBytes", "pressure")),
+}
+
+
+def audit_metrics(kind: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """The doctor dimension + metrics auditing this action kind, or None
+    for actions whose realized effect only shows up longitudinally
+    (ZORDER via future scans' pruning, RECALIBRATE via router audits)."""
+    return _AUDIT.get(kind)
+
+
+def build_audit(action: MaintenanceAction, before, after) -> Dict[str, Any]:
+    """Predicted-vs-realized audit from the doctor reports bracketing the
+    action. ``before``/``after`` are :class:`TableHealthReport`\\ s (after
+    may be None when the action failed before a re-measure)."""
+    audit: Dict[str, Any] = {"predicted": dict(action.predicted)}
+    mapped = audit_metrics(action.kind)
+    if mapped is None or after is None:
+        audit["verdict"] = "pending"
+        audit["detail"] = ("longitudinal action: realized effect shows up "
+                           "in future journal history"
+                           if mapped is None else "no post-action measure")
+        return audit
+    dim_name, keys = mapped
+    try:
+        b = before.dimension(dim_name)
+        a = after.dimension(dim_name)
+    except KeyError:
+        audit["verdict"] = "pending"
+        return audit
+    audit["before"] = {k: b.metrics.get(k) for k in keys}
+    audit["after"] = {k: a.metrics.get(k) for k in keys}
+    audit["severityBefore"] = b.severity
+    audit["severityAfter"] = a.severity
+    realized: Dict[str, Any] = {}
+    improved = worse = False
+    for k in keys:
+        bv, av = b.metrics.get(k), a.metrics.get(k)
+        if isinstance(bv, (int, float)) and isinstance(av, (int, float)):
+            realized[k] = round(bv - av, 6)  # positive = improvement
+            improved = improved or av < bv
+            worse = worse or av > bv
+    from delta_tpu.obs.doctor import SEVERITY_RANK
+
+    if SEVERITY_RANK[a.severity] < SEVERITY_RANK[b.severity]:
+        improved = True
+    audit["realized"] = realized
+    audit["verdict"] = ("improved" if improved and not worse
+                        else "worse" if worse and not improved
+                        else "mixed" if improved
+                        else "unchanged")
+    return audit
+
+
+# ---------------------------------------------------------------------------
+# Per-kind execution
+# ---------------------------------------------------------------------------
+
+
+def _run_optimize(delta_log, action: MaintenanceAction,
+                  max_bytes: Optional[int]) -> Dict[str, Any]:
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    kwargs: Dict[str, Any] = {"max_rewrite_bytes": max_bytes}
+    if action.kind == "ZORDER":
+        kwargs["z_order_by"] = list(action.params.get("columns") or [])
+    elif action.kind == "PURGE":
+        kwargs["purge"] = True
+    cmd = OptimizeCommand(delta_log, **kwargs)
+    cmd.run()
+    return dict(cmd.metrics)
+
+
+def _run_checkpoint(delta_log) -> Dict[str, Any]:
+    meta = delta_log.checkpoint()
+    return {"checkpointVersion": getattr(meta, "version", None)}
+
+
+def _run_vacuum(delta_log) -> Dict[str, Any]:
+    from delta_tpu.commands.vacuum import VacuumCommand
+
+    res = VacuumCommand(delta_log).run()
+    return {"filesDeleted": res.files_deleted, "dirsDeleted": res.dirs_deleted}
+
+
+def _run_evict() -> Dict[str, Any]:
+    from delta_tpu.obs import hbm_ledger
+
+    before = hbm_ledger.totals()["total"]
+    applied = hbm_ledger.maybe_relieve()
+    after = hbm_ledger.totals()["total"]
+    return {"pressureApplied": bool(applied),
+            "bytesBefore": before, "bytesAfter": after,
+            "bytesFreed": max(0, before - after)}
+
+
+def _run_recalibrate(delta_log) -> Dict[str, Any]:
+    from delta_tpu.obs import calibration
+
+    state = calibration.apply_state(delta_log.log_path)
+    return {"calibrationEnabled": calibration.enabled(),
+            "constantsInstalled": len(state)}
+
+
+def execute(delta_log, action: MaintenanceAction,
+            max_bytes: Optional[int] = None,
+            attempts_cap: Optional[int] = None) -> ExecutionResult:
+    """Execute one action against ``delta_log``. Classifies Exceptions into
+    skipped (over budget) / abortedContention (lost to a foreground
+    writer) / failed; BaseException (simulated or real process death)
+    propagates — the caller journaled ``started`` durably first."""
+    from delta_tpu.commands.optimize import OptimizeBudgetExceeded
+    from delta_tpu.txn.transaction import commit_attempts_cap
+
+    kind = spec(action.kind)
+    t0 = time.monotonic()
+
+    def _done(status: str, metrics: Dict[str, Any], **kw) -> ExecutionResult:
+        return ExecutionResult(status=status, metrics=metrics,
+                               duration_ms=(time.monotonic() - t0) * 1000.0,
+                               **kw)
+
+    try:
+        with commit_attempts_cap(attempts_cap if kind.mutates_table else None):
+            if action.kind in ("OPTIMIZE", "ZORDER", "PURGE"):
+                metrics = _run_optimize(delta_log, action, max_bytes)
+            elif action.kind == "CHECKPOINT":
+                metrics = _run_checkpoint(delta_log)
+            elif action.kind == "VACUUM":
+                metrics = _run_vacuum(delta_log)
+            elif action.kind == "EVICT":
+                metrics = _run_evict()
+            elif action.kind == "RECALIBRATE":
+                metrics = _run_recalibrate(delta_log)
+            else:
+                return _done("skipped", {},
+                             reason=f"action {action.kind} is not executable")
+    except OptimizeBudgetExceeded as e:
+        telemetry.bump_counter("autopilot.actions.skipped")
+        return _done("skipped",
+                     {"estBytes": e.est_bytes, "capBytes": e.cap_bytes,
+                      "files": e.files},
+                     reason="over maxBytesPerRun cost cap")
+    except (errors.DeltaConcurrentModificationException,
+            errors.CommitAttemptsExhausted) as e:
+        telemetry.bump_counter("autopilot.contentionAborts")
+        return _done("abortedContention", {},
+                     reason="lost to a foreground writer",
+                     error=f"{type(e).__name__}: {e}")
+    except Exception as e:  # noqa: BLE001 — classified: genuine failure
+        telemetry.bump_counter("autopilot.actions.failed")
+        return _done("failed", {}, error=f"{type(e).__name__}: {e}")
+    telemetry.bump_counter("autopilot.actions.executed")
+    return _done("executed", metrics)

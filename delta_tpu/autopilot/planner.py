@@ -1,0 +1,198 @@
+"""Autopilot planning — turn doctor verdicts + advisor recommendations into
+an ordered, guardrail-filtered list of :class:`~delta_tpu.obs.actions.
+MaintenanceAction`\\ s.
+
+Both input surfaces already speak the shared action catalog
+(`obs/actions.py`): the doctor's per-dimension ``remedy`` and the advisor's
+per-recommendation ``remedy`` are catalog keys, so planning is a mapping
+walk, not string matching. The planner is pure decision logic — it reads
+reports and the persistent action ledger (journal kind ``autopilot``) and
+never touches the table; `delta_tpu/autopilot/executor.py` acts.
+
+Guardrail inputs computed here:
+
+* **cooldowns** — an action key ATTEMPTED (started/executed/failed/
+  interrupted/abortedContention) inside ``delta.tpu.autopilot.cooldownMs``
+  is not re-planned. "Started" entries are flushed to disk before
+  execution, so a crash mid-maintenance still arms the cooldown — the
+  crash-loop guard.
+* **contention backoff** — any ``abortedContention`` ledger entry inside
+  ``delta.tpu.autopilot.contentionBackoffMs`` blocks the whole table.
+* **quiet window** — the journal's recent commit entries, bucketed the
+  same way the advisor buckets contention (60s windows): the table is
+  quiet when at most ``quietMaxCommits`` foreground commits landed inside
+  the last ``quietWindowMs``. Maintenance operations (OPTIMIZE/REORG/
+  RESTORE) don't count — the autopilot's own commits must not un-quiet
+  the window for its next tick.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from delta_tpu.obs import journal as journal_mod
+from delta_tpu.obs.actions import (
+    CATALOG,
+    COOLDOWN_PHASES,
+    MaintenanceAction,
+    RECOMMENDATION_ACTIONS,
+    attempts_in_cooldown,
+)
+from delta_tpu.obs.doctor import SEVERITY_RANK
+from delta_tpu.utils.config import conf
+
+__all__ = ["plan", "quiet_window", "ledger_entries", "cooldown_blocked",
+           "contention_backoff_until", "COOLDOWN_PHASES"]
+
+#: commit operation names that are maintenance, not foreground traffic
+_MAINTENANCE_OPS = frozenset({"OPTIMIZE", "REORG", "VACUUM"})
+
+#: advisor recommendation kinds the autopilot executes (the rest are
+#: conf/schema changes — surfaced, never auto-applied)
+_EXECUTABLE_REC_KINDS = frozenset(
+    {"ZORDER", "CHECKPOINT_INTERVAL", "CALIBRATION"})
+
+
+def ledger_entries(log_path: str) -> List[Dict[str, Any]]:
+    """The table's persisted action ledger, oldest first."""
+    journal_mod.flush(log_path)
+    return journal_mod.read_entries(log_path, kinds=["autopilot"])
+
+
+def cooldown_blocked(ledger: List[Dict[str, Any]], now_ms: int,
+                     log_path: Optional[str] = None
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Action keys inside their cooldown, mapped to the arming entry —
+    the shared `obs/actions.attempts_in_cooldown` rule (the same one the
+    advisor's suppression runs), so the two surfaces can never drift.
+    With ``log_path``, the sweep-proof sidecar is merged in: a ledger
+    segment evicted by the journal's size/age sweep must not un-arm a
+    cooldown."""
+    cooldown = conf.get_int("delta.tpu.autopilot.cooldownMs", 6 * 3_600_000)
+    state = journal_mod.attempt_state(log_path) if log_path else None
+    return attempts_in_cooldown(ledger, now_ms, cooldown, state=state)
+
+
+def contention_backoff_until(ledger: List[Dict[str, Any]], now_ms: int,
+                             log_path: Optional[str] = None
+                             ) -> Optional[int]:
+    """End of the table-wide backoff armed by the last abortedContention
+    attempt (ledger + sweep-proof sidecar), or None when none is active."""
+    backoff = conf.get_int("delta.tpu.autopilot.contentionBackoffMs", 300_000)
+    latest = 0
+    for e in ledger:
+        if e.get("phase") == "abortedContention":
+            latest = max(latest, int(e.get("ts") or 0))
+    if log_path is not None:
+        for st in journal_mod.attempt_state(log_path).values():
+            if st.get("phase") == "abortedContention":
+                latest = max(latest, int(st.get("ts") or 0))
+    until = latest + backoff
+    return until if latest and until > now_ms else None
+
+
+def quiet_window(log_path: str, now_ms: int,
+                 commits: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
+    """Is the table quiet right now? Counts journaled foreground commits
+    whose ``ts`` falls inside the trailing window. ``commits`` lets a
+    caller that already parsed the journal (the daemon reads it once per
+    pass) skip the re-read."""
+    window_ms = conf.get_int("delta.tpu.autopilot.quietWindowMs", 60_000)
+    max_commits = conf.get_int("delta.tpu.autopilot.quietMaxCommits", 0)
+    if commits is None:
+        commits = journal_mod.read_entries(log_path, kinds=["commit"])
+    recent = 0
+    for e in commits:
+        ts = int(e.get("ts") or 0)
+        if now_ms - ts > window_ms:
+            continue
+        op = (e.get("stats") or {}).get("operation")
+        if op in _MAINTENANCE_OPS:
+            continue
+        recent += 1
+    return {
+        "quiet": recent <= max_commits,
+        "recentCommits": recent,
+        "windowMs": window_ms,
+        "maxCommits": max_commits,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Plan synthesis
+# ---------------------------------------------------------------------------
+
+#: doctor dimension → (action kind, predicted-metric keys) for the
+#: dimensions whose remedies the autopilot executes
+_DIMENSION_ACTIONS = {
+    "smallFiles": ("OPTIMIZE", ("count", "estReduction", "bytes")),
+    "checkpoint": ("CHECKPOINT", ("commitsSince", "tailBytes")),
+    "dv": ("PURGE", ("deletedPct", "filesPastPurge")),
+    "tombstones": ("VACUUM", ("count", "bytes")),
+    "device": ("EVICT", ("hbmBytes", "pressure")),
+}
+
+
+def _doctor_actions(doctor_report) -> List[MaintenanceAction]:
+    out: List[MaintenanceAction] = []
+    for d in doctor_report.dimensions:
+        if d.severity == "ok" or not d.remedy:
+            continue
+        mapped = _DIMENSION_ACTIONS.get(d.name)
+        if mapped is None or mapped[0] != d.remedy:
+            # dimensions whose remedy isn't theirs to execute (stats →
+            # OPTIMIZE is owned by smallFiles; REPARTITION is human)
+            continue
+        kind, metric_keys = mapped
+        if not CATALOG[kind].executable:
+            continue
+        out.append(MaintenanceAction(
+            kind=kind,
+            table_path=doctor_report.path,
+            source=f"doctor:{d.name}",
+            priority=SEVERITY_RANK[d.severity] * 10.0,
+            evidence=dict(d.metrics),
+            predicted={k: d.metrics[k] for k in metric_keys
+                       if k in d.metrics},
+        ))
+    return out
+
+
+def _advisor_actions(advisor_report) -> List[MaintenanceAction]:
+    out: List[MaintenanceAction] = []
+    if getattr(advisor_report, "status", "") != "ok":
+        return out
+    for r in advisor_report.recommendations:
+        if r.kind not in _EXECUTABLE_REC_KINDS:
+            continue
+        kind = RECOMMENDATION_ACTIONS[r.kind]
+        if not CATALOG[kind].executable:
+            continue
+        params: Dict[str, Any] = {}
+        target = ""
+        if r.kind == "ZORDER":
+            target = r.target
+            params["columns"] = [r.target]
+        out.append(MaintenanceAction(
+            kind=kind,
+            table_path=advisor_report.path,
+            target=target,
+            params=params,
+            source=f"advisor:{r.kind}",
+            priority=float(r.score),
+            evidence=dict(r.evidence),
+            predicted=dict(r.evidence),
+        ))
+    return out
+
+
+def plan(doctor_report, advisor_report) -> List[MaintenanceAction]:
+    """Merge both surfaces into one deduped, priority-ordered plan.
+    Cooldown/backoff filtering happens in the daemon (it owns the ledger
+    read) — this is the raw decision layer."""
+    merged: Dict[str, MaintenanceAction] = {}
+    for a in _doctor_actions(doctor_report) + _advisor_actions(advisor_report):
+        prev = merged.get(a.key)
+        if prev is None or a.priority > prev.priority:
+            merged[a.key] = a
+    return sorted(merged.values(), key=lambda a: -a.priority)

@@ -33,10 +33,25 @@ from delta_tpu.protocol.actions import Action, AddFile
 from delta_tpu.utils.errors import DeltaAnalysisError
 from delta_tpu.utils import errors
 
-__all__ = ["OptimizeCommand"]
+__all__ = ["OptimizeCommand", "OptimizeBudgetExceeded"]
 
 DEFAULT_MIN_FILE_SIZE = 256 * 1024 * 1024  # files below this are compactable
 DEFAULT_TARGET_ROWS = 1 << 22
+
+
+class OptimizeBudgetExceeded(errors.DeltaError):
+    """The selected rewrite set exceeds ``max_rewrite_bytes``. Raised
+    BEFORE any data is read or written — the cost-capped invocation path
+    (`delta_tpu/autopilot`) turns this into a journaled SKIPPED outcome
+    instead of an over-budget background rewrite."""
+
+    def __init__(self, est_bytes: int, cap_bytes: int, files: int):
+        super().__init__(
+            f"OPTIMIZE would rewrite {est_bytes} bytes across {files} "
+            f"files, over the {cap_bytes}-byte budget")
+        self.est_bytes = est_bytes
+        self.cap_bytes = cap_bytes
+        self.files = files
 
 
 class OptimizeCommand:
@@ -48,6 +63,7 @@ class OptimizeCommand:
         min_file_size: int = DEFAULT_MIN_FILE_SIZE,
         target_rows: int = DEFAULT_TARGET_ROWS,
         purge: bool = False,
+        max_rewrite_bytes: Optional[int] = None,
     ):
         self.delta_log = delta_log
         self.predicate = (
@@ -60,6 +76,10 @@ class OptimizeCommand:
         # exactly the files carrying deletion vectors, materializing the
         # deletes and dropping the DVs — size-based selection is bypassed
         self.purge = purge
+        # cost cap (programmatic maintenance path): the total size of the
+        # files selected for rewrite is bounded up front — an over-budget
+        # job raises OptimizeBudgetExceeded before any IO
+        self.max_rewrite_bytes = max_rewrite_bytes
         self.metrics: Dict[str, int] = {}
 
     def run(self) -> int:
@@ -95,8 +115,9 @@ class OptimizeCommand:
             key = tuple(sorted((f.partition_values or {}).items()))
             by_partition[key].append(f)
 
-        removes: List[Action] = []
-        adds: List[Action] = []
+        # plan first (selection is metadata-only), so the cost cap can
+        # abort an over-budget job before ANY file is read or written
+        groups: List[Tuple[Tuple, List[AddFile]]] = []
         # None-safe ordering: null partition values sort first
         for key, files in sorted(
             by_partition.items(),
@@ -112,6 +133,17 @@ class OptimizeCommand:
                 group = [f for f in files if (f.size or 0) < self.min_file_size]
                 if len(group) < 2:
                     continue  # nothing to compact
+            groups.append((key, group))
+        if self.max_rewrite_bytes is not None:
+            est = sum(f.size or 0 for _, g in groups for f in g)
+            if est > self.max_rewrite_bytes:
+                raise OptimizeBudgetExceeded(
+                    est, self.max_rewrite_bytes,
+                    sum(len(g) for _, g in groups))
+
+        removes: List[Action] = []
+        adds: List[Action] = []
+        for _key, group in groups:
             table = read_files_as_table(
                 self.delta_log.data_path, group, metadata
             )
@@ -135,6 +167,9 @@ class OptimizeCommand:
         self.metrics.update(
             numRemovedFiles=len(removes),
             numAddedFiles=len(adds),
+            numRemovedBytes=sum(f.size or 0 for _k, g in groups for f in g),
+            numAddedBytes=sum(a.size or 0 for a in adds
+                              if isinstance(a, AddFile)),
             timeMs=timer.lap_ms(),
         )
         txn.report_metrics(**self.metrics)

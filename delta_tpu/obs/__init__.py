@@ -11,6 +11,8 @@
 * :mod:`delta_tpu.obs.router_audit` — routed decisions priced vs measured
 * :mod:`delta_tpu.obs.calibration` — EWMA re-fit of the link cost constants
 * :mod:`delta_tpu.obs.hbm_ledger` — device-memory accounting + soft budget
+* :mod:`delta_tpu.obs.actions` — the shared maintenance-action catalog
+  (doctor remedies ≡ advisor remedies ≡ autopilot actions)
 * :mod:`delta_tpu.obs.metric_names` — the single catalog of metric names
 
 Importing this package installs the (inert-until-configured) flight-recorder

@@ -1,0 +1,213 @@
+"""Shared maintenance-action catalog — one machine-readable Action model.
+
+The doctor (`obs/doctor`) names remedies and the advisor (`obs/advisor`)
+names recommended actions; until this module they did it as free-form
+strings, which meant any consumer (dashboards, and now the autopilot
+scheduler in `delta_tpu/autopilot/`) had to string-match two surfaces that
+could silently drift. This catalog closes that: every remedy either surface
+emits is an :class:`ActionSpec` here — validated at emit time exactly the
+way ``metric_names.health_gauge`` validates gauge names — and the autopilot
+consumes :class:`MaintenanceAction` objects whose ``kind`` is a catalog
+key, never a parsed string.
+
+``executable`` marks the actions the autopilot may run unattended: layout
+and metadata maintenance whose failure paths are torture-tested (OPTIMIZE /
+ZORDER / CHECKPOINT / VACUUM / PURGE) plus two process-local knob turns
+(EVICT, RECALIBRATE). REPARTITION and TUNE stay human decisions — a
+partition-scheme or conf change is a policy choice, not maintenance.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ActionSpec", "MaintenanceAction", "CATALOG", "CATALOG_REF",
+           "RECOMMENDATION_ACTIONS", "COOLDOWN_PHASES", "spec",
+           "remedy_name", "executable_kinds", "action_key",
+           "attempts_in_cooldown"]
+
+#: Stable dotted reference both report ``to_dict`` outputs cite, so a JSON
+#: consumer can find the catalog without guessing.
+CATALOG_REF = "delta_tpu.obs.actions.CATALOG"
+
+
+@dataclass(frozen=True)
+class ActionSpec:
+    """One maintenance action the engine knows how to talk about."""
+
+    name: str
+    executable: bool    # the autopilot may run it unattended
+    mutates_table: bool  # writes to the table dir / log (vs process-local)
+    description: str
+
+
+CATALOG: Dict[str, ActionSpec] = {s.name: s for s in (
+    ActionSpec("OPTIMIZE", True, True,
+               "bin-pack small files per partition into compaction targets"),
+    ActionSpec("ZORDER", True, True,
+               "re-sort selected files by the Morton key of hot filter "
+               "columns so min/max stats become selective"),
+    ActionSpec("CHECKPOINT", True, True,
+               "write a checkpoint so cold snapshot builds stop replaying "
+               "the log tail"),
+    ActionSpec("VACUUM", True, True,
+               "delete unreferenced data files past the retention horizon"),
+    ActionSpec("PURGE", True, True,
+               "rewrite files carrying deletion vectors, materializing the "
+               "soft deletes"),
+    ActionSpec("EVICT", True, False,
+               "apply HBM soft-budget pressure to the device-resident "
+               "caches (key cache / state cache LRU)"),
+    ActionSpec("RECALIBRATE", True, False,
+               "re-apply the persisted router calibration state to the "
+               "link cost constants"),
+    ActionSpec("REPARTITION", False, True,
+               "change the table's partition scheme (human decision)"),
+    ActionSpec("TUNE", False, False,
+               "session/table conf change (human decision)"),
+)}
+
+
+#: Advisor ``Recommendation.kind`` → catalog action executing (or citing) it.
+RECOMMENDATION_ACTIONS: Dict[str, str] = {
+    "ZORDER": "ZORDER",
+    "PARTITION": "REPARTITION",
+    "ROW_GROUP_SIZE": "OPTIMIZE",
+    "CHECKPOINT_INTERVAL": "CHECKPOINT",
+    "COMMIT_CONTENTION": "TUNE",
+    "CALIBRATION": "RECALIBRATE",
+    "HBM_BUDGET": "TUNE",
+}
+
+
+def spec(name: str) -> ActionSpec:
+    """The catalog entry for ``name`` — raises on an unknown action, so a
+    typo'd remedy cannot ship (the no-string-matching guarantee)."""
+    try:
+        return CATALOG[name]
+    except KeyError:
+        raise ValueError(f"action {name!r} is not registered in "
+                         "delta_tpu/obs/actions.py") from None
+
+
+def remedy_name(name: str) -> str:
+    """Catalog-checked remedy string for doctor/advisor emit sites."""
+    return spec(name).name
+
+
+def executable_kinds() -> tuple:
+    return tuple(sorted(n for n, s in CATALOG.items() if s.executable))
+
+
+#: Action-ledger phases that arm a cooldown — everything that ATTEMPTED
+#: the action. A crash between "started" and its terminal entry must still
+#: cool down (that is exactly the crash-loop the guardrail exists for), so
+#: "started" is in; "planned"/"deferred"/"skipped" never ran and are not.
+#: One definition, shared by the autopilot planner (re-plan filtering) and
+#: the advisor (suppression of executed recommendations) so the two
+#: surfaces can never drift.
+COOLDOWN_PHASES = frozenset(
+    {"started", "executed", "failed", "interrupted", "abortedContention"})
+
+
+def action_key(action: Dict[str, Any]) -> Optional[str]:
+    """The cooldown/dedup identity of a ledger entry's ``action`` payload —
+    the dict twin of :attr:`MaintenanceAction.key`; None when malformed."""
+    kind = action.get("kind")
+    if not kind:
+        return None
+    target = action.get("target")
+    return f"{kind}:{target}" if target else kind
+
+
+def attempts_in_cooldown(entries: List[Dict[str, Any]], now_ms: int,
+                         cooldown_ms: int,
+                         state: Optional[Dict[str, Dict[str, Any]]] = None
+                         ) -> Dict[str, Dict[str, Any]]:
+    """Action keys whose last ATTEMPT (any :data:`COOLDOWN_PHASES` ledger
+    entry) falls inside the cooldown window, mapped to the arming entry.
+    Newest ``ts`` wins; on a tie the terminal entry (audit attached)
+    outranks its own ``started`` marker. ``state`` merges the sweep-proof
+    sidecar (`obs/journal.attempt_state`) so a ledger segment evicted by
+    the journal sweep cannot un-arm a cooldown — both the autopilot
+    planner and the advisor's suppression pass it."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        if e.get("phase") not in COOLDOWN_PHASES:
+            continue
+        key = action_key(e.get("action") or {})
+        if key is None:
+            continue
+        ts = int(e.get("ts") or 0)
+        if now_ms - ts > cooldown_ms:
+            continue
+        prev = out.get(key)
+        prev_ts = int(prev.get("ts") or 0) if prev is not None else -1
+        if prev is None or ts > prev_ts or (ts == prev_ts and e.get("audit")):
+            out[key] = e
+    for key, st in (state or {}).items():
+        ts = int(st.get("ts") or 0)
+        if (st.get("phase") in COOLDOWN_PHASES
+                and now_ms - ts <= cooldown_ms
+                and ts > int((out.get(key) or {}).get("ts") or 0)):
+            kind, _, target = key.partition(":")
+            out[key] = {"phase": st["phase"], "ts": ts,
+                        "action": {"kind": kind, "target": target},
+                        "source": "stateFile"}
+    return out
+
+
+@dataclass
+class MaintenanceAction:
+    """One planned/executed unit of maintenance, shared between the
+    planner, the executor, and the persistent action ledger (journal
+    entries of kind ``autopilot``)."""
+
+    kind: str                      # CATALOG key
+    table_path: str
+    target: str = ""               # column list / conf key; "" = the table
+    params: Dict[str, Any] = field(default_factory=dict)
+    source: str = ""               # "doctor:<dimension>" | "advisor:<kind>"
+    priority: float = 0.0          # higher = execute first
+    evidence: Dict[str, Any] = field(default_factory=dict)
+    #: metric values the source cited — the audit compares these (and the
+    #: re-measured before values) against the post-action measurement
+    predicted: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        spec(self.kind)  # unknown kinds never enter the pipeline
+
+    @property
+    def key(self) -> str:
+        """Cooldown/dedup identity: the action kind plus its target."""
+        return f"{self.kind}:{self.target}" if self.target else self.kind
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "table": self.table_path,
+            "target": self.target,
+            "params": dict(self.params),
+            "source": self.source,
+            "priority": round(self.priority, 3),
+            "evidence": dict(self.evidence),
+            "predicted": dict(self.predicted),
+            "catalog": CATALOG_REF,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> Optional["MaintenanceAction"]:
+        """Rebuild from a ledger entry; None on malformed/unknown input —
+        an old or torn ledger line must not poison planning."""
+        try:
+            return cls(
+                kind=d["kind"], table_path=d.get("table", ""),
+                target=d.get("target", "") or "",
+                params=dict(d.get("params") or {}),
+                source=d.get("source", ""),
+                priority=float(d.get("priority") or 0.0),
+                evidence=dict(d.get("evidence") or {}),
+                predicted=dict(d.get("predicted") or {}),
+            )
+        except (KeyError, TypeError, ValueError):
+            return None

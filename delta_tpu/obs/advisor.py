@@ -35,10 +35,12 @@ from __future__ import annotations
 
 from collections import Counter
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from delta_tpu.obs import actions as actions_mod
 from delta_tpu.obs import journal as journal_mod
 from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
 
 __all__ = ["Recommendation", "AdvisorReport", "advise"]
 
@@ -69,6 +71,15 @@ class Recommendation:
     action: str        # the concrete command / conf change
     detail: str
     evidence: Dict[str, Any] = field(default_factory=dict)
+    #: catalog key of the maintenance action that executes (or cites) this
+    #: recommendation — `obs/actions.CATALOG`, resolved per kind at emit
+    #: time so the autopilot consumes it without string matching
+    remedy: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.remedy:
+            self.remedy = actions_mod.remedy_name(
+                actions_mod.RECOMMENDATION_ACTIONS[self.kind])
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -76,6 +87,7 @@ class Recommendation:
             "target": self.target,
             "score": round(self.score, 3),
             "action": self.action,
+            "remedy": self.remedy,
             "detail": self.detail,
             "evidence": dict(self.evidence),
         }
@@ -100,6 +112,9 @@ class AdvisorReport:
             "entries": self.entries,
             "facts": dict(self.facts),
             "recommendations": [r.to_dict() for r in self.recommendations],
+            # every recommendation's ``remedy`` is a key of the shared
+            # maintenance Action catalog (same one the doctor cites)
+            "remedyCatalog": actions_mod.CATALOG_REF,
             "doctor": "point-in-time debt: DeltaTable.doctor() / "
                       "GET /doctor?path=<table>",
         }
@@ -347,6 +362,81 @@ def _planning_ms(scans: List[dict]) -> float:
     return float(vals[len(vals) // 2]) if vals else 0.0
 
 
+def _autopilot_facts(entries: List[dict], now_ms: int,
+                     state: Optional[Dict[str, dict]] = None
+                     ) -> Tuple[Dict[str, Any], Dict[str, dict]]:
+    """Aggregate the autopilot action ledger (journal kind ``autopilot``)
+    into facts, and return the actions currently inside their cooldown
+    keyed by action key (shared `obs/actions.attempts_in_cooldown`, the
+    same rule the autopilot planner filters re-plans with) — the advisor
+    cites those instead of re-recommending them."""
+    cooldown_ms = conf.get_int("delta.tpu.autopilot.cooldownMs",
+                               6 * 3_600_000)
+    executed = [e for e in entries if e.get("phase") == "executed"]
+    recent: List[Dict[str, Any]] = []
+    for e in executed[-8:]:
+        a = e.get("action") or {}
+        audit = e.get("audit") or {}
+        recent.append({
+            "kind": a.get("kind"), "target": a.get("target") or "",
+            "ts": e.get("ts"), "verdict": audit.get("verdict"),
+            "predicted": audit.get("predicted") or {},
+            "realized": audit.get("realized") or {},
+        })
+    in_cooldown = actions_mod.attempts_in_cooldown(entries, now_ms,
+                                                   cooldown_ms, state=state)
+    facts = {
+        "entries": len(entries),
+        "executed": len(executed),
+        "recentActions": recent,
+        "cooldownActive": sorted(in_cooldown),
+    }
+    return facts, in_cooldown
+
+
+def _apply_cooldowns(recs: List[Recommendation],
+                     in_cooldown: Dict[str, dict]
+                     ) -> Tuple[List[Recommendation], List[Dict[str, Any]]]:
+    """Drop recommendations whose remedy the autopilot already attempted
+    inside the cooldown window; return (kept, suppressed-citations). The
+    closed loop: an executed action must not be re-recommended until its
+    realized effect has had time to show up in fresh journal history."""
+    if not in_cooldown:
+        return recs, []
+    by_kind: Dict[str, List[dict]] = {}
+    for e in in_cooldown.values():
+        a = e.get("action") or {}
+        by_kind.setdefault(a.get("kind"), []).append(e)
+    kept: List[Recommendation] = []
+    suppressed: List[Dict[str, Any]] = []
+    for r in recs:
+        hit = None
+        for e in by_kind.get(r.remedy, ()):
+            a = e.get("action") or {}
+            targets = [t.strip().lower()
+                       for t in (a.get("target") or "").split(",") if t.strip()]
+            # column-targeted actions must match the column; table-scoped
+            # actions (CHECKPOINT, OPTIMIZE, ...) match on kind alone
+            if not targets or r.target.lower() in targets:
+                hit = e
+                break
+        if hit is None:
+            kept.append(r)
+            continue
+        audit = hit.get("audit") or {}
+        suppressed.append({
+            "kind": r.kind, "target": r.target, "remedy": r.remedy,
+            "phase": hit.get("phase"), "executedAt": hit.get("ts"),
+            "verdict": audit.get("verdict"),
+            "predicted": audit.get("predicted") or {},
+            "realized": audit.get("realized") or {},
+            "detail": "suppressed: the autopilot attempted this action "
+                      "inside its cooldown window — see the action ledger "
+                      "(journal kind 'autopilot')",
+        })
+    return kept, suppressed
+
+
 # ---------------------------------------------------------------------------
 # Recommendation synthesis
 # ---------------------------------------------------------------------------
@@ -543,6 +633,16 @@ def advise(table, snapshot=None, limit: Optional[int] = None) -> AdvisorReport:
         commits = [e for e in entries if e.get("kind") == "commit"]
         dmls = [e for e in entries if e.get("kind") == "dml"]
         routers = [e for e in entries if e.get("kind") == "router"]
+        autopilots = [e for e in entries if e.get("kind") == "autopilot"]
+        # ledger cooldown math runs on wall time: journal ts stamps come
+        # from time.time(), while `now` (delta_log.clock) is injectable.
+        # The sweep-proof sidecar rides along so suppression stays in
+        # lockstep with the planner even after a ledger-segment sweep
+        import time as _time
+
+        ap_facts, in_cooldown = _autopilot_facts(
+            autopilots, int(_time.time() * 1000),
+            state=journal_mod.attempt_state(delta_log.log_path))
         facts: Dict[str, Any] = {
             "scans": len(scans),
             "columns": _column_facts(scans),
@@ -553,8 +653,12 @@ def advise(table, snapshot=None, limit: Optional[int] = None) -> AdvisorReport:
             "router": _router_facts(routers),
             "rowGroups": _row_group_facts(scans),
             "planningP50Ms": _planning_ms(scans),
+            "autopilot": ap_facts,
         }
         recs = _recommend(facts, list(snap.metadata.partition_columns))
+        recs, suppressed = _apply_cooldowns(recs, in_cooldown)
+        if suppressed:
+            ap_facts["suppressed"] = suppressed
         if recs:
             telemetry.bump_counter("advisor.recommendations", len(recs))
         telemetry.add_span_data(

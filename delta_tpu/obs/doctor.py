@@ -22,6 +22,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from delta_tpu.obs import actions as actions_mod
 from delta_tpu.obs.metric_names import health_gauge
 from delta_tpu.utils import telemetry
 
@@ -105,6 +106,10 @@ class TableHealthReport:
             "numFiles": self.num_files,
             "sizeInBytes": self.size_in_bytes,
             "dimensions": [d.to_dict() for d in self.dimensions],
+            # every remedy string above is a key of the shared maintenance
+            # Action catalog — the autopilot consumes it without string
+            # matching, and so can any external consumer
+            "remedyCatalog": actions_mod.CATALOG_REF,
             # the doctor is point-in-time; the workload journal's advisor
             # answers the longitudinal question (what layout do the queries
             # this table ACTUALLY serves need) — see obs/advisor.py
@@ -157,7 +162,7 @@ def _dim_checkpoint(snapshot) -> HealthDimension:
         "checkpoint", sev,
         {"commitsSince": commits_since, "tailBytes": tail_bytes,
          "tailFiles": len(seg.deltas)},
-        remedy="CHECKPOINT" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("CHECKPOINT") if sev != "ok" else None,
         detail=detail,
     )
 
@@ -183,7 +188,7 @@ def _dim_small_files(files) -> HealthDimension:
         "smallFiles", sev,
         {"count": len(small), "bytes": small_bytes,
          "estReduction": reduction},
-        remedy="OPTIMIZE" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("OPTIMIZE") if sev != "ok" else None,
         detail=f"{len(small)} files below the {SMALL_FILE_BYTES >> 20} MiB "
                f"compaction floor; OPTIMIZE would remove ~{reduction}",
     )
@@ -214,7 +219,7 @@ def _dim_dv(files) -> HealthDimension:
         "dv", sev,
         {"files": len(dv_files), "deletedRows": deleted,
          "deletedPct": round(pct, 4), "filesPastPurge": past_purge},
-        remedy="PURGE" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("PURGE") if sev != "ok" else None,
         detail=f"{deleted} rows soft-deleted across {len(dv_files)} files "
                f"({pct:.1%} of the table); {past_purge} files past the "
                f"{DV_PURGE_FILE_PCT:.0%} purge threshold",
@@ -235,7 +240,7 @@ def _dim_stats(files) -> HealthDimension:
     return HealthDimension(
         "stats", sev,
         {"coveragePct": round(cov, 4), "parsedPct": round(parsed_pct, 4)},
-        remedy="OPTIMIZE" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("OPTIMIZE") if sev != "ok" else None,
         detail=f"{with_stats}/{n} files carry stats ({parsed} parseable); "
                "files without stats are never skipped",
     )
@@ -261,7 +266,7 @@ def _dim_partition(files, partition_columns) -> HealthDimension:
     return HealthDimension(
         "partition", sev,
         {"count": n_parts, "gini": round(gini, 4)},
-        remedy="REPARTITION" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("REPARTITION") if sev != "ok" else None,
         detail=f"{n_parts} partitions, byte-skew Gini {gini:.2f}",
     )
 
@@ -277,7 +282,7 @@ def _dim_tombstones(snapshot, live_bytes: int) -> HealthDimension:
     return HealthDimension(
         "tombstones", sev,
         {"count": len(tombs), "bytes": tomb_bytes},
-        remedy="VACUUM" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("VACUUM") if sev != "ok" else None,
         detail=f"{len(tombs)} removed files ({tomb_bytes} bytes) await "
                "retention expiry",
     )
@@ -310,7 +315,7 @@ def _dim_device() -> HealthDimension:
         {"hbmBytes": used, "keyCacheBytes": t["keyCache"],
          "stateCacheBytes": t["stateCache"], "scratchBytes": t["scratch"],
          "budgetBytes": budget or 0, "pressure": round(pressure, 4)},
-        remedy="EVICT" if sev != "ok" else None,
+        remedy=actions_mod.remedy_name("EVICT") if sev != "ok" else None,
         detail=f"{used} device bytes resident "
                f"(keyCache {t['keyCache']}, stateCache {t['stateCache']}, "
                f"scratch {t['scratch']})"

@@ -28,6 +28,13 @@ Entry kinds
 ``router``
     Every `obs/router_audit` record (merge joins AND scan-planning picks),
     so predicted-vs-actual routing history survives the audit ring.
+``autopilot``
+    The maintenance scheduler's **action ledger** (`delta_tpu/autopilot`):
+    one entry per planned/started/executed/skipped/deferred action with the
+    shared :mod:`~delta_tpu.obs.actions` model, its cited evidence, and —
+    for executed actions — the predicted-vs-realized audit. Written through
+    a synchronous flush (the autopilot's cooldowns survive a crash only if
+    the "started" entry is on disk before the action runs).
 
 Hooks live in ``exec/scan.py``, ``txn/transaction.py``, ``commands/*`` and
 ``obs/router_audit.py``; each hook is a dict append under a lock — the IO
@@ -54,11 +61,20 @@ from delta_tpu.utils import telemetry
 from delta_tpu.utils.config import conf
 
 __all__ = ["enabled", "journal_dir", "predicate_fingerprint", "record_scan",
-           "record_commit", "record_dml", "record_router", "flush",
+           "record_commit", "record_dml", "record_router",
+           "record_autopilot", "attempt_state", "record_attempt", "flush",
            "read_entries", "sweep", "reset"]
 
 SEGMENT_PREFIX = "journal-"
 SEGMENT_SUFFIX = ".jsonl"
+
+#: Sweep-proof sidecar mirroring the autopilot's LAST attempt per action
+#: key. Ledger entries live in journal segments the size/age sweep may
+#: legitimately delete well inside a cooldown on a busy table; this one
+#: small JSON file (not SEGMENT_PREFIX-named, so never swept) keeps the
+#: cooldown/backoff guardrail durable for both the planner and the
+#: advisor's suppression regardless of sweep pressure.
+STATE_FILE = "_autopilot_state.json"
 
 # per-table buffers keyed by journal dir; entries are ready-to-write dicts
 _LOCK = threading.Lock()
@@ -299,6 +315,80 @@ def record_router(log_path: str, audit: Dict[str, Any]) -> None:
     if not enabled(log_path):
         return
     _record(log_path, {"kind": "router", "audit": dict(audit)})
+
+
+def record_autopilot(log_path: str, phase: str, action: Dict[str, Any],
+                     durable: bool = True, **payload: Any) -> bool:
+    """Journal one autopilot action-ledger entry (hook:
+    ``delta_tpu/autopilot``). ``phase`` is the lifecycle stage (``planned``
+    / ``started`` / ``executed`` / ``skipped`` / ``deferred`` / ``failed``
+    / ``interrupted`` / ``abortedContention``); ``action`` is a
+    :meth:`~delta_tpu.obs.actions.MaintenanceAction.to_dict` payload.
+    ``durable=True`` (the default) bypasses the write-behind buffer and
+    appends synchronously under the IO lock: the cooldown guardrail only
+    works if attempt entries hit disk BEFORE the action executes — a
+    crash mid-maintenance must leave the attempt visible to the restarted
+    process. Returns False when the journal is inert OR (durable) when
+    the write did not land — an unwritable journal directory drops the
+    batch, and the caller must treat "not on disk" as "do not act"
+    rather than execute with an unarmed cooldown."""
+    if not enabled(log_path):
+        return False
+    entry = {"kind": "autopilot", "phase": phase, "action": dict(action),
+             **payload}
+    if not durable:
+        return _record(log_path, entry)
+    entry.setdefault("ts", int(time.time() * 1000))
+    try:
+        with _IO_LOCK:
+            return _write_batch(journal_dir(log_path), [entry]) > 0
+    except Exception:  # noqa: BLE001 — report failure, never raise into
+        # the maintenance loop; the caller skips the action instead
+        telemetry.logger.debug("durable autopilot journal write failed",
+                               exc_info=True)
+        return False
+
+
+def _state_path(log_path: str) -> str:
+    return os.path.join(journal_dir(log_path), STATE_FILE)
+
+
+def attempt_state(log_path: str) -> Dict[str, Dict[str, Any]]:
+    """The autopilot sidecar's last-attempt map: action key →
+    ``{"phase", "ts"}`` (see :data:`STATE_FILE`); empty when absent."""
+    try:
+        with open(_state_path(log_path), encoding="utf-8") as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def record_attempt(log_path: str, key: str, phase: str, ts_ms: int) -> bool:
+    """Durably mirror one autopilot attempt into the sidecar (atomic
+    replace). Returns False when the write failed — the autopilot treats
+    an un-persistable attempt as "do not act": without it on disk, a
+    crash mid-action would leave the restarted process free to
+    crash-loop."""
+    import contextlib
+    import uuid
+
+    path = _state_path(log_path)
+    state = attempt_state(log_path)
+    state[key] = {"phase": phase, "ts": int(ts_ms)}
+    tmp = f"{path}.{uuid.uuid4().hex}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(state, f, separators=(",", ":"))
+            os.replace(tmp, path)
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)  # replace won: gone already; crash: no orphan
+    except OSError:
+        return False
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,8 @@ GAUGES = frozenset({
     "table.maintenance.lastVacuumTimestamp",
     # -- static analysis (analysis/__init__.publish_metrics, label: rule) -
     "analysis.findings",
+    # -- autopilot maintenance scheduler (delta_tpu/autopilot, label: path)
+    "autopilot.lastRunTimestamp",
 })
 
 #: Counters introduced by the obs layer and its doctor feeds.
@@ -103,6 +105,15 @@ COUNTERS = frozenset({
     "journal.entriesDropped",     # buffer cap hit or unwritable directory
     "advisor.runs",               # advise() invocations
     "advisor.recommendations",    # recommendations emitted across runs
+    # -- autopilot maintenance scheduler (delta_tpu/autopilot) ------------
+    "autopilot.runs",             # run_once passes (daemon ticks + manual)
+    "autopilot.actions.planned",  # actions surviving cooldown into a plan
+    "autopilot.actions.executed",  # actions that ran to completion
+    "autopilot.actions.skipped",  # cost cap / run budget aborts
+    "autopilot.actions.deferred",  # not-quiet / backoff / busy deferrals
+    "autopilot.actions.failed",   # genuine execution failures
+    "autopilot.contentionAborts",  # maintenance commits that lost to
+                                   # foreground writers and backed off
 })
 
 #: Every OTHER counter the engine bumps by constant name — the inverse lint
@@ -183,8 +194,14 @@ PUBLIC_API = {
                    "reset"),
     "journal": ("enabled", "journal_dir", "predicate_fingerprint",
                 "record_scan", "record_commit", "record_dml",
-                "record_router", "flush", "read_entries", "sweep", "reset"),
+                "record_router", "record_autopilot", "attempt_state",
+                "record_attempt", "flush", "read_entries", "sweep",
+                "reset"),
     "advisor": ("Recommendation", "AdvisorReport", "advise"),
+    "actions": ("ActionSpec", "MaintenanceAction", "CATALOG", "CATALOG_REF",
+                "RECOMMENDATION_ACTIONS", "COOLDOWN_PHASES", "spec",
+                "remedy_name", "executable_kinds", "action_key",
+                "attempts_in_cooldown"),
 }
 
 
@@ -262,6 +279,14 @@ DESCRIPTIONS = {
     "journal.entriesDropped": "Journal entries dropped (buffer cap or unwritable dir).",
     "advisor.runs": "Layout-advisor invocations.",
     "advisor.recommendations": "Recommendations emitted by the advisor.",
+    "autopilot.lastRunTimestamp": "Wall-clock ms of the last autopilot pass over the table.",
+    "autopilot.runs": "Autopilot maintenance passes (daemon ticks + manual run_once).",
+    "autopilot.actions.planned": "Maintenance actions planned past the cooldown filter.",
+    "autopilot.actions.executed": "Maintenance actions executed to completion.",
+    "autopilot.actions.skipped": "Maintenance actions aborted by a cost cap or run budget.",
+    "autopilot.actions.deferred": "Maintenance actions deferred (window not quiet, backoff, or busy).",
+    "autopilot.actions.failed": "Maintenance actions that failed outright.",
+    "autopilot.contentionAborts": "Maintenance commits that lost to foreground writers and backed off.",
     # counters — engine
     "checkpoint.parts": "Checkpoint part files written.",
     "checkpoint.actions": "Actions serialized into checkpoints.",

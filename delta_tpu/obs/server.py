@@ -19,6 +19,10 @@ Route          Payload
 ``/advisor``   ``?path=/data/tbl`` → the workload-journal layout advisor
                report (:func:`delta_tpu.obs.advisor.advise`); ``?limit=N``
                restricts to the last N journal entries
+``/autopilot`` maintenance-scheduler status (conf posture, guardrails,
+               last run per table — :func:`delta_tpu.autopilot.status`);
+               with ``?path=/data/tbl`` also the table's action ledger
+               tail (``?limit=N``, default 32)
 =============  ==============================================================
 
 Nothing listens unless :func:`start_server` is called (port argument or
@@ -101,6 +105,22 @@ class _Handler(BaseHTTPRequestHandler):
                 from delta_tpu.obs.advisor import advise
 
                 self._json(advise(path, limit=limit).to_dict())
+            elif route == "/autopilot":
+                from delta_tpu import autopilot as autopilot_mod
+                from delta_tpu.obs import journal as journal_mod
+
+                payload = autopilot_mod.status()
+                path = q.get("path", [None])[0]
+                if path:
+                    try:
+                        limit = int(q.get("limit", [32])[0])
+                    except (TypeError, ValueError):
+                        limit = 32  # like /router: a typo'd limit isn't a 500
+                    log_path = path.rstrip("/") + "/_delta_log"
+                    journal_mod.flush(log_path)
+                    payload["ledger"] = journal_mod.read_entries(
+                        log_path, kinds=["autopilot"], limit=limit)
+                self._json(payload)
             elif route == "/router":
                 from delta_tpu.obs import calibration, router_audit
                 from delta_tpu.parallel import link
@@ -122,7 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
                                        "/trace", "/doctor", "/router",
-                                       "/advisor"]}, 404)
+                                       "/advisor", "/autopilot"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

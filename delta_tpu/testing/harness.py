@@ -65,7 +65,9 @@ class TortureHarness:
                  rate: float = 0.08, kinds=ALL_KINDS,
                  max_step_s: float = 60.0,
                  group_commit: bool = False,
-                 async_checkpoint: bool = False):
+                 async_checkpoint: bool = False,
+                 autopilot: bool = False,
+                 autopilot_cooldown_ms: int = 2000):
         self.path = path
         self.seed = seed
         self.plan = plan or FaultPlan(seed=seed, rate=rate, kinds=kinds)
@@ -79,6 +81,18 @@ class TortureHarness:
         # the default synchronous configuration.
         self.group_commit = group_commit
         self.async_checkpoint = async_checkpoint
+        # autopilot mode (ISSUE 13): interleave non-dry-run maintenance
+        # passes (delta_tpu/autopilot.run_once) with the faulted workload —
+        # a SimulatedCrash mid-maintenance must leave the table consistent,
+        # the interrupted action journaled, and the cooldown armed against
+        # crash-loop re-execution. The extra weighted op changes the seeded
+        # op sequence, so per_point determinism is only comparable between
+        # runs with the same autopilot setting.
+        self.autopilot = autopilot
+        self.autopilot_cooldown_ms = autopilot_cooldown_ms
+        self._weighted_ops = list(self._WEIGHTED_OPS)
+        if autopilot:
+            self._weighted_ops.append(("autopilot", 6))
         self.report = TortureReport()
         # ledger: batch id -> ("present" | "deleted", [ids])
         self.batches: Dict[int, Tuple[str, List[int]]] = {}
@@ -255,6 +269,16 @@ class TortureHarness:
 
         scan_to_table(self._log.snapshot, [], ["id"])
 
+    def _op_autopilot(self) -> None:
+        """One non-dry-run maintenance pass under fault injection.
+        ``force=True`` skips the quiet-window check (the torture workload
+        is never quiet by construction); every other guardrail — cost
+        caps, cooldowns, capped commit attempts, durable started entries —
+        runs exactly as in production."""
+        from delta_tpu import autopilot as autopilot_mod
+
+        autopilot_mod.run_once(self._log, force=True)
+
     # -- crash handling ---------------------------------------------------
 
     def _recover(self) -> None:
@@ -314,9 +338,9 @@ class TortureHarness:
     )
 
     def _pick_op(self) -> str:
-        total = sum(w for _, w in self._WEIGHTED_OPS)
+        total = sum(w for _, w in self._weighted_ops)
         r = self.rng.randrange(total)
-        for name, w in self._WEIGHTED_OPS:
+        for name, w in self._weighted_ops:
             if r < w:
                 return name
             r -= w
@@ -361,6 +385,12 @@ class TortureHarness:
         if self.async_checkpoint:
             extra["delta.tpu.checkpoint.async"] = True
             extra["delta.tpu.checkpoint.incremental"] = True
+        if self.autopilot:
+            extra["delta.tpu.autopilot.enabled"] = True
+            extra["delta.tpu.autopilot.dryRun"] = False
+            extra["delta.tpu.autopilot.cooldownMs"] = \
+                self.autopilot_cooldown_ms
+            extra["delta.tpu.autopilot.contentionBackoffMs"] = 500
         with conf.set_temporarily(
             delta__tpu__faults__plan=self.plan,
             delta__tpu__storage__retry__baseDelayMs=1,
@@ -388,9 +418,11 @@ def run_torture(path: str, seed: int, steps: int,
                 rate: float = 0.08, kinds=ALL_KINDS,
                 check_every: int = 10,
                 group_commit: bool = False,
-                async_checkpoint: bool = False) -> TortureReport:
+                async_checkpoint: bool = False,
+                autopilot: bool = False) -> TortureReport:
     """One-call torture run: fresh harness, seeded plan, invariants on."""
     h = TortureHarness(path, seed, rate=rate, kinds=kinds,
                        group_commit=group_commit,
-                       async_checkpoint=async_checkpoint)
+                       async_checkpoint=async_checkpoint,
+                       autopilot=autopilot)
     return h.run(steps, check_every=check_every)

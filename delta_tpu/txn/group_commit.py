@@ -319,7 +319,9 @@ class GroupCommitCoordinator:
         them — the intra-batch check."""
         txn = p.txn
         dl = self.delta_log
-        max_attempts = conf.get("delta.tpu.maxCommitAttempts")
+        # honors the member's maintenance attempts cap (stamped on the txn
+        # at commit() — the leader thread's own contextvar is irrelevant)
+        max_attempts = transaction_mod.effective_max_commit_attempts(txn)
 
         def _winning(v: int) -> List[Action]:
             # normally served from the shared snapshot; a version _load_tail

@@ -41,11 +41,57 @@ from delta_tpu.utils.telemetry import record_operation
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["OptimisticTransaction", "CommitStats"]
+__all__ = ["OptimisticTransaction", "CommitStats", "commit_attempts_cap",
+           "effective_max_commit_attempts"]
 
 _active_txn: "contextvars.ContextVar[Optional[OptimisticTransaction]]" = contextvars.ContextVar(
     "active_delta_txn", default=None
 )
+
+# Background-maintenance commit-attempts cap (delta_tpu/autopilot): a
+# maintenance commit must LOSE gracefully to foreground writers instead of
+# retry-storming through delta.tpu.maxCommitAttempts (10M) under the commit
+# lock. Thread-confined by contextvar so a daemon's cap never leaks to
+# foreground writers; the cap is stamped onto the txn at commit() time so
+# the group-commit LEADER (a different thread) enforces the member's cap.
+_commit_attempts_cap: "contextvars.ContextVar[Optional[int]]" = contextvars.ContextVar(
+    "delta_commit_attempts_cap", default=None
+)
+
+
+class commit_attempts_cap:
+    """Context manager bounding commit attempts for transactions committed
+    inside it: ``with commit_attempts_cap(3): OptimizeCommand(...).run()``.
+    ``None``/``<= 0`` is a no-op (the registry default applies)."""
+
+    def __init__(self, attempts: Optional[int]):
+        self._attempts = int(attempts) if attempts else None
+        self._token = None
+
+    def __enter__(self) -> "commit_attempts_cap":
+        if self._attempts and self._attempts > 0:
+            self._token = _commit_attempts_cap.set(self._attempts)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            _commit_attempts_cap.reset(self._token)
+        return False
+
+
+def effective_max_commit_attempts(txn=None) -> int:
+    """``delta.tpu.maxCommitAttempts`` bounded by any active
+    :class:`commit_attempts_cap`. A txn that went through commit() carries
+    its OWN stamp (``_attempts_cap``, possibly None = uncapped) and that
+    stamp is authoritative — the current thread's contextvar must NOT be
+    consulted for it, or a group-commit leader running inside a maintenance
+    cap would leak the cap onto its foreground batchmates."""
+    limit = conf.get("delta.tpu.maxCommitAttempts")
+    if txn is not None and hasattr(txn, "_attempts_cap"):
+        cap = txn._attempts_cap
+    else:
+        cap = _commit_attempts_cap.get()
+    return min(limit, cap) if cap else limit
 
 
 def commit_backoff_s(attempts: int) -> float:
@@ -55,9 +101,9 @@ def commit_backoff_s(attempts: int) -> float:
     return min(0.05 * (2 ** min(attempts, 6)), 2.0)
 
 
-def max_attempts_exceeded(attempts: int) -> "errors.DeltaIllegalStateError":
+def max_attempts_exceeded(attempts: int) -> "errors.CommitAttemptsExhausted":
     """The maxCommitAttempts exhaustion error, shared with the grouped path."""
-    return errors.DeltaIllegalStateError(
+    return errors.CommitAttemptsExhausted(
         f"This commit has failed as it has been tried {attempts - 1} times but did not succeed."
     )
 
@@ -314,6 +360,9 @@ class OptimisticTransaction:
             # indeterminate error, re-reading version N and comparing this
             # token decides won/lost (never double-commit, never false-fail)
             self._commit_token = uuid.uuid4().hex
+            # stamp any maintenance attempts cap now: the group-commit
+            # leader runs on ANOTHER thread, where the contextvar is unset
+            self._attempts_cap = _commit_attempts_cap.get()
             commit_info = CommitInfo(
                 timestamp=self.delta_log.clock(),
                 operation=op.name,
@@ -495,7 +544,7 @@ class OptimisticTransaction:
 
     def _do_commit_retry(self, actions: List[Action]) -> int:
         """Retry loop (``doCommitRetryIteratively``, scala:610-642)."""
-        max_attempts = conf.get("delta.tpu.maxCommitAttempts")
+        max_attempts = effective_max_commit_attempts(self)
         attempt_version = self.read_version + 1
         attempts = 0
         with self.delta_log.lock:

@@ -264,6 +264,40 @@ class SqlConf:
         # Persistent XLA compilation cache directory (utils/jaxcache).
         # None = ~/.cache/delta_tpu/xla; empty string disables.
         "delta.tpu.xla.cacheDir": None,
+        # Autopilot maintenance scheduler (delta_tpu/autopilot): closes the
+        # observe→decide→act→audit loop over the doctor's remedies and the
+        # advisor's recommendations. Strictly opt-in: the daemon only runs
+        # when enabled=true AND start() is called, and even then dryRun
+        # (default ON) journals the plan without executing anything.
+        "delta.tpu.autopilot.enabled": False,
+        "delta.tpu.autopilot.dryRun": True,
+        # Daemon tick interval between maintenance passes over the
+        # registered tables.
+        "delta.tpu.autopilot.intervalMs": 60_000,
+        # Per-run cost caps: total bytes an OPTIMIZE/ZORDER/PURGE may
+        # select for rewrite (over-budget jobs abort pre-IO with a
+        # journaled SKIPPED outcome), wall-clock budget across a run's
+        # actions, and how many actions one run may execute.
+        "delta.tpu.autopilot.maxBytesPerRun": 2 << 30,
+        "delta.tpu.autopilot.budgetMs": 300_000,
+        "delta.tpu.autopilot.maxActionsPerRun": 4,
+        # Per-action cooldown: an ATTEMPTED action (started / executed /
+        # failed / interrupted) is not re-planned for this long — also the
+        # crash-loop guard, since "started" ledger entries are flushed to
+        # disk before execution.
+        "delta.tpu.autopilot.cooldownMs": 6 * 3_600_000,
+        # After a maintenance commit loses to a foreground writer, the
+        # whole table backs off for this long.
+        "delta.tpu.autopilot.contentionBackoffMs": 300_000,
+        # Quiet-window pick: execute only when the journal shows at most
+        # quietMaxCommits foreground commits inside the last quietWindowMs
+        # (the same 60s bucketing the advisor's contention analysis uses).
+        "delta.tpu.autopilot.quietWindowMs": 60_000,
+        "delta.tpu.autopilot.quietMaxCommits": 0,
+        # Maintenance commits lose gracefully: attempts are capped at this
+        # (txn.transaction.commit_attempts_cap) instead of retry-storming
+        # through delta.tpu.maxCommitAttempts against foreground writers.
+        "delta.tpu.autopilot.maxCommitAttempts": 3,
     }
 
     def __init__(self):
@@ -285,6 +319,17 @@ class SqlConf:
         if isinstance(v, str):
             return v.strip().lower() not in ("false", "0", "off", "no", "")
         return bool(v)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        """Integer conf with coercion; malformed user-set values fall back
+        to ``default`` (for registered keys the registry default makes
+        None impossible). One helper so numeric-guardrail readers don't
+        each re-implement the try/int dance."""
+        v = self.get(key, default)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return int(default)
 
     def set(self, key: str, value: Any) -> None:
         with self._lock:

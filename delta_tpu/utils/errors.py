@@ -11,6 +11,7 @@ __all__ = [
     "DeltaAnalysisError",
     "DeltaIllegalArgumentError",
     "DeltaIllegalStateError",
+    "CommitAttemptsExhausted",
     "DeltaFileNotFoundError",
     "DeltaIOError",
     "DeltaUnsupportedOperationError",
@@ -46,6 +47,13 @@ class DeltaIllegalArgumentError(DeltaError, ValueError):
 
 class DeltaIllegalStateError(DeltaError, RuntimeError):
     pass
+
+
+class CommitAttemptsExhausted(DeltaIllegalStateError):
+    """A commit gave up after its attempts bound (delta.tpu.maxCommitAttempts
+    or a maintenance `txn.transaction.commit_attempts_cap`). A dedicated
+    subclass so background maintenance can classify losing-to-foreground
+    without message matching; still a DeltaIllegalStateError to callers."""
 
 
 class DeltaFileNotFoundError(DeltaError, FileNotFoundError):

@@ -1,0 +1,714 @@
+"""Autopilot maintenance scheduler (`delta_tpu/autopilot/`): the closed
+observe→decide→act→audit loop, its guardrails (dry-run, cost caps,
+cooldowns, quiet windows, contention backoff, capped maintenance commit
+attempts), the shared action catalog both the doctor and the advisor now
+cite, the persistent action ledger, and crash consistency under fault
+injection.
+"""
+import json
+import http.client
+import time
+from collections import Counter
+
+import pyarrow as pa
+import pytest
+
+from delta_tpu import autopilot
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.autopilot import executor as executor_mod
+from delta_tpu.autopilot import planner as planner_mod
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.obs import actions as actions_mod
+from delta_tpu.obs import journal
+from delta_tpu.obs.actions import MaintenanceAction
+from delta_tpu.obs.advisor import advise
+from delta_tpu.obs.doctor import SEVERITY_RANK, doctor
+from delta_tpu.storage.faults import FaultPlan, SimulatedCrash
+from delta_tpu.utils import errors, telemetry
+from delta_tpu.utils.config import conf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    telemetry.reset_all()
+    journal.reset()
+    autopilot.reset()
+    yield
+    autopilot.reset()
+    journal.reset()
+    telemetry.reset_all()
+
+
+def _ids(n, start=0):
+    import numpy as np
+
+    return pa.table({
+        "id": np.arange(start, start + n).astype("int64"),
+        "v": (np.arange(start, start + n) * 7 % 1000).astype("int64"),
+    })
+
+
+def _debt_table(path, appends=24, checkpoint_interval="1000"):
+    """A table with seeded small-file + stale-checkpoint debt: many tiny
+    commits, interval checkpointing effectively off."""
+    t = DeltaTable.create(
+        path, data=_ids(8),
+        configuration={"delta.checkpointInterval": checkpoint_interval},
+    )
+    for i in range(appends):
+        t.write(_ids(8, start=1000 * (i + 1)))
+    return t
+
+
+def _ledger(path):
+    log_path = path.rstrip("/") + "/_delta_log"
+    journal.flush(log_path)
+    return journal.read_entries(log_path, kinds=["autopilot"])
+
+
+def _quiet_conf(**extra):
+    base = {
+        "delta.tpu.autopilot.dryRun": False,
+        # the debt-seeding commits happened milliseconds ago: shrink the
+        # quiet window so the table counts as quiet without sleeping long
+        "delta.tpu.autopilot.quietWindowMs": 50,
+    }
+    base.update(extra)
+    return conf.set_temporarily(**base)
+
+
+# -- shared action catalog ---------------------------------------------------
+
+
+def test_action_catalog_validates_names():
+    assert actions_mod.spec("OPTIMIZE").executable
+    assert actions_mod.spec("OPTIMIZE").mutates_table
+    assert not actions_mod.spec("REPARTITION").executable
+    assert not actions_mod.spec("EVICT").mutates_table
+    with pytest.raises(ValueError, match="not registered"):
+        actions_mod.spec("DEFRAG")
+    with pytest.raises(ValueError):
+        MaintenanceAction(kind="DEFRAG", table_path="/t")
+    assert set(actions_mod.executable_kinds()) == {
+        "OPTIMIZE", "ZORDER", "CHECKPOINT", "VACUUM", "PURGE", "EVICT",
+        "RECALIBRATE"}
+    # every advisor kind maps into the catalog
+    for kind, action in actions_mod.RECOMMENDATION_ACTIONS.items():
+        assert action in actions_mod.CATALOG, (kind, action)
+
+
+def test_maintenance_action_roundtrip_and_malformed():
+    a = MaintenanceAction(kind="ZORDER", table_path="/t", target="v",
+                          params={"columns": ["v"]}, source="advisor:ZORDER",
+                          priority=3.5, predicted={"pruningMissRate": 1.0})
+    back = MaintenanceAction.from_dict(a.to_dict())
+    assert back is not None and back.key == a.key == "ZORDER:v"
+    assert back.params == {"columns": ["v"]}
+    assert MaintenanceAction.from_dict({"kind": "NOPE"}) is None
+    assert MaintenanceAction.from_dict({}) is None
+
+
+def test_doctor_and_advisor_cite_the_catalog(tmp_table):
+    """Remedy unification satellite: both report surfaces emit only catalog
+    keys and cite the catalog reference in to_dict."""
+    t = _debt_table(tmp_table, appends=24)
+    doc = t.doctor().to_dict()
+    assert doc["remedyCatalog"] == actions_mod.CATALOG_REF
+    for d in doc["dimensions"]:
+        if d["remedy"] is not None:
+            assert d["remedy"] in actions_mod.CATALOG
+    log_path = t.delta_log.log_path
+    from delta_tpu.expr.parser import parse_predicate
+
+    for _ in range(4):
+        journal.record_scan(log_path, report_dict={
+            "filesTotal": 8, "filesScanned": 8, "rowGroupsTotal": 8},
+            predicate=parse_predicate("v = 2"))
+    adv = advise(tmp_table).to_dict()
+    assert adv["remedyCatalog"] == actions_mod.CATALOG_REF
+    zorder = [r for r in adv["recommendations"] if r["kind"] == "ZORDER"]
+    assert zorder and zorder[0]["remedy"] == "ZORDER"
+    for r in adv["recommendations"]:
+        assert r["remedy"] in actions_mod.CATALOG
+
+
+# -- dry run (the default posture) -------------------------------------------
+
+
+def test_dry_run_journals_plan_and_executes_nothing(tmp_table):
+    t = _debt_table(tmp_table)
+    v_before = t.delta_log.update().version
+    assert autopilot.dry_run()  # default ON
+    rep = autopilot.run_once(tmp_table)
+    assert rep.status == "dry-run"
+    assert rep.planned and {a["kind"] for a in rep.planned} >= {"OPTIMIZE"}
+    assert rep.outcomes == []
+    # nothing committed, nothing rewritten
+    assert t.delta_log.update().version == v_before
+    entries = _ledger(tmp_table)
+    assert entries and all(e["phase"] == "planned" for e in entries)
+    assert all(e.get("dryRun") is True for e in entries)
+    # a dry-run plan arms no cooldown: the next pass re-plans it
+    rep2 = autopilot.run_once(tmp_table)
+    assert rep2.planned and rep2.cooled == []
+
+
+# -- the closed-loop acceptance scenario -------------------------------------
+
+
+def test_closed_loop_acceptance(tmp_table):
+    """Seeded small-file + stale-checkpoint debt; the autopilot (non-dry)
+    executes the remedies in a quiet window; doctor severities improve; the
+    ledger records predicted-vs-realized deltas; advise() cites the
+    executed actions and run 2 cooldown-filters them."""
+    t = _debt_table(tmp_table, appends=24)
+    doc_before = t.doctor()
+    assert doc_before.dimension("smallFiles").severity != "ok"
+    assert doc_before.dimension("checkpoint").severity != "ok"
+
+    with _quiet_conf():
+        time.sleep(0.1)  # let the seeding commits age out of the window
+        rep = autopilot.run_once(tmp_table)
+    assert rep.status == "ok"
+    assert rep.quiet["quiet"] is True
+    by_action = {o["action"]: o for o in rep.outcomes}
+    assert by_action["OPTIMIZE"]["status"] == "executed"
+    assert by_action["CHECKPOINT"]["status"] == "executed"
+    # rewritten bytes are metered (they draw down the per-run byte pool)
+    assert by_action["OPTIMIZE"]["result"]["metrics"]["numRemovedBytes"] > 0
+
+    # doctor severities improved
+    doc_after = DeltaTable.for_path(tmp_table).doctor()
+    for dim in ("smallFiles", "checkpoint"):
+        assert (SEVERITY_RANK[doc_after.dimension(dim).severity]
+                < SEVERITY_RANK[doc_before.dimension(dim).severity])
+
+    # the ledger records predicted-vs-realized
+    executed = [e for e in _ledger(tmp_table) if e["phase"] == "executed"]
+    assert {e["action"]["kind"] for e in executed} >= {"OPTIMIZE",
+                                                       "CHECKPOINT"}
+    opt = next(e for e in executed if e["action"]["kind"] == "OPTIMIZE")
+    audit = opt["audit"]
+    assert audit["predicted"]["count"] == doc_before.dimension(
+        "smallFiles").metrics["count"]
+    assert audit["realized"]["count"] > 0
+    assert audit["verdict"] == "improved"
+    assert audit["severityBefore"] != "ok" and audit["severityAfter"] == "ok"
+
+    # advise() cites the executed actions...
+    adv = DeltaTable.for_path(tmp_table).advise()
+    ap = adv.facts["autopilot"]
+    assert ap["executed"] >= 2
+    assert "OPTIMIZE" in ap["cooldownActive"]
+    cited = {a["kind"]: a for a in ap["recentActions"]}
+    assert cited["OPTIMIZE"]["verdict"] == "improved"
+    assert cited["OPTIMIZE"]["realized"]["count"] > 0
+
+    # ...and run 2 does not re-plan them (cooldown)
+    with _quiet_conf():
+        rep2 = autopilot.run_once(tmp_table)
+    replanned = {a["kind"] for a in rep2.planned}
+    assert "OPTIMIZE" not in replanned and "CHECKPOINT" not in replanned
+    json.dumps(rep.to_dict())  # report JSON-able end to end
+
+
+def test_zorder_from_advisor_executes_and_is_suppressed(tmp_table):
+    """The advisor's ZORDER recommendation becomes an executed action, and
+    the NEXT advise() suppresses the recommendation, citing the ledger."""
+    t = DeltaTable.create(tmp_table, data=_ids(64))
+    log_path = t.delta_log.log_path
+    from delta_tpu.expr.parser import parse_predicate
+
+    for _ in range(4):  # filtered, never pruned: ZORDER evidence
+        journal.record_scan(log_path, report_dict={
+            "filesTotal": 8, "filesScanned": 8, "rowGroupsTotal": 8},
+            predicate=parse_predicate("v = 2"))
+    adv = advise(tmp_table)
+    assert [r for r in adv.recommendations
+            if r.kind == "ZORDER" and r.target == "v"]
+
+    with _quiet_conf():
+        time.sleep(0.1)
+        rep = autopilot.run_once(tmp_table)
+    zorder = [o for o in rep.outcomes if o["action"] == "ZORDER:v"]
+    assert zorder and zorder[0]["status"] == "executed"
+    # longitudinal action: realized effect pending until fresh scans land
+    assert zorder[0]["audit"]["verdict"] == "pending"
+    assert zorder[0]["audit"]["predicted"]["pruningMissRate"] == 1.0
+
+    adv2 = advise(tmp_table)
+    assert not [r for r in adv2.recommendations
+                if r.kind == "ZORDER" and r.target == "v"]
+    sup = adv2.facts["autopilot"]["suppressed"]
+    assert any(s["remedy"] == "ZORDER" and s["target"] == "v" for s in sup)
+
+
+# -- guardrails --------------------------------------------------------------
+
+
+def test_cost_cap_aborts_over_budget_optimize(tmp_table):
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    v_before = t.delta_log.update().version
+    with _quiet_conf(**{"delta.tpu.autopilot.maxBytesPerRun": 1}):
+        time.sleep(0.1)
+        rep = autopilot.run_once(tmp_table)
+    opt = next(o for o in rep.outcomes if o["action"] == "OPTIMIZE")
+    assert opt["status"] == "skipped"
+    assert "cost cap" in opt["result"]["reason"]
+    assert opt["result"]["metrics"]["estBytes"] > 1
+    assert opt["result"]["metrics"]["capBytes"] == 1
+    # journaled SKIPPED outcome, and no commit happened
+    skipped = [e for e in _ledger(tmp_table) if e["phase"] == "skipped"]
+    assert skipped and skipped[0]["action"]["kind"] == "OPTIMIZE"
+    assert t.delta_log.update().version == v_before
+
+
+def test_quiet_window_defers_then_force_executes(tmp_table):
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    # default 60s window: the seeding commits are fresh, so NOT quiet
+    with conf.set_temporarily(**{"delta.tpu.autopilot.dryRun": False}):
+        rep = autopilot.run_once(tmp_table)
+        assert rep.status == "deferred"
+        assert rep.quiet["quiet"] is False
+        assert rep.quiet["recentCommits"] > 0
+        assert rep.outcomes == []
+        deferred = [e for e in _ledger(tmp_table)
+                    if e["phase"] == "deferred"]
+        assert deferred and deferred[0]["reason"] == "window not quiet"
+        # deferral arms no cooldown; force executes NOW
+        rep2 = autopilot.run_once(tmp_table, force=True)
+    assert any(o["status"] == "executed" for o in rep2.outcomes)
+    assert t.doctor().dimension("smallFiles").severity == "ok"
+
+
+def test_contention_backoff_blocks_the_table(tmp_table):
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    log_path = t.delta_log.log_path
+    # a maintenance commit just lost to a foreground writer
+    a = MaintenanceAction(kind="OPTIMIZE", table_path=tmp_table)
+    journal.record_autopilot(log_path, "abortedContention", a.to_dict())
+    with _quiet_conf(**{"delta.tpu.autopilot.contentionBackoffMs": 60_000,
+                        # cooldown must not mask what we test: the OPTIMIZE
+                        # attempt itself is inside its cooldown too, so
+                        # check the backoff via a would-be CHECKPOINT
+                        "delta.tpu.autopilot.cooldownMs": 1}):
+        time.sleep(0.1)
+        rep = autopilot.run_once(tmp_table, force=True)
+    assert rep.status == "deferred"
+    assert rep.backoff_until_ms is not None
+    assert rep.outcomes == []
+
+
+def test_cooldown_prevents_reexecution_after_started_only_entry(tmp_table):
+    """A 'started' ledger entry with NO terminal outcome (= crashed
+    mid-action) must block re-planning — the crash-loop guard."""
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    a = MaintenanceAction(kind="OPTIMIZE", table_path=tmp_table)
+    journal.record_autopilot(t.delta_log.log_path, "started", a.to_dict())
+    rep = autopilot.run_once(tmp_table)
+    assert "OPTIMIZE" in rep.cooled
+    assert not any(p["kind"] == "OPTIMIZE" for p in rep.planned)
+
+
+def test_cooldown_survives_ledger_sweep(tmp_table):
+    """The journal's size/age sweep may evict the segment holding a
+    'started' entry mid-cooldown; the sweep-proof sidecar must keep the
+    cooldown armed anyway."""
+    import os
+
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    log_path = t.delta_log.log_path
+    a = MaintenanceAction(kind="OPTIMIZE", table_path=tmp_table)
+    assert journal.record_autopilot(log_path, "started", a.to_dict())
+    assert journal.record_attempt(log_path, a.key, "started",
+                                  int(time.time() * 1000))
+    # simulate the sweep taking every ledger segment
+    jdir = journal.journal_dir(log_path)
+    journal.flush(log_path)
+    journal.reset()
+    for n in os.listdir(jdir):
+        if n.startswith(journal.SEGMENT_PREFIX):
+            os.remove(os.path.join(jdir, n))
+    assert journal.read_entries(log_path, kinds=["autopilot"]) == []
+    blocked = planner_mod.cooldown_blocked([], int(time.time() * 1000),
+                                           log_path=log_path)
+    assert "OPTIMIZE" in blocked
+    assert blocked["OPTIMIZE"]["source"] == "stateFile"
+    rep = autopilot.run_once(tmp_table)
+    assert "OPTIMIZE" in rep.cooled
+
+
+def test_degraded_journal_refuses_to_execute(tmp_table):
+    """An unwritable journal directory cannot arm a cooldown — the
+    autopilot must skip the action (ledgerUnwritable), not execute with a
+    crash-loop window open. (A plain file squatting on the _journal path
+    makes every segment/sidecar write fail, even for root.)"""
+    import os
+    import shutil
+
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    log_path = t.delta_log.log_path
+    v_before = t.delta_log.update().version
+    jdir = journal.journal_dir(log_path)
+    journal.flush(log_path)
+    journal.reset()
+    shutil.rmtree(jdir, ignore_errors=True)
+    with open(jdir, "w") as f:  # a FILE where the journal dir must go
+        f.write("squatter")
+    try:
+        with _quiet_conf():
+            time.sleep(0.1)
+            rep = autopilot.run_once(tmp_table, force=True)
+    finally:
+        os.remove(jdir)
+    assert rep.planned  # it still planned (journal conf is on)...
+    assert rep.outcomes  # ...but refused to execute anything
+    assert all(o["status"] == "skipped"
+               and o["reason"] == "ledgerUnwritable" for o in rep.outcomes)
+    assert t.delta_log.update().version == v_before
+
+
+def test_run_budget_skips_remaining_actions(tmp_table):
+    t = _debt_table(tmp_table, appends=24)
+    with _quiet_conf(**{"delta.tpu.autopilot.budgetMs": 0}):
+        time.sleep(0.1)
+        rep = autopilot.run_once(tmp_table)
+    assert rep.planned
+    assert all(o["status"] == "skipped" and o["reason"] == "runBudget"
+               for o in rep.outcomes)
+    skipped = [e for e in _ledger(tmp_table) if e["phase"] == "skipped"]
+    assert skipped and "budget" in skipped[0]["reason"]
+
+
+def test_journal_disabled_refuses_to_act(tmp_table):
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    v_before = t.delta_log.update().version
+    with conf.set_temporarily(**{"delta.tpu.autopilot.dryRun": False,
+                                 "delta.tpu.journal.enabled": False}):
+        rep = autopilot.run_once(tmp_table, force=True)
+    assert rep.status == "journal disabled"
+    assert rep.planned == [] and rep.outcomes == []
+    assert t.delta_log.update().version == v_before
+
+
+# -- maintenance commits lose gracefully -------------------------------------
+
+
+def test_commit_attempts_cap_loses_gracefully(tmp_table):
+    """Under commit_attempts_cap a racing commit exhausts as
+    CommitAttemptsExhausted instead of retrying 10M times; without the cap
+    the same race retries and wins."""
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.txn.transaction import commit_attempts_cap
+
+    t = DeltaTable.create(tmp_table, data=_ids(8))
+    log = t.delta_log
+
+    def _racing_txn():
+        txn = log.start_transaction()
+        # a foreground writer lands a version before we commit
+        WriteIntoDelta(DeltaLog(tmp_table), "append", _ids(8, 500)).run()
+        return txn
+
+    txn = _racing_txn()
+    with commit_attempts_cap(1):
+        with pytest.raises(errors.CommitAttemptsExhausted):
+            from delta_tpu.commands import operations as ops
+
+            txn.commit([], ops.Optimize(predicate=[]))
+    # same race, no cap: the retry loop absorbs it
+    txn2 = _racing_txn()
+    from delta_tpu.commands import operations as ops
+
+    assert txn2.commit([], ops.Optimize(predicate=[])) >= 0
+
+
+def test_attempts_cap_never_leaks_to_stamped_foreground_txns():
+    """A group-commit leader running inside a maintenance cap processes
+    foreground batchmates: their txn stamp (None = uncapped) is
+    authoritative, the leader thread's contextvar must not apply."""
+    from delta_tpu.txn import transaction as txn_mod
+
+    class _Stamped:
+        _attempts_cap = None  # a foreground member: commit() stamped None
+
+    limit = conf.get("delta.tpu.maxCommitAttempts")
+    with txn_mod.commit_attempts_cap(3):
+        assert txn_mod.effective_max_commit_attempts(_Stamped()) == limit
+        # the maintenance thread's own (unstamped) context stays capped
+        assert txn_mod.effective_max_commit_attempts(None) == 3
+    assert txn_mod.effective_max_commit_attempts(None) == limit
+
+
+def test_executor_classifies_contention(tmp_table):
+    """An executor-level conflict comes back as abortedContention and bumps
+    the contention counter (no retry storm: attempts were capped)."""
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    real_run = executor_mod._run_optimize
+
+    def _losing_run(*a, **kw):
+        raise errors.CommitAttemptsExhausted("lost the race (test)")
+
+    executor_mod._run_optimize = _losing_run
+    try:
+        res = executor_mod.execute(
+            t.delta_log,
+            MaintenanceAction(kind="OPTIMIZE", table_path=tmp_table),
+            attempts_cap=1)
+    finally:
+        executor_mod._run_optimize = real_run
+    assert res.status == "abortedContention"
+    assert "foreground" in res.reason
+    assert telemetry.counters("autopilot.contentionAborts")
+
+
+# -- crash consistency (fault injection) -------------------------------------
+
+
+def test_contention_abort_halts_the_rest_of_the_run(tmp_table):
+    """One lost maintenance commit backs the whole table off IN-RUN: the
+    remaining planned actions defer instead of racing the same writers."""
+    t = _debt_table(tmp_table, appends=24)  # CHECKPOINT + OPTIMIZE plan
+    real_run = executor_mod._run_checkpoint
+
+    def _losing_run(*a, **kw):
+        raise errors.CommitAttemptsExhausted("lost the race (test)")
+
+    executor_mod._run_checkpoint = _losing_run  # first action in the plan
+    try:
+        with _quiet_conf():
+            time.sleep(0.1)
+            rep = autopilot.run_once(tmp_table, force=True)
+    finally:
+        executor_mod._run_checkpoint = real_run
+    statuses = {o["action"]: o["status"] for o in rep.outcomes}
+    assert statuses["CHECKPOINT"] == "abortedContention"
+    assert statuses["OPTIMIZE"] == "deferred"
+    # and the armed backoff blocks the NEXT pass too
+    with _quiet_conf():
+        rep2 = autopilot.run_once(tmp_table, force=True)
+    assert rep2.status == "deferred" and rep2.backoff_until_ms
+
+
+def test_simulated_crash_mid_maintenance(tmp_table):
+    """A SimulatedCrash inside the maintenance commit: the table stays
+    consistent, the interrupted action is journaled, and the cooldown
+    prevents crash-loop re-execution on the restarted process."""
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    rows_before = sorted(t.to_arrow(columns=["id"]).column("id").to_pylist())
+    plan = FaultPlan(script=[("write.commit", "crash_before_publish")])
+    with _quiet_conf(**{"delta.tpu.faults.plan": plan}):
+        time.sleep(0.1)
+        with pytest.raises(SimulatedCrash):
+            autopilot.run_once(tmp_table)
+
+    # the restarted process: fresh log over whatever the crash left
+    DeltaLog.invalidate_cache(tmp_table)
+    t2 = DeltaTable.for_path(tmp_table)
+    rows_after = sorted(
+        t2.to_arrow(columns=["id"]).column("id").to_pylist())
+    assert rows_after == rows_before  # no row lost, none duplicated
+
+    phases = Counter(e["phase"] for e in _ledger(tmp_table))
+    assert phases["started"] == 1
+    assert phases["interrupted"] == 1
+    assert phases.get("executed", 0) == 0
+
+    # crash-loop guard: the restarted autopilot cooldown-filters the action
+    with _quiet_conf():
+        rep = autopilot.run_once(tmp_table, force=True)
+    assert "OPTIMIZE" in rep.cooled
+    assert not any(o["action"].startswith("OPTIMIZE")
+                   for o in rep.outcomes)
+
+
+def test_torture_with_autopilot_tier1(tmp_path):
+    """Fixed-seed torture subset with the autopilot in the mix: all PR 5
+    invariants hold across crashes, and no action key is ever ATTEMPTED
+    twice inside its cooldown window (crash-loop guard, ledger-verified)."""
+    from delta_tpu.testing.harness import run_torture
+
+    path = str(tmp_path / "torture")
+    report = run_torture(path, seed=42, steps=100, autopilot=True)
+    assert report.op_counts.get("autopilot", 0) >= 2
+    assert report.invariant_checks >= 10
+    entries = journal.read_entries(path + "/_delta_log",
+                                   kinds=["autopilot"])
+    phases = Counter(e["phase"] for e in entries)
+    assert phases["started"] >= 1  # maintenance really ran under faults
+    # every started has a terminal sibling or the run crashed right there —
+    # and attempts per action key never violate the cooldown
+    cooldown_ms = 2000  # harness default autopilot_cooldown_ms
+    attempts = {}
+    for e in entries:
+        if e["phase"] not in planner_mod.COOLDOWN_PHASES:
+            continue
+        key = e["action"]["kind"] + (
+            ":" + e["action"]["target"] if e["action"].get("target") else "")
+        ts = e["ts"]
+        prev = attempts.get(key)
+        # "started" + its terminal entry share one attempt window; compare
+        # only across distinct started markers
+        if e["phase"] == "started" and prev is not None:
+            assert ts - prev >= cooldown_ms, (
+                f"{key} re-attempted {ts - prev}ms after the last attempt")
+        if e["phase"] == "started":
+            attempts[key] = ts
+
+
+# -- EVICT / RECALIBRATE (process-local actions) -----------------------------
+
+
+def test_evict_and_recalibrate_execute(tmp_table):
+    t = DeltaTable.create(tmp_table, data=_ids(8))
+    res = executor_mod.execute(
+        t.delta_log, MaintenanceAction(kind="EVICT", table_path=tmp_table))
+    assert res.status == "executed"
+    assert res.metrics["pressureApplied"] is False  # no budget configured
+    res = executor_mod.execute(
+        t.delta_log,
+        MaintenanceAction(kind="RECALIBRATE", table_path=tmp_table))
+    assert res.status == "executed"
+    assert res.metrics["calibrationEnabled"] is False
+    assert res.metrics["constantsInstalled"] == 0
+
+
+def test_planner_plans_evict_under_hbm_pressure(tmp_table):
+    from delta_tpu.obs import hbm_ledger
+
+    t = DeltaTable.create(tmp_table, data=_ids(8))
+    hbm_ledger.adjust("keyCache", 1000)
+    try:
+        with conf.set_temporarily(
+                **{"delta.tpu.device.hbmBudgetBytes": 100}):
+            doc = t.doctor()
+            assert doc.dimension("device").severity != "ok"
+            plan = planner_mod.plan(doc, advise(tmp_table))
+        assert any(a.kind == "EVICT" for a in plan)
+    finally:
+        hbm_ledger.reset()
+
+
+# -- daemon ------------------------------------------------------------------
+
+
+def test_daemon_is_opt_in_and_ticks(tmp_table):
+    with pytest.raises(errors.DeltaIllegalStateError, match="opt-in"):
+        autopilot.Autopilot()
+    _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    with conf.set_temporarily(**{"delta.tpu.autopilot.enabled": True,
+                                 "delta.tpu.autopilot.intervalMs": 50}):
+        pilot = autopilot.Autopilot(tables=[tmp_table]).start()
+        try:
+            assert pilot.running
+            deadline = time.monotonic() + 10
+            while (tmp_table not in autopilot.last_runs()
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+        finally:
+            pilot.stop()
+        assert not pilot.running
+    run = autopilot.last_runs()[tmp_table]
+    assert run["dryRun"] is True  # dry-run posture holds in the daemon
+    assert run["planned"], "the daemon pass planned the seeded debt"
+    st = autopilot.status()
+    assert st["dryRun"] is True and st["daemonRunning"] is False
+    assert st["guardrails"]["maxCommitAttempts"] == 3
+    json.dumps(st)
+
+
+def test_one_table_at_a_time_lock(tmp_table):
+    _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    from delta_tpu.autopilot import daemon as daemon_mod
+
+    assert daemon_mod._EXEC_LOCK.acquire(blocking=False)
+    try:
+        with _quiet_conf():
+            time.sleep(0.1)
+            rep = autopilot.run_once(tmp_table, force=True)
+    finally:
+        daemon_mod._EXEC_LOCK.release()
+    assert rep.status == "busy"
+    assert rep.outcomes == []
+
+
+# -- surfaces: HTTP route + dump tool ----------------------------------------
+
+
+def test_autopilot_http_route(tmp_table):
+    from delta_tpu.obs.server import ObsServer
+
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    a = MaintenanceAction(kind="OPTIMIZE", table_path=tmp_table)
+    journal.record_autopilot(t.delta_log.log_path, "planned", a.to_dict(),
+                             dryRun=True)
+    server = ObsServer(port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        conn.request("GET", "/autopilot")
+        body = json.loads(conn.getresponse().read())
+        assert body["enabled"] is False and body["dryRun"] is True
+        assert "guardrails" in body and "ledger" not in body
+        conn.request("GET", f"/autopilot?path={tmp_table}&limit=10")
+        body = json.loads(conn.getresponse().read())
+        assert body["ledger"] and body["ledger"][-1]["phase"] == "planned"
+        assert body["ledger"][-1]["action"]["kind"] == "OPTIMIZE"
+        # malformed limit degrades, never a 500
+        conn.request("GET", f"/autopilot?path={tmp_table}&limit=bogus")
+        assert conn.getresponse().status == 200
+    finally:
+        server.stop()
+
+
+def test_journal_dump_autopilot_flag(tmp_table, capsys):
+    import tools.journal_dump as dump
+
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    with _quiet_conf():
+        time.sleep(0.1)
+        autopilot.run_once(tmp_table)
+    assert dump.main([tmp_table, "--autopilot"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["entries"] >= 3  # planned + started + executed at minimum
+    assert out["byPhase"].get("executed", 0) >= 1
+    assert out["executedVerdicts"].get("improved", 0) >= 1
+    kinds = {e["action"]["kind"] for e in out["ledger"]}
+    assert "OPTIMIZE" in kinds
+
+
+# -- blackout / counters -----------------------------------------------------
+
+
+def test_counters_and_gauge_are_cataloged(tmp_table):
+    from delta_tpu.obs import metric_names
+
+    _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    with _quiet_conf():
+        time.sleep(0.1)
+        autopilot.run_once(tmp_table)
+    for name in ("autopilot.runs", "autopilot.actions.planned",
+                 "autopilot.actions.executed"):
+        assert name in metric_names.COUNTERS
+        assert telemetry.counters(name), name
+    assert "autopilot.lastRunTimestamp" in metric_names.GAUGES
+    assert telemetry.gauges("autopilot.lastRunTimestamp")
+
+
+def test_optimize_budget_exceeded_is_pre_io(tmp_table):
+    """The cost cap aborts before any file is read or written: no parquet
+    file appears and no commit lands."""
+    import os
+
+    from delta_tpu.commands.optimize import (OptimizeBudgetExceeded,
+                                             OptimizeCommand)
+
+    t = _debt_table(tmp_table, appends=20, checkpoint_interval="10")
+    v = t.delta_log.update().version
+    files_before = {f for f in os.listdir(tmp_table) if f.endswith(".parquet")}
+    with pytest.raises(OptimizeBudgetExceeded) as ei:
+        OptimizeCommand(t.delta_log, max_rewrite_bytes=1).run()
+    assert ei.value.est_bytes > ei.value.cap_bytes == 1
+    assert ei.value.files >= 16
+    assert t.delta_log.update().version == v
+    assert {f for f in os.listdir(tmp_table)
+            if f.endswith(".parquet")} == files_before

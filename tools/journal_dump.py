@@ -10,9 +10,13 @@ or runs the layout advisor over it::
     python tools/journal_dump.py /data/tbl --limit 20       # last N
     python tools/journal_dump.py /data/tbl --summary        # counts per kind
     python tools/journal_dump.py /data/tbl --advise         # advisor report
+    python tools/journal_dump.py /data/tbl --autopilot      # action ledger
 
-Entries print one JSON object per line (pipe into ``jq``); ``--advise`` and
-``--summary`` print one indented JSON document.
+Entries print one JSON object per line (pipe into ``jq``); ``--advise``,
+``--summary`` and ``--autopilot`` print one indented JSON document —
+``--autopilot`` renders the maintenance action ledger (planned / executed
+/ skipped / deferred actions with their cited evidence and the
+predicted-vs-realized audit verdicts).
 """
 from __future__ import annotations
 
@@ -28,7 +32,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("table", help="table data path (the dir holding _delta_log)")
-    ap.add_argument("--kind", choices=["scan", "commit", "dml", "router"],
+    ap.add_argument("--kind",
+                    choices=["scan", "commit", "dml", "router", "autopilot"],
                     help="only entries of this kind")
     ap.add_argument("--limit", type=int, default=None,
                     help="last N entries (after kind filtering)")
@@ -36,11 +41,30 @@ def main(argv=None) -> int:
                     help="print per-kind counts + segment stats instead of entries")
     ap.add_argument("--advise", action="store_true",
                     help="run the layout advisor and print its report")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="print the autopilot action ledger (planned/"
+                         "executed/skipped actions + realized-improvement "
+                         "verdicts)")
     args = ap.parse_args(argv)
 
     from delta_tpu.obs import journal
 
     log_path = os.path.join(args.table.rstrip("/"), "_delta_log")
+    if args.autopilot:
+        entries = journal.read_entries(log_path, kinds=["autopilot"],
+                                       limit=args.limit)
+        by_phase = Counter(e.get("phase", "?") for e in entries)
+        verdicts = Counter(
+            (e.get("audit") or {}).get("verdict")
+            for e in entries if e.get("phase") == "executed")
+        print(json.dumps({
+            "table": args.table,
+            "entries": len(entries),
+            "byPhase": dict(by_phase),
+            "executedVerdicts": {k: v for k, v in verdicts.items() if k},
+            "ledger": entries,
+        }, indent=1, default=str))
+        return 0
     if args.advise:
         from delta_tpu.obs.advisor import advise
 
