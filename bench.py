@@ -424,6 +424,142 @@ def bench_zorder_point_query(workdir):
     }
 
 
+# -- config 10: predicate pushdown synthesis ---------------------------------
+
+
+def bench_pushdown(workdir):
+    """2M-row table, arithmetic + string + cast predicate suite: files and
+    row groups pruned, bytes skipped, and planning ms with predicate
+    synthesis ON vs OFF (`delta.tpu.read.predicateSynthesis`), result
+    identity asserted on every query. Headline: planning-bytes-skipped
+    (file tier + row-group tier) ratio on/off — these shapes paid full
+    scans before the synthesis layer, so OFF skips ~nothing."""
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.obs import scan_report
+    from delta_tpu.utils.config import conf as _c
+
+    n = _rows(2_000_000)
+    ids = np.arange(n, dtype=np.int64)
+    rng = np.random.RandomState(11)
+    regions = np.array(["us-w", "us-e", "eu-c", "eu-w",
+                        "ap-s", "ap-n", "sa-e", "af-s"])
+    # region index correlates with row order → prefixes cluster per file,
+    # like a region-loaded ingest; prices sorted → tight per-file bounds
+    region_ix = (ids * len(regions)) // n
+    sym = np.char.add(np.char.add(regions[region_ix], "-"),
+                      np.char.zfill(ids.astype("U10"), 10))
+    base_us = 1_600_000_000_000_000
+    data = pa.table({
+        "id": ids,
+        "price": ids,
+        "qty": rng.randint(1, 8, n).astype(np.int64),
+        "sym": pa.array(sym),
+        "ts": pa.array(base_us + ids * 60_000_000, pa.timestamp("us")),
+    })
+    path = os.path.join(workdir, "c10")
+    log = DeltaLog.for_table(path)
+    with _c.set_temporarily(**{
+        "delta.tpu.write.targetFileRows": max(n // 16, 1000),
+        "delta.tpu.write.rowGroupRows": max(n // 128, 500),
+    }):
+        WriteIntoDelta(log, "append", data).run()
+    total_bytes = _dir_bytes(path)
+    hi = int(0.97 * n)
+    day = (base_us + int(0.98 * n) * 60_000_000) // 86_400_000_000
+    import datetime as _dt
+
+    day_s = (_dt.date(1970, 1, 1) + _dt.timedelta(days=int(day))).isoformat()
+    queries = [
+        ("arith_mul", f"price * qty > {hi * 7}"),
+        ("arith_chain", f"price * 2 + 10 >= {2 * hi}"),
+        ("arith_div", f"(price - {n // 2}) / 4 >= {int(0.115 * n)}"),
+        ("string_substr", "substr(sym, 1, 4) = 'af-s'"),
+        ("string_like", "sym like 'us-w000000%'"),
+        ("cast_double", f"cast(price as double) * 1.5 >= {1.5 * hi}"),
+        ("temporal_to_date", f"to_date(ts) = '{day_s}'"),
+        ("not_cmp", f"not (price < {hi})"),
+    ]
+    t = DeltaTable.for_path(path)
+    t.to_arrow(filters=[queries[0][1]])  # warm footers + compiles
+
+    def run_suite(enabled):
+        out = {}
+        with _c.set_temporarily(**{
+            "delta.tpu.read.predicateSynthesis": enabled,
+        }):
+            for name, q in queries:
+                t0 = time.perf_counter()
+                result = t.to_arrow(filters=[q])
+                wall_s = time.perf_counter() - t0
+                rep = scan_report.last_scan_report()
+                out[name] = {
+                    "rows": result.num_rows,
+                    "id_sum": int(np.asarray(result.column("id")).sum()),
+                    "files_pruned": rep.files_pruned,
+                    "rowgroups_pruned": rep.row_groups_pruned,
+                    "rowgroups_late_skipped": rep.row_groups_late_skipped,
+                    # planning-skipped = file tier (compressed bytes never
+                    # read) + row-group PLANNER tier (groups never opened);
+                    # late materialization is decode-time, not planning
+                    "bytes_skipped": (total_bytes - rep.bytes_read)
+                    + rep.bytes_skipped_planned,
+                    "planning_ms": rep.phase_ms.get("planning", 0),
+                    "wall_ms": round(wall_s * 1000, 1),
+                    "rewrites_fired": len(rep.rewrites_fired),
+                }
+        return out
+
+    off = run_suite(False)
+    on = run_suite(True)
+    for name, _q in queries:
+        # result identity on every query: synthesis may only change what
+        # decodes, never what returns
+        assert on[name]["rows"] == off[name]["rows"], name
+        assert on[name]["id_sum"] == off[name]["id_sum"], name
+    skipped_on = sum(v["bytes_skipped"] for v in on.values())
+    skipped_off = sum(v["bytes_skipped"] for v in off.values())
+    ratio = skipped_on / max(skipped_off, 1)
+    plan_on = sorted(v["planning_ms"] for v in on.values())
+    plan_off = sorted(v["planning_ms"] for v in off.values())
+    return {
+        "metric": "pushdown_synthesis_bytes_skipped_ratio",
+        "value": round(ratio, 1),
+        "unit": "x",
+        "vs_baseline": round(ratio, 1),
+        "baseline": "same suite with delta.tpu.read.predicateSynthesis="
+                    "false (pre-synthesis engine: these shapes never prune)",
+        "rows": n,
+        "bytes_skipped_on": skipped_on,
+        "bytes_skipped_off": skipped_off,
+        "files_pruned_on": sum(v["files_pruned"] for v in on.values()),
+        "files_pruned_off": sum(v["files_pruned"] for v in off.values()),
+        "rowgroups_pruned_on": sum(v["rowgroups_pruned"] for v in on.values()),
+        "rowgroups_pruned_off": sum(v["rowgroups_pruned"]
+                                    for v in off.values()),
+        "rewrites_fired": sum(v["rewrites_fired"] for v in on.values()),
+        "planning_ms_on_p50": plan_on[len(plan_on) // 2],
+        "planning_ms_off_p50": plan_off[len(plan_off) // 2],
+        "queries": {name: {"on": on[name], "off": off[name]}
+                    for name, _q in queries},
+        # direction-aware sub-metrics for the --compare gate
+        "gate": {
+            "bytes_skipped_ratio": {"value": round(ratio, 1), "unit": "x"},
+            "files_pruned_on": {
+                "value": sum(v["files_pruned"] for v in on.values()),
+                "unit": "files"},
+            "rowgroups_pruned_on": {
+                "value": sum(v["rowgroups_pruned"] for v in on.values()),
+                "unit": "rowgroups"},
+            "planning_ms_on_p50": {
+                "value": plan_on[len(plan_on) // 2], "unit": "ms"},
+        },
+    }
+
+
 # -- config 4: streaming tail of a 1k-commit log -----------------------------
 
 
@@ -1552,6 +1688,7 @@ def main():
         "9": lambda: bench_commit_contention(workdir),
         "6": lambda: bench_hot_plan(workdir),
         "6p": lambda: bench_hot_plan(workdir, partitioned=True),
+        "10": lambda: bench_pushdown(workdir),
         "8": lambda: bench_resident_probe(workdir),
         "5": lambda: bench_checkpoint_replay(workdir),
         "3": lambda: bench_zorder_point_query(workdir),
@@ -1614,8 +1751,8 @@ def main():
                 # ledger per round
                 out["telemetry"] = telemetry.bench_snapshot(
                     include=("scan.rowgroups", "scan.bytes.skipped",
-                             "footerCache", "table.health", "router",
-                             "device.hbm", "journal", "advisor"),
+                             "scan.rewrites", "footerCache", "table.health",
+                             "router", "device.hbm", "journal", "advisor"),
                 )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass

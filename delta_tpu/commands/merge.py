@@ -700,7 +700,7 @@ class MergeIntoCommand:
                         via = "device-upload"
                     else:
                         self._router.setdefault("reason", "upload-declined")
-        self.phase_ms["key_decode_ms"] = decode_t.lap_ms()
+        self.phase_ms["key_decode_ms"] = decode_t.lap_ms_f()
 
         # full-column decode (overlaps the in-flight device probe); when the
         # key projection already covers every needed column, reuse it (the
@@ -731,7 +731,7 @@ class MergeIntoCommand:
             row_base += t.num_rows
             tgt_tables[fid] = t
             pieces.append(t)
-        self.phase_ms["decode_ms"] = decode_t.lap_ms()
+        self.phase_ms["decode_ms"] = decode_t.lap_ms_f()
         if not pieces:
             empty = pa.schema(
                 [pa.field(_TID, pa.int64()), pa.field(_FID, pa.int64())]
@@ -778,7 +778,7 @@ class MergeIntoCommand:
                     )
                     for name in s_taken.column_names:
                         joined = joined.append_column(name, s_taken.column(name))
-                self.phase_ms["join_ms"] = join_t.lap_ms()
+                self.phase_ms["join_ms"] = join_t.lap_ms_f()
                 return joined, tgt_tables
 
         if equi:
@@ -841,11 +841,11 @@ class MergeIntoCommand:
                     pieces.append(piece.combine_chunks())
             joined = (pa.concat_tables(pieces).combine_chunks()
                       if pieces else empty_pairs())
-            self.phase_ms["join_ms"] = join_t.lap_ms()
+            self.phase_ms["join_ms"] = join_t.lap_ms_f()
             return joined, tgt_tables
         if residual:
             joined = joined.filter(boolean_mask(ir.and_all(residual), joined))
-        self.phase_ms["join_ms"] = join_t.lap_ms()
+        self.phase_ms["join_ms"] = join_t.lap_ms_f()
         return joined, tgt_tables
 
     def _referenced_target_columns(

@@ -35,7 +35,7 @@ import math
 import os
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclass_field
 from typing import Any, Dict, FrozenSet, List, Optional, Sequence
 
 import numpy as np
@@ -229,11 +229,15 @@ def _rg_env(meta, i: int, col_index: Dict[str, int],
 @dataclass
 class RowGroupPlan:
     """Surviving row groups of one file. ``skipped_bytes`` is the
-    uncompressed size of the pruned groups (the decode work avoided)."""
+    uncompressed size of the pruned groups (the decode work avoided);
+    ``fired`` lists the synthesized rewrites that individually excluded at
+    least one pruned group (family + conjunct/rewrite shape fingerprints)
+    for ``ScanReport.rewritesFired`` attribution."""
 
     keep: List[int]
     total: int
     skipped_bytes: int = 0
+    fired: List[Dict[str, str]] = dataclass_field(default_factory=list)
 
 
 def plan_row_groups(
@@ -241,35 +245,68 @@ def plan_row_groups(
     predicate: ir.Expression,
     part_row: Optional[Dict[str, Any]] = None,
     partition_cols: FrozenSet[str] = frozenset(),
+    types: Optional[Dict[str, Any]] = None,
+    rewrites: Optional[List] = None,
 ) -> RowGroupPlan:
     """Evaluate ``predicate`` against each row group's footer statistics;
     a group survives unless the rewritten can-match predicate is definitely
-    False. Single-group files short-circuit: the file tier already ruled."""
-    from delta_tpu.ops.pruning import skipping_predicate
+    False. Single-group files short-circuit: the file tier already ruled.
+    ``types`` (lowercased column name → schema DataType) arms the predicate
+    synthesis fallback for arithmetic/string/temporal shapes — the SAME
+    shared rewrite the file tier evaluates, so both tiers keep one
+    conservativeness story. ``rewrites`` short-circuits the rewrite: a
+    scan-constant ``conjunct_rewrites(...)`` list computed ONCE by the
+    caller (the per-file decode loop must not re-derive it per footer)."""
+    from delta_tpu.expr import synthesis
+    from delta_tpu.ops.pruning import conjunct_rewrites, skipping_predicate
 
     n = meta.num_row_groups
     all_groups = list(range(n))
     if n <= 1:
         return RowGroupPlan(all_groups, n)
-    rewritten = skipping_predicate(predicate, partition_cols)
+    if rewrites is None and types is not None:
+        rewrites = conjunct_rewrites([predicate], partition_cols, types)
+    if rewrites is not None:
+        rewritten = ir.and_all([r.rewritten for r in rewrites])
+    else:
+        rewritten = skipping_predicate(predicate, partition_cols)
     if isinstance(rewritten, ir.Literal) and rewritten.value is None:
         return RowGroupPlan(all_groups, n)  # nothing lowerable: keep all
     col_index = _column_index(meta)
     float_leaves = _float_leaves(meta, col_index)
     keep: List[int] = []
     skipped_bytes = 0
+    pruned_envs: List[_StatsEnv] = []
     for i in all_groups:
+        env = _rg_env(meta, i, col_index, float_leaves, part_row)
         try:
-            verdict = rewritten.eval(
-                _rg_env(meta, i, col_index, float_leaves, part_row)
-            )
+            verdict = rewritten.eval(env)
         except Exception:
             verdict = None  # uncomparable stats value vs literal: keep
         if verdict is False:
             skipped_bytes += meta.row_group(i).total_byte_size
+            pruned_envs.append(env)
         else:
             keep.append(i)
-    return RowGroupPlan(keep, n, skipped_bytes)
+    fired: List[Dict[str, str]] = []
+    if pruned_envs and rewrites is not None:
+        for r in rewrites:
+            if not r.synthesized:
+                continue
+            if any(_safe_false(r.rewritten, env) for env in pruned_envs):
+                fired.append({
+                    "family": r.family or "other",
+                    "conjunct": synthesis.shape(r.conjunct),
+                    "rewrite": synthesis.shape(r.rewritten),
+                })
+    return RowGroupPlan(keep, n, skipped_bytes, fired)
+
+
+def _safe_false(expr: ir.Expression, env: _StatsEnv) -> bool:
+    try:
+        return expr.eval(env) is False
+    except Exception:
+        return False
 
 
 def row_group_offsets(meta) -> np.ndarray:

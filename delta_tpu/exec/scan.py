@@ -123,7 +123,22 @@ def read_files_as_table(
         if predicate is not None
         else frozenset()
     )
+    if predicate is not None:
+        from delta_tpu.expr.synthesis import schema_types
+
+        # arms predicate synthesis in the row-group planner (the shared
+        # skipping rewrite needs declared column types to gate its rules)
+        pred_types = schema_types(metadata)
+    else:
+        pred_types = None
+    pred_rewrites = None
     pcols_lower = frozenset(c.lower() for c in part_cols)
+    if pred_types is not None:
+        from delta_tpu.ops.pruning import conjunct_rewrites
+
+        # scan-constant: computed ONCE here, not per file in the decode pool
+        pred_rewrites = conjunct_rewrites([predicate], pcols_lower,
+                                          pred_types)
     pos_hints = list(positions_of_interest) if positions_of_interest else None
     # per-file (rgTotal, rgPruned, rgLateSkipped, bytesSkipped) — summed
     # into counters/span attributes after the pool drains
@@ -280,14 +295,17 @@ def read_files_as_table(
             n_rg = meta.num_row_groups
             keep_idx = list(range(n_rg))
             skipped_bytes = 0
+            plan_fired: list = []
             if predicate is not None and n_rg > 1:
                 part_row = (
                     typed_partition_row(add, part_schema) if part_cols else None
                 )
                 plan = rowgroups.plan_row_groups(
-                    meta, predicate, part_row, pcols_lower
+                    meta, predicate, part_row, pcols_lower, pred_types,
+                    rewrites=pred_rewrites,
                 )
                 keep_idx, skipped_bytes = plan.keep, plan.skipped_bytes
+                plan_fired = plan.fired
             if pos_hint is not None:
                 wanted = rowgroups.row_groups_for_positions(meta, pos_hint)
                 for i in keep_idx:
@@ -304,10 +322,11 @@ def read_files_as_table(
                     abs_path, meta, keep_idx, add, need_positions
                 )
                 rg_stats.append(
-                    (n_rg, pruned, late_n, skipped_bytes + late_bytes)
+                    (n_rg, pruned, late_n, skipped_bytes, late_bytes,
+                     plan_fired)
                 )
             else:
-                rg_stats.append((n_rg, 0, 0, 0))
+                rg_stats.append((n_rg, 0, 0, 0, 0, ()))
         if t is None:
             # full decode — the seed path; reuse the already-parsed footer
             # when the planner fetched one.
@@ -418,7 +437,8 @@ def read_files_as_table(
             rg_total = sum(s[0] for s in rg_stats)
             rg_pruned = sum(s[1] for s in rg_stats)
             rg_late = sum(s[2] for s in rg_stats)
-            bytes_skipped = sum(s[3] for s in rg_stats)
+            planned_bytes = sum(s[3] for s in rg_stats)
+            bytes_skipped = planned_bytes + sum(s[4] for s in rg_stats)
             telemetry.bump_counter("scan.rowgroups.total", rg_total)
             if rg_pruned:
                 telemetry.bump_counter("scan.rowgroups.pruned", rg_pruned)
@@ -438,7 +458,21 @@ def read_files_as_table(
             scan_report_mod.contribute(
                 row_groups_total=rg_total, row_groups_pruned=rg_pruned,
                 row_groups_late_skipped=rg_late, bytes_skipped=bytes_skipped,
+                bytes_skipped_planned=planned_bytes,
             )
+            # fired-rewrite attribution: each synthesized conjunct that
+            # excluded a row group records ONCE per scan (the per-file
+            # planner reports per file; the report layer dedupes against
+            # the file tier too)
+            seen_fired = set()
+            for s in rg_stats:
+                for fe in s[5]:
+                    key = (fe["family"], fe["conjunct"])
+                    if key in seen_fired:
+                        continue
+                    seen_fired.add(key)
+                    scan_report_mod.record_rewrite_fired(
+                        fe["family"], fe["conjunct"], fe["rewrite"])
         if per_file:
             return pieces
         return pa.concat_tables(pieces, promote_options="permissive")
@@ -493,11 +527,15 @@ def plan_scans(
     entry = DeviceStateCache.instance().get(snapshot)
     range_ix, term_lists = [], []
     if entry is not None:
+        from delta_tpu.expr.synthesis import schema_types
+
         pcols = frozenset(c.lower() for c in snapshot.metadata.partition_columns)
+        types = schema_types(snapshot.metadata)
         for i, exprs in enumerate(parsed):
             if not exprs:
                 continue
-            rewritten = pruning.skipping_predicate(ir.and_all(list(exprs)), pcols)
+            rewritten = pruning.skipping_predicate(ir.and_all(list(exprs)),
+                                                   pcols, types)
             terms = extract_range_union(rewritten, entry.columns,
                                         entry.part_info,
                                         str_lanes=entry.str_lanes)
@@ -632,10 +670,22 @@ def scan_to_table(
                     # journal or telemetry is disabled)
                     from delta_tpu.obs import journal as journal_mod
 
+                    from delta_tpu.expr.synthesis import schema_types
+
+                    # resolve the synthesis conf NOW: the fingerprint is
+                    # computed deferred on the journal writer thread, and
+                    # the process conf may sit in a different window by
+                    # flush time (types=None = synthesis was off)
+                    fp_types = (
+                        schema_types(snapshot.metadata)
+                        if conf.get_bool(
+                            "delta.tpu.read.predicateSynthesis", True)
+                        else None)
                     journal_mod.record_scan(
                         snapshot.delta_log.log_path, report_dict=rep_dict,
                         predicate=(ir.and_all(residual) if residual else None),
                         partition_cols=snapshot.metadata.partition_columns,
+                        types=fp_types,
                     )
             scan_ok = True
             return table

@@ -5,9 +5,10 @@ the persistent workload journal (`obs/journal`) and answers the question the
 doctor cannot: *given the queries this table actually serves, what layout
 should it have?* "Only Aggressive Elephants are Fast Elephants" (PAPERS.md)
 shows metadata-layer layout tuning is safe and decisive once a workload
-trace exists to drive it; "Optimal Predicate Pushdown Synthesis" needs
+trace exists to drive it; "Optimal Predicate Pushdown Synthesis" needed
 exactly the evidence collected here — which predicate shapes never pruned
-and why — to know where rewrite synthesis (ROADMAP item 5) pays off.
+and why — and `expr/synthesis` (PR 12) now consumes it: ``neverPruned``
+splits layout vs shape vs synthesized-but-layout-bound vs stale history.
 
 :func:`advise` aggregates journal history into **workload facts** (hot
 columns by filter frequency, predicates that never pruned split by reason,
@@ -181,10 +182,31 @@ def _column_facts(scans: List[dict]) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+#: Shape-string tokens the synthesis layer (expr/synthesis) has rules for —
+#: the ``staleShape`` recognizer for journal entries recorded BEFORE the
+#: feature existed (their fingerprints carry no ``synthesizable`` field, so
+#: only the normalized shape can witness that a fresh scan would now prune).
+#: ``func(`` covers pre-r12 fingerprints, which rendered EVERY named
+#:  function as the ``Func`` class name — whether that specific function is
+#: covered can't be recovered from the legacy shape, and "fresh scans will
+#: prune it or reclassify" is exactly staleShape's promise.
+_SYNTH_SHAPE_TOKENS = ("mul(", "add(", "sub(", "div(", "mod(", "neg(",
+                       "cast(", "substr(", "substring(", "like(",
+                       "startswith(", "year(", "to_date(", "date_add(",
+                       "date_sub(", "func(")
+
+
+def _shape_synthesizable(key: str) -> bool:
+    return any(tok in key for tok in _SYNTH_SHAPE_TOKENS)
+
+
 def _never_pruned(scans: List[dict]) -> List[Dict[str, Any]]:
     """Predicate fingerprints whose scans NEVER pruned, with the reason:
-    residual-only shapes can't prune without rewrite synthesis; prunable
-    shapes that never fired point at layout (clustering), not semantics."""
+    residual-only shapes can't prune even with rewrite synthesis; prunable
+    shapes that never fired point at layout (clustering), not semantics —
+    split into base-evaluable (``layout``) vs synthesis-only
+    (``synthesizedLayout``); pre-synthesis history whose shape is now
+    covered gets ``staleShape`` instead of polluting either bucket."""
     by_key: Dict[str, Dict[str, Any]] = {}
     for e in scans:
         fp = e.get("fingerprint")
@@ -196,11 +218,21 @@ def _never_pruned(scans: List[dict]) -> List[Dict[str, Any]]:
         g = by_key.setdefault(fp["key"], {
             "fingerprint": fp["key"], "scans": 0, "pruned": 0,
             "columns": fp.get("columns") or [],
-            "prunable": bool(fp.get("prunableColumns")),
+            "prunable": False, "basePrunable": False,
+            "synthInfo": False,
             "partition": bool(conjuncts) and all(
                 c.get("partition") for c in conjuncts),
         })
         g["scans"] += 1
+        g["prunable"] = g["prunable"] or bool(fp.get("prunableColumns"))
+        for c in conjuncts:
+            if "synthesizable" in c:
+                g["synthInfo"] = True
+                if c.get("prunable") and not c.get("synthesizable"):
+                    g["basePrunable"] = True
+            elif c.get("prunable"):
+                # pre-synthesis entry: prunable meant base-evaluable
+                g["basePrunable"] = True
         if _scan_pruned(e.get("report") or {}):
             g["pruned"] += 1
     out = []
@@ -214,16 +246,30 @@ def _never_pruned(scans: List[dict]) -> List[Dict[str, Any]]:
                 "partition: pushed down at the partition tier but its "
                 "values never excluded a partition — check the value "
                 "distribution / partitioning scheme")
-        elif g["prunable"]:
+        elif g["basePrunable"]:
             g["reason"] = (
                 "layout: shape is min/max-evaluable but stats never "
                 "excluded anything — the filtered columns are not "
                 "clustered")
+        elif g["prunable"]:
+            g["reason"] = (
+                "synthesizedLayout: shape lowers only via predicate "
+                "synthesis and its rewrites never excluded anything — "
+                "the referenced columns are not clustered (layout, not "
+                "shape)")
+        elif not g["synthInfo"] and _shape_synthesizable(g["fingerprint"]):
+            g["reason"] = (
+                "staleShape: recorded before predicate synthesis covered "
+                "this shape — fresh scans will prune it or reclassify "
+                "the reason")
         else:
             g["reason"] = (
-                "shape: not min/max-evaluable — only predicate rewrite "
-                "synthesis (ROADMAP item 5) could push it down")
+                "shape: not min/max-evaluable and predicate synthesis has "
+                "no sound rewrite for it — only a residual filter can "
+                "evaluate this conjunct")
         g.pop("pruned")
+        g.pop("basePrunable")
+        g.pop("synthInfo")
         out.append(g)
     return sorted(out, key=lambda g: -g["scans"])
 

@@ -159,42 +159,8 @@ def _flush_interval_s() -> float:
 # ---------------------------------------------------------------------------
 
 
-def _shape(expr) -> str:
-    """Normalized op shape of an IR expression: class names lowered, column
-    names kept (lowercased), literals abstracted to ``?`` — so ``v = 5`` and
-    ``v = 9`` share the fingerprint ``eq(v,?)`` while ``price * qty > 1000``
-    keeps its arithmetic structure (``gt(mul(price,qty),?)``)."""
-    from delta_tpu.expr import ir
-
-    if isinstance(expr, ir.Column):
-        return expr.name.lower()
-    if isinstance(expr, ir.Literal):
-        return "?"
-    name = type(expr).__name__.lower()
-    kids = ",".join(_shape(c) for c in expr.children)
-    return f"{name}({kids})"
-
-
-def _can_exclude(rewritten) -> bool:
-    """Can the skipping rewrite of a conjunct ever evaluate to False — i.e.
-    actually exclude a row group? ``skipping_predicate`` returns
-    ``Literal(None)`` (= keep) for unsupported shapes, but And/Or recurse,
-    so an unsupported disjunction comes back as ``Or(NULL, NULL)``, not a
-    bare NULL root. Three-valued logic: an OR excludes only when BOTH
-    branches can, an AND through either; a constant leaf never depends on
-    stats, so clustering can't make it selective."""
-    from delta_tpu.expr import ir
-
-    if isinstance(rewritten, ir.Literal):
-        return False
-    if isinstance(rewritten, ir.And):
-        return _can_exclude(rewritten.left) or _can_exclude(rewritten.right)
-    if isinstance(rewritten, ir.Or):
-        return _can_exclude(rewritten.left) and _can_exclude(rewritten.right)
-    return True
-
-
-def predicate_fingerprint(predicate, partition_cols: Iterable[str] = ()
+def predicate_fingerprint(predicate, partition_cols: Iterable[str] = (),
+                          types: Optional[Dict[str, Any]] = None
                           ) -> Optional[Dict[str, Any]]:
     """Normalize a predicate into its workload fingerprint: referenced
     columns, per-conjunct op shapes, and the prunable-vs-residual split —
@@ -202,10 +168,14 @@ def predicate_fingerprint(predicate, partition_cols: Iterable[str] = ()
     (``ops.pruning.skipping_predicate``, the same one ``exec/rowgroups``
     evaluates against footer stats) lowers it to something min/max-evaluable;
     otherwise it can only run as a residual filter and no amount of
-    clustering will ever let it skip data."""
+    clustering will ever let it skip data. With ``types`` (lowercased
+    column name → schema DataType) the rewrite includes the synthesis
+    fallback, and each conjunct carries ``synthesizable``: prunable ONLY
+    thanks to a synthesized rewrite — the advisor splits never-pruned
+    evidence into layout vs shape vs synthesized-but-layout-bound with it."""
     if predicate is None:
         return None
-    from delta_tpu.expr import ir
+    from delta_tpu.expr import ir, synthesis
     from delta_tpu.ops.pruning import skipping_predicate
 
     pcols = frozenset(c.lower() for c in partition_cols)
@@ -215,14 +185,26 @@ def predicate_fingerprint(predicate, partition_cols: Iterable[str] = ()
     for c in ir.split_conjuncts(predicate):
         cols = sorted({r.lower() for r in ir.references(c)})
         try:
-            prunable = _can_exclude(skipping_predicate(c, pcols))
+            # typed but synthesis-free baseline: the NOT pushdown is a
+            # base-rule fix, so it must read prunable, not synthesizable
+            base_prunable = synthesis.can_exclude(
+                skipping_predicate(c, pcols, types, synthesize=False))
+            # synthesize=True: this runs DEFERRED on the writer thread —
+            # the conf decision was resolved at scan time (record_scan
+            # passes types=None when synthesis was off), so the process
+            # conf's state at flush time must not re-decide it
+            prunable = base_prunable or (
+                types is not None
+                and synthesis.can_exclude(
+                    skipping_predicate(c, pcols, types, synthesize=True)))
         except Exception:  # noqa: BLE001 — fingerprinting must not fail a scan
-            prunable = False
+            base_prunable = prunable = False
         (prunable_cols if prunable else residual_cols).update(cols)
         conjuncts.append({
-            "shape": _shape(c),
+            "shape": synthesis.shape(c),
             "columns": cols,
             "prunable": prunable,
+            "synthesizable": prunable and not base_prunable,
             "partition": bool(cols) and all(col in pcols for col in cols),
         })
     return {
@@ -274,20 +256,22 @@ def _record(log_path: str, entry: Dict[str, Any]) -> bool:
 
 def record_scan(log_path: str, report=None, predicate=None,
                 partition_cols: Iterable[str] = (),
-                report_dict: Optional[Dict[str, Any]] = None) -> None:
+                report_dict: Optional[Dict[str, Any]] = None,
+                types: Optional[Dict[str, Any]] = None) -> None:
     """Journal one completed scan: the ScanReport plus the normalized
     predicate fingerprint (hook: ``exec/scan.scan_to_table``). The hot path
     pays only a dict append: callers pass the ``report_dict`` they already
     serialized for the span, and the fingerprint (an IR walk + the skipping
     rewrite per conjunct) is deferred to the writer thread — predicate IR
-    expressions are immutable, so walking them off-thread is safe."""
+    expressions and the schema ``types`` map are immutable, so walking them
+    off-thread is safe."""
     if not enabled(log_path):
         return
     _record(log_path, {
         "kind": "scan",
         "report": (report_dict if report_dict is not None
                    else report.to_dict()),
-        "_fingerprint_input": (predicate, tuple(partition_cols)),
+        "_fingerprint_input": (predicate, tuple(partition_cols), types),
     })
 
 
@@ -488,7 +472,8 @@ def _write_batch(jdir: str, entries: List[dict]) -> int:
         fp_in = e.pop("_fingerprint_input", None)
         if fp_in is not None:
             try:
-                e["fingerprint"] = predicate_fingerprint(fp_in[0], fp_in[1])
+                e["fingerprint"] = predicate_fingerprint(
+                    fp_in[0], fp_in[1], fp_in[2] if len(fp_in) > 2 else None)
             except Exception:  # noqa: BLE001 — never lose the report over it
                 e["fingerprint"] = None
         try:

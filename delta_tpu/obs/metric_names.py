@@ -84,6 +84,8 @@ COUNTERS = frozenset({
     "storage.retry.exhausted",    # gave up: surfaced to the caller
     "faults.injected",            # deterministic fault injector fired
     "commit.reconciled",          # ambiguous commit resolved via txnId
+    # -- predicate pushdown synthesis (obs/scan_report.record_rewrite_fired)
+    "scan.rewrites.fired",        # synthesized rewrite excluded data in a scan
     # -- device MERGE router + resident key cache (commands/merge.py,
     #    ops/key_cache.py) — `auto_used_device` made observable on
     #    production tables via /metrics and flight-recorder incidents
@@ -145,6 +147,8 @@ ENGINE_COUNTERS = frozenset({
     "scan.rowgroups.total",
     "scan.rowgroups.pruned",
     "scan.rowgroups.lateSkipped",
+    "scan.rewrites.synthesized",
+    "scan.rewrites.unknown",
     "stateCache.builds",
     "stateCache.plan.resident",
     "stateCache.plan.fallback.lowering",
@@ -179,7 +183,7 @@ PUBLIC_API = {
                "SEVERITY_RANK"),
     "scan_report": ("ScanReport", "last_scan_report", "clear_last_report",
                     "start_report", "current_report", "contribute",
-                    "finish_report"),
+                    "record_rewrite_fired", "finish_report"),
     "server": ("ObsServer", "start_server", "stop_server"),
     "flight_recorder": ("install", "uninstall", "record_incident",
                         "incident_files"),
@@ -312,6 +316,9 @@ DESCRIPTIONS = {
     "scan.rowgroups.total": "Row groups considered by the second pruning tier.",
     "scan.rowgroups.pruned": "Row groups skipped via footer stats.",
     "scan.rowgroups.lateSkipped": "Row groups skipped by late materialization.",
+    "scan.rewrites.synthesized": "Conjuncts lowered to stats bounds only via predicate synthesis.",
+    "scan.rewrites.fired": "Synthesized rewrites that excluded files or row groups in a scan.",
+    "scan.rewrites.unknown": "Conjuncts predicate synthesis still could not lower (kept residual).",
     "stateCache.builds": "Device state-cache lane builds.",
     "stateCache.plan.resident": "Scan plans served from resident lanes.",
     "stateCache.plan.fallback.lowering": "Scan plans that could not lower to ranges.",

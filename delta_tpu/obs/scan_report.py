@@ -23,7 +23,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 __all__ = ["ScanReport", "last_scan_report", "clear_last_report",
-           "start_report", "current_report", "contribute", "finish_report"]
+           "start_report", "current_report", "contribute",
+           "record_rewrite_fired", "finish_report"]
 
 
 @dataclass
@@ -43,8 +44,16 @@ class ScanReport:
     row_groups_late_skipped: int = 0  # late-materialization tier
     bytes_read: int = 0
     bytes_skipped: int = 0
+    #: the slice of ``bytes_skipped`` the footer-stats PLANNER avoided
+    #: (row groups never opened); the remainder is late materialization
+    bytes_skipped_planned: int = 0
     rows_out: int = 0
     phase_ms: Dict[str, int] = field(default_factory=dict)
+    #: synthesized predicate rewrites (expr/synthesis) that excluded at
+    #: least one file or row group this scan: {family, conjunct, rewrite}
+    #: with shape fingerprints; one entry per (family, conjunct), matching
+    #: the ``scan.rewrites.fired`` counter delta by construction
+    rewrites_fired: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def files_pruned(self) -> int:
@@ -65,8 +74,10 @@ class ScanReport:
             "rowGroupsLateSkipped": self.row_groups_late_skipped,
             "bytesRead": self.bytes_read,
             "bytesSkipped": self.bytes_skipped,
+            "bytesSkippedPlanned": self.bytes_skipped_planned,
             "rowsOut": self.rows_out,
             "phaseMs": dict(self.phase_ms),
+            "rewritesFired": [dict(f) for f in self.rewrites_fired],
         }
 
 
@@ -99,6 +110,27 @@ def contribute(**deltas: int) -> None:
         return
     for k, v in deltas.items():
         setattr(rep, k, getattr(rep, k) + v)
+
+
+def record_rewrite_fired(family: str, conjunct: str, rewrite: str) -> None:
+    """Attribute one fired synthesized rewrite (both pruning tiers call
+    this with shape fingerprints). Deduped per (family, conjunct) within
+    the in-flight report — a conjunct that fires at the file tier AND the
+    row-group tier is one workload fact, not two — and the
+    ``scan.rewrites.fired`` counter bumps exactly once per appended entry,
+    so ``last_scan_report().rewritesFired`` matches the counter delta by
+    construction. Without an in-flight report (DML reads, blackout) the
+    counter still counts the event."""
+    from delta_tpu.utils.telemetry import bump_counter
+
+    rep = _CURRENT.get()
+    if rep is not None:
+        if any(f.get("family") == family and f.get("conjunct") == conjunct
+               for f in rep.rewrites_fired):
+            return
+        rep.rewrites_fired.append(
+            {"family": family, "conjunct": conjunct, "rewrite": rewrite})
+    bump_counter("scan.rewrites.fired")
 
 
 def finish_report(token: "contextvars.Token",

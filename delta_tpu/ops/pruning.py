@@ -26,11 +26,13 @@ import pyarrow.compute as pc
 from delta_tpu.utils.jaxcompat import enable_x64
 from delta_tpu.expr import ir
 from delta_tpu.expr import partition as partition_expr
+from delta_tpu.expr import synthesis
 from delta_tpu.protocol.actions import AddFile, Metadata
 from delta_tpu.ops import state_export
 from delta_tpu.utils.config import conf
 
-__all__ = ["DataSize", "DeltaScan", "skipping_predicate", "prune_files", "files_for_scan"]
+__all__ = ["DataSize", "DeltaScan", "skipping_predicate", "ConjunctRewrite",
+           "conjunct_rewrites", "prune_files", "files_for_scan"]
 
 
 @dataclass
@@ -67,15 +69,54 @@ def _nulls(c: str) -> ir.Expression:
 
 _UNKNOWN = ir.Literal(None)
 
+#: Resident-path fired-rewrite attribution isolates a conjunct with an
+#: extra host lane pass — observability-only work, bounded to tables where
+#: it is noise next to the plan itself; beyond this, scan-level attribution
+#: (documented over-attribution) applies.
+_ATTRIBUTION_ISOLATE_MAX_FILES = 65_536
+
+
+#: De Morgan / comparison flips for pushing NOT through (`Not(Lt)` ≡ `Ge`
+#: etc.; `Not(Eq)` stays UNKNOWN: excluding on min=max=lit would trust
+#: possibly-truncated foreign bounds to be exact). The inequality flips are
+#: NOT equivalent over floating columns: a NaN row fails every comparison
+#: (Python/IEEE semantics, which this engine's evaluators share), so
+#: ``NOT (f < L)`` is TRUE for it while ``f >= L`` is FALSE — and min/max
+#: stats ignore NaN, so the flipped rewrite would prune the NaN row's file.
+#: They therefore require ``types`` and only fire when every referenced
+#: column is non-floating; ``Not(Ne)`` ≡ ``Eq`` is safe either way (both
+#: sides are FALSE for a NaN row).
+_NOT_FLIP = {ir.Lt: ir.Ge, ir.Le: ir.Gt, ir.Gt: ir.Le, ir.Ge: ir.Lt,
+             ir.Ne: ir.Eq}
+
+
+def _not_flip_safe(c: ir.Expression, types) -> bool:
+    if type(c) is ir.Ne:
+        return True
+    if types is None:
+        return False
+    from delta_tpu.schema.types import DoubleType, FloatType
+
+    return not any(isinstance(types.get(col.lower()), (FloatType, DoubleType))
+                   for col in ir.references(c))
+
 
 def skipping_predicate(
-    e: ir.Expression, partition_cols: frozenset = frozenset()
+    e: ir.Expression, partition_cols: frozenset = frozenset(),
+    types=None, synthesize: Optional[bool] = None,
 ) -> ir.Expression:
     """Rewrite a data predicate into a can-match predicate over stats columns.
     Returns ``Literal(None)`` (= keep) for unsupported shapes. Partition
     columns have no stats lanes — references to them rewrite to UNKNOWN
     (they only reach here inside mixed OR branches; pure partition conjuncts
-    are routed to partition pruning upstream)."""
+    are routed to partition pruning upstream).
+
+    ``types`` (lowercased column name → schema DataType) arms the
+    synthesis fallback (`expr/synthesis`): arithmetic / string / temporal
+    shapes the base rules cannot lower rewrite into sound interval or
+    monotone-wrap can-match predicates instead of UNKNOWN. With
+    ``types=None`` (or ``delta.tpu.read.predicateSynthesis=false``) the
+    base behavior is unchanged."""
 
     def _is_part(col: ir.Expression) -> bool:
         return isinstance(col, ir.Column) and col.name.lower() in partition_cols
@@ -83,23 +124,37 @@ def skipping_predicate(
     t = type(e)
     if t is ir.And:
         return ir.And(
-            skipping_predicate(e.left, partition_cols),
-            skipping_predicate(e.right, partition_cols),
+            skipping_predicate(e.left, partition_cols, types, synthesize),
+            skipping_predicate(e.right, partition_cols, types, synthesize),
         )
     if t is ir.Or:
         return ir.Or(
-            skipping_predicate(e.left, partition_cols),
-            skipping_predicate(e.right, partition_cols),
+            skipping_predicate(e.left, partition_cols, types, synthesize),
+            skipping_predicate(e.right, partition_cols, types, synthesize),
         )
     if t is ir.Not:
         c = e.child
         if isinstance(c, ir.IsNull):
-            return skipping_predicate(ir.IsNotNull(c.child), partition_cols)
+            return skipping_predicate(ir.IsNotNull(c.child), partition_cols, types, synthesize)
         if isinstance(c, ir.IsNotNull):
-            return skipping_predicate(ir.IsNull(c.child), partition_cols)
+            return skipping_predicate(ir.IsNull(c.child), partition_cols, types, synthesize)
         if all(col.lower() in partition_cols for col in ir.references(c)):
             return e  # exact per-file partition verdict, negation included
-        return _UNKNOWN
+        if isinstance(c, ir.Not):
+            return skipping_predicate(c.child, partition_cols, types, synthesize)
+        tc = type(c)
+        if tc in _NOT_FLIP and _not_flip_safe(c, types):
+            # NULL operands agree (both sides yield NULL for a NULL row);
+            # the NaN hazard is gated by _not_flip_safe
+            return skipping_predicate(
+                _NOT_FLIP[tc](c.left, c.right), partition_cols, types, synthesize)
+        if tc is ir.And:  # De Morgan: each side rewrites conservatively
+            return skipping_predicate(
+                ir.Or(ir.Not(c.left), ir.Not(c.right)), partition_cols, types, synthesize)
+        if tc is ir.Or:
+            return skipping_predicate(
+                ir.And(ir.Not(c.left), ir.Not(c.right)), partition_cols, types, synthesize)
+        return _synth_fallback(e, partition_cols, types, synthesize)
     if any(_is_part(c) for c in getattr(e, "children", ())):
         # a partition column's value is constant per file: keep the predicate
         # as-is and evaluate it exactly against the bound partition value —
@@ -117,7 +172,7 @@ def skipping_predicate(
             t = type(e)
             l, r = e.left, e.right
         if not (isinstance(l, ir.Column) and isinstance(r, ir.Literal)):
-            return _UNKNOWN
+            return _synth_fallback(e, partition_cols, types, synthesize)
         c, lit = l.name, r
         if lit.value is None:
             return ir.Literal(False)  # col <op> NULL matches nothing
@@ -137,7 +192,7 @@ def skipping_predicate(
             return _UNKNOWN
         out: Optional[ir.Expression] = None
         for o in opts:
-            one = skipping_predicate(ir.Eq(e.value, o))
+            one = skipping_predicate(ir.Eq(e.value, o), partition_cols, types, synthesize)
             out = one if out is None else ir.Or(out, one)
         return out if out is not None else ir.Literal(False)
     if t is ir.IsNull and isinstance(e.child, ir.Column):
@@ -154,7 +209,27 @@ def skipping_predicate(
                 return lower
             # every string with prefix p is strictly < hi
             return ir.And(ir.Lt(_min(c), ir.Literal(hi)), lower)
-    return _UNKNOWN
+    return _synth_fallback(e, partition_cols, types, synthesize)
+
+
+def _synth_fallback(e: ir.Expression, partition_cols: frozenset,
+                    types, synthesize: Optional[bool]) -> ir.Expression:
+    """Hand an unsupported leaf to the synthesis layer when armed.
+    ``synthesize`` is tri-state: ``False`` (the attribution baseline in
+    :func:`conjunct_rewrites`) skips it even with types present; ``True``
+    forces it past the conf — the journal's DEFERRED fingerprinting uses
+    this, having resolved the conf at SCAN time into ``types`` (reading
+    the process-global conf on the writer thread would stamp a scan with
+    whatever conf window happens to be active at flush time); ``None``
+    (callers on the scan path) consults the conf here."""
+    if types is None or synthesize is False:
+        return _UNKNOWN
+    if synthesize is None and not conf.get_bool(
+            "delta.tpu.read.predicateSynthesis", True):
+        return _UNKNOWN
+    return synthesis.synthesize(
+        e, partition_cols, types,
+        base=lambda x: skipping_predicate(x, partition_cols))
 
 
 def _prefix_upper_bound(p: str) -> Optional[str]:
@@ -173,13 +248,112 @@ def _prefix_upper_bound(p: str) -> Optional[str]:
     return None
 
 
+@dataclass
+class ConjunctRewrite:
+    """One conjunct's skipping rewrite plus its synthesis attribution:
+    ``attempted`` means the base rules could not exclude on this shape (so
+    synthesis was consulted); ``synthesized`` that synthesis produced a
+    rewrite that can; ``family`` is the rewrite family label (arithmetic /
+    string / cast / ...)."""
+
+    conjunct: ir.Expression
+    rewritten: ir.Expression
+    attempted: bool = False
+    synthesized: bool = False
+    family: Optional[str] = None
+
+
+def conjunct_rewrites(
+    filters: Sequence[ir.Expression],
+    partition_cols: frozenset,
+    types,
+) -> List[ConjunctRewrite]:
+    """Per-conjunct skipping rewrites with synthesis attribution. The AND
+    of the rewrites equals ``skipping_predicate(and_all(filters))`` (the
+    rewrite distributes over conjunctions), so callers can evaluate the
+    fused predicate AND still attribute which conjuncts only lower thanks
+    to synthesis."""
+    out: List[ConjunctRewrite] = []
+    for f in filters:
+        for c in ir.split_conjuncts(f):
+            # the attribution baseline is TYPED but synthesis-free: the NOT
+            # comparison pushdown (a base-rule fix, type-gated for the NaN
+            # hazard) must not read as "synthesized"
+            base_rw = skipping_predicate(c, partition_cols, types,
+                                         synthesize=False)
+            base_ok = synthesis.can_exclude(base_rw)
+            if base_ok or types is None:
+                out.append(ConjunctRewrite(c, base_rw))
+                continue
+            rw = skipping_predicate(c, partition_cols, types)
+            ok = synthesis.can_exclude(rw)
+            out.append(ConjunctRewrite(
+                c, rw, attempted=True, synthesized=ok,
+                family=synthesis.classify_family(c) if ok else None))
+    return out
+
+
+def _count_rewrites(rewrites: Sequence[ConjunctRewrite]) -> None:
+    """One ``scan.rewrites.{synthesized,unknown}`` event per conjunct the
+    base rules couldn't lower — bumped by the tier that actually SERVED the
+    prune (resident serve or the generic prune), never both."""
+    from delta_tpu.utils.telemetry import bump_counter
+
+    for r in rewrites:
+        if r.attempted:
+            bump_counter("scan.rewrites.synthesized" if r.synthesized
+                         else "scan.rewrites.unknown")
+
+
+def _record_fired(rewrite: ConjunctRewrite) -> None:
+    from delta_tpu.obs import scan_report
+
+    scan_report.record_rewrite_fired(
+        rewrite.family or "other",
+        synthesis.shape(rewrite.conjunct),
+        synthesis.shape(rewrite.rewritten),
+    )
+
+
+def _attribute_fired(
+    rewrites: Sequence[ConjunctRewrite],
+    excluded: Sequence[AddFile],
+    metadata: Metadata,
+) -> None:
+    """Per-conjunct attribution of a file-tier prune: a synthesized rewrite
+    *fired* when it alone excludes at least one of the files the fused
+    predicate dropped. Best-effort — attribution must never fail a scan."""
+    synths = [r for r in rewrites if r.synthesized]
+    if not synths or not excluded:
+        return
+    from delta_tpu.expr.vectorized import evaluate
+
+    try:
+        table = state_export.stats_table(excluded, metadata)
+    except Exception:  # noqa: BLE001 — attribution is observability only
+        return
+    for r in synths:
+        try:
+            verdict = evaluate(r.rewritten, table)
+            hit = pc.any(pc.equal(pc.cast(verdict, "bool"), False)).as_py()
+        except Exception:  # noqa: BLE001
+            hit = False
+        if hit:
+            _record_fired(r)
+
+
 def _prune_host(files: Sequence[AddFile], metadata: Metadata, pred: ir.Expression) -> np.ndarray:
     from delta_tpu.expr.vectorized import evaluate
 
     table = state_export.stats_table(files, metadata)
-    verdict = evaluate(pred, table)
-    # keep unless definitely False
-    keep = pc.fill_null(pc.cast(verdict, "bool"), True)
+    try:
+        verdict = evaluate(pred, table)
+        # keep unless definitely False
+        keep = pc.fill_null(pc.cast(verdict, "bool"), True)
+    except Exception:  # noqa: BLE001 — a stats/type surprise (e.g. foreign
+        # stats that contradict the declared schema under a synthesized
+        # rewrite) must degrade to keep-everything, never fail the scan
+        return np.ones(len(files), bool)
     return np.asarray(keep)
 
 
@@ -224,7 +398,10 @@ def prune_files(
     if not files or not data_filters:
         return list(files)
     pcols = frozenset(c.lower() for c in metadata.partition_columns)
-    pred = skipping_predicate(ir.and_all(list(data_filters)), pcols)
+    rewrites = conjunct_rewrites(list(data_filters), pcols,
+                                 synthesis.schema_types(metadata))
+    _count_rewrites(rewrites)
+    pred = ir.and_all([r.rewritten for r in rewrites])
     keep: Optional[np.ndarray] = None
     # The device path pays a dispatch + transfer per scan; below a few
     # thousand files the vectorized host evaluator finishes before a single
@@ -236,7 +413,11 @@ def prune_files(
         keep = _prune_device(arrays, pred)
     if keep is None:
         keep = _prune_host(files, metadata, pred)
-    return [f for f, k in zip(files, keep) if k]
+    kept = [f for f, k in zip(files, keep) if k]
+    if len(kept) < len(files):
+        _attribute_fired(rewrites, [f for f, k in zip(files, keep) if not k],
+                         metadata)
+    return kept
 
 
 def _resident_scan(
@@ -266,8 +447,10 @@ def _resident_scan(
         bump_counter("stateCache.scan.fallback.noentry")
         return None
     pcols = frozenset(c.lower() for c in snapshot.metadata.partition_columns)
-    pred = skipping_predicate(
-        ir.and_all(list(partition_filters) + list(data_filters)), pcols)
+    rewrites = conjunct_rewrites(
+        list(partition_filters) + list(data_filters), pcols,
+        synthesis.schema_types(snapshot.metadata))
+    pred = ir.and_all([r.rewritten for r in rewrites])
     terms = extract_range_union(pred, entry.columns, entry.part_info,
                                 str_lanes=entry.str_lanes)
     if not terms or not all(t.exact for t in terms):
@@ -292,6 +475,7 @@ def _resident_scan(
         bump_counter("stateCache.scan.fallback.version")
         return None
     bump_counter("stateCache.scan.resident")
+    _count_rewrites(rewrites)  # this tier serves: it owns the count
 
     def _union(chunk):
         if len(chunk) == 1:
@@ -305,6 +489,29 @@ def _resident_scan(
     sizes = entry.h_size[: entry.num_rows]
     total_bytes = int(sizes[alive].sum())
     n_alive = int(alive.sum())
+    if len(rows) < n_alive:
+        # fired-rewrite attribution on the resident path: isolate each
+        # synthesized conjunct on the host mirrors when its rewrite lowers
+        # to a single range term; multi-term/unlowerable rewrites — and
+        # large tables, where an extra per-conjunct host lane pass would
+        # rival the resident plan this path exists to keep O(ms) —
+        # attribute at scan level (the scan did prune and the conjunct is
+        # part of the conjunction that pruned it)
+        isolate = n_alive <= _ATTRIBUTION_ISOLATE_MAX_FILES
+        for r in (x for x in rewrites if x.synthesized):
+            fired = True
+            if isolate:
+                terms_i = extract_range_union(r.rewritten, entry.columns,
+                                              entry.part_info,
+                                              str_lanes=entry.str_lanes)
+                if terms_i is not None and len(terms_i) == 1:
+                    plans_i = entry.plan_ranges(
+                        terms_i, k=1, use_device=False,
+                        expected_version=snapshot.version)
+                    if plans_i is not None:
+                        fired = plans_i[0].count < n_alive
+            if fired:
+                _record_fired(r)
     total = DataSize(bytes_compressed=total_bytes, files=n_alive)
     if partition_filters:
         prows = _union(plans[n_main:]) if data_filters else rows

@@ -208,6 +208,16 @@ class SqlConf:
         # uncompressed (snappy on random int64 is 14x slower to decode for
         # ~10% size); or a codec name applied to all columns.
         "delta.tpu.write.compression": "auto",
+        # Predicate pushdown synthesis (expr/synthesis): arithmetic /
+        # string / temporal predicates the base skipping rules can't lower
+        # (`price * qty > 1000`, `substr(id,1,4) = 'us-w'`, `year(d) =
+        # 2026`) rewrite into sound can-match predicates over the same
+        # min/max stats lanes, at BOTH pruning tiers (file + row group).
+        # False disables the synthesis fallback: such shapes keep every
+        # file/row group and run as residual filters only. The NOT
+        # comparison pushdown (`Not(Lt)` ≡ `Ge`, type-gated) is a
+        # base-rule fix and stays on either way.
+        "delta.tpu.read.predicateSynthesis": True,
         # Second pruning tier inside the Parquet decode (exec/rowgroups):
         # footer row-group stats skip non-matching row groups, and predicate
         # columns decode first so remaining columns decode only for row

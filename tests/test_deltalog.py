@@ -291,6 +291,13 @@ def test_update_coalescing_adopts_concurrent_listing(tmp_table):
 
     def counting_list(path):
         lists["n"] += 1
+        # slow the listing so the racers below genuinely QUEUE while the
+        # leader lists — on a fast host a ~50µs real listing can finish
+        # before the other threads even reach the lock, and zero
+        # coalescing is then correct behavior (flaky assert)
+        import time as _time
+
+        _time.sleep(0.05)
         return orig(path)
 
     log.store.list_from = counting_list

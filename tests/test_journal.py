@@ -442,22 +442,26 @@ def test_advisor_no_zorder_when_pruning_works(tmp_table):
 
 
 def test_advisor_flags_residual_only_shapes(tmp_table):
-    """Predicates the skipping rewrite cannot lower are reported under
-    neverPruned with the 'shape' reason — the evidence ROADMAP item 5
-    (pushdown synthesis) needs."""
+    """neverPruned splits by reason: a shape predicate synthesis can lower
+    but that never excluded anything is 'synthesizedLayout' (clustering
+    WOULD help it now); one synthesis has no sound rewrite for (division
+    by a zero-crossing column interval) stays 'shape'."""
     t = DeltaTable.create(tmp_table, data=pa.table({
         "price": pa.array([float(i) for i in range(100)], pa.float64()),
         "qty": pa.array(range(100), pa.int64()),
     }))
     for _ in range(3):
         t.to_arrow(filters=["price * qty > 1000"])
+        t.to_arrow(filters=["qty / price > 2"])
     rep = t.advise()
     [g] = [g for g in rep.facts["neverPruned"]
-           if set(g["columns"]) == {"price", "qty"}]
-    assert g["prunable"] is False
-    assert "synthesis" in g["reason"]
-    # no ZORDER rec: clustering can't help a non-evaluable shape
-    assert not [r for r in rep.recommendations if r.kind == "ZORDER"]
+           if g["fingerprint"].startswith("gt(mul")]
+    assert g["prunable"] is True
+    assert g["reason"].startswith("synthesizedLayout")
+    [g2] = [g2 for g2 in rep.facts["neverPruned"]
+            if g2["fingerprint"].startswith("gt(div")]
+    assert g2["prunable"] is False
+    assert g2["reason"].startswith("shape")
 
 
 def test_row_group_facts_ignore_unpredicated_scans(tmp_table):

@@ -36,12 +36,15 @@ def _mk_table(path, n_files=6, rows=40, start=0):
 
 
 def _ranges_for(snap, exprs):
+    from delta_tpu.expr.synthesis import schema_types
+
     entry = DeviceStateCache.instance().get(snap)
     assert entry is not None
     pcols = frozenset()
+    types = schema_types(snap.metadata)  # the production planners pass these
     rs = []
     for e in exprs:
-        pred = pruning.skipping_predicate(parse_expression(e), pcols)
+        pred = pruning.skipping_predicate(parse_expression(e), pcols, types)
         r = extract_ranges(pred, entry.columns)
         assert r is not None, e
         rs.append(r)

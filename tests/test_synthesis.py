@@ -1,0 +1,676 @@
+"""Predicate pushdown synthesis (`expr/synthesis`): soundness above all.
+
+The spine is a seeded property harness: random predicates per rewrite
+family over random tables, asserting a synthesized prune NEVER excludes a
+file/row-group that contains a matching row — NULLs, NaN, negative ranges,
+int64 boundaries, and unicode prefix edges included. Both pruning tiers
+share one rewrite (`ops.pruning.skipping_predicate`), so the harness
+exercises the rewrite against the stats-env semantics the tiers evaluate,
+plus end-to-end result identity through the real scan path, the device
+(jaxeval) file tier, and the resident device planner (router audit).
+"""
+import datetime as dt
+import json
+import math
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.commands.write import WriteIntoDelta
+from delta_tpu.exec.rowgroups import _StatsEnv
+from delta_tpu.expr import ir, synthesis
+from delta_tpu.expr.parser import parse_predicate
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.ops import pruning, state_export
+from delta_tpu.ops.state_cache import DeviceStateCache
+from delta_tpu.protocol.actions import AddFile, Metadata
+from delta_tpu.schema.types import (
+    DateType, DoubleType, LongType, StringType, StructType, TimestampType,
+)
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+SCHEMA = (StructType()
+          .add("a", LongType()).add("b", LongType())
+          .add("f", DoubleType()).add("s", StringType())
+          .add("d", DateType()).add("ts", TimestampType()))
+TYPES = {f.name: f.data_type for f in SCHEMA.fields}
+META = Metadata(schema_string=SCHEMA.to_json())
+
+PAIRS_PER_FAMILY = 500
+FILES_PER_CASE = 3
+ROWS_PER_FILE = 12
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state_cache():
+    DeviceStateCache.reset()
+    yield
+    DeviceStateCache.reset()
+
+
+# ---------------------------------------------------------------------------
+# Random tables + the exact stats env both tiers evaluate against
+# ---------------------------------------------------------------------------
+
+_INT_POOL = [-(2**62), -(2**31), -1000, -7, -1, 0, 1, 3, 7, 999,
+             2**31, 2**53, 2**62, 2**63 - 1]
+_STR_POOL = ["", "a", "ab", "us-west", "us-west-2", "eu-central-1",
+             "zz", "éclair", "中文abc", "us-w￿",
+             "US-WEST", "0", "  pad"]
+
+
+def _gen_rows(rng):
+    rows = []
+    base_day = dt.date(2020, 1, 1)
+    for _ in range(ROWS_PER_FILE):
+        row = {}
+        row["a"] = None if rng.random() < 0.12 else (
+            int(rng.choice(_INT_POOL)) if rng.random() < 0.3
+            else int(rng.integers(-10_000, 10_000)))
+        row["b"] = None if rng.random() < 0.12 else (
+            int(rng.choice(_INT_POOL)) if rng.random() < 0.2
+            else int(rng.integers(-50, 50)))
+        r = rng.random()
+        row["f"] = (None if r < 0.1 else math.nan if r < 0.18
+                    else float(rng.normal(0, 1e3)))
+        row["s"] = None if rng.random() < 0.1 else str(rng.choice(_STR_POOL))
+        row["d"] = None if rng.random() < 0.1 else (
+            base_day + dt.timedelta(days=int(rng.integers(0, 3000))))
+        row["ts"] = None if rng.random() < 0.1 else dt.datetime(
+            2020, 1, 1) + dt.timedelta(minutes=int(rng.integers(0, 2_000_000)))
+        rows.append(row)
+    return rows
+
+
+def _stat_json(v, round_up=False):
+    from delta_tpu.exec.parquet import json_stat_value
+
+    return json_stat_value(v, round_up)
+
+
+def _stats_env(rows) -> _StatsEnv:
+    """The file-tier stats env for one synthetic file: min/max over non-null
+    (floats: non-NaN) values rendered the way JSON stats carry them."""
+    env = _StatsEnv()
+    env["numrecords"] = len(rows)
+    for c in TYPES:
+        vals = [r[c] for r in rows if r[c] is not None]
+        if isinstance(TYPES[c], DoubleType):
+            vals = [v for v in vals if not math.isnan(v)]
+        env[f"nullcount.{c}"] = len(rows) - len([r for r in rows
+                                                if r[c] is not None])
+        if vals:
+            mn, mx = _stat_json(min(vals)), _stat_json(max(vals), True)
+            if mn is not None:
+                env[f"min.{c}"] = mn
+            if mx is not None:
+                env[f"max.{c}"] = mx
+    return env
+
+
+def _matches(pred: ir.Expression, rows) -> bool:
+    for r in rows:
+        try:
+            if pred.eval(dict(r)) is True:
+                return True
+        except Exception:
+            return True  # un-evaluable row: treat as a potential match
+    return False
+
+
+def _soundness_case(pred: ir.Expression, files) -> None:
+    rewritten = pruning.skipping_predicate(pred, frozenset(), TYPES)
+    for rows in files:
+        if not _matches(pred, rows):
+            continue
+        env = _stats_env(rows)
+        try:
+            verdict = rewritten.eval(env)
+        except Exception:
+            verdict = None  # the tiers keep on evaluation errors
+        assert verdict is not False, (
+            f"synthesized rewrite pruned a matching file\n"
+            f"  predicate: {pred.sql()}\n  rewrite:   {rewritten.sql()}\n"
+            f"  env: {dict(env)}\n  rows: {rows}")
+
+
+# ---------------------------------------------------------------------------
+# Random predicate generators per family
+# ---------------------------------------------------------------------------
+
+
+def _lit_num(rng):
+    if rng.random() < 0.3:
+        return ir.Literal(int(rng.choice(_INT_POOL)))
+    if rng.random() < 0.5:
+        return ir.Literal(float(rng.normal(0, 1e4)))
+    return ir.Literal(int(rng.integers(-5_000, 5_000)))
+
+
+_CMPS = [ir.Eq, ir.Lt, ir.Le, ir.Gt, ir.Ge]
+
+
+def _arith_expr(rng, depth=0):
+    r = rng.random()
+    if depth >= 2 or r < 0.35:
+        return ir.Column(str(rng.choice(["a", "b", "f"])))
+    if r < 0.45:
+        return _lit_num(rng)
+    op = rng.choice(["add", "sub", "mul", "div", "mod", "neg"])
+    if op == "neg":
+        return ir.Neg(_arith_expr(rng, depth + 1))
+    if op in ("div", "mod"):
+        cls = ir.Div if op == "div" else ir.Mod
+        return cls(_arith_expr(rng, depth + 1), _lit_num(rng))
+    cls = {"add": ir.Add, "sub": ir.Sub, "mul": ir.Mul}[op]
+    return cls(_arith_expr(rng, depth + 1), _arith_expr(rng, depth + 1))
+
+
+def _gen_arith(rng):
+    cmp_cls = rng.choice(_CMPS)
+    l, r = _arith_expr(rng), _lit_num(rng)
+    return cmp_cls(r, l) if rng.random() < 0.2 else cmp_cls(l, r)
+
+
+def _gen_string(rng):
+    col = ir.Column("s")
+    kind = rng.choice(["substr", "like", "startswith", "substr_cmp"])
+    prefix = str(rng.choice(_STR_POOL))
+    if kind == "like":
+        pat = prefix + rng.choice(["%", "%x", "_z%", "", "%_"])
+        return ir.Like(col, ir.Literal(pat))
+    if kind == "startswith":
+        return ir.StartsWith(col, ir.Literal(prefix))
+    k = int(rng.integers(0, 6))
+    sub = ir.Func("substr", [col, ir.Literal(1), ir.Literal(k)])
+    cmp_cls = rng.choice(_CMPS)
+    return cmp_cls(sub, ir.Literal(prefix[:k] if kind == "substr" else prefix))
+
+
+def _gen_temporal(rng):
+    kind = rng.choice(["year", "to_date", "date_add", "cast_long",
+                       "cast_double"])
+    if kind == "year":
+        return rng.choice(_CMPS)(
+            ir.Func("year", [ir.Column("d")]),
+            ir.Literal(int(rng.integers(2018, 2031))))
+    if kind == "to_date":
+        day = dt.date(2020, 1, 1) + dt.timedelta(days=int(rng.integers(0, 3000)))
+        return rng.choice(_CMPS)(
+            ir.Func("to_date", [ir.Column("ts")]), ir.Literal(day.isoformat()))
+    if kind == "date_add":
+        day = dt.date(2020, 1, 1) + dt.timedelta(days=int(rng.integers(0, 3000)))
+        fn = rng.choice(["date_add", "date_sub"])
+        # over BOTH temporal columns: on a timestamp the composite is
+        # day-truncating, not strict monotone (the r12 review catch)
+        col = str(rng.choice(["d", "ts"]))
+        return rng.choice(_CMPS)(
+            ir.Func(fn, [ir.Column(col), ir.Literal(int(rng.integers(-40, 40)))]),
+            ir.Literal(day.isoformat()))
+    target = LongType() if kind == "cast_long" else DoubleType()
+    return rng.choice(_CMPS)(
+        ir.Cast(_arith_expr(rng, depth=1), target), _lit_num(rng))
+
+
+def _gen_compound(rng):
+    a, b = _gen_arith(rng), rng.choice([_gen_arith, _gen_string])(rng)
+    r = rng.random()
+    if r < 0.3:
+        return ir.And(a, b)
+    if r < 0.6:
+        return ir.Or(a, b)
+    if r < 0.8:
+        return ir.Not(a)
+    return ir.Not(ir.And(a, b) if rng.random() < 0.5 else ir.Or(a, b))
+
+
+@pytest.mark.parametrize("family,gen", [
+    ("arithmetic", _gen_arith),
+    ("string", _gen_string),
+    ("temporal", _gen_temporal),
+    ("compound", _gen_compound),
+])
+def test_property_soundness(family, gen):
+    """≥500 random predicate/table pairs per family: a matching row's file
+    is never excluded by the synthesized rewrite (seeded, no wall clock)."""
+    rng = np.random.default_rng(hash(family) % (2**32))
+    for _ in range(PAIRS_PER_FAMILY):
+        files = [_gen_rows(rng) for _ in range(FILES_PER_CASE)]
+        _soundness_case(gen(rng), files)
+
+
+def test_property_soundness_device_file_tier():
+    """A slice of random arithmetic predicates through the REAL device file
+    tier (jaxeval over FileStateArrays lanes): keep-set must be a superset
+    of the files holding matches."""
+    rng = np.random.default_rng(4242)
+    n_checked = 0
+    for _ in range(12):
+        files = [_gen_rows(rng) for _ in range(FILES_PER_CASE)]
+        pred = _gen_arith(rng)
+        adds = [_addfile(i, rows) for i, rows in enumerate(files)]
+        rewritten = pruning.skipping_predicate(
+            pred, frozenset(), synthesis.schema_types(META))
+        arrays = state_export.files_to_arrays(adds, META)
+        keep = pruning._prune_device(arrays, rewritten)
+        if keep is None:
+            continue  # not device-compilable (e.g. rewrote to UNKNOWN+str)
+        n_checked += 1
+        for i, rows in enumerate(files):
+            if _matches(pred, rows):
+                assert keep[i], (pred.sql(), rewritten.sql(), rows)
+    assert n_checked >= 4  # the slice must actually exercise the device
+
+
+def _addfile(i, rows):
+    stats = {
+        "numRecords": len(rows),
+        "minValues": {}, "maxValues": {}, "nullCount": {},
+    }
+    for c in TYPES:
+        vals = [r[c] for r in rows if r[c] is not None]
+        if isinstance(TYPES[c], DoubleType):
+            vals = [v for v in vals if not math.isnan(v)]
+        stats["nullCount"][c] = len(rows) - len(
+            [r for r in rows if r[c] is not None])
+        if vals:
+            mn, mx = _stat_json(min(vals)), _stat_json(max(vals), True)
+            if mn is not None:
+                stats["minValues"][c] = mn
+            if mx is not None:
+                stats["maxValues"][c] = mx
+    return AddFile(path=f"part-{i:05d}.parquet", partition_values={},
+                   size=1000, modification_time=0, data_change=True,
+                   stats=json.dumps(stats))
+
+
+# ---------------------------------------------------------------------------
+# Explicit edge matrix
+# ---------------------------------------------------------------------------
+
+
+def _env(d):
+    e = _StatsEnv()
+    for k, v in d.items():
+        e[k.lower()] = v
+    return e
+
+
+def _rw(s, types=TYPES):
+    return pruning.skipping_predicate(parse_predicate(s), frozenset(), types)
+
+
+def test_edge_null_only_column():
+    rw = _rw("a * b > 10")
+    env = _env({"numRecords": 5, "nullCount.a": 5, "nullCount.b": 0,
+                "min.b": 1, "max.b": 2})
+    assert rw.eval(env) is None  # missing bounds: keep (conservative)
+
+
+def test_edge_div_by_zero_crossing_interval_is_unknown():
+    rw = _rw("a / b > 2")
+    assert not synthesis.can_exclude(rw)
+    rw2 = _rw("a / 0 > 2")  # literal zero divisor: NULL, never matches
+    assert isinstance(rw2, ir.Literal) and rw2.value is False
+
+
+def test_edge_int64_boundary_multiplication():
+    """Products near ±2^63 must not wrap into a wrong exclusion: candidates
+    evaluate in float64 where overflow saturates monotonically."""
+    big = 2**62
+    rows = [{"a": big, "b": 4, "f": 0.0, "s": None, "d": None, "ts": None}]
+    pred = parse_predicate(f"a * b >= {big * 4}")
+    _soundness_case(pred, [rows])
+    # and the Arrow host tier end to end over AddFile stats
+    adds = [_addfile(0, rows)]
+    kept = pruning.prune_files(adds, META, [pred])
+    assert kept == adds
+
+
+def test_edge_nan_float_bounds_keep():
+    rw = _rw("f * 2 > 100")
+    env = _env({"numRecords": 3, "nullCount.f": 0})  # NaN bounds dropped
+    assert rw.eval(env) is None
+
+
+def test_edge_truncated_string_stats_keep():
+    # binary/truncated footer bounds are dropped before the env is built
+    # (exec/rowgroups._safe_bounds) — absent lanes must keep
+    rw = _rw("substr(s, 1, 4) = 'us-w'")
+    assert rw.eval(_env({"numRecords": 3, "nullCount.s": 0})) is None
+    # present full-string bounds prune correctly
+    env = _env({"numRecords": 3, "nullCount.s": 0,
+                "min.s": "aa", "max.s": "bz"})
+    assert rw.eval(env) is False
+
+
+def test_edge_date_add_over_timestamp_is_day_truncating():
+    """date_add over a TIMESTAMP truncates to a date first, so the shift is
+    NOT strict monotone — an exact inversion onto the raw column would
+    prune files whose rows fall later inside the matching day (caught in
+    review; the rewrite must use the to_date monotone wrap instead)."""
+    rows = [{"a": None, "b": None, "f": None, "s": None, "d": None,
+             "ts": dt.datetime(2021, 6, 1, 8, 30)}]
+    pred = parse_predicate("date_add(ts, 5) = '2021-06-06'")
+    assert pred.eval(dict(rows[0])) is True
+    _soundness_case(pred, [rows])
+    rw = pruning.skipping_predicate(pred, frozenset(), TYPES)
+    assert "to_date" in rw.sql()  # the wrap, not a raw ts comparison
+
+
+def test_edge_unicode_prefix():
+    rows = [{"a": None, "b": None, "f": None, "s": "éclair-42",
+             "d": None, "ts": None}]
+    for q in ["substr(s, 1, 2) = 'éc'", "s like 'écl%'"]:
+        _soundness_case(parse_predicate(q), [rows])
+
+
+def test_edge_null_literal_arithmetic_never_matches():
+    rw = pruning.skipping_predicate(
+        ir.Gt(ir.Add(ir.Column("a"), ir.Literal(None)), ir.Literal(1)),
+        frozenset(), TYPES)
+    assert isinstance(rw, ir.Literal) and rw.value is False
+
+
+def test_edge_mod_bounds():
+    # |a % 7| <= 7 always: an impossible comparison excludes everything...
+    rw = _rw("a % 7 >= 100")
+    assert isinstance(rw, ir.Literal) and rw.value is False
+    # ...while a satisfiable one can never exclude on stats alone
+    assert not synthesis.can_exclude(_rw("a % 7 < 3"))
+
+
+def test_partition_columns_stay_unknown():
+    types = dict(TYPES)
+    rw = pruning.skipping_predicate(
+        parse_predicate("a * 2 > 10"), frozenset({"a"}), types)
+    assert not synthesis.can_exclude(rw)
+
+
+def test_string_column_arithmetic_gated():
+    # `s * 2 > 5` on a string column must NOT synthesize (str order is not
+    # numeric order; Python would happily repeat-concatenate)
+    rw = _rw("s * 2 > 5")
+    assert not synthesis.can_exclude(rw)
+
+
+def test_narrowing_cast_of_string_gated():
+    rw = _rw("cast(s as long) > 5")
+    assert not synthesis.can_exclude(rw)
+
+
+def test_synthesis_conf_off_restores_base():
+    with conf.set_temporarily(**{"delta.tpu.read.predicateSynthesis": False}):
+        rw = _rw("a * b > 10")
+    assert not synthesis.can_exclude(rw)
+
+
+# ---------------------------------------------------------------------------
+# NOT pushdown (satellite bugfix) — conservatism
+# ---------------------------------------------------------------------------
+
+
+def test_not_pushdown_comparisons():
+    def base(s):
+        return pruning.skipping_predicate(parse_predicate(s), frozenset(),
+                                          TYPES)
+
+    assert base("not a < 5").sql() == base("a >= 5").sql()
+    assert base("not a >= 5").sql() == base("a < 5").sql()
+    # Not(Ne) ≡ Eq needs no type gate (both FALSE for NaN)
+    assert pruning.skipping_predicate(parse_predicate("not a != 5")).sql() \
+        == base("a = 5").sql()
+    # Not(Eq) stays UNKNOWN (documented conservatism)
+    assert not synthesis.can_exclude(base("not a = 5"))
+    # De Morgan: each branch rewrites conservatively
+    assert synthesis.can_exclude(base("not (a < 5 and b < 5)"))
+
+
+def test_not_inequality_flip_gated_on_float_nan_hazard():
+    """`NOT (f < L)` is TRUE for a NaN row while `f >= L` is FALSE — the
+    flip must not fire for floating columns (min/max stats ignore NaN, so
+    it would prune the NaN row's file)."""
+    rw = _rw("not f < 3000")
+    assert not synthesis.can_exclude(rw)
+    # and the full scenario: a file whose only match is the NaN row
+    rows = [{"a": 1, "b": 1, "f": math.nan, "s": None, "d": None, "ts": None},
+            {"a": 2, "b": 1, "f": 10.0, "s": None, "d": None, "ts": None}]
+    _soundness_case(parse_predicate("not f < 3000"), [rows])
+    # typeless callers keep the old UNKNOWN behavior for inequalities
+    assert not synthesis.can_exclude(
+        pruning.skipping_predicate(parse_predicate("not a < 5")))
+
+
+def test_not_pushdown_conservative_on_nulls():
+    """Not(Lt(a, 5)) ≡ Ge(a, 5) under 3-valued logic: a NULL row matches
+    neither, so pruning to the flipped comparison never drops a match."""
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        files = [_gen_rows(rng) for _ in range(FILES_PER_CASE)]
+        cmp_cls = rng.choice(_CMPS)
+        pred = ir.Not(cmp_cls(ir.Column("a"), _lit_num(rng)))
+        _soundness_case(pred, files)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: result identity + attribution parity + both tiers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def synth_table(tmp_table):
+    with conf.set_temporarily(**{
+        "delta.tpu.write.rowGroupRows": 250,
+        "delta.tpu.write.targetFileRows": 1000,
+    }):
+        n = 4000
+        ids = np.arange(n, dtype=np.int64)
+        base = dt.datetime(2021, 1, 1)
+        t = pa.table({
+            "id": ids,
+            "price": ids,  # sorted: tight per-file/group bounds
+            "qty": pa.array([None if i % 13 == 0 else int(i % 7) + 1
+                             for i in range(n)], pa.int64()),
+            "sym": pa.array([f"{'us-w' if i < n // 2 else 'eu-c'}{i:06d}"
+                             for i in range(n)]),
+            "ts": pa.array([base + dt.timedelta(hours=i) for i in range(n)],
+                           pa.timestamp("us")),
+        })
+        log = DeltaLog.for_table(tmp_table)
+        WriteIntoDelta(log, "append", t).run()
+    return DeltaTable.for_path(tmp_table)
+
+
+E2E_PREDICATES = [
+    "price * qty > 26000",
+    "price * 2 + 10 >= 7000",
+    "(price - 100) / 4 <= 20",
+    "- price >= -50",
+    "substr(sym, 1, 4) = 'eu-c'",
+    "sym like 'us-w0001%'",
+    "cast(price as double) * 1.5 > 5900",
+    "not (price < 3900)",
+    "to_date(ts) = '2021-02-01'",
+    "price * qty > 26000 or sym like 'zz%'",
+    "price % 1000 >= 0 and price * 3 > 11500",
+]
+
+
+@pytest.mark.parametrize("pred", E2E_PREDICATES)
+def test_e2e_result_identity(synth_table, pred):
+    on = synth_table.to_arrow(filters=[pred])
+    with conf.set_temporarily(**{"delta.tpu.read.predicateSynthesis": False}):
+        off = synth_table.to_arrow(filters=[pred])
+    assert on.sort_by("id").equals(off.sort_by("id"))
+
+
+def test_e2e_synthesis_actually_prunes(synth_table):
+    from delta_tpu.obs import scan_report
+
+    telemetry.reset_all()
+    synth_table.to_arrow(filters=["price * qty > 26000"])
+    rep = scan_report.last_scan_report()
+    assert rep.files_pruned > 0 and rep.row_groups_pruned > 0
+    assert rep.bytes_skipped > 0
+    with conf.set_temporarily(**{"delta.tpu.read.predicateSynthesis": False}):
+        telemetry.reset_all()
+        synth_table.to_arrow(filters=["price * qty > 26000"])
+        rep_off = scan_report.last_scan_report()
+    assert rep_off.files_pruned == 0 and rep_off.row_groups_pruned == 0
+
+
+def test_e2e_rewrites_fired_matches_counter(synth_table):
+    from delta_tpu.obs import scan_report
+
+    telemetry.reset_all()
+    synth_table.to_arrow(
+        filters=["price * qty > 26000 and substr(sym, 1, 4) = 'us-w'"])
+    rep = scan_report.last_scan_report()
+    fired = telemetry.counters().get("scan.rewrites.fired", 0)
+    assert len(rep.rewrites_fired) == fired > 0
+    families = {f["family"] for f in rep.rewrites_fired}
+    assert "arithmetic" in families and "string" in families
+    for f in rep.rewrites_fired:
+        assert f["conjunct"] and f["rewrite"]
+    # the journal fingerprint marks the same conjuncts synthesizable
+    from delta_tpu.obs import journal
+
+    log = synth_table.delta_log
+    journal.flush(log.log_path)
+    scans = journal.read_entries(log.log_path, kinds=("scan",))
+    fp = scans[-1]["fingerprint"]
+    assert all(c["synthesizable"] for c in fp["conjuncts"])
+    assert fp["prunableColumns"]
+
+
+def test_e2e_rowgroup_tier_without_file_tier(synth_table):
+    """The row-group planner fires on the same rewrite even when the file
+    tier can't help (predicate selective within files only)."""
+    from delta_tpu.exec import rowgroups
+
+    snap = synth_table.delta_log.update()
+    scan = pruning.files_for_scan(snap, [parse_predicate("price * 2 >= 500")])
+    add = scan.files[0]
+    meta = rowgroups.read_footer(
+        os.path.join(snap.delta_log.data_path, add.path))
+    plan = rowgroups.plan_row_groups(
+        meta, parse_predicate("price * 2 >= 500"), None, frozenset(),
+        synthesis.schema_types(snap.metadata))
+    assert 0 < len(plan.keep) < plan.total
+    assert plan.fired and plan.fired[0]["family"] == "arithmetic"
+
+
+def test_device_plan_path_serves_synthesized_rewrite(synth_table):
+    """Acceptance: a synthesized numeric rewrite lowers to ranges and the
+    RESIDENT device planner serves it — the router audit shows the device
+    plan path engaged (auto mode, host priced out via a calibrated
+    constant), and the scan still equals the host result."""
+    from delta_tpu.obs import router_audit
+    from delta_tpu.parallel import link
+
+    telemetry.reset_all()
+    router_audit.clear_audits()
+    link.set_calibrated("HOST_PRUNE_S_PER_CELL", 10.0)  # price the host out
+    try:
+        on = synth_table.to_arrow(filters=["price * 2 + 10 >= 7000"])
+        audits = [a for a in router_audit.recent_audits()
+                  if a["op"] == "scan.plan"]
+        assert audits and audits[-1]["decision"] == "device"
+        assert telemetry.counters().get("stateCache.scan.resident", 0) >= 1
+    finally:
+        link.clear_calibrated()
+    with conf.set_temporarily(**{"delta.tpu.read.predicateSynthesis": False}):
+        off = synth_table.to_arrow(filters=["price * 2 + 10 >= 7000"])
+    assert on.sort_by("id").equals(off.sort_by("id"))
+
+
+# ---------------------------------------------------------------------------
+# Advisor: staleShape regression over a pre-recorded journal segment
+# ---------------------------------------------------------------------------
+
+
+def test_advisor_stale_shape_from_pre_synthesis_journal(tmp_table):
+    """Journal entries recorded BEFORE the synthesis feature carry no
+    ``synthesizable`` field; when their shape is now coverable they get the
+    distinct ``staleShape`` reason instead of polluting layout/shape
+    evidence."""
+    t = DeltaTable.create(tmp_table, data=pa.table({
+        "price": pa.array(range(100), pa.int64()),
+        "qty": pa.array(range(100), pa.int64()),
+    }))
+    from delta_tpu.obs import journal
+
+    jdir = journal.journal_dir(t.delta_log.log_path)
+    os.makedirs(jdir, exist_ok=True)
+    entry = {
+        "kind": "scan", "ts": 1_600_000_000_000,
+        "report": {"filesTotal": 4, "filesAfterPartition": 4,
+                   "filesScanned": 4, "rowGroupsTotal": 4,
+                   "rowGroupsPruned": 0, "rowGroupsLateSkipped": 0},
+        "fingerprint": {
+            "columns": ["price", "qty"],
+            "conjuncts": [{"shape": "gt(mul(price,qty),?)",
+                           "columns": ["price", "qty"],
+                           "prunable": False, "partition": False}],
+            "prunableColumns": [], "residualColumns": ["price", "qty"],
+            "key": "gt(mul(price,qty),?)",
+        },
+    }
+    seg = os.path.join(jdir, "journal-0000000000001-99999-000001.jsonl")
+    with open(seg, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    rep = t.advise()
+    [g] = [g for g in rep.facts["neverPruned"]
+           if g["fingerprint"] == "gt(mul(price,qty),?)"]
+    assert g["reason"].startswith("staleShape")
+    # a genuinely uncoverable legacy shape still reads as 'shape'
+    entry["fingerprint"] = {
+        "columns": ["price"], "conjuncts": [
+            {"shape": "eq(coalesce(price,?),?)", "columns": ["price"],
+             "prunable": False, "partition": False}],
+        "prunableColumns": [], "residualColumns": ["price"],
+        "key": "eq(coalesce(price,?),?)",
+    }
+    with open(seg, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    rep = t.advise()
+    [g2] = [g2 for g2 in rep.facts["neverPruned"]
+            if g2["fingerprint"] == "eq(coalesce(price,?),?)"]
+    assert g2["reason"].startswith("shape")
+
+
+# ---------------------------------------------------------------------------
+# Unit: rewrite shapes
+# ---------------------------------------------------------------------------
+
+
+def test_single_column_inversion_is_exact_lane_comparison():
+    rw = _rw("price * 2 + 10 >= 1000", {"price": LongType()})
+    assert rw.sql() == "(`max.price` >= 495)"
+    rw = _rw("price * -2 >= 10", {"price": LongType()})
+    assert rw.sql() == "(`min.price` <= -5)"
+    rw = _rw("100 - price < 40", {"price": LongType()})
+    assert rw.sql() == "(`max.price` > 60)"
+
+
+def test_trunc_cast_pads_one_unit():
+    rw = _rw("cast(f as long) = 10", {"f": DoubleType()})
+    assert "9" in rw.sql() and "11" in rw.sql()
+
+
+def test_interval_mul_emits_four_endpoint_products():
+    rw = _rw("a * b > 100", {"a": LongType(), "b": LongType()})
+    assert rw.sql().count("*") == 4
+
+
+def test_classify_family():
+    assert synthesis.classify_family(parse_predicate("a * b > 1")) == "arithmetic"
+    assert synthesis.classify_family(
+        parse_predicate("substr(s, 1, 2) = 'ab'")) == "string"
+    assert synthesis.classify_family(
+        parse_predicate("cast(a as long) > 1")) == "cast"
+    assert synthesis.classify_family(parse_predicate("not a = 1")) == "not"
