@@ -1442,6 +1442,187 @@ def bench_resident_probe(workdir):
     }
 
 
+# -- config 11: fleet observability plane ------------------------------------
+
+
+def bench_fleet(workdir):
+    """Config 11: K registered tables x a skewed (one-hot-table) commit +
+    scan workload. Measures what the fleet plane costs and what it serves:
+
+    * scraper steady-state overhead — the same workload with the
+      ``delta-obs-scraper`` daemon OFF vs ON (hot 100ms interval, SLO
+      evaluation riding every scrape), and the same pair again under a
+      telemetry blackout, where the ON leg must cost ≈0 (the blackout
+      guarantee: a ticking scraper does no registry work);
+    * /fleet and /slo route latency (p50/p95 over N GETs) with the rings
+      warm and a live doctor sweep per /fleet request.
+    """
+    import http.client
+
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.obs import fleet, slo, timeseries
+    from delta_tpu.obs.server import ObsServer
+    from delta_tpu.utils.config import conf
+
+    K = 6
+    ops_per_leg = max(int(400 * min(SCALE, 2.0)), 40)
+    base = os.path.join(workdir, "fleet")
+    rng = np.random.RandomState(7)
+
+    def ids(n, start=0):
+        import pyarrow as pa
+
+        return pa.table({"id": np.arange(start, start + n).astype("int64")})
+
+    tables = []
+    for i in range(K):
+        path = f"{base}/t{i}"
+        tables.append(DeltaTable.create(path, data=ids(2000)))
+
+    # skew: table 0 takes ~half the traffic (the hot-table case the SLO
+    # attribution exists for)
+    picks = np.where(rng.rand(ops_per_leg) < 0.5, 0,
+                     rng.randint(1, K, ops_per_leg))
+
+    def leg():
+        # overwrite, not append: a leg must not grow the tables and bias
+        # the next leg's scan/commit cost (the on-vs-off comparison needs
+        # identical work per leg)
+        for j, i in enumerate(picks):
+            t = tables[int(i)]
+            if j % 3 == 0:
+                t.write(ids(50, start=10_000 + 50 * j), mode="overwrite")
+            else:
+                t.to_arrow(filters=[f"id < {50 + (j % 200)}"])
+
+    leg()  # warm caches/JITs so the off leg isn't paying one-time costs
+    timeseries.reset()
+    slo.reset()
+    # interleaved min-of-2 per leg (config 9's idiom): off/on/off/on, so
+    # drift affects both legs alike and host noise is floored by the min
+    def on_leg():
+        with conf.set_temporarily(
+                **{"delta.tpu.obs.scrape.intervalMs": 100}):
+            timeseries.start_scraper()
+            try:
+                return _timed(leg)[0]
+            finally:
+                timeseries.stop_scraper()
+
+    # ABBA order: the log tail grows a little every leg, so a fixed
+    # off-then-on order would bill that drift entirely to the ON side
+    offs, ons = [], []
+    offs.append(_timed(leg)[0]); ons.append(on_leg())
+    ons.append(on_leg()); offs.append(_timed(leg)[0])
+    off_s, on_s = min(offs), min(ons)
+    scrapes_on = timeseries.scrape_count()
+    overhead_pct = (on_s / off_s - 1.0) * 100.0
+
+    # blackout pair: the scraper daemon ticking over a disabled registry.
+    # Rings reset first so the leg's own counts are what gets asserted —
+    # the ON leg above legitimately filled them
+    timeseries.reset()
+    slo.reset()
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        dark_offs, dark_ons = [], []
+        dark_offs.append(_timed(leg)[0]); dark_ons.append(on_leg())
+        dark_ons.append(on_leg()); dark_offs.append(_timed(leg)[0])
+        dark_off_s, dark_on_s = min(dark_offs), min(dark_ons)
+        dark_scrapes = timeseries.scrape_count()
+        dark_series = len(timeseries.series_snapshot()["counters"])
+    blackout_overhead_pct = (dark_on_s / dark_off_s - 1.0) * 100.0
+
+    # route latency with the rings warm and the registry full
+    with conf.set_temporarily(
+            **{"delta.tpu.obs.scrape.intervalMs": 100}):
+        timeseries.start_scraper()
+        srv = ObsServer(port=0)
+        try:
+            def get(route):
+                c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                               timeout=30)
+                try:
+                    c.request("GET", route)
+                    r = c.getresponse()
+                    assert r.status == 200, route
+                    return r.read()
+                finally:
+                    c.close()
+
+            get("/fleet")  # warm the sweep path once
+            n_req = 30
+            fleet_ms = sorted(
+                _timed(lambda: get("/fleet"))[0] * 1000
+                for _ in range(n_req))
+            slo_ms = sorted(
+                _timed(lambda: get("/slo"))[0] * 1000
+                for _ in range(n_req))
+            fleet_doc = json.loads(get("/fleet"))
+        finally:
+            srv.stop()
+            timeseries.stop_scraper()
+
+    assert fleet_doc["tables"] >= K
+    ranked = fleet_doc["sweep"]["entries"]
+
+    def pct(samples, q):
+        # upper-rounded index: p95 over 30 samples is the 29th, not ~p91
+        import math
+
+        return round(samples[min(len(samples) - 1,
+                                 math.ceil(q * len(samples)) - 1)], 2)
+
+    p50 = pct(fleet_ms, 0.50)
+    return {
+        "metric": "fleet_route_p50_ms",
+        "value": p50,
+        "unit": "ms",
+        "vs_baseline": 0,
+        "baseline": "no prior fleet plane: first-round absolute numbers",
+        "tables": K,
+        "ops_per_leg": ops_per_leg,
+        "route_fleet_ms": {"p50": p50, "p95": pct(fleet_ms, 0.95)},
+        "route_slo_ms": {"p50": pct(slo_ms, 0.50),
+                         "p95": pct(slo_ms, 0.95)},
+        "scraper": {
+            "off_s": round(off_s, 3), "on_s": round(on_s, 3),
+            "overhead_pct": round(overhead_pct, 2),
+            "scrapes_during_leg": scrapes_on,
+        },
+        "blackout": {
+            "off_s": round(dark_off_s, 3), "on_s": round(dark_on_s, 3),
+            "overhead_pct": round(blackout_overhead_pct, 2),
+            "scrapes": dark_scrapes, "series": dark_series,
+            "inert": _assert_blackout_inert(dark_scrapes, dark_series),
+        },
+        "sweep_ranked_tables": len(ranked),
+        "gate": {
+            "route_slo_p50_ms": {
+                "value": pct(slo_ms, 0.50), "unit": "ms"},
+            "sweep_tables": {"value": len(ranked), "unit": "tables"},
+        },
+        "note": "overhead legs share one warmed workload fn in ABBA "
+                "order (min-of-2 per side) and run the scraper at 100ms "
+                "— 100x hotter than the 10s default; measured on/off "
+                "deltas land within this host's ±15% wall-clock noise "
+                "band in BOTH directions across rounds, i.e. the "
+                "steady-state cost is not distinguishable from zero at "
+                "this cadence (and is ~1/100th of whatever it is at the "
+                "default 10s). blackout inert=true is the structural "
+                "assertion: zero scrapes recorded AND zero series "
+                "retained while the daemon ticked through the dark leg",
+    }
+
+
+def _assert_blackout_inert(scrapes, series):
+    # the blackout guarantee is ASSERTED, not just recorded: a scraper that
+    # does registry work under blackout must fail the config (the wall-
+    # clock delta stays recorded-only — it is host-noise-bound)
+    assert scrapes == 0 and series == 0, (
+        f"blackout leg not inert: scrapes={scrapes} series={series}")
+    return True
+
+
 # -- config 9: sustained-contention commit path (group commit) ---------------
 
 
@@ -1632,6 +1813,11 @@ def _reset_engine_state():
         from delta_tpu import autopilot
 
         autopilot.reset()
+        from delta_tpu.obs import fleet, slo, timeseries
+
+        timeseries.reset()
+        slo.reset()
+        fleet.reset()
     except Exception:
         pass
 
@@ -1689,6 +1875,7 @@ def main():
         "6": lambda: bench_hot_plan(workdir),
         "6p": lambda: bench_hot_plan(workdir, partitioned=True),
         "10": lambda: bench_pushdown(workdir),
+        "11": lambda: bench_fleet(workdir),
         "8": lambda: bench_resident_probe(workdir),
         "5": lambda: bench_checkpoint_replay(workdir),
         "3": lambda: bench_zorder_point_query(workdir),
@@ -1752,7 +1939,9 @@ def main():
                 out["telemetry"] = telemetry.bench_snapshot(
                     include=("scan.rowgroups", "scan.bytes.skipped",
                              "scan.rewrites", "footerCache", "table.health",
-                             "router", "device.hbm", "journal", "advisor"),
+                             "router", "device.hbm", "journal", "advisor",
+                             "fleet", "slo", "obs.scrape",
+                             "obs.server.clientAborts"),
                 )
         except Exception:  # noqa: BLE001 — metrics must never fail the bench
             pass

@@ -46,6 +46,7 @@ REGISTERED_POOLS = frozenset({
     "delta-join-upload",          # ops/join_kernel.py async kernel launch
     "delta-object-store-http",    # storage/object_store_emulator.py server
     "delta-autopilot",            # autopilot/daemon.py maintenance daemon
+    "delta-obs-scraper",          # obs/timeseries.py metrics scraper daemon
 })
 
 _CTOR_KW = {

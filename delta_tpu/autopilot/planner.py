@@ -189,10 +189,27 @@ def _advisor_actions(advisor_report) -> List[MaintenanceAction]:
 def plan(doctor_report, advisor_report) -> List[MaintenanceAction]:
     """Merge both surfaces into one deduped, priority-ordered plan.
     Cooldown/backoff filtering happens in the daemon (it owns the ledger
-    read) — this is the raw decision layer."""
+    read) — this is the raw decision layer.
+
+    A firing per-table SLO alert (`obs/slo`) boosts every planned action
+    for that table by ``delta.tpu.obs.slo.priorityBoost`` and is cited in
+    the action's evidence — across a fleet, the burning table's
+    maintenance outranks routine debt elsewhere."""
     merged: Dict[str, MaintenanceAction] = {}
     for a in _doctor_actions(doctor_report) + _advisor_actions(advisor_report):
         prev = merged.get(a.key)
         if prev is None or a.priority > prev.priority:
             merged[a.key] = a
+    if merged:
+        from delta_tpu.obs import slo
+
+        boost, alerts = slo.priority_boost(doctor_report.path)
+        if boost:
+            for a in merged.values():
+                a.priority += boost
+                a.evidence["sloAlerts"] = [
+                    {"objective": al["objective"],
+                     "burnFast": al["burnFast"], "burnSlow": al["burnSlow"]}
+                    for al in alerts]
+                a.evidence["sloPriorityBoost"] = boost
     return sorted(merged.values(), key=lambda a: -a.priority)

@@ -119,6 +119,11 @@ class DeltaLog:
         # recovery): listings skip them so update()'s early-exit holds
         self._corrupt_checkpoints: frozenset = frozenset()
         self._initialize()
+        # fleet registry (obs/fleet): weakref'd — the registry never keeps
+        # a table alive — and inert under a telemetry blackout
+        from delta_tpu.obs import fleet as fleet_mod
+
+        fleet_mod.register(self)
 
     @property
     def corrupt_checkpoints(self) -> frozenset:
@@ -234,7 +239,14 @@ class DeltaLog:
         return self._do_update()
 
     def _do_update(self) -> Snapshot:
+        from delta_tpu.obs import fleet as fleet_mod
         from delta_tpu.utils import telemetry
+
+        # re-offer this handle to the fleet registry: a table constructed
+        # under a telemetry blackout that later lifted must not stay
+        # invisible for the life of the process (a lock-free dict probe
+        # when already registered, a conf check when still dark)
+        fleet_mod.register(self)
 
         t_arrive = time.monotonic()
         with self._update_lock, telemetry.record_operation(

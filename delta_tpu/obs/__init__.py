@@ -14,6 +14,9 @@
 * :mod:`delta_tpu.obs.actions` — the shared maintenance-action catalog
   (doctor remedies ≡ advisor remedies ≡ autopilot actions)
 * :mod:`delta_tpu.obs.metric_names` — the single catalog of metric names
+* :mod:`delta_tpu.obs.fleet` — process-wide table registry + ranked sweeps
+* :mod:`delta_tpu.obs.timeseries` — scraped metric rings (windowed series)
+* :mod:`delta_tpu.obs.slo` — SLO objectives with multi-window burn alerts
 
 Importing this package installs the (inert-until-configured) flight-recorder
 failure hook; everything else is pull-by-call.
@@ -21,6 +24,7 @@ failure hook; everything else is pull-by-call.
 from delta_tpu.obs import flight_recorder as _flight_recorder
 from delta_tpu.obs.advisor import AdvisorReport, advise
 from delta_tpu.obs.doctor import TableHealthReport, doctor
+from delta_tpu.obs.fleet import fleet_advise, fleet_doctor
 from delta_tpu.obs.scan_report import ScanReport, last_scan_report
 from delta_tpu.obs.server import ObsServer, start_server, stop_server
 
@@ -29,4 +33,5 @@ _flight_recorder.install()
 __all__ = [
     "doctor", "TableHealthReport", "ScanReport", "last_scan_report",
     "ObsServer", "start_server", "stop_server", "advise", "AdvisorReport",
+    "fleet_doctor", "fleet_advise",
 ]

@@ -510,6 +510,15 @@ def _write_batch(jdir: str, entries: List[dict]) -> int:
         telemetry.bump_counter("journal.segments.written")
     telemetry.bump_counter("journal.entries", len(lines))
     telemetry.bump_counter("journal.bytes.written", len(data))
+    # per-table write volume for the fleet plane (label: hashed table path
+    # — jdir is <table>/_delta_log/_journal). KiB, not bytes: the shared
+    # log2 histogram buckets top out at 65536, so byte-valued flushes over
+    # 64 KiB would all collapse into +Inf
+    from delta_tpu.obs.fleet import table_label
+
+    table_path = os.path.dirname(os.path.dirname(jdir))
+    telemetry.observe("journal.flushKb", len(data) / 1024.0,
+                      table=table_label(table_path))
     return len(lines)
 
 

@@ -68,6 +68,13 @@ GAUGES = frozenset({
     "analysis.findings",
     # -- autopilot maintenance scheduler (delta_tpu/autopilot, label: path)
     "autopilot.lastRunTimestamp",
+    # -- fleet observability plane (obs/fleet, obs/timeseries, obs/slo) ---
+    "fleet.tables",               # live registered DeltaLogs
+    "obs.scrape.series",          # series held in the scrape rings
+    "slo.burnRate",               # labels: objective, table, window
+    "slo.alerts",                 # alerts currently firing
+    # -- resident key cache per-table residency (ops/key_cache, label: table)
+    "keyCache.residentBytes",
 })
 
 #: Counters introduced by the obs layer and its doctor feeds.
@@ -116,6 +123,13 @@ COUNTERS = frozenset({
     "autopilot.actions.failed",   # genuine execution failures
     "autopilot.contentionAborts",  # maintenance commits that lost to
                                    # foreground writers and backed off
+    # -- fleet observability plane (obs/fleet, obs/timeseries, obs/slo) ---
+    "obs.server.clientAborts",    # responses cut short by a client hangup
+    "obs.scrape.ticks",           # scraper passes over the registry
+    "fleet.sweeps",               # fleet_doctor/fleet_advise sweeps run
+    "slo.evaluations",            # SLO evaluation passes
+    "slo.alerts.fired",           # alerts that crossed both burn windows
+    "slo.alerts.cleared",         # alerts cleared by fast-window recovery
 })
 
 #: Every OTHER counter the engine bumps by constant name — the inverse lint
@@ -171,8 +185,10 @@ HISTOGRAMS = frozenset({
     "commit.queueWaitMs",
     "delta.checkpoint.duration_ms",
     "delta.commit.duration_ms",
+    "delta.scan.planning.duration_ms",
     "delta.streaming.sink.batch_ms",
     "delta.streaming.source.batch_ms",
+    "journal.flushKb",
     "router.predicted_ms",
     "router.actual_ms",
 })
@@ -206,6 +222,15 @@ PUBLIC_API = {
                 "RECOMMENDATION_ACTIONS", "COOLDOWN_PHASES", "spec",
                 "remedy_name", "executable_kinds", "action_key",
                 "attempts_in_cooldown"),
+    "fleet": ("enabled", "register", "unregister", "live_tables",
+              "table_label", "label_path", "fleet_doctor", "fleet_advise",
+              "fleet_status", "FleetEntry", "FleetReport", "reset"),
+    "timeseries": ("Scraper", "start_scraper", "stop_scraper", "scrape_once",
+                   "scrape_count", "counter_window", "quantile_window",
+                   "histogram_labels", "series_snapshot", "reset"),
+    "slo": ("SloObjective", "SloAlert", "SloBreach", "objectives",
+            "evaluate", "active_alerts", "priority_boost", "status",
+            "reset"),
 }
 
 
@@ -255,6 +280,11 @@ DESCRIPTIONS = {
     "table.maintenance.lastOptimizeVersion": "Table version written by the last OPTIMIZE.",
     "table.maintenance.lastVacuumTimestamp": "Wall-clock ms of the last VACUUM.",
     "analysis.findings": "Non-baselined static-analysis findings per rule (tools/analyze.py).",
+    "fleet.tables": "DeltaLog handles registered in the process-wide fleet registry.",
+    "obs.scrape.series": "Distinct series retained in the obs scraper's in-memory rings.",
+    "slo.burnRate": "Observed-over-objective burn rate per objective/table/window.",
+    "slo.alerts": "SLO alerts currently firing.",
+    "keyCache.residentBytes": "HBM-resident key-cache slab bytes per table.",
     # counters — obs layer
     "obs.incidents.written": "Flight-recorder incident files written.",
     "obs.server.requests": "HTTP requests served by the obs endpoint.",
@@ -291,6 +321,12 @@ DESCRIPTIONS = {
     "autopilot.actions.deferred": "Maintenance actions deferred (window not quiet, backoff, or busy).",
     "autopilot.actions.failed": "Maintenance actions that failed outright.",
     "autopilot.contentionAborts": "Maintenance commits that lost to foreground writers and backed off.",
+    "obs.server.clientAborts": "HTTP responses cut short by a client disconnect (BrokenPipe/ConnectionReset).",
+    "obs.scrape.ticks": "Scraper passes snapshotting the metrics registry into rings.",
+    "fleet.sweeps": "Fleet-wide doctor/advisor sweeps over the table registry.",
+    "slo.evaluations": "SLO burn-rate evaluation passes.",
+    "slo.alerts.fired": "SLO alerts fired (both burn windows crossed 1.0).",
+    "slo.alerts.cleared": "SLO alerts cleared by fast-window recovery below the hysteresis ratio.",
     # counters — engine
     "checkpoint.parts": "Checkpoint part files written.",
     "checkpoint.actions": "Actions serialized into checkpoints.",
@@ -334,6 +370,8 @@ DESCRIPTIONS = {
     "stateExport.statsLanes.us": "Checkpoint stats decoded with microsecond timestamps.",
     "streaming.sink.batches": "Micro-batches written by the streaming sink.",
     # histograms
+    "delta.scan.planning.duration_ms": "Scan-planning (file pruning) latency per table (ms).",
+    "journal.flushKb": "JSONL KiB per journal flush batch, labeled per table.",
     "commit.group.batchSize": "Transactions written per group-commit batch.",
     "commit.queueWaitMs": "Time a grouped commit waited in the coordinator queue (ms).",
     "delta.checkpoint.duration_ms": "Checkpoint write latency (ms).",

@@ -23,7 +23,17 @@ Route          Payload
                last run per table — :func:`delta_tpu.autopilot.status`);
                with ``?path=/data/tbl`` also the table's action ledger
                tail (``?limit=N``, default 32)
+``/fleet``     table-registry status (:func:`delta_tpu.obs.fleet.
+               fleet_status`) plus a ranked sweep: ``?sweep=doctor``
+               (default) or ``advisor``, ``?limit=N`` tails the ranking;
+               ``?series=<prefix>`` attaches the scraped time series
+``/slo``       SLO monitor state (:func:`delta_tpu.obs.slo.status`):
+               objectives, burn rates per window, firing + cleared alerts
 =============  ==============================================================
+
+Query parameters degrade, never 500: every numeric param goes through
+:func:`_q_int`, so ``?limit=abc`` behaves like an absent param on EVERY
+route (the pre-unification ``/events`` handler 500'd on it).
 
 Nothing listens unless :func:`start_server` is called (port argument or
 ``delta.tpu.obs.port``); the server is a ``ThreadingHTTPServer`` on a daemon
@@ -44,17 +54,38 @@ from delta_tpu.utils.config import conf
 __all__ = ["ObsServer", "start_server", "stop_server"]
 
 
+def _q_int(q, name: str, default: Optional[int] = None) -> Optional[int]:
+    """One parser for every numeric query param: absent OR malformed values
+    degrade to ``default`` — an operator's typo'd ``?limit=abc`` must serve
+    the route's default view, not a 500 (the rule /router and /advisor
+    already followed, now shared by construction)."""
+    vals = q.get(name)
+    if not vals:
+        return default
+    try:
+        return int(vals[0])
+    except (TypeError, ValueError):
+        return default
+
+
 class _Handler(BaseHTTPRequestHandler):
     # the engine's logger, not stderr-per-request
     def log_message(self, fmt, *args):  # noqa: D401 — stdlib signature
         telemetry.logger.debug("obs.server %s", fmt % args)
 
     def _reply(self, status: int, body: bytes, content_type: str) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # the client hung up mid-response: counting it is the whole
+            # story — re-raising would send the broad do_GET handler off
+            # to serve a 500 on the same dead socket and spam the logger
+            telemetry.bump_counter("obs.server.clientAborts")
+            self.close_connection = True
 
     def _json(self, payload, status: int = 200) -> None:
         body = json.dumps(payload, default=str).encode("utf-8")
@@ -78,9 +109,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/events":
                 prefix = q.get("prefix", [""])[0]
                 events = telemetry.recent_events(prefix)
-                limit = q.get("limit", [None])[0]
+                limit = _q_int(q, "limit")
                 if limit is not None:
-                    n = max(int(limit), 0)
+                    n = max(limit, 0)
                     events = events[-n:] if n else []
                 self._json([json.loads(e.to_json()) for e in events])
             elif route == "/trace":
@@ -98,10 +129,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if not path:
                     self._json({"error": "missing ?path=<table path>"}, 400)
                     return
-                try:
-                    limit = int(q.get("limit", [None])[0] or 0) or None
-                except (TypeError, ValueError):
-                    limit = None  # like /router: a typo'd limit isn't a 500
+                limit = _q_int(q, "limit") or None
                 from delta_tpu.obs.advisor import advise
 
                 self._json(advise(path, limit=limit).to_dict())
@@ -112,10 +140,7 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = autopilot_mod.status()
                 path = q.get("path", [None])[0]
                 if path:
-                    try:
-                        limit = int(q.get("limit", [32])[0])
-                    except (TypeError, ValueError):
-                        limit = 32  # like /router: a typo'd limit isn't a 500
+                    limit = _q_int(q, "limit", 32)
                     log_path = path.rstrip("/") + "/_delta_log"
                     journal_mod.flush(log_path)
                     payload["ledger"] = journal_mod.read_entries(
@@ -125,10 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
                 from delta_tpu.obs import calibration, router_audit
                 from delta_tpu.parallel import link
 
-                try:
-                    limit = int(q.get("limit", [32])[0])
-                except (TypeError, ValueError):
-                    limit = 32
+                limit = _q_int(q, "limit", 32)
                 self._json({
                     "stats": router_audit.audit_stats(),
                     "calibration": {
@@ -138,11 +160,34 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                     "audits": router_audit.recent_audits(limit),
                 })
+            elif route == "/fleet":
+                from delta_tpu.obs import fleet, timeseries
+
+                payload = fleet.fleet_status()
+                sweep = q.get("sweep", ["doctor"])[0]
+                limit = _q_int(q, "limit")
+                if sweep in ("doctor", "advisor"):
+                    report = (fleet.fleet_doctor() if sweep == "doctor"
+                              else fleet.fleet_advise())
+                    ranked = report.to_dict()
+                    if limit is not None and limit >= 0:
+                        ranked["entries"] = ranked["entries"][:limit]
+                    payload["sweep"] = ranked
+                series_prefix = q.get("series", [None])[0]
+                if series_prefix is not None:
+                    payload["series"] = timeseries.series_snapshot(
+                        series_prefix, limit=_q_int(q, "samples"))
+                self._json(payload)
+            elif route == "/slo":
+                from delta_tpu.obs import slo
+
+                self._json(slo.status())
             else:
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
                                        "/trace", "/doctor", "/router",
-                                       "/advisor", "/autopilot"]}, 404)
+                                       "/advisor", "/autopilot", "/fleet",
+                                       "/slo"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

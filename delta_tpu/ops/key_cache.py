@@ -1114,6 +1114,12 @@ class KeyCache:
         # per-table rewrite generation (bump_epoch): entries built under an
         # older epoch are never served or cached
         self._epochs: Dict[str, int] = {}
+        # tables whose residency gauge was last published non-zero, so a
+        # full drop publishes an explicit 0 (see _publish_residency), and
+        # the last value published per table (unchanged values skip the
+        # telemetry lock)
+        self._last_resident: set = set()
+        self._published_bytes: Dict[str, int] = {}
 
     @classmethod
     def instance(cls) -> "KeyCache":
@@ -1134,6 +1140,7 @@ class KeyCache:
                 self._build_locks.pop(k, None)
                 if e is not None:
                     e.drop_device()  # return its bytes to the HBM ledger
+        self._publish_residency()
 
     def epoch(self, log_path: str) -> int:
         with self._lock:
@@ -1158,6 +1165,7 @@ class KeyCache:
                 e.drop_device()  # return its bytes to the HBM ledger
         if stale:
             bump_counter("merge.keyCache.invalidations", len(stale))
+            self._publish_residency()
 
     def register(self, entry: ResidentJoinKeys) -> bool:
         """Adopt an externally built slab (the merge cold pipeline's
@@ -1342,6 +1350,34 @@ class KeyCache:
                 # in O(1) instead of attempting a from-zero tail decode
                 e.version = snapshot.version if ok else _POISON_VERSION
 
+    def _publish_residency(self) -> None:
+        """Per-table ``keyCache.residentBytes`` gauges for the fleet plane
+        (label: hashed table path). Runs only on mutation paths (build /
+        advance / evict / invalidate / epoch bump — pure cache hits return
+        before ``_evict``); unchanged values skip the telemetry lock, and
+        tables whose last entry just dropped publish an explicit 0 so
+        scraped series show the release."""
+        from delta_tpu.obs.fleet import table_label
+        from delta_tpu.utils.telemetry import set_gauge
+
+        with self._lock:
+            by_table: Dict[str, int] = {t: 0 for t in self._last_resident}
+            for (log_path, _sig), e in self._entries.items():
+                if e.is_resident:
+                    table = log_path[:-len("/_delta_log")] \
+                        if log_path.endswith("/_delta_log") else log_path
+                    by_table[table] = by_table.get(table, 0) + e.device_bytes
+            self._last_resident = {t for t, b in by_table.items() if b}
+            changed = {t: b for t, b in by_table.items()
+                       if self._published_bytes.get(t) != b}
+            self._published_bytes.update(changed)
+            # published under the lock: two racing mutators (a drop and a
+            # register) must not land their gauge writes out of order and
+            # leave a stale value standing
+            for table, total in changed.items():
+                set_gauge("keyCache.residentBytes", total,
+                          table=table_label(table))
+
     def _evict(self, keep) -> None:
         budget = int(conf.get("delta.tpu.keyCache.maxBytes", 1 << 30))
         # the process-wide device-memory soft budget (obs/hbm_ledger): the
@@ -1373,3 +1409,4 @@ class KeyCache:
                     e.drop_device()  # return its bytes to the HBM ledger
                     if len(self._entries) <= max_entries:
                         break
+        self._publish_residency()

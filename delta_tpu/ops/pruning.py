@@ -545,7 +545,7 @@ def files_for_scan(
     step is the skipping path the reference leaves unwired. Unpartitioned
     tables with an exactly-lowerable predicate serve from the resident
     state cache instead of materializing every AddFile."""
-    from delta_tpu.utils.telemetry import record_operation, with_status
+    from delta_tpu.utils.telemetry import observe, record_operation, with_status
 
     with record_operation("delta.scan.planning") as pev:
         with with_status("Filtering files for query"):
@@ -554,7 +554,18 @@ def files_for_scan(
             filesTotal=scan.total.files, filesAfterPartition=scan.partition.files,
             filesScanned=scan.scanned.files,
         )
-        return scan
+    # unmeasured (telemetry blackout) or a bare snapshot shim (tests prune
+    # synthetic file lists with no DeltaLog behind them): skip the series
+    delta_log = getattr(snapshot, "delta_log", None)
+    if pev.duration_us is not None and delta_log is not None:
+        from delta_tpu.obs.fleet import table_label
+
+        # hashed table label ONLY — a new series has no back-compat pull
+        # toward the raw-path label, and bounded label bytes is the whole
+        # point of the hash (the fleet registry resolves it back)
+        observe("delta.scan.planning.duration_ms", pev.duration_us / 1000.0,
+                table=table_label(delta_log.data_path))
+    return scan
 
 
 def _files_for_scan_impl(

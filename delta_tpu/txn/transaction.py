@@ -423,6 +423,10 @@ class OptimisticTransaction:
             # (the reference's `CommitStats` recordDeltaEvent), with the
             # command's operationMetrics riding along when history metrics
             # are enabled — the same gate as CommitInfo.operationMetrics.
+            # function-level like every engine-side obs import — the obs
+            # package must load lazily, not as an engine import side effect
+            from delta_tpu.obs.fleet import table_label as _table_label
+
             stats_data = self.stats.to_event_data()
             stats_data["operation"] = op.name
             op_metrics = self._final_metrics(op)
@@ -437,7 +441,8 @@ class OptimisticTransaction:
                 stats_data["batchSize"] = gm["batchSize"]
                 stats_data["queueWaitMs"] = round(gm["queueWaitMs"], 3)
                 telemetry.observe("commit.queueWaitMs", gm["queueWaitMs"],
-                                  path=self.delta_log.data_path)
+                                  path=self.delta_log.data_path,
+                                  table=_table_label(self.delta_log.data_path))
             commit_ev.data.update(stats_data)
             telemetry.record_event(
                 "delta.commit.stats", stats_data, path=self.delta_log.data_path
@@ -448,6 +453,9 @@ class OptimisticTransaction:
             telemetry.observe(
                 "delta.commit.duration_ms", self.stats.commit_duration_ms,
                 path=self.delta_log.data_path,
+                # hashed table label: the cross-table aggregation key the
+                # fleet plane (obs/fleet, obs/slo) groups by
+                table=_table_label(self.delta_log.data_path),
             )
             # workload journal: CommitStats + the reconcile outcome persist
             # across processes so the advisor can find contention windows

@@ -185,6 +185,48 @@ class SqlConf:
         # the operation's thread.
         "delta.tpu.journal.flushEntries": 64,
         "delta.tpu.journal.flushIntervalMs": 2000,
+        # -- fleet observability plane (obs/fleet, obs/timeseries, obs/slo) --
+        # Process-wide table registry: every DeltaLog auto-registers on
+        # construction (weakref'd) so fleet_doctor()/fleet_advise() can
+        # sweep all live tables. Inert under a telemetry blackout either
+        # way; this switch turns just the registry off.
+        "delta.tpu.obs.fleet.enabled": True,
+        # Metrics scraper daemon (obs/timeseries): snapshot the telemetry
+        # registry every intervalMs into bounded in-memory rings of
+        # `keep` samples per series (counter cumulatives, gauge values,
+        # histogram bucket counts). 10s x 400 ~= 67min of history —
+        # deliberately PAST the 1h SLO slow window, so the slow-window
+        # baseline is a real sample, not the counts-from-zero fallback.
+        "delta.tpu.obs.scrape.intervalMs": 10_000,
+        "delta.tpu.obs.scrape.keep": 400,
+        # Hard cap on distinct series tracked across the rings; past it
+        # the series whose value went stale longest ago are evicted
+        # (bounds memory under table churn — dead tables' labeled series
+        # stop changing and age out first).
+        "delta.tpu.obs.scrape.maxSeries": 8192,
+        # SLO burn-rate monitors (obs/slo) over the scraped series,
+        # evaluated after each scrape: an objective fires only when BOTH
+        # the fast and the slow window burn past 1.0 (multi-window rule),
+        # and clears with hysteresis once the fast window drops below
+        # clearRatio. Firing alerts write a flight-recorder incident
+        # (when incidentDir is set) and boost the autopilot's priority
+        # for the offending table's actions by priorityBoost.
+        "delta.tpu.obs.slo.enabled": True,
+        "delta.tpu.obs.slo.fastWindowMs": 300_000,
+        "delta.tpu.obs.slo.slowWindowMs": 3_600_000,
+        "delta.tpu.obs.slo.clearRatio": 0.8,
+        # Observation floor per window before an alert may fire: right
+        # after scraper start both windows see the same counts-from-zero
+        # delta, so one cold-start outlier must not page.
+        "delta.tpu.obs.slo.minObservations": 10,
+        "delta.tpu.obs.slo.priorityBoost": 25.0,
+        # Default objectives (obs/slo.objectives): per-table latency
+        # quantiles and process-wide failure-rate ceilings.
+        "delta.tpu.obs.slo.commitLatencyP99Ms": 2_000.0,
+        "delta.tpu.obs.slo.scanPlanningP99Ms": 500.0,
+        "delta.tpu.obs.slo.commitConflictRate": 0.05,
+        "delta.tpu.obs.slo.retryExhaustionRate": 0.02,
+        "delta.tpu.obs.slo.journalDropRate": 0.01,
         # Streaming backlog gauges walk at most this many pending files past
         # each batch end (a deeply lagging consumer must not re-read its
         # whole remaining log tail per micro-batch; the published count is a

@@ -60,6 +60,7 @@ __all__ = [
     "export_chrome_trace", "current_span", "add_span_data", "reset_all",
     "HISTOGRAM_BUCKETS", "span_stack_snapshot", "add_failure_hook",
     "remove_failure_hook", "span_context", "adopt_span_context", "propagated",
+    "histogram_rows", "bucket_quantile", "drop_labeled_series",
 ]
 
 
@@ -446,6 +447,36 @@ def histograms(prefix: str = "") -> Dict[LabelKey, "_Histogram"]:
         return {k: v for k, v in _HISTOGRAMS.items() if _prefix_match(k[0], prefix)}
 
 
+def histogram_rows(prefix: str = "") -> List[Tuple[str, Tuple[Tuple[str, str], ...], List[int], float, int]]:
+    """Immutable ``(name, labels, bucket_counts, sum, count)`` rows for every
+    labeled histogram matching ``prefix`` — the payloads are COPIED under the
+    lock, so the obs scraper (`obs/timeseries`) can diff cumulative bucket
+    counts across scrapes without holding any reference to live state."""
+    with _LOCK:
+        return [(n, lb, list(h.counts), h.sum, h.count)
+                for (n, lb), h in _HISTOGRAMS.items()
+                if _prefix_match(n, prefix)]
+
+
+def drop_labeled_series(**labels: str) -> int:
+    """Remove every gauge/histogram series whose label set contains ALL of
+    ``labels`` (e.g. ``drop_labeled_series(table=<hash>)``); returns the
+    series dropped. The registry otherwise never forgets a labeled series,
+    so per-table series would accumulate for the life of a long-running
+    process under table churn — the fleet registry calls this when a
+    table's handle dies (obs/fleet.live_tables). Counters are label-free
+    and unaffected."""
+    want = {(k, str(v)) for k, v in labels.items()}
+    dropped = 0
+    with _LOCK:
+        for store in (_GAUGES, _HISTOGRAMS):
+            dead = [key for key in store if want <= set(key[1])]
+            for key in dead:
+                del store[key]
+            dropped += len(dead)
+    return dropped
+
+
 def clear_metrics() -> None:
     with _LOCK:
         _GAUGES.clear()
@@ -552,9 +583,13 @@ def _labels_suffix(labels: Tuple[Tuple[str, str], ...]) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in labels) + "}" if labels else ""
 
 
-def _hist_quantile(counts: List[int], count: int, q: float) -> Optional[float]:
+def bucket_quantile(counts: Sequence[int], count: int, q: float) -> Optional[float]:
     """Upper bucket bound where the cumulative count crosses q (approximate,
-    conservative-upward — the usual bucket-quantile estimate)."""
+    conservative-upward — the usual bucket-quantile estimate). Public: the
+    obs scraper extracts windowed quantiles from cumulative-bucket deltas
+    with exactly this rule, so /slo and bench_snapshot can never disagree.
+    Returns None for an empty histogram or a crossing past the last bound
+    (the +Inf bucket) — callers choose their own sentinel."""
     if count <= 0:
         return None
     target = q * count
@@ -564,6 +599,9 @@ def _hist_quantile(counts: List[int], count: int, q: float) -> Optional[float]:
         if cum >= target:
             return bound
     return None  # beyond the last bound (+Inf bucket) — keep JSON strict
+
+
+_hist_quantile = bucket_quantile
 
 
 def metrics_snapshot() -> Dict[str, Any]:

@@ -1,0 +1,681 @@
+"""Fleet observability plane (`delta_tpu/obs/fleet`, `obs/timeseries`,
+`obs/slo`): the process-wide table registry, the metrics scraper's bounded
+rings, the multi-window SLO burn-rate state machine, and the end-to-end
+degradation scenario (one of K tables burns its commit-latency budget ->
+exactly that table's alert fires through /slo, the flight recorder, and the
+autopilot planner; recovery clears it).
+"""
+import json
+import threading
+import time
+import urllib.parse
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from delta_tpu.api.tables import DeltaTable
+from delta_tpu.log.deltalog import DeltaLog
+from delta_tpu.obs import fleet, flight_recorder, slo, timeseries
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    for mod in (fleet, timeseries, slo):
+        mod.reset()
+    telemetry.reset_all()
+    yield
+    for mod in (fleet, timeseries, slo):
+        mod.reset()
+    telemetry.reset_all()
+
+
+def _ids(n, start=0):
+    return pa.table({"id": np.arange(start, start + n).astype("int64")})
+
+
+T0 = 1_700_000_000_000  # pinned evaluation clock (ms)
+
+#: pinned SLO windows used throughout: fast 60s, slow 600s
+WINDOWS = {"delta.tpu.obs.slo.fastWindowMs": 60_000,
+           "delta.tpu.obs.slo.slowWindowMs": 600_000}
+
+
+def _observe_commit(label, value_ms, n=1, path="/fleet/test"):
+    for _ in range(n):
+        telemetry.observe("delta.commit.duration_ms", float(value_ms),
+                          path=path, table=label)
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_deltalog_autoregisters_in_fleet(tmp_table):
+    t = DeltaTable.create(tmp_table, data=_ids(10))
+    live = fleet.live_tables()
+    assert tmp_table in live and live[tmp_table] is t.delta_log
+    status = fleet.fleet_status()
+    assert status["tables"] == 1
+    [row] = status["entries"]
+    assert row["path"] == tmp_table and row["alive"]
+    assert row["table"] == fleet.table_label(tmp_table)
+    # the registry publishes its size as a cataloged gauge
+    assert telemetry.gauges("fleet.tables")[("fleet.tables", ())] == 1
+
+
+def test_fleet_registry_blackout_inert(tmp_table):
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        DeltaTable.create(tmp_table, data=_ids(5))
+        assert fleet.live_tables() == {}
+    # the switch alone also gates it
+    with conf.set_temporarily(delta__tpu__obs__fleet__enabled=False):
+        DeltaLog.clear_cache()
+        DeltaLog.for_table(tmp_table)
+        assert fleet.live_tables() == {}
+
+
+def test_fleet_registry_weakref_never_keeps_a_table_alive(tmp_table):
+    import gc
+
+    DeltaTable.create(tmp_table, data=_ids(5))
+    assert tmp_table in fleet.live_tables()
+    DeltaLog.clear_cache()  # drop the only strong reference
+    gc.collect()
+    assert tmp_table not in fleet.live_tables()
+
+
+def test_table_label_stable_and_reversible(tmp_table):
+    a = fleet.table_label(tmp_table)
+    assert a == fleet.table_label(tmp_table)
+    assert len(a) == 12 and a != tmp_table
+    assert fleet.label_path(a) == tmp_table
+    assert fleet.label_path("nope") is None
+
+
+def test_fleet_doctor_ranks_degraded_table_first(tmp_path):
+    healthy = str(tmp_path / "healthy")
+    degraded = str(tmp_path / "degraded")
+    DeltaTable.create(healthy, data=_ids(100))
+    with conf.set_temporarily(**{"delta.tpu.write.targetFileRows": 10}):
+        DeltaTable.create(degraded, data=_ids(400))  # 40 tiny files
+    report = fleet.fleet_doctor()
+    assert report.entries[0].path == degraded
+    assert report.entries[0].severity in ("warn", "critical")
+    assert report.entries[0].worst_dimension == "smallFiles"
+    assert "OPTIMIZE" in report.entries[0].remedies
+    assert report.entries[-1].path == healthy
+    json.dumps(report.to_dict())
+    assert telemetry.counters("fleet.sweeps") == {"fleet.sweeps": 1}
+
+
+def test_fleet_doctor_survives_a_broken_table(tmp_path):
+    import shutil
+
+    ok = str(tmp_path / "ok")
+    broken = str(tmp_path / "broken")
+    DeltaTable.create(ok, data=_ids(10))
+    DeltaTable.create(broken, data=_ids(10))
+    shutil.rmtree(broken)  # the table dir vanishes under the handle
+    report = fleet.fleet_doctor()
+    by_path = {e.path: e for e in report.entries}
+    assert by_path[ok].error is None
+    # the broken table either reports an error or degrades to an empty
+    # report — either way the sweep completed with both entries present
+    assert len(report.entries) == 2
+
+
+def test_fleet_advise_ranks_by_recommendation_score(tmp_table):
+    t = DeltaTable.create(tmp_table, data=_ids(100))
+    t.to_arrow(filters=["id < 5"])
+    report = fleet.fleet_advise()
+    assert [e.path for e in report.entries] == [tmp_table]
+    assert report.entries[0].detail["status"] in ("ok", "no history")
+
+
+# -- scraper + rings ---------------------------------------------------------
+
+
+def test_scrape_once_snapshots_all_metric_kinds():
+    telemetry.bump_counter("commit.total", 5)
+    telemetry.set_gauge("fleet.tables", 2)
+    telemetry.observe("delta.commit.duration_ms", 12.0, table="abc")
+    n = timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+    assert n == 3
+    snap = timeseries.series_snapshot()
+    assert snap["counters"]["commit.total"] == [[T0, 5.0]]
+    assert snap["gauges"]["fleet.tables"] == [[T0, 2.0]]
+    [(key, samples)] = snap["histograms"].items()
+    assert key == "delta.commit.duration_ms{table=abc}"
+    assert samples == [[T0, 1, 12.0]]
+    assert timeseries.scrape_count() == 1
+
+
+def test_scrape_rings_bounded_and_resizable():
+    telemetry.bump_counter("commit.total")
+    with conf.set_temporarily(delta__tpu__obs__scrape__keep=5):
+        for i in range(20):
+            timeseries.scrape_once(now_ms=T0 + i * 1000,
+                                   evaluate_slo=False)
+        samples = timeseries.series_snapshot()["counters"]["commit.total"]
+        assert len(samples) == 5  # ring bound holds
+        assert samples[-1][0] == T0 + 19_000  # newest kept
+
+
+def test_counter_window_rate():
+    telemetry.bump_counter("commit.total", 10)
+    timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+    telemetry.bump_counter("commit.total", 30)
+    timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+    # windows never reach before the first scrape: the 10 counts that
+    # predate it are history, not signal — only the scraped delta counts
+    win = timeseries.counter_window("commit.total", 60_000,
+                                    now_ms=T0 + 10_000)
+    assert win["delta"] == 30.0 and win["ratePerSec"] == pytest.approx(3.0)
+    win = timeseries.counter_window("commit.total", 5_000,
+                                    now_ms=T0 + 10_000)
+    assert win["delta"] == 30.0 and win["ratePerSec"] == pytest.approx(3.0)
+    # a single sample can compute no delta at all
+    timeseries.reset()
+    telemetry.bump_counter("commit.total", 5)
+    timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+    win = timeseries.counter_window("commit.total", 60_000, now_ms=T0)
+    assert win["delta"] == 0.0
+
+
+def test_quantile_window_from_bucket_deltas():
+    _observe_commit("q", 10.0, n=100)
+    timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+    _observe_commit("q", 5000.0, n=100)
+    timeseries.scrape_once(now_ms=T0 + 30_000, evaluate_slo=False)
+    labels = (("path", "/fleet/test"), ("table", "q"))
+    # window covering only the slow batch: p99 lands in the 8192 bucket
+    v, n = timeseries.quantile_window("delta.commit.duration_ms", labels,
+                                      0.99, 20_000, now_ms=T0 + 30_000)
+    assert n == 100 and v == 8192.0
+    # a huge window still baselines at the FIRST scrape — the 100 fast
+    # observations that predate it never enter any window
+    v, n = timeseries.quantile_window("delta.commit.duration_ms", labels,
+                                      0.50, 600_000, now_ms=T0 + 30_000)
+    assert n == 100 and v == 8192.0
+    # empty window
+    v, n = timeseries.quantile_window("delta.commit.duration_ms", labels,
+                                      0.99, 1, now_ms=T0 + 90_000_000)
+    assert v is None and n == 0
+
+
+def test_full_ring_window_does_not_widen_to_all_time():
+    """Once a ring has evicted history, a window bigger than the retained
+    span must baseline at the oldest RETAINED sample — not fall back to
+    counts-from-zero, which would let an ancient incident keep the slow
+    burn hot forever."""
+    labels = (("path", "/fleet/test"), ("table", "ev"))
+    with conf.set_temporarily(delta__tpu__obs__scrape__keep=4):
+        _observe_commit("ev", 9000.0, n=100)      # the ancient incident
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("ev", 10.0, n=50)
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        _observe_commit("ev", 10.0, n=50)
+        for i in (2, 3, 4):                        # T0 sample falls out
+            timeseries.scrape_once(now_ms=T0 + i * 10_000,
+                                   evaluate_slo=False)
+        v, n = timeseries.quantile_window(
+            "delta.commit.duration_ms", labels, 0.99, 3_600_000,
+            now_ms=T0 + 40_000)
+    # only the 50 goods observed after the oldest retained sample count;
+    # the 100 ancient bads (and the first 50 goods) are excluded
+    assert n == 50 and v == 16.0
+
+
+def test_series_cap_evicts_stalest_series():
+    """Under table churn, dead tables' labeled series stop changing and
+    must age out once the maxSeries cap is hit."""
+    with conf.set_temporarily(delta__tpu__obs__scrape__maxSeries=10):
+        for i in range(40):                        # 40 dead-table series
+            telemetry.observe("delta.commit.duration_ms", 5.0,
+                              path=f"/dead/{i}", table=f"dead{i}")
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        for i in range(1, 6):                      # one live counter moves
+            telemetry.bump_counter("commit.total")
+            timeseries.scrape_once(now_ms=T0 + i * 10_000,
+                                   evaluate_slo=False)
+        snap = timeseries.series_snapshot()
+        total = (len(snap["counters"]) + len(snap["gauges"])
+                 + len(snap["histograms"]))
+        assert total <= 10
+        assert "commit.total" in snap["counters"]  # the live one survived
+
+
+def test_fleet_status_reports_dead_handle_before_prune(tmp_table):
+    import gc
+
+    DeltaTable.create(tmp_table, data=_ids(5))
+    DeltaLog.clear_cache()
+    gc.collect()
+    [row] = fleet.fleet_status()["entries"]
+    assert row["path"] == tmp_table and row["alive"] is False
+    fleet.live_tables()                            # prunes
+    assert fleet.fleet_status()["entries"] == []
+
+
+def test_scraper_blackout_zero_series_zero_work():
+    telemetry.bump_counter("commit.total", 5)
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        assert timeseries.scrape_once(now_ms=T0) == 0
+        assert timeseries.scrape_count() == 0
+    snap = timeseries.series_snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    # not even the scrape tick counter moved — zero wakeup work
+    assert telemetry.counters("obs.scrape") == {}
+
+
+def test_scraper_daemon_runs_and_stops():
+    telemetry.bump_counter("commit.total")
+    with conf.set_temporarily(delta__tpu__obs__scrape__intervalMs=10):
+        s = timeseries.start_scraper()
+        assert s.running
+        assert timeseries.start_scraper() is s  # idempotent
+        deadline = time.time() + 10
+        while timeseries.scrape_count() < 3 and time.time() < deadline:
+            s.tick()
+            time.sleep(0.02)
+        assert timeseries.scrape_count() >= 3
+        timeseries.stop_scraper()
+        assert not s.running
+
+
+def test_concurrent_scrape_torture():
+    """Scraper daemon at a hot interval while writer threads mutate the
+    registry: no torn snapshots (cumulative counters never decrease within
+    a ring, timestamps are monotonic), ring bounds hold."""
+    stop = threading.Event()
+
+    def load(tid):
+        i = 0
+        while not stop.is_set():
+            telemetry.bump_counter("commit.total")
+            telemetry.observe("delta.commit.duration_ms", (i % 37) + 1.0,
+                              path="/torture", table=f"tt{tid}")
+            telemetry.set_gauge("fleet.tables", i % 7)
+            i += 1
+
+    threads = [threading.Thread(target=load, args=(tid,),
+                                name=f"delta-journal-writer")  # reuse a lane
+               for tid in range(3)]
+    with conf.set_temporarily(delta__tpu__obs__scrape__intervalMs=1,
+                              delta__tpu__obs__scrape__keep=16,
+                              delta__tpu__obs__slo__enabled=False):
+        for t in threads:
+            t.start()
+        s = timeseries.start_scraper()
+        deadline = time.time() + 15
+        while timeseries.scrape_count() < 40 and time.time() < deadline:
+            s.tick()
+            time.sleep(0.005)
+        stop.set()
+        for t in threads:
+            t.join()
+        timeseries.stop_scraper()
+    assert timeseries.scrape_count() >= 40
+    snap = timeseries.series_snapshot()
+    ctr = snap["counters"]["commit.total"]
+    assert len(ctr) <= 16  # ring bound held under load
+    assert all(a[0] <= b[0] for a, b in zip(ctr, ctr[1:]))  # ts monotonic
+    assert all(a[1] <= b[1] for a, b in zip(ctr, ctr[1:]))  # never torn
+    for key, samples in snap["histograms"].items():
+        counts = [c for _t, c, _s in samples]
+        assert all(a <= b for a, b in zip(counts, counts[1:])), key
+
+
+def test_concurrent_scrape_blackout_stays_dark():
+    """The torture shape under blackout: daemon running, load running, and
+    the rings stay byte-for-byte empty."""
+    stop = threading.Event()
+
+    def load():
+        while not stop.is_set():
+            telemetry.bump_counter("commit.total")
+
+    t = threading.Thread(target=load, name="delta-journal-writer")
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False,
+                              delta__tpu__obs__scrape__intervalMs=1):
+        t.start()
+        s = timeseries.start_scraper()
+        for _ in range(20):
+            s.tick()
+            time.sleep(0.005)
+        stop.set()
+        t.join()
+        timeseries.stop_scraper()
+    assert timeseries.scrape_count() == 0
+    snap = timeseries.series_snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+
+
+# -- SLO burn-rate matrix ----------------------------------------------------
+
+
+def _eval_commit_rows(now_ms):
+    rows = slo.evaluate(now_ms=now_ms)
+    return [r for r in rows if r["objective"] == "commitLatencyP99"]
+
+
+def test_slo_both_windows_fire():
+    with conf.set_temporarily(**WINDOWS):
+        _observe_commit("bad", 10.0, n=1)  # the series must predate the
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)  # baseline
+        _observe_commit("bad", 9000.0, n=50)
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        [row] = _eval_commit_rows(T0 + 10_000)
+        assert row["burnFast"] > 1 and row["burnSlow"] > 1
+        assert row["alert"]["firing"]
+    [alert] = slo.active_alerts()
+    assert alert["objective"] == "commitLatencyP99"
+    assert alert["table"] == "bad"
+    assert telemetry.counters("slo.alerts.fired") == {"slo.alerts.fired": 1}
+    g = telemetry.gauges("slo.alerts")
+    assert g[("slo.alerts", ())] == 1
+
+
+def test_slo_fast_only_does_not_fire():
+    """A short blip inside a healthy slow window never pages."""
+    with conf.set_temporarily(**WINDOWS):
+        _observe_commit("blip", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("blip", 10.0, n=2000)  # long good history
+        timeseries.scrape_once(now_ms=T0 + 100_000, evaluate_slo=False)
+        _observe_commit("blip", 9000.0, n=15)  # bad samples, recent
+        timeseries.scrape_once(now_ms=T0 + 550_000, evaluate_slo=False)
+        [row] = _eval_commit_rows(T0 + 550_000)
+        assert row["burnFast"] > 1          # the blip is the whole window
+        assert row["burnSlow"] < 1          # diluted by the good history
+        assert "alert" not in row
+    assert slo.active_alerts() == []
+
+
+def test_slo_slow_only_does_not_fire():
+    """An already-recovered incident (bad history, quiet now) never pages."""
+    with conf.set_temporarily(**WINDOWS):
+        _observe_commit("old", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0 - 10_000, evaluate_slo=False)
+        _observe_commit("old", 9000.0, n=500)  # the incident...
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        # ...then quiet: nothing new lands in the fast window
+        timeseries.scrape_once(now_ms=T0 + 120_000, evaluate_slo=False)
+        [row] = _eval_commit_rows(T0 + 120_000)
+        assert row["burnFast"] == 0.0       # nothing in the fast window
+        assert row["burnSlow"] > 1
+        assert "alert" not in row
+    assert slo.active_alerts() == []
+
+
+def test_slo_recovery_clears_alert():
+    with conf.set_temporarily(**WINDOWS):
+        _observe_commit("rec", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("rec", 9000.0, n=50)
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 10_000)
+        assert len(slo.active_alerts()) == 1
+        # the fast window drains past the bad batch: recovery
+        timeseries.scrape_once(now_ms=T0 + 200_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 200_000)
+    assert slo.active_alerts() == []
+    assert telemetry.counters("slo.alerts.cleared") == {
+        "slo.alerts.cleared": 1}
+    assert telemetry.gauges("slo.alerts")[("slo.alerts", ())] == 0
+    # the cleared alert stays visible in status with its clear timestamp
+    [hist] = slo.status()["alerts"]
+    assert not hist["firing"] and hist["clearedAt"] == T0 + 200_000
+
+
+def test_slo_hysteresis_on_flapping_series():
+    """Between clearRatio and 1.0 the alert neither re-fires nor clears —
+    a flapping series holds one alert instead of strobing."""
+    with conf.set_temporarily(**WINDOWS, **{
+            "delta.tpu.obs.slo.commitLatencyP99Ms": 1250.0}):
+        _observe_commit("flap", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("flap", 2000.0, n=100)    # p99 bucket 2048
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 10_000)          # burn 1.64: fires
+        assert len(slo.active_alerts()) == 1
+        _observe_commit("flap", 800.0, n=100)     # p99 bucket 1024
+        timeseries.scrape_once(now_ms=T0 + 130_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 130_000)         # burn 0.82 ∈ [0.8, 1)
+        assert len(slo.active_alerts()) == 1      # still firing: hysteresis
+        _observe_commit("flap", 300.0, n=100)     # p99 bucket 512
+        timeseries.scrape_once(now_ms=T0 + 250_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 250_000)         # burn 0.41 < 0.8: clears
+        assert slo.active_alerts() == []
+    c = telemetry.counters("slo.alerts")
+    assert c["slo.alerts.fired"] == 1 and c["slo.alerts.cleared"] == 1
+
+
+def test_slo_cold_start_history_never_pages():
+    """All-time process history must not page when the scraper starts: the
+    first sample of a series is the baseline, never zero — a process with
+    lifetime counters/histograms full of old badness starts clean."""
+    with conf.set_temporarily(**WINDOWS):
+        # pre-scraper history: lifetime 30% conflict ratio + slow commits
+        telemetry.bump_counter("commit.total", 1000)
+        telemetry.bump_counter("commit.conflicts", 300)
+        _observe_commit("cold", 9000.0, n=500)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        rows = slo.evaluate(now_ms=T0)
+        assert all(r["burnFast"] == 0.0 and r["burnSlow"] == 0.0
+                   for r in rows), rows
+        assert slo.active_alerts() == []
+
+
+def test_slo_observation_floor_holds_back_tiny_windows():
+    """A handful of bad samples below minObservations must not page, and
+    the floor is conf-tunable."""
+    with conf.set_temporarily(**WINDOWS):
+        _observe_commit("cold", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("cold", 9000.0, n=3)  # 3 outliers < floor of 10
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        [row] = _eval_commit_rows(T0 + 10_000)
+        assert row["burnFast"] > 1 and row["burnSlow"] > 1
+        assert "alert" not in row             # floor (10) holds it back
+        assert slo.active_alerts() == []
+        # the floor is conf-tunable: at 1 the same series fires
+        with conf.set_temporarily(
+                **{"delta.tpu.obs.slo.minObservations": 1}):
+            slo.evaluate(now_ms=T0 + 10_000)
+            assert len(slo.active_alerts()) == 1
+
+
+def test_series_snapshot_negative_limit_degrades_to_full_series():
+    telemetry.bump_counter("commit.total")
+    for i in range(8):
+        timeseries.scrape_once(now_ms=T0 + i * 1000, evaluate_slo=False)
+    full = timeseries.series_snapshot()["counters"]["commit.total"]
+    neg = timeseries.series_snapshot(limit=-5)["counters"]["commit.total"]
+    assert neg == full                # not a head-truncated pseudo-tail
+    tail = timeseries.series_snapshot(limit=3)["counters"]["commit.total"]
+    assert tail == full[-3:]
+
+
+def test_slo_ratio_objective_fires_and_clears():
+    with conf.set_temporarily(**WINDOWS):
+        telemetry.bump_counter("commit.total", 100)
+        telemetry.bump_counter("commit.conflicts", 0)  # series must predate
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)  # the baseline
+        telemetry.bump_counter("commit.total", 100)
+        telemetry.bump_counter("commit.conflicts", 30)  # 30% >> 5%
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        rows = [r for r in slo.evaluate(now_ms=T0 + 10_000)
+                if r["objective"] == "commitConflictRate"]
+        [row] = rows
+        assert row["burnFast"] > 1 and row["burnSlow"] > 1
+        [alert] = slo.active_alerts()
+        assert alert["objective"] == "commitConflictRate"
+        assert alert["table"] is None      # process-wide, not per-table
+        # conflict-free traffic drains the fast window: clears
+        telemetry.bump_counter("commit.total", 500)
+        timeseries.scrape_once(now_ms=T0 + 120_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 120_000)
+        assert slo.active_alerts() == []
+
+
+def test_slo_evaluate_blackout_inert():
+    _observe_commit("dark", 9000.0, n=50)
+    timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        assert slo.evaluate(now_ms=T0 + 10_000) == []
+    assert slo.active_alerts() == []
+
+
+def test_slo_alert_writes_flight_recorder_incident(tmp_path):
+    inc_dir = str(tmp_path / "incidents")
+    with conf.set_temporarily(delta__tpu__obs__incidentDir=inc_dir,
+                              **WINDOWS):
+        _observe_commit("inc", 10.0, n=1)
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit("inc", 9000.0, n=50)
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 10_000)
+    [path] = flight_recorder.incident_files(inc_dir)
+    incident = json.load(open(path, encoding="utf-8"))
+    assert incident["opType"] == "delta.slo.alert"
+    assert "SloBreach" in incident["error"]
+    assert incident["data"]["objective"] == "commitLatencyP99"
+    assert incident["tags"]["table"] == "inc"
+
+
+# -- autopilot consumption ---------------------------------------------------
+
+
+def test_planner_boosts_and_cites_slo_alert(tmp_table):
+    from delta_tpu.autopilot import planner
+    from delta_tpu.obs.advisor import advise
+    from delta_tpu.obs.doctor import doctor
+
+    with conf.set_temporarily(**{"delta.tpu.write.targetFileRows": 10}):
+        t = DeltaTable.create(tmp_table, data=_ids(400))  # small-file debt
+    base_plan = planner.plan(doctor(t), advise(t))
+    assert base_plan, "debt table must plan at least one action"
+    base_priority = base_plan[0].priority
+
+    label = fleet.table_label(tmp_table)
+    with conf.set_temporarily(**WINDOWS):
+        timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+        _observe_commit(label, 9000.0, n=50, path=tmp_table)
+        timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+        slo.evaluate(now_ms=T0 + 10_000)
+    assert slo.active_alerts(tmp_table), "the alert must resolve the path"
+
+    boosted = planner.plan(doctor(t), advise(t))
+    assert boosted[0].priority == pytest.approx(base_priority + 25.0)
+    cited = boosted[0].evidence["sloAlerts"]
+    assert cited[0]["objective"] == "commitLatencyP99"
+    assert boosted[0].evidence["sloPriorityBoost"] == 25.0
+    # the citation survives into the journaled action dict
+    assert "sloAlerts" in boosted[0].to_dict()["evidence"]
+
+
+# -- end-to-end degradation scenario (acceptance) ----------------------------
+
+
+def test_degradation_scenario_end_to_end(tmp_path):
+    """One of K tables inflates its commit latency: exactly that table's
+    SLO alert fires through all three consumers — /slo, a flight-recorder
+    incident on disk, and an autopilot plan citing the alert — recovery
+    clears it, and fleet_doctor ranks the degraded table first."""
+    import http.client
+
+    from delta_tpu.autopilot import daemon as ap_daemon
+    from delta_tpu.obs.server import ObsServer
+
+    inc_dir = str(tmp_path / "incidents")
+    paths = [str(tmp_path / f"t{i}") for i in range(3)]
+    degraded = paths[1]
+    tables = {}
+    for p in paths:
+        if p == degraded:  # debt so the doctor/autopilot have a remedy
+            with conf.set_temporarily(
+                    **{"delta.tpu.write.targetFileRows": 10}):
+                tables[p] = DeltaTable.create(p, data=_ids(400))
+        else:
+            tables[p] = DeltaTable.create(p, data=_ids(50))
+        tables[p].write(_ids(10, start=1000))  # real commits: series exist
+    assert set(fleet.live_tables()) == set(paths)
+
+    srv = ObsServer(port=0)
+    try:
+        with conf.set_temporarily(delta__tpu__obs__incidentDir=inc_dir,
+                                  **WINDOWS):
+            timeseries.scrape_once(now_ms=T0, evaluate_slo=False)
+            # forced commit-latency inflation on the degraded table only
+            _observe_commit(fleet.table_label(degraded), 9000.0, n=50,
+                            path=degraded)
+            timeseries.scrape_once(now_ms=T0 + 10_000, evaluate_slo=False)
+            slo.evaluate(now_ms=T0 + 10_000)
+
+            # consumer 1: /slo names exactly the degraded table
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            c.request("GET", "/slo")
+            doc = json.loads(c.getresponse().read())
+            c.close()
+            firing = [a for a in doc["alerts"] if a["firing"]]
+            assert [a["path"] for a in firing] == [degraded]
+            assert firing[0]["objective"] == "commitLatencyP99"
+
+            # consumer 2: one incident file on disk, attributed
+            [inc] = flight_recorder.incident_files(inc_dir)
+            blob = json.load(open(inc, encoding="utf-8"))
+            assert blob["data"]["path"] == degraded
+
+            # consumer 3: the autopilot plan cites the alert as priority
+            report = ap_daemon.run_once(degraded)  # dry-run default
+            assert report.planned, "the degraded table must plan actions"
+            top = report.planned[0]
+            assert top["evidence"]["sloAlerts"][0]["objective"] == \
+                "commitLatencyP99"
+            assert top["priority"] >= 25.0
+            # ...and the healthy neighbours plan WITHOUT any boost
+            for p in paths:
+                if p == degraded:
+                    continue
+                rep = ap_daemon.run_once(p)
+                for a in rep.planned:
+                    assert "sloAlerts" not in a["evidence"]
+
+            # the fleet sweep ranks the degraded table first
+            sweep = fleet.fleet_doctor()
+            assert sweep.entries[0].path == degraded
+
+            # recovery: the fast window drains and the alert clears
+            timeseries.scrape_once(now_ms=T0 + 200_000, evaluate_slo=False)
+            slo.evaluate(now_ms=T0 + 200_000)
+            assert slo.active_alerts() == []
+            c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+            c.request("GET", "/slo")
+            doc = json.loads(c.getresponse().read())
+            c.close()
+            assert doc["firing"] == 0
+    finally:
+        srv.stop()
+
+
+# -- blackout: the whole plane is inert --------------------------------------
+
+
+def test_fleet_plane_blackout_smoke(tmp_table):
+    """PR 4/8-style blackout guarantee for the whole plane: no registry
+    entries, no scraper work, no series bytes, no SLO evaluation."""
+    with conf.set_temporarily(delta__tpu__telemetry__enabled=False):
+        t = DeltaTable.create(tmp_table, data=_ids(100))
+        t.to_arrow(filters=["id < 5"])
+        assert fleet.live_tables() == {}
+        assert timeseries.scrape_once() == 0
+        assert slo.evaluate() == []
+        assert timeseries.series_snapshot()["counters"] == {}
+        # fleet sweeps still ANSWER (pull-by-call, like doctor under
+        # blackout) but see an empty registry
+        assert fleet.fleet_doctor().entries == []
+    # scan planning histograms are span-derived: blackout recorded nothing
+    assert telemetry.histograms("delta.scan.planning.duration_ms") == {}

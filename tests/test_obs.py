@@ -431,6 +431,108 @@ def test_server_error_routes(obs_server):
     assert "routes" in json.loads(body)
 
 
+def test_server_garbage_query_params_never_500(tmp_table, obs_server):
+    """Regression (ISSUE 15 satellite): `/events?limit=abc` 500'd through
+    the bare int() while /router and /advisor degraded — every route's
+    numeric params now share one degrading parser (`server._q_int`)."""
+    import urllib.parse
+
+    DeltaTable.create(tmp_table, data=_ids(10))
+    quoted = urllib.parse.quote(tmp_table)
+    routes = [
+        "/events?limit=abc", "/events?limit=", "/events?limit=%20",
+        "/events?prefix=delta.commit&limit=abc",
+        "/router?limit=abc", "/router?limit=1e3",
+        f"/advisor?path={quoted}&limit=abc",
+        f"/autopilot?path={quoted}&limit=abc",
+        f"/doctor?path={quoted}&limit=abc",   # ignored param: still fine
+        "/autopilot?limit=abc",
+        "/fleet?limit=abc&sweep=bogus&samples=xyz",
+        "/fleet?series=&samples=abc",
+        "/slo?limit=abc",
+        "/metrics?limit=abc", "/healthz?limit=abc", "/trace?limit=abc",
+    ]
+    for route in routes:
+        status, _, body = _get(obs_server, route)
+        assert status == 200, (route, body)
+    # a malformed limit behaves exactly like an absent one
+    _, _, with_garbage = _get(obs_server, "/events?limit=abc")
+    _, _, without = _get(obs_server, "/events")
+    assert json.loads(with_garbage) == json.loads(without)
+    # negative limits clamp to "none" rather than erroring
+    status, _, body = _get(obs_server, "/events?limit=-3")
+    assert status == 200 and json.loads(body) == []
+
+
+def test_reply_swallows_client_abort():
+    """A client hanging up mid-response must be counted, not logged as a
+    500-on-a-dead-socket cascade."""
+    from delta_tpu.obs.server import _Handler
+
+    class _DeadWfile:
+        def write(self, data):
+            raise BrokenPipeError("client went away")
+
+    class _FakeHandler:
+        close_connection = False
+        wfile = _DeadWfile()
+
+        def send_response(self, status):
+            pass
+
+        def send_header(self, k, v):
+            pass
+
+        def end_headers(self):
+            pass
+
+    before = telemetry.counters("obs.server.clientAborts").get(
+        "obs.server.clientAborts", 0)
+    fake = _FakeHandler()
+    _Handler._reply(fake, 200, b"payload", "application/json")  # no raise
+    assert fake.close_connection
+    after = telemetry.counters("obs.server.clientAborts")
+    assert after["obs.server.clientAborts"] == before + 1
+
+    class _ResetWfile:
+        def write(self, data):
+            raise ConnectionResetError("reset")
+
+    fake = _FakeHandler()
+    fake.wfile = _ResetWfile()
+    _Handler._reply(fake, 200, b"payload", "application/json")
+    assert telemetry.counters("obs.server.clientAborts")[
+        "obs.server.clientAborts"] == before + 2
+
+
+def test_server_fleet_and_slo_routes(tmp_table, obs_server):
+    from delta_tpu.obs import fleet
+
+    t = DeltaTable.create(tmp_table, data=_ids(10))
+    status, _, body = _get(obs_server, "/fleet")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["tables"] >= 1
+    assert any(e["path"] == tmp_table for e in doc["entries"])
+    assert doc["sweep"]["kind"] == "doctor"
+    status, _, body = _get(obs_server, "/fleet?sweep=advisor&limit=1")
+    doc = json.loads(body)
+    assert doc["sweep"]["kind"] == "advisor"
+    assert len(doc["sweep"]["entries"]) <= 1
+    status, _, body = _get(obs_server, "/fleet?sweep=none&series=fleet")
+    doc = json.loads(body)
+    assert "sweep" not in doc and "series" in doc
+
+    status, _, body = _get(obs_server, "/slo")
+    assert status == 200
+    doc = json.loads(body)
+    assert {o["name"] for o in doc["objectives"]} == {
+        "commitLatencyP99", "scanPlanningP99", "commitConflictRate",
+        "retryExhaustion", "journalDropRate"}
+    fleet.unregister(tmp_table)
+    del t
+
+
 def test_start_server_requires_opt_in():
     from delta_tpu.obs.server import start_server
 

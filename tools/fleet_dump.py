@@ -1,0 +1,132 @@
+"""Fleet observability inspector — the CLI twin of ``/fleet`` and ``/slo``.
+
+Two modes:
+
+* **Remote** (``--url``): fetch a running engine's ``/fleet`` and ``/slo``
+  routes (`delta_tpu/obs/server.py`) and pretty-print the ranked sweep,
+  burn rates, and alerts — the operator's one-liner against a served
+  process::
+
+      python tools/fleet_dump.py --url http://127.0.0.1:8066
+      python tools/fleet_dump.py --url http://127.0.0.1:8066 --slo
+      python tools/fleet_dump.py --url http://127.0.0.1:8066 --json
+
+* **In-process** (paths): open the given tables in THIS process, register
+  them, and run the same fleet sweep locally — offline triage over tables
+  on disk, no server required::
+
+      python tools/fleet_dump.py /data/tbl1 /data/tbl2
+      python tools/fleet_dump.py /data/tbl1 --sweep advisor --json
+
+``--json`` prints the raw documents (pipe into ``jq``); the default output
+is a compact ranked table (worst first).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fetch(url: str):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _print_sweep(sweep) -> None:
+    entries = (sweep or {}).get("entries", [])
+    if not entries:
+        print("  (no registered tables)")
+        return
+    for i, e in enumerate(entries, 1):
+        if e.get("error"):
+            print(f"  {i:>2}. {e['path']}  ERROR {e['error']}")
+            continue
+        remedies = ",".join(e.get("remedies") or []) or "-"
+        print(f"  {i:>2}. [{e.get('severity', '?'):>8}] {e['path']} "
+              f"(table={e.get('table')}) worst={e.get('worstDimension') or '-'} "
+              f"crit={e.get('criticalDims', 0)} warn={e.get('warnDims', 0)} "
+              f"score={e.get('topScore', 0)} remedies={remedies}")
+
+
+def _print_slo(doc) -> None:
+    print(f"SLO: enabled={doc.get('enabled')} firing={doc.get('firing')} "
+          f"windows={doc.get('windows')}")
+    for o in doc.get("objectives", []):
+        print(f"  objective {o['name']}: {o['series']} <= {o['threshold']}"
+              f"{' (per table)' if o.get('perTable') else ''}")
+    alerts = doc.get("alerts", [])
+    if not alerts:
+        print("  no alerts")
+    for a in alerts:
+        state = "FIRING" if a.get("firing") else "cleared"
+        print(f"  [{state}] {a['objective']} table={a.get('table') or '-'} "
+              f"path={a.get('path') or '-'} burnFast={a.get('burnFast')} "
+              f"burnSlow={a.get('burnSlow')} observed={a.get('observed')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("tables", nargs="*",
+                    help="table data paths to open + sweep in-process")
+    ap.add_argument("--url", help="base URL of a running obs server "
+                                  "(e.g. http://127.0.0.1:8066)")
+    ap.add_argument("--sweep", choices=["doctor", "advisor"],
+                    default="doctor", help="which fleet sweep to rank by")
+    ap.add_argument("--slo", action="store_true",
+                    help="only the SLO document (skip the fleet sweep)")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="show only the worst N tables")
+    ap.add_argument("--json", action="store_true",
+                    help="print raw JSON documents instead of tables")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        base = args.url.rstrip("/")
+        fleet_doc = None
+        if not args.slo:
+            route = f"{base}/fleet?sweep={args.sweep}"
+            if args.limit is not None:
+                route += f"&limit={args.limit}"
+            fleet_doc = _fetch(route)
+        slo_doc = _fetch(f"{base}/slo")
+    else:
+        if not args.tables:
+            ap.error("give table paths or --url")
+        from delta_tpu.log.deltalog import DeltaLog
+        from delta_tpu.obs import fleet, slo as slo_mod, timeseries
+
+        logs = [DeltaLog.for_table(p) for p in args.tables]  # registers
+        timeseries.scrape_once()  # one scrape so /slo-style burns exist
+        fleet_doc = None
+        if not args.slo:
+            report = (fleet.fleet_doctor() if args.sweep == "doctor"
+                      else fleet.fleet_advise())
+            fleet_doc = fleet.fleet_status()
+            ranked = report.to_dict()
+            if args.limit is not None:
+                ranked["entries"] = ranked["entries"][:args.limit]
+            fleet_doc["sweep"] = ranked
+        slo_doc = slo_mod.status()
+        del logs  # keep the handles alive through the sweep
+
+    if args.json:
+        doc = {"slo": slo_doc}
+        if fleet_doc is not None:
+            doc["fleet"] = fleet_doc
+        print(json.dumps(doc, indent=1, default=str))
+        return 0
+    if fleet_doc is not None:
+        print(f"fleet: {fleet_doc.get('tables', 0)} registered table(s); "
+              f"sweep={args.sweep}")
+        _print_sweep(fleet_doc.get("sweep"))
+    _print_slo(slo_doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
