@@ -69,9 +69,11 @@ class DeleteCommand:
             # survivors rewritten into new files: bump the resident
             # key-cache epoch (ops/key_cache.py) — plain removes and DV
             # marks advance incrementally and need no invalidation
+            from delta_tpu.ops.column_cache import ColumnCache
             from delta_tpu.ops.key_cache import KeyCache
 
             KeyCache.instance().bump_epoch(self.delta_log.log_path)
+            ColumnCache.instance().bump_epoch(self.delta_log.log_path)
         return version
 
     def _perform_delete(self, txn, timer: Timer) -> List[Action]:

@@ -184,9 +184,11 @@ class OptimizeCommand:
         # file rewrite: bump the resident key-cache epoch so a stale HBM
         # slab can never serve a post-OPTIMIZE MERGE (ops/key_cache.py)
         if removes or adds:
+            from delta_tpu.ops.column_cache import ColumnCache
             from delta_tpu.ops.key_cache import KeyCache
 
             KeyCache.instance().bump_epoch(self.delta_log.log_path)
+            ColumnCache.instance().bump_epoch(self.delta_log.log_path)
         # feed the table-health doctor: maintenance recency as gauges, work
         # done as counters (obs/metric_names.py catalog)
         from delta_tpu.utils import telemetry

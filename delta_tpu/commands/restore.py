@@ -137,10 +137,12 @@ class RestoreCommand:
             )))
             if actions:
                 # file-set rewind (re-adds may shrink deletion vectors):
-                # bump the resident key-cache epoch (ops/key_cache.py)
+                # bump the resident key-cache and scan column-cache epochs
+                from delta_tpu.ops.column_cache import ColumnCache
                 from delta_tpu.ops.key_cache import KeyCache
 
                 KeyCache.instance().bump_epoch(self.delta_log.log_path)
+                ColumnCache.instance().bump_epoch(self.delta_log.log_path)
             return version
 
         return self.delta_log.with_new_transaction(body)

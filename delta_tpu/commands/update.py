@@ -155,10 +155,13 @@ class UpdateCommand:
         if not use_dv and removes:
             # whole-file rewrite (not a DV mark): bump the resident
             # key-cache epoch — stale slabs must never serve a
-            # post-rewrite MERGE (DV-mode diffs advance incrementally)
+            # post-rewrite MERGE (DV-mode diffs advance incrementally);
+            # same bump for the scan column cache
+            from delta_tpu.ops.column_cache import ColumnCache
             from delta_tpu.ops.key_cache import KeyCache
 
             KeyCache.instance().bump_epoch(self.delta_log.log_path)
+            ColumnCache.instance().bump_epoch(self.delta_log.log_path)
         return version
 
     def _apply_updates(self, table: pa.Table, mask, metadata) -> pa.Table:

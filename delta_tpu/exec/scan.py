@@ -45,6 +45,7 @@ def read_files_as_table(
     positions_of_interest: Optional[Sequence] = None,
     late_materialize: bool = True,
     file_ready=None,
+    device_masks=None,
 ):
     """Decode AddFiles to one Arrow table, materializing partition columns.
 
@@ -83,6 +84,16 @@ def read_files_as_table(
     the hook the MERGE fused pipeline uses to stream key lanes onto the
     device while the remaining files still decode. The callback must not
     raise; an exception from it fails the whole read.
+
+    ``device_masks`` ({add.path → bool ndarray over the file's physical
+    rows}, from `ops/column_cache.device_residual_masks`) switches masked
+    files to the device residual path: row groups whose mask slice is
+    all-False skip decode, surviving groups decode in one read with NO
+    host predicate evaluation, and — unlike the contract above — rows
+    within surviving groups ARE filtered to the mask. Only callers that
+    re-apply the residual over the result may pass it (``scan_to_table``
+    does); a file whose mask doesn't line up with its footer falls back to
+    the host path.
     """
     from delta_tpu.utils import telemetry
 
@@ -140,8 +151,10 @@ def read_files_as_table(
         pred_rewrites = conjunct_rewrites([predicate], pcols_lower,
                                           pred_types)
     pos_hints = list(positions_of_interest) if positions_of_interest else None
-    # per-file (rgTotal, rgPruned, rgLateSkipped, bytesSkipped) — summed
-    # into counters/span attributes after the pool drains
+    # per-file (rgTotal, rgPruned, rgLateSkipped, bytesSkippedPlanned,
+    # bytesLateSkipped, planFired, rgDeviceSkipped, bytesDeviceSkipped,
+    # bytesDeviceSurvivor) — summed into counters/span attributes after the
+    # pool drains
     rg_stats: List[tuple] = []
 
     def _dummy(n: int) -> pa.Table:
@@ -273,6 +286,56 @@ def read_files_as_table(
             )
         return t, pos, late_skipped, late_bytes
 
+    def _decode_device_masked(abs_path, meta, keep_idx, add, need_positions,
+                              dev_mask):
+        """The device residual path's survivor fetch: drop row groups whose
+        device mask slice is all-False, decode the survivors' projected
+        columns in ONE read (no host predicate evaluation), and filter rows
+        to the mask. The caller re-applies the residual over the result
+        (``scan_to_table``), so an over-keep can never leak; an under-keep
+        cannot happen because the mask is the exact Kleene-TRUE set of the
+        same predicate. Returns None when the mask doesn't line up with the
+        footer (→ host path), else (table, positions | None,
+        (device_skipped_groups, device_skipped_bytes, survivor_bytes))."""
+        import numpy as np
+
+        from delta_tpu.exec import rowgroups
+
+        offsets = rowgroups.row_group_offsets(meta)
+        if len(dev_mask) != offsets[-1]:
+            return None
+        survivors = []
+        dev_skipped = dev_bytes = surv_bytes = 0
+        for i in keep_idx:
+            if dev_mask[offsets[i]:offsets[i + 1]].any():
+                survivors.append(i)
+                surv_bytes += meta.row_group(i).total_byte_size
+            else:
+                dev_skipped += 1
+                dev_bytes += meta.row_group(i).total_byte_size
+        pf = pq.ParquetFile(abs_path, memory_map=True, metadata=meta)
+        present = set(pf.schema_arrow.names)
+        file_cols = [c for c in data_cols if c in present]
+        if not survivors:
+            t = (pf.schema_arrow.empty_table().select(file_cols)
+                 if file_cols else _dummy(0))
+            pos = np.empty(0, dtype=np.int64) if need_positions else None
+            return t, pos, (dev_skipped, dev_bytes, 0)
+        if file_cols:
+            t = pf.read_row_groups(survivors, columns=file_cols)
+        else:
+            t = _dummy(int(sum(meta.row_group(i).num_rows
+                               for i in survivors)))
+        keep = np.concatenate(
+            [dev_mask[offsets[i]:offsets[i + 1]] for i in survivors])
+        t = t.filter(pa.array(keep))
+        pos = None
+        if need_positions:
+            phys = np.concatenate(
+                [np.arange(offsets[i], offsets[i + 1]) for i in survivors])
+            pos = phys[keep].astype(np.int64)
+        return t, pos, (dev_skipped, dev_bytes, surv_bytes)
+
     def read_one(job) -> pa.Table:
         fidx, add, pos_hint = job
         abs_path = _abs_data_path(data_path, add.path)
@@ -317,16 +380,27 @@ def read_files_as_table(
                 late_materialize and predicate is not None
                 and keep_idx and pred_refs and n_rg > 1
             )
-            if pruned or late_capable:
+            dev_mask = device_masks.get(add.path) if device_masks else None
+            if dev_mask is not None:
+                res = _decode_device_masked(
+                    abs_path, meta, keep_idx, add, need_positions, dev_mask
+                )
+                if res is not None:
+                    t, positions, dstats = res
+                    rg_stats.append(
+                        (n_rg, pruned, 0, skipped_bytes, 0, plan_fired)
+                        + dstats
+                    )
+            if t is None and (pruned or late_capable):
                 t, positions, late_n, late_bytes = _decode_pruned(
                     abs_path, meta, keep_idx, add, need_positions
                 )
                 rg_stats.append(
                     (n_rg, pruned, late_n, skipped_bytes, late_bytes,
-                     plan_fired)
+                     plan_fired, 0, 0, 0)
                 )
-            else:
-                rg_stats.append((n_rg, 0, 0, 0, 0, ()))
+            elif t is None:
+                rg_stats.append((n_rg, 0, 0, 0, 0, (), 0, 0, 0))
         if t is None:
             # full decode — the seed path; reuse the already-parsed footer
             # when the planner fetched one.
@@ -438,17 +512,34 @@ def read_files_as_table(
             rg_pruned = sum(s[1] for s in rg_stats)
             rg_late = sum(s[2] for s in rg_stats)
             planned_bytes = sum(s[3] for s in rg_stats)
-            bytes_skipped = planned_bytes + sum(s[4] for s in rg_stats)
+            rg_device = sum(s[6] for s in rg_stats)
+            device_bytes = sum(s[7] for s in rg_stats)
+            device_survivor = sum(s[8] for s in rg_stats)
+            bytes_skipped = (planned_bytes + sum(s[4] for s in rg_stats)
+                             + device_bytes)
             telemetry.bump_counter("scan.rowgroups.total", rg_total)
             if rg_pruned:
                 telemetry.bump_counter("scan.rowgroups.pruned", rg_pruned)
             if rg_late:
                 telemetry.bump_counter("scan.rowgroups.lateSkipped", rg_late)
+            if rg_device:
+                telemetry.bump_counter("scan.rowgroups.deviceSkipped",
+                                       rg_device)
             if bytes_skipped:
                 telemetry.bump_counter("scan.bytes.skipped", bytes_skipped)
+            if device_bytes:
+                telemetry.bump_counter("scan.bytes.deviceSkipped",
+                                       device_bytes)
+            if device_survivor:
+                # survivor-group bytes the device path sent to host decode —
+                # the host-decoded remainder of masked files, counted apart
+                # from plain host reads so the bench can split the two
+                telemetry.bump_counter("scan.bytes.deviceSurvivor",
+                                       device_survivor)
             rev.data.update(
                 rowGroupsTotal=rg_total, rowGroupsPruned=rg_pruned,
                 rowGroupsLateSkipped=rg_late, bytesSkipped=bytes_skipped,
+                rowGroupsDeviceSkipped=rg_device,
             )
             # the in-flight per-query ScanReport (obs/scan_report) gets the
             # SAME sums that fed the counters — report/counter parity by
@@ -459,6 +550,9 @@ def read_files_as_table(
                 row_groups_total=rg_total, row_groups_pruned=rg_pruned,
                 row_groups_late_skipped=rg_late, bytes_skipped=bytes_skipped,
                 bytes_skipped_planned=planned_bytes,
+                row_groups_device_skipped=rg_device,
+                bytes_device_skipped=device_bytes,
+                bytes_device_survivor=device_survivor,
             )
             # fired-rewrite attribution: each synthesized conjunct that
             # excluded a row group records ONCE per scan (the per-file
@@ -628,13 +722,24 @@ def scan_to_table(
                     needed.update(ir.references(e))
                 read_cols = [c for c in [f.name for f in snapshot.metadata.schema.fields]
                              if c in needed]
+            # third tier, when the router prices it: the device residual
+            # path (ops/column_cache) computes per-file survivor masks from
+            # HBM-resident lanes in one jitted pass; None = host path
+            device_masks = None
+            if residual and scan.files:
+                from delta_tpu.ops import column_cache
+
+                if column_cache.column_cache_enabled():
+                    device_masks = column_cache.device_residual_masks(
+                        snapshot, scan.files, ir.and_all(residual))
             # the residual predicate rides into the decode: footer row-group
             # stats prune inside each file (second tier), and the residual
             # filter below re-applies the exact semantics over the survivors
             table = read_files_as_table(data_path, scan.files, snapshot.metadata,
                                         read_cols, distribute=distribute,
                                         predicate=(ir.and_all(residual)
-                                                   if residual else None))
+                                                   if residual else None),
+                                        device_masks=device_masks)
             t2 = _time.perf_counter_ns()
             if residual and table.num_rows:
                 table = filter_table(table, ir.and_all(residual))

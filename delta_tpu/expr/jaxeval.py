@@ -22,7 +22,8 @@ import numpy as np
 from delta_tpu.expr import ir
 from delta_tpu.utils.errors import DeltaAnalysisError
 
-__all__ = ["DeviceColumn", "compile_expr", "NotDeviceCompilable"]
+__all__ = ["DeviceColumn", "compile_expr", "NotDeviceCompilable",
+           "ResidualPlan", "compile_residual", "STR_CODE_ABSENT"]
 
 
 class NotDeviceCompilable(DeltaAnalysisError):
@@ -288,6 +289,39 @@ def compile_expr(e: ir.Expression) -> _Compiled:
         div = 60_000_000 if e.name == "minute" else 1_000_000
         return lambda env: (lambda c: DeviceColumn(
             (c.values // div) % 60, c.valid))(ct(env))
+    if t is ir.Func and e.name == "hour" and len(e.children) == 1:
+        # timestamp lanes are epoch microseconds (naive UTC)
+        ct = compile_expr(e.children[0])
+        return lambda env: (lambda c: DeviceColumn(
+            (c.values // 3_600_000_000) % 24, c.valid))(ct(env))
+    if t is ir.Func and e.name == "__ts_days" and len(e.children) == 1:
+        # compile_residual's unit bridge: epoch-µs timestamp lane → epoch
+        # days, so the calendar kernels below serve both temporal lanes
+        ct = compile_expr(e.children[0])
+        return lambda env: (lambda c: DeviceColumn(
+            jnp.floor_divide(c.values, 86_400_000_000), c.valid))(ct(env))
+    if t is ir.Func and e.name in ("__year_days", "__month_days",
+                                   "__day_days") and len(e.children) == 1:
+        ct = compile_expr(e.children[0])
+        idx = ("__year_days", "__month_days", "__day_days").index(e.name)
+
+        def run_civil(env: Env, _ct=ct, _idx=idx) -> DeviceColumn:
+            # civil-from-days (Hinnant): exact for every date32 value; all
+            # intermediate operands are non-negative after the era shift,
+            # so jnp floor division matches the reference arithmetic
+            c = _ct(env)
+            z = c.values.astype(jnp.int64) + 719468
+            era = jnp.floor_divide(z, 146097)
+            doe = z - era * 146097
+            yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+            doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+            mp = (5 * doy + 2) // 153
+            day = doy - (153 * mp + 2) // 5 + 1
+            month = jnp.where(mp < 10, mp + 3, mp - 9)
+            year = yoe + era * 400 + (month <= 2)
+            return DeviceColumn((year, month, day)[_idx], c.valid)
+
+        return run_civil
     raise NotDeviceCompilable(f"{type(e).__name__} has no device lowering: {e.sql()}")
 
 
@@ -295,3 +329,245 @@ def columns_from_numpy(data: Dict[str, np.ndarray], masks: Optional[Dict[str, np
     """Build a device env from host numpy columns (tests / small paths)."""
     masks = masks or {}
     return {k: DeviceColumn.of(v, masks.get(k)) for k, v in data.items()}
+
+
+# -- residual-predicate lowering (the device scan path) ----------------------
+
+#: dictionary code bound to a string literal ABSENT from a file's
+#: dictionary — real codes are >= 0, so equality never fires against it
+#: and inequality fires for every non-NULL row, exactly the host verdicts.
+STR_CODE_ABSENT = -2
+
+_STRLIT_PREFIX = "__strlit"
+_CMP_TYPES = (ir.Eq, ir.Ne, ir.Lt, ir.Le, ir.Gt, ir.Ge)
+_CMP_FLIP = {ir.Lt: ir.Gt, ir.Le: ir.Ge, ir.Gt: ir.Lt, ir.Ge: ir.Le}
+
+
+class ResidualPlan(NamedTuple):
+    """A residual predicate lowered for the device scan path
+    (``ops/column_cache``): the rewritten expression (string literals have
+    become placeholder columns over dictionary codes, temporal literals
+    epoch ints — hashable, so it doubles as the jit-cache key), the data
+    columns the device env must bind as lanes, the partition columns bound
+    as per-file scalars, and the string-literal bindings the caller resolves
+    per file against that file's dictionary (absent value →
+    :data:`STR_CODE_ABSENT`)."""
+
+    expr: ir.Expression
+    refs: frozenset        # data columns needed as lanes (lower-cased)
+    part_refs: frozenset   # partition columns bound as per-file scalars
+    str_binds: tuple       # ((placeholder, column_lower, literal_value), ...)
+
+
+def compile_residual(e: ir.Expression, types: Dict[str, Any],
+                     partition_names=()) -> ResidualPlan:
+    """Rewrite + gate a residual predicate so :func:`compile_expr` can run
+    it over decoded file lanes:
+
+    * string equality / ``IN`` against literals lowers to int32
+      dictionary-code compares via per-file placeholder columns — string
+      ORDER comparisons do not lower (codes are unordered);
+    * date/timestamp literals (ISO strings or datetime objects) become the
+      lane encodings (epoch days / epoch microseconds), and
+      ``year``/``month``/``day``/``to_date``/``hour`` over temporal columns
+      lower to the device calendar kernels;
+    * decimal columns, string partition references, and mixed
+      date-vs-timestamp compares raise :class:`NotDeviceCompilable` — the
+      caller falls back to the Arrow path.
+
+    ``types`` maps lower-cased column names to declared
+    :class:`~delta_tpu.schema.types.DataType`; ``partition_names`` marks the
+    columns bound as per-file scalars instead of lanes.
+    """
+    import datetime as _dt
+
+    from delta_tpu.schema.types import (DateType, DecimalType, StringType,
+                                        TimestampType)
+
+    parts = frozenset(c.lower() for c in partition_names)
+    binds: list = []
+    refs: set = set()
+    part_refs: set = set()
+
+    def _ctype(x):
+        while isinstance(x, ir.Alias):
+            x = x.child
+        if isinstance(x, ir.Column):
+            return types.get(x.name.lower())
+        if isinstance(x, ir.Func) and x.name == "to_date" and len(x.children) == 1:
+            ct = _ctype(x.children[0])
+            return DateType() if isinstance(ct, (DateType, TimestampType)) else None
+        return None
+
+    def _note(c: ir.Column) -> ir.Column:
+        n = c.name.lower()
+        if isinstance(types.get(n), DecimalType):
+            raise NotDeviceCompilable(
+                f"decimal column {c.name!r} stays on host (exact arithmetic)")
+        if n in parts:
+            if isinstance(types.get(n), StringType):
+                raise NotDeviceCompilable(
+                    f"string partition column {c.name!r} has no device codes")
+            part_refs.add(n)
+        else:
+            refs.add(n)
+        return ir.Column(n)
+
+    def _temporal_lit(lit: ir.Literal, dt) -> ir.Literal:
+        v = lit.value
+        if v is None:
+            return lit
+        if isinstance(v, str):
+            from delta_tpu.utils.timeparse import iso_to_date, iso_to_naive_utc
+
+            try:
+                v = (iso_to_date(v) if isinstance(dt, DateType)
+                     else iso_to_naive_utc(v))
+            except ValueError:
+                raise NotDeviceCompilable(
+                    f"unparseable temporal literal {lit.value!r}") from None
+        if isinstance(dt, TimestampType) and isinstance(v, _dt.date) \
+                and not isinstance(v, _dt.datetime):
+            v = _dt.datetime.combine(v, _dt.time())  # midnight, like Spark
+        if isinstance(v, _dt.datetime):
+            if not isinstance(dt, TimestampType):
+                raise NotDeviceCompilable("timestamp literal vs date lane")
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=_dt.timezone.utc)  # naive IS UTC here
+            return ir.Literal(int(v.timestamp() * 1_000_000))
+        if isinstance(v, _dt.date):
+            return ir.Literal((v - _dt.date(1970, 1, 1)).days)
+        raise NotDeviceCompilable(
+            f"literal {v!r} does not coerce to a temporal lane")
+
+    def _strip(x):
+        while isinstance(x, ir.Alias):
+            x = x.child
+        return x
+
+    def _ifunc(name: str, child: ir.Expression) -> ir.Func:
+        # internal lowering-only node (__ts_days / __{year,month,day}_days):
+        # built via the clone idiom because ir.Func validates public names,
+        # and these never reach host eval — compile_expr consumes them
+        f = object.__new__(ir.Func)
+        f.name = name
+        f.children = (child,)
+        return f
+
+    def rw(x: ir.Expression) -> ir.Expression:
+        t = type(x)
+        if t is ir.Alias:
+            return rw(x.child)
+        if t is ir.Column:
+            return _note(x)
+        if t is ir.Literal:
+            v = x.value
+            if isinstance(v, str):
+                # a string literal outside a code compare has no device form
+                raise NotDeviceCompilable(
+                    f"string literal {v!r} outside a dictionary-code compare")
+            if isinstance(v, _dt.datetime):
+                if v.tzinfo is None:
+                    v = v.replace(tzinfo=_dt.timezone.utc)
+                return ir.Literal(int(v.timestamp() * 1_000_000))
+            if isinstance(v, _dt.date):
+                return ir.Literal((v - _dt.date(1970, 1, 1)).days)
+            return x
+        if t in _CMP_TYPES or t is ir.NullSafeEq:
+            l, r = x.left, x.right
+            if isinstance(l, ir.Literal) and not isinstance(r, ir.Literal):
+                l, r = r, l
+                t = _CMP_FLIP.get(t, t)
+            lt_, rt_ = _ctype(l), _ctype(r)
+            if isinstance(lt_, (DateType, TimestampType)) \
+                    and isinstance(rt_, (DateType, TimestampType)):
+                if type(lt_) is not type(rt_):
+                    raise NotDeviceCompilable(
+                        "mixed date/timestamp compare (lane units differ)")
+                return t(rw(l), rw(r))
+            if isinstance(lt_, (DateType, TimestampType)) and isinstance(r, ir.Literal):
+                return t(rw(l), _temporal_lit(r, lt_))
+            stringy = (isinstance(lt_, StringType) or isinstance(rt_, StringType)
+                       or isinstance(getattr(_strip(l), "value", None), str)
+                       or isinstance(getattr(_strip(r), "value", None), str))
+            if stringy:
+                col, lit = _strip(l), _strip(r)
+                if t in (ir.Eq, ir.Ne, ir.NullSafeEq) \
+                        and isinstance(lt_, StringType) \
+                        and isinstance(col, ir.Column) \
+                        and isinstance(lit, ir.Literal) \
+                        and (lit.value is None or isinstance(lit.value, str)):
+                    if lit.value is None:
+                        return t(_note(col), ir.Literal(None))
+                    ph = f"{_STRLIT_PREFIX}{len(binds)}"
+                    binds.append((ph, col.name.lower(), lit.value))
+                    return t(_note(col), ir.Column(ph))
+                raise NotDeviceCompilable(
+                    f"string comparison stays on host: {x.sql()}")
+            return t(rw(l), rw(r))
+        if t is ir.In:
+            v = _strip(x.value)
+            vt = _ctype(v)
+            opts = list(x.options)
+            if isinstance(vt, StringType):
+                if not isinstance(v, ir.Column):
+                    raise NotDeviceCompilable("string IN over a non-column")
+                new_opts = []
+                for o in opts:
+                    o = _strip(o)
+                    if not isinstance(o, ir.Literal):
+                        raise NotDeviceCompilable(
+                            "string IN option is not a literal")
+                    if o.value is None:
+                        new_opts.append(o)  # NULL option: Kleene semantics
+                        continue
+                    if not isinstance(o.value, str):
+                        raise NotDeviceCompilable(
+                            f"non-string option {o.value!r} in string IN")
+                    ph = f"{_STRLIT_PREFIX}{len(binds)}"
+                    binds.append((ph, v.name.lower(), o.value))
+                    new_opts.append(ir.Column(ph))
+                return ir.In(_note(v), new_opts)
+            if isinstance(vt, (DateType, TimestampType)):
+                new_opts = [o if (isinstance(_strip(o), ir.Literal)
+                                  and _strip(o).value is None)
+                            else _temporal_lit(_strip(o), vt)
+                            if isinstance(_strip(o), ir.Literal) else rw(o)
+                            for o in opts]
+                return ir.In(rw(x.value), new_opts)
+            return ir.In(rw(x.value), [rw(o) for o in opts])
+        if t is ir.Func and x.name in ("year", "month", "day") \
+                and len(x.children) == 1:
+            ct = _ctype(x.children[0])
+            child = rw(x.children[0])
+            if isinstance(ct, TimestampType):
+                child = _ifunc("__ts_days", child)
+            elif not isinstance(ct, DateType):
+                raise NotDeviceCompilable(
+                    f"{x.name}() over a non-temporal lane")
+            return _ifunc(f"__{x.name}_days", child)
+        if t is ir.Func and x.name == "to_date" and len(x.children) == 1:
+            ct = _ctype(x.children[0])
+            if isinstance(ct, TimestampType):
+                return _ifunc("__ts_days", rw(x.children[0]))
+            if isinstance(ct, DateType):
+                return rw(x.children[0])
+            raise NotDeviceCompilable("to_date over a non-temporal lane")
+        if t is ir.Func and x.name == "hour" and len(x.children) == 1:
+            if not isinstance(_ctype(x.children[0]), TimestampType):
+                raise NotDeviceCompilable("hour() needs a timestamp lane")
+            return ir.Func("hour", [rw(x.children[0])])
+        # generic rebuild (And/Or/Not/arith/null tests/Coalesce/CaseWhen/
+        # Cast/other Funcs) — unsupported shapes surface from compile_expr
+        new_children = tuple(rw(c) for c in x.children)
+        if new_children == x.children:
+            return x
+        clone = object.__new__(t)
+        clone.__dict__.update(x.__dict__)
+        clone.children = new_children
+        return clone
+
+    out = rw(e)
+    compile_expr(out)  # validate the lowering NOW — routers price after this
+    return ResidualPlan(out, frozenset(refs), frozenset(part_refs),
+                        tuple(binds))

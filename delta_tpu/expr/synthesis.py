@@ -32,6 +32,14 @@ three families:
   wildcard-free LIKE → Eq. Inherits the file tier's truncated-bounds
   conservatism: stats lanes the engine cannot trust (binary / absent)
   evaluate NULL and keep.
+* **conditional / abs / col-vs-col** — ``abs(x) op v`` decomposes exactly
+  into its two signed comparisons (Or for the upper tests, And for the
+  lower); ``coalesce``/``CASE WHEN`` compare via the disjunction of their
+  branch values' can-matches (conditions ignored — over-approximate, never
+  unsound); ``a < b`` between two data columns excludes when
+  ``min.a >= max.b`` — gated to integer/decimal/temporal lanes because
+  float lanes are NaN-blind and string bounds may be truncated (the same
+  conservatism as the NOT flip).
 * **temporal / cast** — monotone shapes only: numeric widening casts
   (identity up to float64 rounding, covered by the relaxation),
   integer-truncation casts (``|x - trunc(x)| < 1`` → bounds padded by one
@@ -147,30 +155,44 @@ def can_exclude(rewritten: ir.Expression) -> bool:
 
 _FAMILY_STRING = ("substr", "substring")
 _FAMILY_TEMPORAL = ("year", "to_date", "date_add", "date_sub")
+_FAMILY_ARITH_FUNCS = ("abs",)
+_CMP_CLASSES = (ir.Eq, ir.Ne, ir.Lt, ir.Le, ir.Gt, ir.Ge)
 
 
 def classify_family(expr: ir.Expression) -> str:
     """Coarse rewrite-family label for attribution (``ScanReport.
-    rewritesFired`` / the advisor's mining): string > arithmetic > cast >
-    not > other, by the ops present anywhere in the conjunct."""
-    has_string = has_arith = has_cast = has_not = False
+    rewritesFired`` / the advisor's mining): string > arithmetic >
+    conditional > cast > colcol > not > other, by the ops present anywhere
+    in the conjunct."""
+    has_string = has_arith = has_cond = has_cast = has_colcol = False
+    has_not = False
     for e in expr.walk():
         if isinstance(e, (ir.Like, ir.StartsWith)) or (
                 isinstance(e, ir.Func) and e.name in _FAMILY_STRING):
             has_string = True
-        elif isinstance(e, (ir.Add, ir.Sub, ir.Mul, ir.Div, ir.Mod, ir.Neg)):
+        elif isinstance(e, (ir.Add, ir.Sub, ir.Mul, ir.Div, ir.Mod, ir.Neg)) \
+                or (isinstance(e, ir.Func) and e.name in _FAMILY_ARITH_FUNCS):
             has_arith = True
+        elif isinstance(e, (ir.Coalesce, ir.CaseWhen)):
+            has_cond = True
         elif isinstance(e, ir.Cast) or (
                 isinstance(e, ir.Func) and e.name in _FAMILY_TEMPORAL):
             has_cast = True
+        elif isinstance(e, _CMP_CLASSES) and isinstance(e.left, ir.Column) \
+                and isinstance(e.right, ir.Column):
+            has_colcol = True
         elif isinstance(e, ir.Not):
             has_not = True
     if has_string:
         return "string"
     if has_arith:
         return "arithmetic"
+    if has_cond:
+        return "conditional"
     if has_cast:
         return "cast"
+    if has_colcol:
+        return "colcol"
     if has_not:
         return "not"
     return "other"
@@ -524,6 +546,18 @@ def _interval(e: ir.Expression, pcols: FrozenSet[str],
         if name in _WIDENING_CASTS:
             return lo, hi  # float64 rounding is inside the relaxation
         raise _Unknown
+    if isinstance(e, ir.Func) and e.name == "abs" and len(e.children) == 1:
+        lo, hi = _interval(e.children[0], pcols, types)
+        m = _members(lo, hi)
+        if len(m) + 1 > _MAX_CANDS:
+            raise _Unknown
+        wrapped = [ir.Func("abs", [x]) for x in m]
+        # the child interval may span zero, where |v| bottoms out at 0 even
+        # though every |endpoint| is large — the 0 lower candidate is what
+        # keeps the composed interval sound. The endpoint achieving the
+        # child's min (resp. max) is a member, so max(|members|) covers the
+        # true upper bound.
+        return [ir.Literal(0.0)] + wrapped, wrapped
     raise _Unknown
 
 
@@ -589,6 +623,149 @@ def _interval_cmp(t, expr_side: ir.Expression, lit_value: Any,
     if side is False:
         raise _Never
     return side
+
+
+# ---------------------------------------------------------------------------
+# Branch combinators + abs / conditional / col-vs-col rules
+# ---------------------------------------------------------------------------
+
+
+def _or_branches(thunks: List[Callable[[], ir.Expression]]) -> ir.Expression:
+    """can-match of a disjunction of can-matches. A _Never branch is False
+    and drops out; _Unknown propagates (one might-match branch makes the
+    whole OR unbounded — nothing stats can exclude); every branch impossible
+    → _Never."""
+    parts: List[ir.Expression] = []
+    for th in thunks:
+        try:
+            parts.append(th())
+        except _Never:
+            continue
+    if not parts:
+        raise _Never
+    return _or_all(parts)
+
+
+def _and_branches(thunks: List[Callable[[], ir.Expression]]) -> ir.Expression:
+    """can-match conjunction: And(UNKNOWN, X) over-approximates soundly to
+    X alone, a _Never branch propagates (the conjunction is impossible),
+    all-UNKNOWN → _Unknown."""
+    parts: List[ir.Expression] = []
+    for th in thunks:
+        try:
+            parts.append(th())
+        except _Unknown:
+            continue
+    if not parts:
+        raise _Unknown
+    out = parts[0]
+    for p in parts[1:]:
+        out = ir.And(out, p)
+    return out
+
+
+def _synth_abs(t, child: ir.Expression, lit: ir.Literal,
+               pcols: FrozenSet[str], types: Dict[str, DataType],
+               base: _Base) -> ir.Expression:
+    """Exact logical decomposition of ``abs(x) op v``: the upper tests split
+    into ``x > v OR x < -v``, the lower into ``x < v AND x > -v``, each side
+    re-synthesized recursively — strictly stronger than the interval path
+    for the lower/equality shapes, where abs's 0 lower candidate makes the
+    interval trivially satisfiable."""
+    v = _as_num(lit.value)
+
+    def sub(cmp_cls, bound):
+        return lambda: _synthesize(cmp_cls(child, ir.Literal(bound)),
+                                   pcols, types, base)
+
+    if t in (ir.Gt, ir.Ge):
+        if v < 0 or (t is ir.Ge and v == 0):
+            raise _Unknown  # trivially true for every non-null row
+        return _or_branches([sub(t, v), sub(_CMP_FLIP[t], -v)])
+    if t in (ir.Lt, ir.Le):
+        if v < 0 or (t is ir.Lt and v == 0):
+            raise _Never  # |x| below a non-positive bound: impossible
+        return _and_branches([sub(t, v), sub(_CMP_FLIP[t], -v)])
+    if t is ir.Eq:
+        if v < 0:
+            raise _Never
+        if v == 0:
+            return _synthesize(ir.Eq(child, ir.Literal(v)), pcols, types, base)
+        return _or_branches([sub(ir.Eq, v), sub(ir.Eq, -v)])
+    raise _Unknown
+
+
+def _synth_branches(t, e: ir.Expression, lit: ir.Literal,
+                    pcols: FrozenSet[str], types: Dict[str, DataType],
+                    base: _Base) -> ir.Expression:
+    """can-match for ``coalesce(...) op lit`` / ``CASE WHEN ... op lit``: a
+    row's value is always one of the branch values (CaseWhen conditions and
+    coalesce nullness ignored — a sound over-approximation), so the OR of
+    per-branch can-matches covers every row. A literal branch resolves
+    statically: satisfying → some row may take it and match (_Unknown — no
+    stats lane can rule it out); NULL or non-satisfying → drops out."""
+    if isinstance(e, ir.Coalesce):
+        vals = list(e.children)
+    else:  # CaseWhen children: (c1, v1, ..., default)
+        vals = [e.children[2 * i + 1] for i in range(e.n_branches)]
+        vals.append(e.children[-1])
+    thunks: List[Callable[[], ir.Expression]] = []
+    for b in vals:
+        b = _fold(b)
+        if isinstance(b, ir.Literal):
+            if b.value is None:
+                continue  # comparison against NULL can't match
+            try:
+                ok = t(b, lit).eval({})
+            except Exception:  # noqa: BLE001 — incomparable literal pair
+                raise _Unknown from None
+            if ok is True:
+                raise _Unknown
+            continue
+        thunks.append(lambda bb=b: _synthesize(t(bb, lit), pcols, types, base))
+    if not thunks:
+        raise _Never
+    return _or_branches(thunks)
+
+
+#: Col-vs-col comparisons trust BOTH lanes' min/max to bound actual row
+#: values — the same hazard the NOT flip gates: float lanes are blind to
+#: NaN rows, and string lanes may carry truncated bounds whose max
+#: under-reports. Integer-family + decimal + (same-type) temporal only.
+_COLCOL_SAFE_NUM = (ByteType, ShortType, IntegerType, LongType, DecimalType)
+
+
+def _synth_colcol(t, l: ir.Column, r: ir.Column,
+                  pcols: FrozenSet[str],
+                  types: Dict[str, DataType]) -> ir.Expression:
+    """``a < b`` can match only when ``min.a < max.b`` (some pair of row
+    values can land in order), ``a = b`` only when the two stat intervals
+    intersect. NULL/absent lanes evaluate NULL = keep (Kleene)."""
+    la, ra = l.name.lower(), r.name.lower()
+    if la in pcols or ra in pcols:
+        raise _Unknown  # partition columns have no stats lanes
+    ta, tb = types.get(la), types.get(ra)
+    ok = ((isinstance(ta, _COLCOL_SAFE_NUM) and isinstance(tb, _COLCOL_SAFE_NUM))
+          or (isinstance(ta, DateType) and isinstance(tb, DateType))
+          or (isinstance(ta, TimestampType) and isinstance(tb, TimestampType)))
+    if not ok:
+        raise _Unknown
+    if la == ra:
+        if t in (ir.Lt, ir.Gt):
+            raise _Never  # a < a matches no row
+        raise _Unknown  # a <= a / a = a: true for every non-null row
+    if t is ir.Lt:
+        return ir.Lt(_min(l.name), _max(r.name))
+    if t is ir.Le:
+        return ir.Le(_min(l.name), _max(r.name))
+    if t is ir.Gt:
+        return ir.Gt(_max(l.name), _min(r.name))
+    if t is ir.Ge:
+        return ir.Ge(_max(l.name), _min(r.name))
+    if t is ir.Eq:
+        return ir.And(ir.Le(_min(l.name), _max(r.name)),
+                      ir.Ge(_max(l.name), _min(r.name)))
+    raise _Unknown
 
 
 # ---------------------------------------------------------------------------
@@ -782,12 +959,21 @@ def _synthesize(e: ir.Expression, pcols: FrozenSet[str],
         if isinstance(l, ir.Literal) and not isinstance(r, ir.Literal):
             t = _CMP_FLIP[t]
             l, r = r, l
+        if isinstance(l, ir.Column) and isinstance(r, ir.Column):
+            return _synth_colcol(t, l, r, pcols, types)
         if not isinstance(r, ir.Literal) or isinstance(l, ir.Literal):
             raise _Unknown
         if isinstance(l, ir.Func) and l.name in _FAMILY_STRING:
             return _synth_substr(t, l, r, types, pcols, base)
         if isinstance(l, ir.Func) and l.name in _FAMILY_TEMPORAL:
             return _synth_temporal(t, l, r, types, pcols, base)
+        if isinstance(l, ir.Func) and l.name == "abs" and len(l.children) == 1:
+            try:
+                return _synth_abs(t, l.children[0], r, pcols, types, base)
+            except _Unknown:
+                pass  # the interval path below is abs-aware
+        if isinstance(l, (ir.Coalesce, ir.CaseWhen)):
+            return _synth_branches(t, l, r, pcols, types, base)
         v = _as_num(r.value)
         try:
             return _invert_chain(l, _Bounds.from_cmp(t, v), pcols, types, base)

@@ -193,7 +193,8 @@ def _column_facts(scans: List[dict]) -> Dict[str, Dict[str, Any]]:
 _SYNTH_SHAPE_TOKENS = ("mul(", "add(", "sub(", "div(", "mod(", "neg(",
                        "cast(", "substr(", "substring(", "like(",
                        "startswith(", "year(", "to_date(", "date_add(",
-                       "date_sub(", "func(")
+                       "date_sub(", "func(", "abs(", "coalesce(",
+                       "casewhen(")
 
 
 def _shape_synthesizable(key: str) -> bool:

@@ -2,19 +2,22 @@
 
 PR 6 put three kinds of engine state in HBM: the MERGE key-cache slabs
 (`ops/key_cache`), the scan-planning state cache (`ops/state_cache`), and
-transient join scratch (probe source uploads). None of it was measured —
-an operator diagnosing device OOM had no number, and nothing connected the
-two caches' independent byte budgets. This module is the single ledger:
+transient join scratch (probe source uploads); the device scan path added a
+fourth, the hot-column lanes of `ops/column_cache`. None of it was measured
+originally — an operator diagnosing device OOM had no number, and nothing
+connected the caches' independent byte budgets. This module is the single
+ledger:
 
 * each component's live device bytes, published as
-  ``device.hbm.{keyCache,stateCache,scratch}Bytes`` gauges (gated on
-  ``delta.tpu.telemetry.enabled``; the internal tallies always run —
-  budget enforcement must survive a telemetry blackout);
+  ``device.hbm.{keyCache,stateCache,scratch,columnCache}Bytes`` gauges
+  (gated on ``delta.tpu.telemetry.enabled``; the internal tallies always
+  run — budget enforcement must survive a telemetry blackout);
 * a process-wide soft budget ``delta.tpu.device.hbmBudgetBytes`` (unset =
-  unlimited).  When set, the KeyCache's LRU eviction prices itself against
-  ``budget - stateCache - scratch`` (:func:`key_cache_allowance`) so growth
-  anywhere turns into eviction *pressure* instead of OOM — soft: a
-  transient slab mid-build may overshoot until it registers;
+  unlimited).  When set, each LRU cache prices itself against
+  ``budget - everyone else`` (:func:`key_cache_allowance`,
+  :func:`column_cache_allowance`) so growth anywhere turns into eviction
+  *pressure* instead of OOM — soft: a transient slab mid-build may
+  overshoot until it registers;
 * the numbers behind the doctor's 8th dimension ("device residency
   pressure", `obs/doctor._dim_device`) with its EVICT remedy.
 
@@ -31,10 +34,12 @@ from delta_tpu.utils import telemetry
 from delta_tpu.utils.config import conf
 
 __all__ = ["Account", "adjust", "totals", "budget_bytes",
-           "key_cache_allowance", "over_budget", "maybe_relieve", "reset"]
+           "key_cache_allowance", "column_cache_allowance", "over_budget",
+           "maybe_relieve", "reset"]
 
 _LOCK = threading.Lock()
-_BYTES: Dict[str, int] = {"keyCache": 0, "stateCache": 0, "scratch": 0}
+_BYTES: Dict[str, int] = {"keyCache": 0, "stateCache": 0, "scratch": 0,
+                          "columnCache": 0}
 
 # gauge names are constants from the obs/metric_names catalog — mapped here
 # so every component publishes through a registered name
@@ -42,6 +47,7 @@ _GAUGE = {
     "keyCache": "device.hbm.keyCacheBytes",
     "stateCache": "device.hbm.stateCacheBytes",
     "scratch": "device.hbm.scratchBytes",
+    "columnCache": "device.hbm.columnCacheBytes",
 }
 
 
@@ -109,17 +115,28 @@ def budget_bytes() -> Optional[int]:
         return None
 
 
-def key_cache_allowance() -> Optional[int]:
-    """How many HBM bytes the KeyCache may hold under the soft budget:
-    ``budget - stateCache - scratch`` (floored at 0), or None when no budget
-    is set. `ops/key_cache.KeyCache._evict` takes the min of this and its
-    own ``delta.tpu.keyCache.maxBytes``."""
+def _allowance(component: str) -> Optional[int]:
     budget = budget_bytes()
     if budget is None:
         return None
     with _LOCK:
-        other = _BYTES["stateCache"] + _BYTES["scratch"]
+        other = sum(v for k, v in _BYTES.items() if k != component)
     return max(0, budget - other)
+
+
+def key_cache_allowance() -> Optional[int]:
+    """How many HBM bytes the KeyCache may hold under the soft budget:
+    ``budget - everyone else`` (floored at 0), or None when no budget is
+    set. `ops/key_cache.KeyCache._evict` takes the min of this and its
+    own ``delta.tpu.keyCache.maxBytes``."""
+    return _allowance("keyCache")
+
+
+def column_cache_allowance() -> Optional[int]:
+    """Same contract for the scan ColumnCache: ``budget - everyone else``
+    or None. `ops/column_cache.ColumnCache._evict` takes the min of this
+    and ``delta.tpu.columnCache.maxBytes``."""
+    return _allowance("columnCache")
 
 
 def over_budget() -> bool:
@@ -133,9 +150,11 @@ def maybe_relieve() -> bool:
     pressure was applied. Never called with cache/entry locks held."""
     if not over_budget():
         return False
+    from delta_tpu.ops.column_cache import ColumnCache
     from delta_tpu.ops.key_cache import KeyCache
 
     KeyCache.instance()._evict(keep=None)
+    ColumnCache.instance()._evict(keep=None)
     return True
 
 

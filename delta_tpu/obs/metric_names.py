@@ -54,6 +54,7 @@ GAUGES = frozenset({
     "device.hbm.keyCacheBytes",
     "device.hbm.stateCacheBytes",
     "device.hbm.scratchBytes",
+    "device.hbm.columnCacheBytes",
     # -- router audit + calibration (obs/router_audit, obs/calibration) --
     "router.missRate",
     "router.calibration",        # label: constant
@@ -75,6 +76,8 @@ GAUGES = frozenset({
     "slo.alerts",                 # alerts currently firing
     # -- resident key cache per-table residency (ops/key_cache, label: table)
     "keyCache.residentBytes",
+    # -- scan column cache per-table residency (ops/column_cache, label: table)
+    "columnCache.residentBytes",
 })
 
 #: Counters introduced by the obs layer and its doctor feeds.
@@ -158,9 +161,19 @@ ENGINE_COUNTERS = frozenset({
     "scan.files.read",
     "scan.bytes.read",
     "scan.bytes.skipped",
+    "scan.bytes.deviceSkipped",
+    "scan.bytes.deviceSurvivor",
     "scan.rowgroups.total",
     "scan.rowgroups.pruned",
     "scan.rowgroups.lateSkipped",
+    "scan.rowgroups.deviceSkipped",
+    "scan.device.engaged",
+    "scan.device.declined",
+    "scan.device.fallback",
+    "columnCache.hits",
+    "columnCache.misses",
+    "columnCache.evictions",
+    "columnCache.invalidations",
     "scan.rewrites.synthesized",
     "scan.rewrites.unknown",
     "stateCache.builds",
@@ -210,8 +223,8 @@ PUBLIC_API = {
     "calibration": ("enabled", "ingest", "state_path", "load_state",
                     "save_state", "apply_state", "current_state", "reset"),
     "hbm_ledger": ("Account", "adjust", "totals", "budget_bytes",
-                   "key_cache_allowance", "over_budget", "maybe_relieve",
-                   "reset"),
+                   "key_cache_allowance", "column_cache_allowance",
+                   "over_budget", "maybe_relieve", "reset"),
     "journal": ("enabled", "journal_dir", "predicate_fingerprint",
                 "record_scan", "record_commit", "record_dml",
                 "record_router", "record_autopilot", "attempt_state",
@@ -272,6 +285,8 @@ DESCRIPTIONS = {
     "device.hbm.keyCacheBytes": "Process-wide key-cache bytes resident on device.",
     "device.hbm.stateCacheBytes": "Process-wide state-cache bytes resident on device.",
     "device.hbm.scratchBytes": "Process-wide transient scratch bytes resident on device.",
+    "device.hbm.columnCacheBytes": "Process-wide scan column-cache lane bytes resident on device.",
+    "columnCache.residentBytes": "HBM-resident scan column-lane bytes per table.",
     "router.missRate": "Fraction of routed decisions where a rejected route predicted faster.",
     "router.calibration": "Installed calibrated value per link constant.",
     "streaming.source.backlogFiles": "Committed files not yet served to the streaming consumer.",
@@ -349,9 +364,19 @@ DESCRIPTIONS = {
     "scan.files.read": "Data files decoded by scans.",
     "scan.bytes.read": "Compressed bytes of files decoded by scans.",
     "scan.bytes.skipped": "Uncompressed bytes skipped by row-group pruning.",
+    "scan.bytes.deviceSkipped": "Uncompressed bytes skipped by all-False device residual masks.",
+    "scan.bytes.deviceSurvivor": "Survivor row-group bytes host-decoded on the device residual path.",
     "scan.rowgroups.total": "Row groups considered by the second pruning tier.",
     "scan.rowgroups.pruned": "Row groups skipped via footer stats.",
     "scan.rowgroups.lateSkipped": "Row groups skipped by late materialization.",
+    "scan.rowgroups.deviceSkipped": "Row groups skipped by all-False device residual masks.",
+    "scan.device.engaged": "Scans whose residual mask was computed on device.",
+    "scan.device.declined": "Scans where the cost model kept the residual on host.",
+    "scan.device.fallback": "Device residual attempts that fell back to the host path.",
+    "columnCache.hits": "Scan column-cache lane hits (file, column resident).",
+    "columnCache.misses": "Scan column-cache lane misses (cold decode).",
+    "columnCache.evictions": "Scan column-cache lanes evicted by the LRU bound.",
+    "columnCache.invalidations": "Scan column-cache lanes dropped by a rewrite epoch bump.",
     "scan.rewrites.synthesized": "Conjuncts lowered to stats bounds only via predicate synthesis.",
     "scan.rewrites.fired": "Synthesized rewrites that excluded files or row groups in a scan.",
     "scan.rewrites.unknown": "Conjuncts predicate synthesis still could not lower (kept residual).",

@@ -42,11 +42,24 @@ class ScanReport:
     row_groups_total: int = 0
     row_groups_pruned: int = 0        # footer-stats tier
     row_groups_late_skipped: int = 0  # late-materialization tier
+    #: row groups whose device residual mask came back all-False — skipped
+    #: without the host ever decoding them (ops/column_cache path)
+    row_groups_device_skipped: int = 0
     bytes_read: int = 0
     bytes_skipped: int = 0
     #: the slice of ``bytes_skipped`` the footer-stats PLANNER avoided
     #: (row groups never opened); the remainder is late materialization
     bytes_skipped_planned: int = 0
+    #: the slice of ``bytes_skipped`` the DEVICE mask avoided (all-False
+    #: row groups) — disjoint from the host late-materialization slice
+    bytes_device_skipped: int = 0
+    #: row-group bytes decoded on host because the device mask kept at
+    #: least one of their rows — the device path's survivor fetch, counted
+    #: separately from plain host-decoded bytes
+    bytes_device_survivor: int = 0
+    #: ``"device"`` when the jitted residual path served this scan; None on
+    #: the pure host path (declined / fallback / not attempted)
+    device_residual: Optional[str] = None
     rows_out: int = 0
     phase_ms: Dict[str, int] = field(default_factory=dict)
     #: synthesized predicate rewrites (expr/synthesis) that excluded at
@@ -72,9 +85,13 @@ class ScanReport:
             "rowGroupsTotal": self.row_groups_total,
             "rowGroupsPruned": self.row_groups_pruned,
             "rowGroupsLateSkipped": self.row_groups_late_skipped,
+            "rowGroupsDeviceSkipped": self.row_groups_device_skipped,
             "bytesRead": self.bytes_read,
             "bytesSkipped": self.bytes_skipped,
             "bytesSkippedPlanned": self.bytes_skipped_planned,
+            "bytesDeviceSkipped": self.bytes_device_skipped,
+            "bytesDeviceSurvivor": self.bytes_device_survivor,
+            "deviceResidual": self.device_residual,
             "rowsOut": self.rows_out,
             "phaseMs": dict(self.phase_ms),
             "rewritesFired": [dict(f) for f in self.rewrites_fired],

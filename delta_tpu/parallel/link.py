@@ -36,7 +36,9 @@ __all__ = [
     "HOST_KEY_DECODE_S_PER_ROW", "RESIDENT_PROBE_S_PER_ROW",
     "RESIDENT_PROBE_FIXED_S", "RESIDENT_FINALIZE_S_PER_ROW",
     "RESIDENT_PAIR_S_PER_ROW", "DEVICE_SORT_S_PER_ROW",
+    "HOST_RESIDUAL_S_PER_CELL", "DEVICE_RESIDUAL_S_PER_CELL",
     "resident_probe_device_s", "cold_merge_device_s",
+    "host_residual_filter_s", "device_residual_mask_s",
     "CALIBRATABLE", "constant", "set_calibrated", "calibrated_constants",
     "clear_calibrated",
 ]
@@ -76,6 +78,12 @@ RESIDENT_PAIR_S_PER_ROW = 1.0e-7
 # device slab sort (lax.sort of the key lane + permutation), amortized per
 # row — paid once per cold build / tail append, not per probe
 DEVICE_SORT_S_PER_ROW = 5.0e-8
+# residual predicate over decoded Arrow columns, host compute kernels
+# (`expr/vectorized`): DRAM-bound compares + Kleene combines per cell
+HOST_RESIDUAL_S_PER_CELL = 1.5e-8
+# the same residual from HBM-resident SoA lanes (`ops/column_cache`), one
+# fused jitted pass: VPU elementwise compares at HBM bandwidth
+DEVICE_RESIDUAL_S_PER_CELL = 5.0e-10
 
 
 # -- self-calibration --------------------------------------------------------
@@ -94,7 +102,8 @@ CALIBRATABLE = frozenset({
     "KERNEL_S_PER_ROW", "HOST_JOIN_S_PER_ROW", "HOST_PRUNE_S_PER_CELL",
     "DEVICE_PRUNE_S_PER_CELL", "HOST_KEY_DECODE_S_PER_ROW",
     "RESIDENT_PROBE_S_PER_ROW", "RESIDENT_PAIR_S_PER_ROW",
-    "DEVICE_SORT_S_PER_ROW",
+    "DEVICE_SORT_S_PER_ROW", "HOST_RESIDUAL_S_PER_CELL",
+    "DEVICE_RESIDUAL_S_PER_CELL",
 })
 
 _calibrated: dict = {}
@@ -166,6 +175,32 @@ def cold_merge_device_s(n: int, m: int, p: "LinkProfile") -> float:
 # the same cells on-device from HBM-resident f32 lanes (see ops/state_cache):
 # ~2 f32 reads/cell at HBM bandwidth, fused compares
 DEVICE_PRUNE_S_PER_CELL = 2.0e-11
+
+
+def host_residual_filter_s(rows: int, ncols: int) -> float:
+    """The router's cost model for evaluating a scan's residual predicate on
+    host over already-decoded Arrow columns. Residual *evaluation* only —
+    the host decode of non-predicate projection columns is common to both
+    sides and cancels. ONE definition — `ops/column_cache` and the device
+    scan bench both call this, so they cannot drift apart."""
+    return rows * ncols * constant("HOST_RESIDUAL_S_PER_CELL")
+
+
+def device_residual_mask_s(cold_rows: int, resident_rows: int, ncols: int,
+                           p: "LinkProfile") -> float:
+    """Cost model for the device residual-mask pass: cold predicate-column
+    decode on host (resident rows skip it — that's the cache's winnings),
+    the cold lane upload, one fused elementwise kernel over every row, the
+    bool-mask download (~1 byte/row), and the dispatch round trips. Priced
+    against :func:`host_residual_filter_s`; audited as ``scan.residual``."""
+    rows = cold_rows + resident_rows
+    return (
+        cold_rows * ncols * constant("HOST_KEY_DECODE_S_PER_ROW")
+        + p.upload_s(cold_rows * ncols * 8)
+        + rows * ncols * constant("DEVICE_RESIDUAL_S_PER_CELL")
+        + p.download_s(rows)
+        + 2 * p.latency_s
+    )
 
 
 @dataclass(frozen=True)

@@ -94,11 +94,20 @@ class SqlConf:
         # Resident key-cache budgets (ops/key_cache.KeyCache._evict).
         "delta.tpu.keyCache.maxBytes": 1 << 30,
         "delta.tpu.keyCache.maxEntries": 8,
+        # Device residual-filter path (ops/column_cache): "auto" prices
+        # device vs host per scan through parallel/link, "force" always
+        # engages (bench legs), "off" disables the path and the cache.
+        "delta.tpu.read.deviceResidual.mode": "auto",
+        # Scan column-cache budgets (ops/column_cache.ColumnCache._evict);
+        # entries are per-(file, column) lanes, hence the larger count.
+        "delta.tpu.columnCache.maxBytes": 1 << 30,
+        "delta.tpu.columnCache.maxEntries": 4096,
         # Process-wide soft budget over EVERY device-resident byte the
-        # engine holds (key-cache slabs + state-cache lanes + join scratch,
-        # obs/hbm_ledger). When set, the KeyCache's LRU eviction prices
-        # itself against budget - stateCache - scratch, so growth anywhere
-        # becomes eviction pressure instead of OOM. None = unlimited.
+        # engine holds (key-cache slabs + state-cache lanes + join scratch
+        # + scan column lanes, obs/hbm_ledger). When set, each LRU cache
+        # prices itself against budget minus everyone else, so growth
+        # anywhere becomes eviction pressure instead of OOM. None =
+        # unlimited.
         "delta.tpu.device.hbmBudgetBytes": None,
         # Router audit ledger (obs/router_audit): last N routed decisions
         # kept for the HTTP /router route.

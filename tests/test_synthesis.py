@@ -216,8 +216,59 @@ def _gen_temporal(rng):
         ir.Cast(_arith_expr(rng, depth=1), target), _lit_num(rng))
 
 
+def _branch_val(rng):
+    r = rng.random()
+    if r < 0.3:
+        return _lit_num(rng)
+    if r < 0.4:
+        return ir.Literal(None)
+    return _arith_expr(rng, depth=1)
+
+
+def _gen_conditional(rng):
+    """abs / coalesce / CASE WHEN shapes (the r16 synthesis additions)."""
+    cmp_cls = rng.choice(_CMPS)
+    kind = rng.choice(["abs", "coalesce", "casewhen"])
+    if kind == "abs":
+        return cmp_cls(ir.Func("abs", [_arith_expr(rng, depth=1)]),
+                       _lit_num(rng))
+    if kind == "coalesce":
+        n = int(rng.integers(1, 4))
+        return cmp_cls(ir.Coalesce(*[_branch_val(rng) for _ in range(n)]),
+                       _lit_num(rng))
+    n = int(rng.integers(1, 3))
+    branches = [(rng.choice(_CMPS)(ir.Column(str(rng.choice(["a", "b"]))),
+                                   _lit_num(rng)), _branch_val(rng))
+                for _ in range(n)]
+    default = _branch_val(rng) if rng.random() < 0.7 else None
+    return cmp_cls(ir.CaseWhen(branches, default), _lit_num(rng))
+
+
+def _gen_colcol(rng):
+    """Column-vs-column comparisons over every type pairing — the float,
+    string, and mixed pairs must stay gated (UNKNOWN), the int/temporal
+    pairs must stay sound."""
+    cols = ["a", "b", "f", "s", "d", "ts"]
+    l = ir.Column(str(rng.choice(cols)))
+    r = ir.Column(str(rng.choice(cols)))
+    return rng.choice(_CMPS)(l, r)
+
+
+def _gen_colcol_typed(rng):
+    """Row-evaluable pairings only (for compound conjuncts: an un-evaluable
+    comparison would mark every row a 'potential match' and mask the other
+    conjunct's exclusion in the harness's conservative accounting)."""
+    groups = [["a", "b"], ["f"], ["s"], ["d"], ["ts"]]
+    group = groups[int(rng.integers(0, len(groups)))]
+    l = ir.Column(str(rng.choice(group)))
+    r = ir.Column(str(rng.choice(group)))
+    return rng.choice(_CMPS)(l, r)
+
+
 def _gen_compound(rng):
-    a, b = _gen_arith(rng), rng.choice([_gen_arith, _gen_string])(rng)
+    a = _gen_arith(rng)
+    b = rng.choice([_gen_arith, _gen_string, _gen_conditional,
+                    _gen_colcol_typed])(rng)
     r = rng.random()
     if r < 0.3:
         return ir.And(a, b)
@@ -232,6 +283,8 @@ def _gen_compound(rng):
     ("arithmetic", _gen_arith),
     ("string", _gen_string),
     ("temporal", _gen_temporal),
+    ("conditional", _gen_conditional),
+    ("colcol", _gen_colcol),
     ("compound", _gen_compound),
 ])
 def test_property_soundness(family, gen):
@@ -627,19 +680,21 @@ def test_advisor_stale_shape_from_pre_synthesis_journal(tmp_table):
     [g] = [g for g in rep.facts["neverPruned"]
            if g["fingerprint"] == "gt(mul(price,qty),?)"]
     assert g["reason"].startswith("staleShape")
-    # a genuinely uncoverable legacy shape still reads as 'shape'
+    # a genuinely uncoverable legacy shape still reads as 'shape' —
+    # coalesce/abs graduated to synthesizable in r16, so use a truly
+    # non-monotone wrap (lower) that synthesis can never invert
     entry["fingerprint"] = {
         "columns": ["price"], "conjuncts": [
-            {"shape": "eq(coalesce(price,?),?)", "columns": ["price"],
+            {"shape": "eq(lower(price),?)", "columns": ["price"],
              "prunable": False, "partition": False}],
         "prunableColumns": [], "residualColumns": ["price"],
-        "key": "eq(coalesce(price,?),?)",
+        "key": "eq(lower(price),?)",
     }
     with open(seg, "a", encoding="utf-8") as fh:
         fh.write(json.dumps(entry) + "\n")
     rep = t.advise()
     [g2] = [g2 for g2 in rep.facts["neverPruned"]
-            if g2["fingerprint"] == "eq(coalesce(price,?),?)"]
+            if g2["fingerprint"] == "eq(lower(price),?)"]
     assert g2["reason"].startswith("shape")
 
 
@@ -667,6 +722,106 @@ def test_interval_mul_emits_four_endpoint_products():
     assert rw.sql().count("*") == 4
 
 
+def test_abs_rewrite_shapes():
+    # |a| < v excludes when the whole stats range sits outside (-v, v)
+    rw = _rw("abs(a) < 10")
+    env = _env({"numRecords": 2, "nullCount.a": 0, "min.a": 50, "max.a": 99})
+    assert rw.eval(env) is False
+    env2 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -5, "max.a": 99})
+    assert rw.eval(env2) is not False
+    # the upper test splits into the two signed comparisons
+    rw = _rw("abs(a) > 100")
+    assert synthesis.can_exclude(rw)
+    env3 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -5, "max.a": 5})
+    assert rw.eval(env3) is False
+    env4 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -500, "max.a": 5})
+    assert rw.eval(env4) is not False
+    # impossible bounds are constant-folded to never-match
+    for q in ["abs(a) < 0", "abs(a) <= -3", "abs(a) = -1"]:
+        rw = _rw(q)
+        assert isinstance(rw, ir.Literal) and rw.value is False
+    # trivially-true bounds can never exclude (the interval fallback may
+    # still emit an always-true rewrite — it must not evaluate False)
+    rw = _rw("abs(a) >= 0")
+    env5 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -5, "max.a": 5})
+    assert rw.eval(env5) is not False
+
+
+def test_abs_nested_in_interval():
+    # abs below arithmetic goes through the interval path, whose lower
+    # candidate 0 keeps the zero-crossing case sound
+    rw = _rw("abs(a) * 2 > 100")
+    env = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -5, "max.a": 5})
+    assert rw.eval(env) is False
+    env2 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -80, "max.a": 5})
+    assert rw.eval(env2) is not False
+
+
+def test_coalesce_casewhen_rewrites():
+    # the 0 literal branch fails `> 10`, so only a's stats decide
+    rw = _rw("coalesce(a, 0) > 10")
+    env = _env({"numRecords": 2, "nullCount.a": 0, "min.a": -5, "max.a": 5})
+    assert rw.eval(env) is False
+    # a satisfying literal branch means some row may match: unprunable
+    assert not synthesis.can_exclude(_rw("coalesce(a, 100) > 10"))
+    # expression branches OR together
+    rw = _rw("coalesce(a, b) > 10")
+    env_hi = _env({"numRecords": 2, "nullCount.a": 0, "min.a": 50,
+                   "max.a": 60, "min.b": 0, "max.b": 1})
+    assert rw.eval(env_hi) is not False
+    # CASE WHEN: branch values + default, conditions ignored
+    pred = ir.Ge(ir.CaseWhen(
+        [(ir.Gt(ir.Column("b"), ir.Literal(0)), ir.Column("a"))]),
+        ir.Literal(1000))
+    rw = pruning.skipping_predicate(pred, frozenset(), TYPES)
+    env = _env({"numRecords": 2, "nullCount.a": 0, "min.a": 1, "max.a": 10})
+    assert rw.eval(env) is False  # NULL default drops out; a's range too low
+    env2 = _env({"numRecords": 2, "nullCount.a": 0, "min.a": 1,
+                 "max.a": 5000})
+    assert rw.eval(env2) is not False
+
+
+def test_colcol_rewrite_shapes():
+    rw = _rw("a < b")
+    assert rw.sql() == "(`min.a` < `max.b`)"
+    rw = _rw("a >= b")
+    assert rw.sql() == "(`max.a` >= `min.b`)"
+    rw = _rw("a = b")  # interval intersection
+    s = rw.sql()
+    assert "min.a" in s and "max.a" in s and "min.b" in s and "max.b" in s
+    # strict self-comparison can never match
+    rw = _rw("a < a")
+    assert isinstance(rw, ir.Literal) and rw.value is False
+    assert not synthesis.can_exclude(_rw("a <= a"))
+
+
+def test_colcol_gates():
+    # float columns are NaN-blind: gated (same hazard as the NOT flip)
+    assert not synthesis.can_exclude(_rw("f < a"))
+    assert not synthesis.can_exclude(_rw("a < f"))
+    # string bounds may be truncated: gated
+    assert not synthesis.can_exclude(pruning.skipping_predicate(
+        parse_predicate("x < y"), frozenset(),
+        {"x": StringType(), "y": StringType()}))
+    # mixed temporal types: gated; same-type temporal fires
+    assert not synthesis.can_exclude(_rw("d < ts"))
+    assert synthesis.can_exclude(pruning.skipping_predicate(
+        parse_predicate("x < y"), frozenset(),
+        {"x": DateType(), "y": DateType()}))
+    # partition columns have no stats lanes
+    assert not synthesis.can_exclude(pruning.skipping_predicate(
+        parse_predicate("a < b"), frozenset({"b"}), TYPES))
+
+
+def test_colcol_temporal_soundness():
+    rng = np.random.default_rng(1616)
+    for _ in range(100):
+        files = [_gen_rows(rng) for _ in range(FILES_PER_CASE)]
+        for col in ("d", "ts"):
+            pred = rng.choice(_CMPS)(ir.Column(col), ir.Column(col))
+            _soundness_case(pred, files)
+
+
 def test_classify_family():
     assert synthesis.classify_family(parse_predicate("a * b > 1")) == "arithmetic"
     assert synthesis.classify_family(
@@ -674,3 +829,7 @@ def test_classify_family():
     assert synthesis.classify_family(
         parse_predicate("cast(a as long) > 1")) == "cast"
     assert synthesis.classify_family(parse_predicate("not a = 1")) == "not"
+    assert synthesis.classify_family(parse_predicate("abs(a) > 1")) == "arithmetic"
+    assert synthesis.classify_family(
+        parse_predicate("coalesce(a, 0) > 1")) == "conditional"
+    assert synthesis.classify_family(parse_predicate("a < b")) == "colcol"
