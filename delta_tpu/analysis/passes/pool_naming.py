@@ -35,6 +35,7 @@ REGISTERED_POOLS = frozenset({
     "delta-ckpt-decode",          # log/columnar.py part decoders
     "delta-vacuum-list",          # commands/vacuum.py partition listing
     "delta-vacuum-delete",        # commands/vacuum.py parallel delete
+    "delta-replay-prep",          # replay/shadow.py candidate clone prep
     # dedicated threads (threading.Thread name)
     "delta-ckpt-async",           # log/checkpointer.py coalescing daemon
     "delta-journal-writer",       # obs/journal.py writer daemon

@@ -74,6 +74,9 @@ class RunReport:
     planned: List[Dict[str, Any]] = field(default_factory=list)
     planned_keys: List[str] = field(default_factory=list)
     cooled: List[str] = field(default_factory=list)   # keys inside cooldown
+    #: rewrite-class actions the ``requireShadow`` guardrail held back,
+    #: with the verdict + shadow evidence cited (`planner.shadow_gate`)
+    shadow_filtered: List[Dict[str, Any]] = field(default_factory=list)
     backoff_until_ms: Optional[int] = None
     outcomes: List[Dict[str, Any]] = field(default_factory=list)
     duration_ms: float = 0.0
@@ -88,6 +91,7 @@ class RunReport:
             "planned": list(self.planned),
             "plannedKeys": list(self.planned_keys),
             "cooldownFiltered": list(self.cooled),
+            "shadowFiltered": list(self.shadow_filtered),
             "backoffUntil": self.backoff_until_ms,
             "outcomes": list(self.outcomes),
             "durationMs": round(self.duration_ms, 3),
@@ -153,6 +157,23 @@ def run_once(table, force: bool = False) -> RunReport:
         backoff = planner.contention_backoff_until(ledger, wall_now,
                                                    log_path=log_path)
         actions = planner.plan(doc, adv)
+        # requireShadow guardrail BEFORE the cooldown filter and the
+        # dry-run return: a dry-run plan must show the suppression too —
+        # that's the whole point of rehearsing
+        actions, shadow_deferred = planner.shadow_gate(
+            actions, log_path,
+            entries=[e for e in entries if e.get("kind") == "shadow"])
+        if shadow_deferred:
+            report.shadow_filtered = shadow_deferred
+            telemetry.bump_counter("autopilot.actions.deferred",
+                                   len(shadow_deferred))
+            for d in shadow_deferred:
+                journal_mod.record_autopilot(
+                    log_path, "deferred",
+                    {"kind": d["kind"], "target": d["target"],
+                     "shadow": d.get("shadow")},
+                    durable=False,
+                    reason=f"requireShadow: {d['reason']}")
         runnable: List[MaintenanceAction] = []
         for a in actions:
             if a.key in blocked:

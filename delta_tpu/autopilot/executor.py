@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from delta_tpu.obs.actions import MaintenanceAction, spec
 from delta_tpu.utils import errors, telemetry
+from delta_tpu.utils.config import conf
 
 __all__ = ["ExecutionResult", "execute", "audit_metrics", "build_audit"]
 
@@ -76,6 +77,23 @@ def build_audit(action: MaintenanceAction, before, after) -> Dict[str, Any]:
     audit: Dict[str, Any] = {"predicted": dict(action.predicted)}
     mapped = audit_metrics(action.kind)
     if mapped is None or after is None:
+        if (mapped is None and action.kind == "ZORDER"
+                and conf.get_bool("delta.tpu.autopilot.shadowAudit", True)):
+            # ZORDER has no doctor dimension to diff, but when a shadow
+            # scorecard covered this (kind, target) its trace can replay
+            # against the now-rewritten LIVE table — a measured realized
+            # verdict instead of a pending longitudinal one
+            try:
+                from delta_tpu.replay.shadow import realized_audit
+
+                shadow = realized_audit(action.table_path, action.kind,
+                                        action.target)
+            except Exception:  # noqa: BLE001 — audit must not fail the run
+                shadow = None
+            if shadow is not None:
+                audit.update(shadow)
+                audit["auditSource"] = "shadowReplay"
+                return audit
         audit["verdict"] = "pending"
         audit["detail"] = ("longitudinal action: realized effect shows up "
                            "in future journal history"

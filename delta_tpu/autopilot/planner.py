@@ -41,7 +41,7 @@ from delta_tpu.obs.doctor import SEVERITY_RANK
 from delta_tpu.utils.config import conf
 
 __all__ = ["plan", "quiet_window", "ledger_entries", "cooldown_blocked",
-           "contention_backoff_until", "COOLDOWN_PHASES"]
+           "contention_backoff_until", "shadow_gate", "COOLDOWN_PHASES"]
 
 #: commit operation names that are maintenance, not foreground traffic
 _MAINTENANCE_OPS = frozenset({"OPTIMIZE", "REORG", "VACUUM"})
@@ -184,6 +184,73 @@ def _advisor_actions(advisor_report) -> List[MaintenanceAction]:
             predicted=dict(r.evidence),
         ))
     return out
+
+
+#: rewrite-class action kinds the ``requireShadow`` guardrail covers —
+#: the ones that spend real IO reshaping data layout
+_SHADOW_GATED_KINDS = frozenset({"OPTIMIZE", "ZORDER", "PURGE"})
+
+
+def _est_bytes(a: MaintenanceAction) -> Optional[int]:
+    for src in (a.evidence, a.predicted):
+        for key in ("bytes", "estBytes", "tailBytes"):
+            v = src.get(key)
+            if v is not None:
+                try:
+                    return int(v)
+                except (TypeError, ValueError):
+                    pass
+    return None
+
+
+def shadow_gate(actions: List[MaintenanceAction], log_path: str,
+                entries: Optional[List[Dict[str, Any]]] = None):
+    """The ``delta.tpu.autopilot.requireShadow`` guardrail: rewrite-class
+    actions at/above ``requireShadowMinBytes`` only pass once a journaled
+    shadow run CONFIRMED their (kind, target) — refuted ones are suppressed
+    with the measured deltas cited, untested ones deferred until a shadow
+    run exists. Unknown rewrite sizes are treated as over the threshold
+    (fail closed). Returns ``(kept, deferred)`` where each deferred row
+    cites the action key, the verdict, and the covering shadow evidence.
+    No-op (everything kept) while the conf is off — shadow validation is
+    opt-in, like dry-run is opt-out."""
+    if not conf.get_bool("delta.tpu.autopilot.requireShadow", False):
+        return list(actions), []
+    min_bytes = conf.get_int("delta.tpu.autopilot.requireShadowMinBytes", 0)
+    if entries is None:
+        journal_mod.flush(log_path)
+        entries = journal_mod.read_entries(log_path, kinds=["shadow"])
+    from delta_tpu.replay.shadow import shadow_verdicts
+
+    verdicts = shadow_verdicts(entries)
+    kept: List[MaintenanceAction] = []
+    deferred: List[Dict[str, Any]] = []
+    for a in actions:
+        if a.kind not in _SHADOW_GATED_KINDS:
+            kept.append(a)
+            continue
+        est = _est_bytes(a)
+        if est is not None and est < min_bytes:
+            kept.append(a)  # too small to be worth a shadow run
+            continue
+        hit = verdicts.get((a.kind, (a.target or "").lower()))
+        verdict = str((hit or {}).get("verdict", "untested"))
+        if verdict == "confirmed":
+            # measured evidence rides into the plan (and the journal's
+            # ``planned`` entry) — NOT into ``predicted``, which stays
+            # the advisor's forecast for the longitudinal audit
+            a.evidence["shadow"] = dict(hit)
+            kept.append(a)
+        else:
+            deferred.append({
+                "action": a.key, "kind": a.kind, "target": a.target,
+                "verdict": verdict, "estBytes": est,
+                "reason": ("refuted by shadow run"
+                           if verdict == "refuted"
+                           else "no confirming shadow run"),
+                "shadow": dict(hit) if hit else None,
+            })
+    return kept, deferred
 
 
 def plan(doctor_report, advisor_report) -> List[MaintenanceAction]:

@@ -76,6 +76,13 @@ class Recommendation:
     #: recommendation — `obs/actions.CATALOG`, resolved per kind at emit
     #: time so the autopilot consumes it without string matching
     remedy: str = ""
+    #: latest shadow-run verdict covering this (kind, target), when one
+    #: exists (`replay/shadow.shadow_verdicts`): measured score/deltas plus
+    #: ``verdict`` confirmed|refuted|inconclusive. A DEDICATED field, not
+    #: evidence — the autopilot copies ``evidence`` into each action's
+    #: ``predicted`` payload, and a shadow verdict is measured, not
+    #: predicted.
+    shadow: Optional[Dict[str, Any]] = None
 
     def __post_init__(self) -> None:
         if not self.remedy:
@@ -91,6 +98,8 @@ class Recommendation:
             "remedy": self.remedy,
             "detail": self.detail,
             "evidence": dict(self.evidence),
+            "shadowVerdict": (self.shadow or {}).get("verdict", "untested"),
+            "shadow": dict(self.shadow) if self.shadow else None,
         }
 
 
@@ -644,6 +653,28 @@ def _recommend(facts: Dict[str, Any],
 # ---------------------------------------------------------------------------
 
 
+def _attach_shadow_verdicts(recs: List[Recommendation],
+                            entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Attach the latest journaled shadow verdict to each matching
+    recommendation (measured evidence the what-if replayer produced for
+    this exact (kind, target)); returns the ``facts["shadow"]`` summary.
+    Recommendations without a covering run stay ``shadowVerdict:
+    untested`` — the advisor never fakes a measurement."""
+    runs = sum(1 for e in entries if e.get("kind") == "shadow")
+    if not runs:
+        return {"runs": 0}
+    from delta_tpu.replay.shadow import shadow_verdicts
+
+    verdicts = shadow_verdicts(entries)
+    attached: Dict[str, str] = {}
+    for r in recs:
+        hit = verdicts.get((r.kind, r.target.lower()))
+        if hit is not None:
+            r.shadow = dict(hit)
+            attached[f"{r.kind}:{r.target}"] = str(hit.get("verdict"))
+    return {"runs": runs, "attached": attached}
+
+
 def advise(table, snapshot=None, limit: Optional[int] = None) -> AdvisorReport:
     """Aggregate a table's workload journal into facts + ranked
     recommendations. ``table`` is a DeltaTable, DeltaLog, or path (like
@@ -704,6 +735,7 @@ def advise(table, snapshot=None, limit: Optional[int] = None) -> AdvisorReport:
         }
         recs = _recommend(facts, list(snap.metadata.partition_columns))
         recs, suppressed = _apply_cooldowns(recs, in_cooldown)
+        facts["shadow"] = _attach_shadow_verdicts(recs, entries)
         if suppressed:
             ap_facts["suppressed"] = suppressed
         if recs:

@@ -35,6 +35,20 @@ Entry kinds
     for executed actions — the predicted-vs-realized audit. Written through
     a synchronous flush (the autopilot's cooldowns survive a crash only if
     the "started" entry is on disk before the action runs).
+``shadow``
+    One :class:`~delta_tpu.replay.shadow.ShadowScorecard` per shadow-
+    optimizer run (`delta_tpu/replay`): candidate layouts ranked by their
+    MEASURED replay deltas against the baseline clone. The advisor
+    attaches these verdicts to matching recommendations, and the
+    autopilot's ``requireShadow`` guardrail gates rewrites on them.
+
+Scan entries additionally carry a bounded **literal-sample reservoir**:
+the first ``delta.tpu.journal.literalSamples`` (default 3) scans per
+fingerprint key persist their concrete predicate SQL as ``sample`` —
+deterministic first-K, so replays are stable — and every scan past the
+bound has its report ``predicate`` redacted, making the reservoir the only
+place concrete literals persist (size-bounded via :data:`SAMPLE_MAX_SQL`,
+blackout-inert like every other journal write).
 
 Hooks live in ``exec/scan.py``, ``txn/transaction.py``, ``commands/*`` and
 ``obs/router_audit.py``; each hook is a dict append under a lock — the IO
@@ -62,8 +76,8 @@ from delta_tpu.utils.config import conf
 
 __all__ = ["enabled", "journal_dir", "predicate_fingerprint", "record_scan",
            "record_commit", "record_dml", "record_router",
-           "record_autopilot", "attempt_state", "record_attempt", "flush",
-           "read_entries", "sweep", "reset"]
+           "record_autopilot", "record_shadow", "attempt_state",
+           "record_attempt", "flush", "read_entries", "sweep", "reset"]
 
 SEGMENT_PREFIX = "journal-"
 SEGMENT_SUFFIX = ".jsonl"
@@ -95,6 +109,17 @@ _ATEXIT = False  # final synchronous drain registered (once per process)
 #: hard cap per table buffer — a stalled writer degrades to dropped entries
 #: (counted), never to unbounded memory
 MAX_BUFFERED = 4096
+
+#: longest predicate SQL a literal-sample reservoir slot accepts — one
+#: pathological megabyte predicate must not blow the segment size bound
+#: just to preserve a replay literal (truncated SQL would not parse back)
+SAMPLE_MAX_SQL = 2048
+
+#: literal-sample reservoir bookkeeping: journal dir → fingerprint key →
+#: samples stamped so far this process. Deterministic first-K (not random
+#: reservoir sampling): the same workload replayed over a fresh journal
+#: yields the same sampled literals, which keeps shadow replays stable
+_SAMPLE_COUNTS: Dict[str, Dict[str, int]] = {}
 
 
 def enabled(log_path: Optional[str] = None) -> bool:
@@ -267,11 +292,16 @@ def record_scan(log_path: str, report=None, predicate=None,
     off-thread is safe."""
     if not enabled(log_path):
         return
+    # the reservoir bound is resolved NOW, like the synthesis decision in
+    # the fingerprint input: the writer thread must not re-read a conf the
+    # caller's set_temporarily scope may have exited by flush time
     _record(log_path, {
         "kind": "scan",
         "report": (report_dict if report_dict is not None
                    else report.to_dict()),
         "_fingerprint_input": (predicate, tuple(partition_cols), types),
+        "_sample_limit": (conf.get_int("delta.tpu.journal.literalSamples", 3)
+                          if predicate is not None else 0),
     })
 
 
@@ -333,6 +363,17 @@ def record_autopilot(log_path: str, phase: str, action: Dict[str, Any],
         return False
 
 
+def record_shadow(log_path: str, scorecard: Dict[str, Any]) -> bool:
+    """Journal one shadow-optimizer scorecard (hook:
+    ``delta_tpu/replay/shadow.shadow_run``): the ranked candidate verdicts
+    with their measured replay deltas. Buffered like scans — the shadow
+    runner calls :func:`flush` itself so the NEXT ``advise()`` sees the
+    verdicts read-after-write."""
+    if not enabled(log_path):
+        return False
+    return _record(log_path, {"kind": "shadow", "scorecard": dict(scorecard)})
+
+
 def _state_path(log_path: str) -> str:
     return os.path.join(journal_dir(log_path), STATE_FILE)
 
@@ -373,6 +414,41 @@ def record_attempt(log_path: str, key: str, phase: str, ts_ms: int) -> bool:
     except OSError:
         return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# Literal-sample reservoir
+# ---------------------------------------------------------------------------
+
+
+def _stamp_sample(jdir: str, e: Dict[str, Any], predicate,
+                  limit: int) -> None:
+    """Persist the first ``limit`` concrete predicate SQLs per fingerprint
+    key as ``e["sample"]`` — the bounded literal store that lets the replay
+    layer (`delta_tpu/replay/trace`) rehydrate abstract fingerprints
+    (``eq(v,?)``) back into executable scans. Entries past the bound get
+    their report ``predicate`` redacted instead: the reservoir is then the
+    ONLY place concrete literals persist, so the bound is a real bound.
+    Runs on the writer thread; callers hold ``_IO_LOCK``."""
+    fp = e.get("fingerprint") or {}
+    key = fp.get("key")
+    if key and limit > 0:
+        counts = _SAMPLE_COUNTS.setdefault(jdir, {})
+        if counts.get(key, 0) < limit:
+            try:
+                sql = predicate.sql()
+            except Exception:  # noqa: BLE001 — sampling must not drop entries
+                sql = None
+            if sql and len(sql) <= SAMPLE_MAX_SQL:
+                e["sample"] = sql
+                counts[key] = counts.get(key, 0) + 1
+                telemetry.bump_counter("journal.literalSamples")
+                return
+    report = e.get("report")
+    if isinstance(report, dict) and report.get("predicate") is not None:
+        # COPY before redacting — the caller's report dict is the SAME
+        # object attached to the scan span's ``scanReport`` payload
+        e["report"] = {**report, "predicate": None}
 
 
 # ---------------------------------------------------------------------------
@@ -470,12 +546,15 @@ def _write_batch(jdir: str, entries: List[dict]) -> int:
     lines = []
     for e in entries:
         fp_in = e.pop("_fingerprint_input", None)
+        sample_limit = e.pop("_sample_limit", 0)
         if fp_in is not None:
             try:
                 e["fingerprint"] = predicate_fingerprint(
                     fp_in[0], fp_in[1], fp_in[2] if len(fp_in) > 2 else None)
             except Exception:  # noqa: BLE001 — never lose the report over it
                 e["fingerprint"] = None
+            if fp_in[0] is not None:
+                _stamp_sample(jdir, e, fp_in[0], sample_limit)
         try:
             lines.append(json.dumps(e, separators=(",", ":"), default=str))
         except (TypeError, ValueError):
@@ -651,3 +730,4 @@ def reset() -> None:
         _SWEPT.clear()
     with _IO_LOCK:
         _ACTIVE.clear()
+        _SAMPLE_COUNTS.clear()

@@ -74,6 +74,8 @@ GAUGES = frozenset({
     "obs.scrape.series",          # series held in the scrape rings
     "slo.burnRate",               # labels: objective, table, window
     "slo.alerts",                 # alerts currently firing
+    # -- shadow optimizer (delta_tpu/replay, label: path) -----------------
+    "shadow.topScore",            # best candidate score of the last run
     # -- resident key cache per-table residency (ops/key_cache, label: table)
     "keyCache.residentBytes",
     # -- scan column cache per-table residency (ops/column_cache, label: table)
@@ -115,6 +117,7 @@ COUNTERS = frozenset({
     "journal.segments.written",   # segment files opened
     "journal.segments.swept",     # segments deleted by the size/age sweep
     "journal.entriesDropped",     # buffer cap hit or unwritable directory
+    "journal.literalSamples",     # reservoir-sampled concrete predicates
     "advisor.runs",               # advise() invocations
     "advisor.recommendations",    # recommendations emitted across runs
     # -- autopilot maintenance scheduler (delta_tpu/autopilot) ------------
@@ -133,6 +136,13 @@ COUNTERS = frozenset({
     "slo.evaluations",            # SLO evaluation passes
     "slo.alerts.fired",           # alerts that crossed both burn windows
     "slo.alerts.cleared",         # alerts cleared by fast-window recovery
+    # -- workload replay + shadow optimizer (delta_tpu/replay) ------------
+    "replay.traces.built",        # WorkloadTraces reconstructed from journals
+    "replay.scans.replayed",      # trace scans re-executed in replays
+    "replay.literals.synthesized",  # predicates rebuilt from file stats
+    "replay.capacity.runs",       # time-compressed SLO capacity replays
+    "shadow.runs",                # shadow_run scorecards produced
+    "shadow.candidates",          # candidate configurations scored
 })
 
 #: Every OTHER counter the engine bumps by constant name — the inverse lint
@@ -227,9 +237,9 @@ PUBLIC_API = {
                    "over_budget", "maybe_relieve", "reset"),
     "journal": ("enabled", "journal_dir", "predicate_fingerprint",
                 "record_scan", "record_commit", "record_dml",
-                "record_router", "record_autopilot", "attempt_state",
-                "record_attempt", "flush", "read_entries", "sweep",
-                "reset"),
+                "record_router", "record_autopilot", "record_shadow",
+                "attempt_state", "record_attempt", "flush", "read_entries",
+                "sweep", "reset"),
     "advisor": ("Recommendation", "AdvisorReport", "advise"),
     "actions": ("ActionSpec", "MaintenanceAction", "CATALOG", "CATALOG_REF",
                 "RECOMMENDATION_ACTIONS", "COOLDOWN_PHASES", "spec",
@@ -299,6 +309,7 @@ DESCRIPTIONS = {
     "obs.scrape.series": "Distinct series retained in the obs scraper's in-memory rings.",
     "slo.burnRate": "Observed-over-objective burn rate per objective/table/window.",
     "slo.alerts": "SLO alerts currently firing.",
+    "shadow.topScore": "Best candidate score of the table's latest shadow run.",
     "keyCache.residentBytes": "HBM-resident key-cache slab bytes per table.",
     # counters — obs layer
     "obs.incidents.written": "Flight-recorder incident files written.",
@@ -326,6 +337,7 @@ DESCRIPTIONS = {
     "journal.segments.written": "Journal segment files opened.",
     "journal.segments.swept": "Journal segments deleted by the size/age sweep.",
     "journal.entriesDropped": "Journal entries dropped (buffer cap or unwritable dir).",
+    "journal.literalSamples": "Concrete predicate SQLs persisted by the literal-sample reservoir.",
     "advisor.runs": "Layout-advisor invocations.",
     "advisor.recommendations": "Recommendations emitted by the advisor.",
     "autopilot.lastRunTimestamp": "Wall-clock ms of the last autopilot pass over the table.",
@@ -342,6 +354,12 @@ DESCRIPTIONS = {
     "slo.evaluations": "SLO burn-rate evaluation passes.",
     "slo.alerts.fired": "SLO alerts fired (both burn windows crossed 1.0).",
     "slo.alerts.cleared": "SLO alerts cleared by fast-window recovery below the hysteresis ratio.",
+    "replay.traces.built": "WorkloadTraces reconstructed from table journals.",
+    "replay.scans.replayed": "Trace scan events re-executed through the real scan path.",
+    "replay.literals.synthesized": "Scan predicates rehydrated via stats-guided literal synthesis.",
+    "replay.capacity.runs": "Time-compressed capacity replays against the SLO plane.",
+    "shadow.runs": "Shadow-optimizer what-if runs completed.",
+    "shadow.candidates": "Candidate configurations scored across shadow runs.",
     # counters — engine
     "checkpoint.parts": "Checkpoint part files written.",
     "checkpoint.actions": "Actions serialized into checkpoints.",

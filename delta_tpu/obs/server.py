@@ -29,6 +29,9 @@ Route          Payload
                ``?series=<prefix>`` attaches the scraped time series
 ``/slo``       SLO monitor state (:func:`delta_tpu.obs.slo.status`):
                objectives, burn rates per window, firing + cleared alerts
+``/replay``    ``?path=/data/tbl`` → the table's journaled shadow-run
+               scorecards (``?limit=N``, default 8) with the latest one
+               inlined — see :mod:`delta_tpu.replay.shadow`
 =============  ==============================================================
 
 Query parameters degrade, never 500: every numeric param goes through
@@ -182,12 +185,30 @@ class _Handler(BaseHTTPRequestHandler):
                 from delta_tpu.obs import slo
 
                 self._json(slo.status())
+            elif route == "/replay":
+                path = q.get("path", [None])[0]
+                if not path:
+                    self._json({"error": "missing ?path=<table path>"}, 400)
+                    return
+                limit = _q_int(q, "limit", 8)
+                from delta_tpu.obs import journal as journal_mod
+
+                log_path = path.rstrip("/") + "/_delta_log"
+                journal_mod.flush(log_path)
+                cards = journal_mod.read_entries(
+                    log_path, kinds=["shadow"], limit=limit)
+                self._json({
+                    "path": path,
+                    "shadowRuns": cards,
+                    "latest": (cards[-1].get("scorecard")
+                               if cards else None),
+                })
             else:
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
                                        "/trace", "/doctor", "/router",
                                        "/advisor", "/autopilot", "/fleet",
-                                       "/slo"]}, 404)
+                                       "/slo", "/replay"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

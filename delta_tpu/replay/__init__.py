@@ -1,0 +1,39 @@
+"""Workload replay + shadow optimizer (ROADMAP item 5).
+
+The journal already persists a complete replayable workload trace — every
+scan's predicate fingerprint (with a bounded literal-sample reservoir),
+every commit outcome, every router decision. This package closes the loop:
+
+- :mod:`delta_tpu.replay.trace` reconstructs an ordered
+  :class:`~delta_tpu.replay.trace.WorkloadTrace` from the journal,
+  rehydrating concrete scan predicates from the reservoir samples (falling
+  back to stats-guided literal synthesis, flagged so scores discount them).
+- :mod:`delta_tpu.replay.shadow` replays a trace's scans against sandboxed
+  clones under candidate layouts/configurations (alternative ZORDER column
+  sets, partition schemes, ``rowGroupRows``, conf deltas) and scores the
+  MEASURED bytes-skipped / planning-p50 / row-groups-pruned deltas into a
+  ranked :class:`~delta_tpu.replay.shadow.ShadowScorecard` — the advisor
+  attaches the verdicts to its recommendations and the autopilot's
+  ``requireShadow`` guardrail defers unproven rewrites on them.
+- :mod:`delta_tpu.replay.scenarios` replays traces time-compressed (10x /
+  100x) against the live scraper/SLO plane for capacity testing, and ships
+  synthetic scenario traces (zipf hot-key storm, CDC burst, contention
+  flood) serialized in the same trace format.
+"""
+from delta_tpu.replay.trace import TraceEvent, WorkloadTrace, build_trace
+from delta_tpu.replay.shadow import (
+    Candidate, ShadowScorecard, default_candidates, realized_audit,
+    shadow_run, shadow_verdicts,
+)
+from delta_tpu.replay.scenarios import (
+    SCENARIOS, capacity_replay, cdc_burst, contention_flood,
+    zipf_hot_key_storm,
+)
+
+__all__ = [
+    "TraceEvent", "WorkloadTrace", "build_trace",
+    "Candidate", "ShadowScorecard", "default_candidates", "realized_audit",
+    "shadow_run", "shadow_verdicts",
+    "SCENARIOS", "capacity_replay", "cdc_burst", "contention_flood",
+    "zipf_hot_key_storm",
+]

@@ -1,0 +1,474 @@
+"""Sandboxed what-if execution — the shadow optimizer.
+
+A shadow run clones the table into a temp sandbox (CLONE machinery — the
+clones are shallow, so prep costs metadata + any candidate rewrite, never a
+second copy of untouched data), applies each candidate layout/configuration,
+and re-executes the trace's scans through the REAL ``exec/scan`` path with
+the flight-recorder/scan-report plane armed. What comes back is *measured*:
+bytes skipped, row groups pruned, planning p50 — per candidate, against a
+baseline replay on an untouched clone of the same table. The ranked
+:class:`ShadowScorecard` journals as a ``shadow`` entry, the advisor
+attaches its verdicts to matching recommendations
+(``shadowVerdict: confirmed|refuted|untested``), and the autopilot's
+``delta.tpu.autopilot.requireShadow`` guardrail defers unproven rewrites
+until a confirming run exists (`autopilot/planner.shadow_gate`).
+
+Candidate kinds:
+
+- ``ZORDER``   — clone + ``OPTIMIZE ZORDER BY (columns)`` on the clone
+- ``PARTITION``— rebuild the clone's data into a table partitioned by
+  ``columns`` (CTAS; heaviest prep, full data rewrite)
+- ``ROW_GROUP_ROWS`` — clone + compaction rewrite under an alternative
+  ``delta.tpu.write.rowGroupRows`` (``rows``)
+- ``CONF``     — no rewrite; replay under conf overrides (``conf`` dict:
+  cache-budget deltas, synthesis on/off, ...)
+
+Every replayed scan's ``rowsOut`` is checked against the baseline's: a
+layout change that alters query RESULTS is a correctness failure and the
+candidate is refuted outright (``resultMismatch``), whatever its score.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+from delta_tpu.replay.trace import WorkloadTrace, build_trace, _resolve_log
+
+__all__ = ["Candidate", "ShadowScorecard", "default_candidates",
+           "realized_audit", "shadow_run", "shadow_verdicts"]
+
+#: score band treated as noise: |score| below this is ``inconclusive``
+SCORE_EPS = 0.01
+
+#: relative realized-vs-shadow-baseline band for the post-execution audit
+REALIZED_EPS = 0.01
+
+
+@dataclass
+class Candidate:
+    """One what-if configuration to score against the baseline replay."""
+
+    kind: str  # ZORDER | PARTITION | ROW_GROUP_ROWS | CONF
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def label(self) -> str:
+        if self.kind in ("ZORDER", "PARTITION"):
+            return f"{self.kind}:{','.join(self.params.get('columns') or ())}"
+        if self.kind == "ROW_GROUP_ROWS":
+            return f"ROW_GROUP_ROWS:{self.params.get('rows')}"
+        keys = ",".join(sorted(self.params.get("conf") or ()))
+        return f"CONF:{keys}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "label": self.label,
+                "params": dict(self.params)}
+
+    def match_keys(self) -> List[Tuple[str, str]]:
+        """(kind, target) keys this candidate's verdict applies to, in the
+        advisor-recommendation / autopilot-action namespaces — ZORDER and
+        PARTITION per clustered column, ROW_GROUP_ROWS to both the
+        compaction action and the advisor's ROW_GROUP_SIZE conf rec."""
+        if self.kind in ("ZORDER", "PARTITION"):
+            return [(self.kind, str(c).lower())
+                    for c in self.params.get("columns") or ()]
+        if self.kind == "ROW_GROUP_ROWS":
+            return [("OPTIMIZE", ""),
+                    ("ROW_GROUP_SIZE", "delta.tpu.write.rowgrouprows")]
+        return [("CONF", self.label.split(":", 1)[1].lower())]
+
+
+@dataclass
+class ShadowScorecard:
+    """Ranked measured outcomes of one shadow run."""
+
+    path: str
+    ts: int
+    trace: Dict[str, Any]
+    baseline: Dict[str, Any]
+    candidates: List[Dict[str, Any]]  # ranked by score, best first
+
+    @property
+    def top(self) -> Optional[Dict[str, Any]]:
+        return self.candidates[0] if self.candidates else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path, "ts": self.ts, "trace": dict(self.trace),
+            "baseline": dict(self.baseline),
+            "candidates": [dict(c) for c in self.candidates],
+            "topCandidate": (self.top or {}).get("candidate", {}).get("label"),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Replay + scoring
+# ---------------------------------------------------------------------------
+
+
+def _replay_scans(table_path: str, scans: Iterable[Any],
+                  conf_overrides: Optional[Dict[str, Any]] = None,
+                  discount: Optional[float] = None) -> Dict[str, Any]:
+    """Re-execute trace scans against ``table_path`` through the real scan
+    path and aggregate the measured ScanReports. Events with synthesized
+    literals contribute at ``discount`` weight. The replayed table's own
+    journal stays silent (``journal.enabled=false`` for the scope) — a
+    shadow run must not feed the workload history it replays."""
+    from delta_tpu.api.tables import DeltaTable
+    from delta_tpu.obs import scan_report
+
+    if discount is None:
+        try:
+            discount = float(
+                conf.get("delta.tpu.replay.literalDiscount", 0.5))
+        except (TypeError, ValueError):
+            discount = 0.5
+    overrides: Dict[str, Any] = {"delta.tpu.journal.enabled": False}
+    overrides.update(conf_overrides or {})
+    table = DeltaTable.for_path(table_path)
+    agg: Dict[str, Any] = {
+        "scans": 0, "errors": 0, "weight": 0.0, "rowsOut": 0,
+        "bytesRead": 0.0, "bytesSkipped": 0.0, "bytesSkippedPlanned": 0.0,
+        "rowGroupsTotal": 0.0, "rowGroupsPruned": 0.0,
+        "filesScanned": 0.0, "filesPruned": 0.0,
+    }
+    planning: List[float] = []
+    with conf.set_temporarily(**overrides):
+        for ev in scans:
+            w = discount if ev.synthesized else 1.0
+            try:
+                filters = (ev.predicate,) if ev.predicate else ()
+                out = table.to_arrow(filters=filters)
+            except Exception:  # noqa: BLE001 — a stale literal must not
+                agg["errors"] += 1  # sink the whole run
+                continue
+            rep = scan_report.last_scan_report()
+            telemetry.bump_counter("replay.scans.replayed")
+            agg["scans"] += 1
+            agg["weight"] += w
+            agg["rowsOut"] += out.num_rows
+            if rep is None:
+                continue
+            agg["bytesRead"] += w * rep.bytes_read
+            agg["bytesSkipped"] += w * rep.bytes_skipped
+            agg["bytesSkippedPlanned"] += w * rep.bytes_skipped_planned
+            agg["rowGroupsTotal"] += w * rep.row_groups_total
+            agg["rowGroupsPruned"] += w * (rep.row_groups_pruned
+                                           + rep.row_groups_late_skipped)
+            agg["filesScanned"] += w * rep.files_scanned
+            agg["filesPruned"] += w * rep.files_pruned
+            planning.append(float(rep.phase_ms.get("planning", 0)))
+    planning.sort()
+    agg["planningP50Ms"] = (planning[len(planning) // 2] if planning else 0.0)
+    return agg
+
+
+def _score(base: Dict[str, Any], cand: Dict[str, Any]) -> Dict[str, Any]:
+    """Measured deltas candidate-vs-baseline, collapsed to one score: the
+    fraction of the workload's bytes no longer READ (file-tier pruning
+    losses surface here — a skipped file never shows in bytesSkipped, but
+    un-skipping one inflates the read), plus the fraction newly skipped,
+    plus quarter-weight terms for planner-tier skips (bytes a
+    late-materialization skip still pays to open, a planned skip never
+    touches) and row-group pruning, minus a tenth-weight planning-latency
+    term."""
+    byte_denom = max(base["bytesRead"] + base["bytesSkipped"], 1.0)
+    d_read = (base["bytesRead"] - cand["bytesRead"]) / byte_denom
+    d_bytes = (cand["bytesSkipped"] - base["bytesSkipped"]) / byte_denom
+    d_planned = ((cand["bytesSkippedPlanned"] - base["bytesSkippedPlanned"])
+                 / byte_denom)
+    d_rg = ((cand["rowGroupsPruned"] - base["rowGroupsPruned"])
+            / max(base["rowGroupsTotal"], 1.0))
+    d_plan = ((cand["planningP50Ms"] - base["planningP50Ms"])
+              / max(base["planningP50Ms"], 1.0))
+    mismatch = cand["rowsOut"] != base["rowsOut"] or cand["errors"] > base["errors"]
+    score = (d_read + d_bytes + 0.25 * d_planned + 0.25 * d_rg
+             - 0.1 * d_plan)
+    if mismatch:
+        verdict = "refuted"
+    elif score >= SCORE_EPS:
+        verdict = "confirmed"
+    elif score <= -SCORE_EPS:
+        verdict = "refuted"
+    else:
+        verdict = "inconclusive"
+    return {
+        "score": round(score, 6), "verdict": verdict,
+        "resultMismatch": mismatch,
+        "deltas": {
+            "bytesRead": round(cand["bytesRead"] - base["bytesRead"], 1),
+            "bytesSkipped": round(cand["bytesSkipped"] - base["bytesSkipped"], 1),
+            "bytesSkippedFrac": round(d_bytes, 6),
+            "bytesSkippedPlanned": round(cand["bytesSkippedPlanned"]
+                                         - base["bytesSkippedPlanned"], 1),
+            "rowGroupsPruned": round(cand["rowGroupsPruned"]
+                                     - base["rowGroupsPruned"], 1),
+            "planningP50Ms": round(cand["planningP50Ms"]
+                                   - base["planningP50Ms"], 3),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Candidate prep
+# ---------------------------------------------------------------------------
+
+
+def _clone(src_log, target: str) -> None:
+    from delta_tpu.commands.clone import CloneCommand
+
+    CloneCommand(src_log, target).run()
+
+
+def _prep_candidate(src_log, cand: Candidate, target: str) -> Dict[str, Any]:
+    """Materialize one candidate under ``target``; returns the replay-time
+    conf overrides. Runs on the ``delta-replay-prep`` pool for prep that
+    never touches process conf (ZORDER/PARTITION/CONF); ROW_GROUP_ROWS
+    preps sequentially on the caller because its rewrite rides a
+    ``set_temporarily`` scope other threads must not observe."""
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.log.deltalog import DeltaLog
+
+    if cand.kind == "ZORDER":
+        _clone(src_log, target)
+        OptimizeCommand(DeltaLog.for_table(target),
+                        z_order_by=list(cand.params.get("columns") or ()),
+                        min_file_size=0).run()
+        return {}
+    if cand.kind == "PARTITION":
+        from delta_tpu.api.tables import DeltaTable
+
+        data = DeltaTable(src_log).to_arrow()
+        DeltaTable.create(target, partition_columns=list(
+            cand.params.get("columns") or ()), data=data)
+        return {}
+    if cand.kind == "ROW_GROUP_ROWS":
+        _clone(src_log, target)
+        rows = int(cand.params.get("rows") or 0) or 131_072
+        with conf.set_temporarily(**{"delta.tpu.write.rowGroupRows": rows}):
+            # every file is "small" at this threshold: the compaction
+            # rewrites the whole table under the candidate row-group size
+            # (min_file_size=0 would select nothing — a no-op rewrite)
+            OptimizeCommand(DeltaLog.for_table(target),
+                            min_file_size=1 << 60).run()
+        return {}
+    # CONF: baseline layout, alternative runtime configuration
+    _clone(src_log, target)
+    return dict(cand.params.get("conf") or {})
+
+
+def default_candidates(table: Any, advisor_report: Any = None
+                       ) -> List[Candidate]:
+    """Derive candidates from the advisor's current recommendations —
+    every ZORDER/PARTITION target plus a ROW_GROUP_SIZE alternative."""
+    out: List[Candidate] = []
+    if advisor_report is None:
+        from delta_tpu.obs.advisor import advise
+
+        advisor_report = advise(table)
+    seen = set()
+    for r in getattr(advisor_report, "recommendations", ()):
+        if r.kind in ("ZORDER", "PARTITION"):
+            key = (r.kind, r.target.lower())
+            if key not in seen:
+                seen.add(key)
+                out.append(Candidate(r.kind, {"columns": [r.target]}))
+        elif r.kind == "ROW_GROUP_SIZE" and ("RGR",) not in seen:
+            seen.add(("RGR",))
+            rows = max(1024, conf.get_int(
+                "delta.tpu.write.rowGroupRows", 131_072) // 4)
+            out.append(Candidate("ROW_GROUP_ROWS", {"rows": rows}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shadow_run
+# ---------------------------------------------------------------------------
+
+
+def shadow_run(table: Any, trace: Optional[WorkloadTrace] = None,
+               candidates: Optional[List[Candidate]] = None,
+               limit: Optional[int] = None) -> ShadowScorecard:
+    """Score ``candidates`` (default: advisor-derived) against a baseline
+    replay of ``trace`` (default: rebuilt from the journal) in a temp
+    sandbox. The sandbox is removed on EVERY exit — including
+    KeyboardInterrupt — so an aborted run never leaks clones."""
+    import time as _time
+
+    from delta_tpu.obs import journal
+
+    delta_log = _resolve_log(table)
+    if trace is None:
+        trace = build_trace(delta_log, limit=limit)
+    scans = trace.scans()
+    if candidates is None:
+        candidates = default_candidates(delta_log)
+
+    sandbox_root = conf.get("delta.tpu.replay.sandboxDir") or None
+    sandbox = tempfile.mkdtemp(prefix="delta-shadow-", dir=sandbox_root)
+    rows: List[Dict[str, Any]] = []
+    try:
+        _clone(delta_log, os.path.join(sandbox, "baseline"))
+        workers = max(1, conf.get_int("delta.tpu.replay.prepWorkers", 2))
+        pooled = [(i, c) for i, c in enumerate(candidates)
+                  if c.kind != "ROW_GROUP_ROWS"]
+        serial = [(i, c) for i, c in enumerate(candidates)
+                  if c.kind == "ROW_GROUP_ROWS"]
+        overrides: Dict[int, Dict[str, Any]] = {}
+        failed: Dict[int, str] = {}
+
+        def _prep(item):
+            i, c = item
+            return i, _prep_candidate(delta_log, c,
+                                      os.path.join(sandbox, f"cand-{i}"))
+
+        if pooled:
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="delta-replay-prep") as pool:
+                futures = [(i, c, pool.submit(_prep, (i, c)))
+                           for i, c in pooled]
+                for i, c, fut in futures:
+                    try:
+                        overrides[i] = fut.result()[1]
+                    except Exception as exc:  # noqa: BLE001
+                        failed[i] = f"{type(exc).__name__}: {exc}"
+        for i, c in serial:
+            try:
+                overrides[i] = _prep((i, c))[1]
+            except Exception as exc:  # noqa: BLE001
+                failed[i] = f"{type(exc).__name__}: {exc}"
+
+        base = _replay_scans(os.path.join(sandbox, "baseline"), scans)
+        for i, c in enumerate(candidates):
+            telemetry.bump_counter("shadow.candidates")
+            if i in failed:
+                rows.append({"candidate": c.to_dict(), "verdict": "error",
+                             "error": failed[i], "score": float("-inf")})
+                continue
+            metrics = _replay_scans(os.path.join(sandbox, f"cand-{i}"), scans,
+                                    conf_overrides=overrides.get(i))
+            row = {"candidate": c.to_dict(), "metrics": metrics}
+            row.update(_score(base, metrics))
+            rows.append(row)
+    finally:
+        # BaseException-safe: KeyboardInterrupt mid-replay still cleans up
+        shutil.rmtree(sandbox, ignore_errors=True)
+
+    rows.sort(key=lambda r: r.get("score", float("-inf")), reverse=True)
+    card = ShadowScorecard(
+        path=delta_log.data_path, ts=int(_time.time() * 1000),
+        trace={"source": trace.source, "events": len(trace.events),
+               "scansReplayed": len(scans),
+               "synthesizedLiterals": trace.synthesized_literals},
+        baseline=base, candidates=rows,
+    )
+    telemetry.bump_counter("shadow.runs")
+    if rows and rows[0].get("score", 0) not in (float("-inf"),):
+        telemetry.set_gauge("shadow.topScore", float(rows[0]["score"]),
+                            path=delta_log.data_path)
+    journal.record_shadow(delta_log.log_path, card.to_dict())
+    journal.flush(delta_log.log_path)
+    return card
+
+
+# ---------------------------------------------------------------------------
+# Verdict lookups + realized audit
+# ---------------------------------------------------------------------------
+
+
+def shadow_verdicts(entries: Iterable[Dict[str, Any]]
+                    ) -> Dict[Tuple[str, str], Dict[str, Any]]:
+    """(kind, target)-keyed latest shadow verdicts from journal entries —
+    the lookup the advisor and the planner's ``requireShadow`` gate share.
+    ``entries`` is any journal slice; non-``shadow`` kinds are skipped, and
+    later scorecards overwrite earlier ones per key (entries arrive
+    ts-sorted from ``journal.read_entries``)."""
+    out: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for e in entries:
+        if e.get("kind") != "shadow":
+            continue
+        sc = e.get("scorecard") or {}
+        for rank, row in enumerate(sc.get("candidates") or ()):
+            cand = Candidate(str((row.get("candidate") or {}).get("kind", "")),
+                             dict((row.get("candidate") or {}).get("params")
+                                  or {}))
+            payload = {
+                "verdict": row.get("verdict", "untested"),
+                "score": row.get("score"),
+                "deltas": dict(row.get("deltas") or {}),
+                "rank": rank,
+                "label": cand.label,
+                "ts": int(e.get("ts") or sc.get("ts") or 0),
+                "scorecardTs": int(sc.get("ts") or 0),
+            }
+            for key in cand.match_keys():
+                out[key] = payload
+    return out
+
+
+def realized_audit(table_path: str, kind: str, target: str
+                   ) -> Optional[Dict[str, Any]]:
+    """Post-execution audit: after the autopilot executes a shadow-scored
+    action, replay the SAME workload the scorecard measured against the now
+    live (rewritten) table and compare realized bytes-skipped against the
+    scorecard's stored baseline. Verdict ``improved`` / ``worse`` /
+    ``unchanged`` with the realized numbers — the autopilot executor
+    attaches it to the action's audit. Returns None when no journaled
+    scorecard covers (kind, target), or the covered trace has no scans."""
+    from delta_tpu.log.deltalog import DeltaLog
+    from delta_tpu.obs import journal
+
+    delta_log = DeltaLog.for_table(table_path)
+    journal.flush(delta_log.log_path)
+    entries = journal.read_entries(delta_log.log_path, kinds=("shadow",))
+    want = (str(kind), str(target).lower())
+    match: Optional[Tuple[Dict[str, Any], Dict[str, Any]]] = None
+    for e in entries:  # ts-sorted: the LAST match wins
+        sc = e.get("scorecard") or {}
+        for row in sc.get("candidates") or ():
+            cand = Candidate(str((row.get("candidate") or {}).get("kind", "")),
+                             dict((row.get("candidate") or {}).get("params")
+                                  or {}))
+            if want in cand.match_keys():
+                match = (sc, row)
+    if match is None:
+        return None
+    sc, row = match
+    base = sc.get("baseline") or {}
+    trace = build_trace(delta_log, before_ts=int(sc.get("ts") or 0) or None)
+    scans = trace.scans()
+    if not scans or not base:
+        return None
+    realized = _replay_scans(delta_log.data_path, scans)
+    base_skipped = float(base.get("bytesSkipped", 0.0))
+    base_read = float(base.get("bytesRead", 0.0))
+    d_skip = realized["bytesSkipped"] - base_skipped
+    d_read = realized["bytesRead"] - base_read
+    # same measure the scorecard scored on: bytes newly skipped plus bytes
+    # no longer read (file-tier pruning shows only in the read side)
+    gain = d_skip - d_read
+    band = REALIZED_EPS * max(base_skipped + base_read, 1.0)
+    verdict = ("improved" if gain > band
+               else "worse" if gain < -band else "unchanged")
+    return {
+        "verdict": verdict,
+        "bytesSkippedDelta": round(d_skip, 1),
+        "bytesReadDelta": round(d_read, 1),
+        "realized": {"bytesSkipped": realized["bytesSkipped"],
+                     "bytesRead": realized["bytesRead"],
+                     "planningP50Ms": realized["planningP50Ms"],
+                     "scans": realized["scans"]},
+        "shadowBaseline": {"bytesSkipped": base.get("bytesSkipped"),
+                           "bytesRead": base.get("bytesRead"),
+                           "planningP50Ms": base.get("planningP50Ms")},
+        "shadowPredicted": dict(row.get("deltas") or {}),
+        "shadowScore": row.get("score"),
+    }

@@ -194,6 +194,28 @@ class SqlConf:
         # the operation's thread.
         "delta.tpu.journal.flushEntries": 64,
         "delta.tpu.journal.flushIntervalMs": 2000,
+        # Literal-sample reservoir: the first K scans per predicate
+        # fingerprint persist their concrete SQL (deterministic first-K,
+        # replay-stable); past the bound the report predicate is redacted,
+        # so K bounds how many concrete literals ever hit disk. 0 redacts
+        # everything (fingerprints only — workload replay then falls back
+        # to stats-guided literal synthesis).
+        "delta.tpu.journal.literalSamples": 3,
+        # -- workload replay + shadow optimizer (delta_tpu/replay) -----------
+        # Scans replayed per trace (newest kept) — bounds a shadow run's
+        # cost on a long-journaled table.
+        "delta.tpu.replay.maxScans": 256,
+        # Sandbox root for shadow clones; None = a fresh tempfile.mkdtemp
+        # per run. Always removed afterwards, BaseException included.
+        "delta.tpu.replay.sandboxDir": None,
+        # Score weight for scans whose literal was synthesized from file
+        # stats instead of sampled from the journal — measured-on-real-
+        # literals evidence counts full, synthesized counts this fraction.
+        "delta.tpu.replay.literalDiscount": 0.5,
+        # Candidate clones are prepared concurrently on the
+        # delta-replay-prep pool (replays themselves run sequentially: the
+        # per-scan flight recorder is process-global).
+        "delta.tpu.replay.prepWorkers": 2,
         # -- fleet observability plane (obs/fleet, obs/timeseries, obs/slo) --
         # Process-wide table registry: every DeltaLog auto-registers on
         # construction (weakref'd) so fleet_doctor()/fleet_advise() can
@@ -359,6 +381,20 @@ class SqlConf:
         # (txn.transaction.commit_attempts_cap) instead of retry-storming
         # through delta.tpu.maxCommitAttempts against foreground writers.
         "delta.tpu.autopilot.maxCommitAttempts": 3,
+        # Shadow-validation guardrail: when on, rewrite-class actions
+        # (OPTIMIZE/ZORDER/PURGE) whose selection exceeds
+        # requireShadowMinBytes only execute once a journaled shadow run
+        # CONFIRMED them — refuted candidates are suppressed with the
+        # measured deltas cited, untested ones deferred until a shadow run
+        # exists. 0 gates every rewrite; unknown sizes are treated as over
+        # the threshold (fail closed).
+        "delta.tpu.autopilot.requireShadow": False,
+        "delta.tpu.autopilot.requireShadowMinBytes": 0,
+        # After an executed ZORDER, audit the realized effect by replaying
+        # the shadow run's trace against the live table (replay/shadow.
+        # realized_audit) instead of reporting a pending longitudinal
+        # verdict.
+        "delta.tpu.autopilot.shadowAudit": True,
     }
 
     def __init__(self):

@@ -11,12 +11,15 @@ or runs the layout advisor over it::
     python tools/journal_dump.py /data/tbl --summary        # counts per kind
     python tools/journal_dump.py /data/tbl --advise         # advisor report
     python tools/journal_dump.py /data/tbl --autopilot      # action ledger
+    python tools/journal_dump.py /data/tbl --shadow         # shadow scorecards
 
 Entries print one JSON object per line (pipe into ``jq``); ``--advise``,
-``--summary`` and ``--autopilot`` print one indented JSON document —
-``--autopilot`` renders the maintenance action ledger (planned / executed
-/ skipped / deferred actions with their cited evidence and the
-predicted-vs-realized audit verdicts).
+``--summary``, ``--autopilot`` and ``--shadow`` print one indented JSON
+document — ``--autopilot`` renders the maintenance action ledger (planned
+/ executed / skipped / deferred actions with their cited evidence and the
+predicted-vs-realized audit verdicts), ``--shadow`` summarizes the shadow
+optimizer's journaled scorecards (candidate rankings, verdicts, measured
+deltas — `delta_tpu/replay/shadow.py`).
 """
 from __future__ import annotations
 
@@ -33,7 +36,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("table", help="table data path (the dir holding _delta_log)")
     ap.add_argument("--kind",
-                    choices=["scan", "commit", "dml", "router", "autopilot"],
+                    choices=["scan", "commit", "dml", "router", "autopilot",
+                             "shadow"],
                     help="only entries of this kind")
     ap.add_argument("--limit", type=int, default=None,
                     help="last N entries (after kind filtering)")
@@ -45,6 +49,9 @@ def main(argv=None) -> int:
                     help="print the autopilot action ledger (planned/"
                          "executed/skipped actions + realized-improvement "
                          "verdicts)")
+    ap.add_argument("--shadow", action="store_true",
+                    help="summarize journaled shadow-run scorecards "
+                         "(candidate rankings, verdicts, measured deltas)")
     args = ap.parse_args(argv)
 
     from delta_tpu.obs import journal
@@ -63,6 +70,34 @@ def main(argv=None) -> int:
             "byPhase": dict(by_phase),
             "executedVerdicts": {k: v for k, v in verdicts.items() if k},
             "ledger": entries,
+        }, indent=1, default=str))
+        return 0
+    if args.shadow:
+        entries = journal.read_entries(log_path, kinds=["shadow"],
+                                       limit=args.limit)
+        verdicts: Counter = Counter()
+        runs = []
+        for e in entries:
+            sc = e.get("scorecard") or {}
+            cands = sc.get("candidates") or []
+            for c in cands:
+                verdicts[c.get("verdict", "?")] += 1
+            runs.append({
+                "ts": e.get("ts"),
+                "trace": sc.get("trace"),
+                "topCandidate": sc.get("topCandidate"),
+                "candidates": [
+                    {"label": (c.get("candidate") or {}).get("label"),
+                     "verdict": c.get("verdict"),
+                     "score": c.get("score"),
+                     "deltas": c.get("deltas")}
+                    for c in cands],
+            })
+        print(json.dumps({
+            "table": args.table,
+            "shadowRuns": len(entries),
+            "candidateVerdicts": dict(verdicts),
+            "runs": runs,
         }, indent=1, default=str))
         return 0
     if args.advise:
