@@ -36,6 +36,7 @@ REGISTERED_POOLS = frozenset({
     "delta-vacuum-list",          # commands/vacuum.py partition listing
     "delta-vacuum-delete",        # commands/vacuum.py parallel delete
     "delta-replay-prep",          # replay/shadow.py candidate clone prep
+    "delta-dist-exec",            # parallel/executor.py sharded work items
     # dedicated threads (threading.Thread name)
     "delta-ckpt-async",           # log/checkpointer.py coalescing daemon
     "delta-journal-writer",       # obs/journal.py writer daemon

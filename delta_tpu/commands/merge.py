@@ -400,6 +400,11 @@ class MergeIntoCommand:
             if not any(r.startswith(_SRC) for r in ir.references(c))
         ]
         candidates = candidate_files(txn, ir.and_all(target_only) if target_only else None)
+        # distributed findTouchedFiles probe: restrict the candidates to
+        # files whose equi keys intersect the source BEFORE the join
+        # decodes full rows (conf-gated; result-identical — see the method)
+        if equi:
+            candidates = self._probe_touched_files(candidates, src, equi, metadata)
         insert_only = not self.matched_clauses
         matched_pairs, tgt_tables = self._join(
             txn, candidates, src, equi, residual, metadata,
@@ -528,6 +533,61 @@ class MergeIntoCommand:
         version = txn.commit(removes + adds + cdc_actions, op)
         self._maybe_build_resident_keys()
         return version
+
+    # -- distributed touched-files probe ----------------------------------
+
+    def _probe_touched_files(self, candidates, src, equi, metadata):
+        """findTouchedFiles-style pre-probe on the sharded executor
+        (reference `MergeIntoCommand.scala` findTouchedFiles — phase 1 of
+        the two-phase merge): read ONLY the equi-key columns of each
+        candidate file as byte-weighted work items and keep the files whose
+        keys intersect the source keys.
+
+        Soundness: per-key-column ``is_in`` is a conservative superset of
+        exact tuple membership, so a touched file is never dropped;
+        untouched files contribute no matched pairs and are never
+        rewritten, and this MERGE has no NOT-MATCHED-BY-SOURCE clauses, so
+        restricting the candidate set is result-identical by construction.
+        Null target keys never equal a source key, so dropping all-miss
+        files stays exact under SQL join semantics.
+        """
+        from delta_tpu.utils.config import conf
+
+        if not conf.get_bool("delta.tpu.distributed.merge.probe.enabled", True):
+            return candidates
+        min_files = conf.get_int("delta.tpu.distributed.merge.probe.minFiles", 8)
+        if len(candidates) < max(min_files, 2):
+            return candidates
+        import pyarrow.compute as pc
+
+        cols = sorted({r.lower() for t_e, _ in equi for r in ir.references(t_e)})
+        svals = [(t_e, evaluate(s_e, src)) for t_e, s_e in equi]
+
+        def _touched(f) -> bool:
+            tbl = read_files_as_table(
+                self.delta_log.data_path, [f], metadata, columns=cols)
+            if tbl.num_rows == 0:
+                return False
+            for t_e, sv in svals:
+                tv, sv2 = _coerce_join_keys(evaluate(t_e, tbl), sv)
+                if isinstance(tv, pa.ChunkedArray):
+                    tv = tv.combine_chunks()
+                if isinstance(sv2, pa.ChunkedArray):
+                    sv2 = sv2.combine_chunks()
+                if not pc.any(pc.is_in(tv, value_set=sv2)).as_py():
+                    return False
+            return True
+
+        from delta_tpu.parallel.executor import run_sharded
+        from delta_tpu.utils import telemetry
+
+        probe_t = Timer()
+        telemetry.bump_counter("dist.merge.filesProbed", len(candidates))
+        report = run_sharded(
+            candidates, _touched,
+            sizes=[f.size or 0 for f in candidates], label="merge-probe")
+        self.phase_ms["probe_ms"] = probe_t.lap_ms_f()
+        return [f for f, hit in zip(candidates, report.results) if hit]
 
     # -- join -------------------------------------------------------------
 

@@ -64,6 +64,8 @@ class OptimizeCommand:
         target_rows: int = DEFAULT_TARGET_ROWS,
         purge: bool = False,
         max_rewrite_bytes: Optional[int] = None,
+        workers: Optional[int] = None,
+        distribute: bool = False,
     ):
         self.delta_log = delta_log
         self.predicate = (
@@ -80,7 +82,27 @@ class OptimizeCommand:
         # files selected for rewrite is bounded up front — an over-budget
         # job raises OptimizeBudgetExceeded before any IO
         self.max_rewrite_bytes = max_rewrite_bytes
+        # sharded execution (parallel/executor): bin-pack groups rewrite on
+        # `workers` LPT-seeded work-stealing workers (None = the
+        # delta.tpu.distributed.optimize.workers conf, default 1 —
+        # sequential, byte-identical to the classic loop). `distribute`
+        # additionally splits the groups across jax.distributed hosts
+        # (byte-weighted LPT); each host commits its disjoint rearrange-only
+        # slice, funneled through the group-commit coordinator.
+        self.workers = workers
+        self.distribute = distribute
+        # the last run's executor evidence (per-worker timings, steals,
+        # skew) — the sharded-scan bench and the MULTICHIP artifact read it
+        self.shard_report = None
         self.metrics: Dict[str, int] = {}
+
+    def _resolve_workers(self) -> int:
+        if self.workers is not None:
+            return max(int(self.workers), 1)
+        from delta_tpu.utils.config import conf
+
+        got = conf.get("delta.tpu.distributed.optimize.workers")
+        return max(int(got), 1) if got is not None else 1
 
     def run(self) -> int:
         from delta_tpu.utils.telemetry import record_operation
@@ -141,9 +163,39 @@ class OptimizeCommand:
                     est, self.max_rewrite_bytes,
                     sum(len(g) for _, g in groups))
 
+        # multi-host mode: every host plans the SAME group list from the
+        # same snapshot, then takes its disjoint byte-weighted LPT slice —
+        # deterministic, no scheduler RPC. Each host commits only its own
+        # rearranged files, so the per-host transactions are disjoint
+        # rearrange-only commits that cannot conflict.
+        fan_in = False
+        if self.distribute:
+            from delta_tpu.parallel.distributed import (
+                host_shard_indices, process_info)
+
+            proc, n_procs = process_info()
+            if n_procs > 1:
+                gsizes = [sum(f.size or 0 for f in g) for _k, g in groups]
+                mine = host_shard_indices(
+                    len(groups), proc, n_procs, sizes=gsizes)
+                groups = [groups[i] for i in mine]
+                # narrow the recorded read set to THIS host's slice: the
+                # commit's validity depends only on its own files surviving
+                # (the reference's OPTIMIZE pins its read files the same
+                # way), so a peer host's rearrange-only removes must not
+                # fail us with a delete-read conflict
+                keep = {f.path for _k, g in groups for f in g}
+                for p in [p for p in txn.read_files if p not in keep]:
+                    del txn.read_files[p]
+                from delta_tpu.utils.config import conf
+
+                fan_in = conf.get_bool(
+                    "delta.tpu.distributed.singleWriterFanIn", True)
+
         removes: List[Action] = []
         adds: List[Action] = []
-        for _key, group in groups:
+
+        def _rewrite(group: List[AddFile]):
             table = read_files_as_table(
                 self.delta_log.data_path, group, metadata
             )
@@ -153,16 +205,33 @@ class OptimizeCommand:
                 ]
                 perm = morton_order(cols)
                 table = table.take(pa.array(perm))
-            adds.extend(
-                write_exec.write_files(
-                    self.delta_log.data_path,
-                    table,
-                    metadata,
-                    data_change=False,
-                    target_file_rows=self.target_rows,
-                )
+            new_adds = write_exec.write_files(
+                self.delta_log.data_path,
+                table,
+                metadata,
+                data_change=False,
+                target_file_rows=self.target_rows,
             )
-            removes.extend(f.remove(data_change=False) for f in group)
+            return new_adds, [f.remove(data_change=False) for f in group]
+
+        if groups:
+            from delta_tpu.parallel.executor import run_sharded
+            from delta_tpu.utils import telemetry
+
+            telemetry.bump_counter("dist.optimize.groups", len(groups))
+            report = run_sharded(
+                [g for _k, g in groups],
+                _rewrite,
+                sizes=[sum(f.size or 0 for f in g) for _k, g in groups],
+                workers=self._resolve_workers(),
+                label="optimize",
+            )
+            self.shard_report = report
+            # results are index-ordered, so adds/removes land in the exact
+            # order the classic sequential loop produced them
+            for new_adds, new_removes in report.results:
+                adds.extend(new_adds)
+                removes.extend(new_removes)
 
         self.metrics.update(
             numRemovedFiles=len(removes),
@@ -180,7 +249,18 @@ class OptimizeCommand:
             op = ops.Optimize(
                 predicate=pred_sql, z_order_by=self.z_order_by or None,
             )
-        version = txn.commit(removes + adds, op)
+        if fan_in:
+            # single-writer fan-in: every host's commit funnels through the
+            # group-commit coordinator (PR 9), so the log sees one ordered
+            # writer instead of n_procs racing _do_commit_retry loops
+            from delta_tpu.utils.config import conf
+            from delta_tpu.utils import telemetry
+
+            telemetry.bump_counter("dist.commit.fanin")
+            with conf.set_temporarily(**{"delta.tpu.commit.group.enabled": True}):
+                version = txn.commit(removes + adds, op)
+        else:
+            version = txn.commit(removes + adds, op)
         # file rewrite: bump the resident key-cache epoch so a stale HBM
         # slab can never serve a post-OPTIMIZE MERGE (ops/key_cache.py)
         if removes or adds:

@@ -104,7 +104,11 @@ def read_files_as_table(
             )
         from delta_tpu.parallel.distributed import host_partition
 
-        files = host_partition(list(files))
+        # byte-weighted LPT: the strided count-based split hands one host
+        # the hot shard's bytes on a zipf-skewed file list; sizes are on
+        # every AddFile, so the balanced assignment is free and RPC-less
+        files = list(files)
+        files = host_partition(files, sizes=[f.size or 0 for f in files])
     total_bytes = sum(f.size or 0 for f in files)
     telemetry.bump_counter("scan.files.read", len(files))
     telemetry.bump_counter("scan.bytes.read", total_bytes)

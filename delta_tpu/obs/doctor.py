@@ -304,21 +304,39 @@ def _dim_device() -> HealthDimension:
     budget = hbm_ledger.budget_bytes()
     used = t["total"]
     pressure = (used / budget) if budget else 0.0
+    # per-device breakdown (sharded residency attributes slices): severity
+    # follows the WORST device, not the mesh-wide mean — under an even
+    # budget split, one device at 5x its fair share is the OOM candidate
+    # even when the aggregate looks healthy
+    per_device = hbm_ledger.device_totals()
+    worst = hbm_ledger.worst_device()
+    worst_pressure = 0.0
+    if worst is not None and budget and per_device:
+        fair = budget / max(len(per_device), 1)
+        worst_pressure = worst[1] / fair if fair else 0.0
     sev = "ok"
     if budget:
-        if used > budget:
+        eff = max(pressure, worst_pressure)
+        if eff > 1.0:
             sev = "critical"
-        elif pressure >= 0.8:
+        elif eff >= 0.8:
             sev = "warn"
+    metrics = {"hbmBytes": used, "keyCacheBytes": t["keyCache"],
+               "stateCacheBytes": t["stateCache"], "scratchBytes": t["scratch"],
+               "budgetBytes": budget or 0, "pressure": round(pressure, 4)}
+    if worst is not None:
+        metrics["worstDevice"] = worst[0]
+        metrics["worstDeviceBytes"] = worst[1]
+        metrics["worstDevicePressure"] = round(worst_pressure, 4)
     return HealthDimension(
         "device", sev,
-        {"hbmBytes": used, "keyCacheBytes": t["keyCache"],
-         "stateCacheBytes": t["stateCache"], "scratchBytes": t["scratch"],
-         "budgetBytes": budget or 0, "pressure": round(pressure, 4)},
+        metrics,
         remedy=actions_mod.remedy_name("EVICT") if sev != "ok" else None,
         detail=f"{used} device bytes resident "
                f"(keyCache {t['keyCache']}, stateCache {t['stateCache']}, "
                f"scratch {t['scratch']})"
+               + (f"; worst device {worst[0]} holds {worst[1]} bytes"
+                  if worst is not None else "")
                + (f" against a {budget}-byte soft budget" if budget
                   else "; no delta.tpu.device.hbmBudgetBytes budget set"),
     )

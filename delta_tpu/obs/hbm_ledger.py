@@ -34,12 +34,17 @@ from delta_tpu.utils import telemetry
 from delta_tpu.utils.config import conf
 
 __all__ = ["Account", "adjust", "totals", "budget_bytes",
+           "device_totals", "worst_device",
            "key_cache_allowance", "column_cache_allowance", "over_budget",
            "maybe_relieve", "reset"]
 
 _LOCK = threading.Lock()
 _BYTES: Dict[str, int] = {"keyCache": 0, "stateCache": 0, "scratch": 0,
                           "columnCache": 0}
+# per-device breakdown (component -> device index -> bytes): sharded
+# residency (ops/state_cache sharded lanes) accounts each device's slice,
+# so one hot device can't hide under the mesh-wide aggregate
+_DEVICES: Dict[str, Dict[int, int]] = {}
 
 # gauge names are constants from the obs/metric_names catalog — mapped here
 # so every component publishes through a registered name
@@ -51,16 +56,36 @@ _GAUGE = {
 }
 
 
-def adjust(component: str, delta_bytes: int) -> None:
+def adjust(component: str, delta_bytes: int,
+           device: Optional[int] = None) -> None:
     """Add ``delta_bytes`` (may be negative) to a component's ledger entry.
     Callers are the residency transitions themselves (alloc/upload = +,
     drop/free = -); the ledger clamps at zero so a double-free can never
-    drive the total negative."""
+    drive the total negative. With ``device`` the delta also lands in that
+    device's breakdown, published as the same gauge with a ``device=<i>``
+    label next to the unlabeled aggregate."""
+    dvalue = None
     with _LOCK:
         _BYTES[component] = max(0, _BYTES[component] + int(delta_bytes))
         value = _BYTES[component]
+        if device is not None:
+            d = _DEVICES.setdefault(component, {})
+            d[int(device)] = max(0, d.get(int(device), 0) + int(delta_bytes))
+            dvalue = d[int(device)]
     if conf.get_bool("delta.tpu.telemetry.enabled", True):
         telemetry.set_gauge(_GAUGE[component], value)
+        if dvalue is not None:
+            telemetry.set_gauge(_GAUGE[component], dvalue, device=str(device))
+
+
+def _charge(component: str, items, rest: int, sign: int) -> None:
+    """Apply an Account's (per-device items, unattributed rest) charge with
+    ``sign`` = +1 (on) / -1 (off and the gc-finalizer backstop). Module
+    function + plain values only, so the finalizer never pins its owner."""
+    for dev, b in items:
+        adjust(component, sign * b, device=dev)
+    if rest:
+        adjust(component, sign * rest)
 
 
 class Account:
@@ -71,28 +96,41 @@ class Account:
     dies resident still returns its bytes), :meth:`off` at the drop.
     Callers hold their own entry lock; the ledger lock stays a leaf."""
 
-    __slots__ = ("component", "bytes", "_final")
+    __slots__ = ("component", "bytes", "_final", "_per_device", "_rest")
 
     def __init__(self, component: str):
         self.component = component
         self.bytes = 0
         self._final = None
+        self._per_device = ()
+        self._rest = 0
 
-    def on(self, owner, nbytes: int) -> None:
+    def on(self, owner, nbytes: int,
+           per_device: Optional[Dict[int, int]] = None) -> None:
+        """Account ``nbytes`` resident; ``per_device`` attributes slices to
+        device indices (sharded residency) — any remainder stays in the
+        unattributed aggregate."""
         if self.bytes:
             return
         self.bytes = int(nbytes)
-        adjust(self.component, self.bytes)
+        items = tuple(sorted(
+            (int(d), int(b)) for d, b in (per_device or {}).items() if b
+        ))
+        self._per_device = items
+        self._rest = self.bytes - sum(b for _, b in items)
+        _charge(self.component, items, self._rest, 1)
         # the callback must not reference `owner` (it would never collect):
-        # module function + captured scalars only
-        self._final = weakref.finalize(owner, adjust, self.component,
-                                       -self.bytes)
+        # module function + captured plain values only
+        self._final = weakref.finalize(owner, _charge, self.component,
+                                       items, self._rest, -1)
 
     def off(self) -> None:
         if not self.bytes:
             return
-        adjust(self.component, -self.bytes)
+        _charge(self.component, self._per_device, self._rest, -1)
         self.bytes = 0
+        self._per_device = ()
+        self._rest = 0
         if self._final is not None:
             self._final.detach()
             self._final = None
@@ -104,6 +142,28 @@ def totals() -> Dict[str, int]:
         out = dict(_BYTES)
     out["total"] = sum(out.values())
     return out
+
+
+def device_totals() -> Dict[int, int]:
+    """Per-device resident bytes summed across components (only devices
+    that ever held attributed residency appear)."""
+    out: Dict[int, int] = {}
+    with _LOCK:
+        for d in _DEVICES.values():
+            for dev, b in d.items():
+                out[dev] = out.get(dev, 0) + b
+    return out
+
+
+def worst_device() -> Optional[tuple]:
+    """(device index, bytes) of the most-loaded device, or None when no
+    per-device residency is attributed — what the doctor's device dimension
+    flags, so a single hot device can't hide under the mesh-wide mean."""
+    per = device_totals()
+    if not per:
+        return None
+    dev = max(per, key=lambda i: (per[i], -i))
+    return dev, per[dev]
 
 
 def budget_bytes() -> Optional[int]:
@@ -163,3 +223,4 @@ def reset() -> None:
     with _LOCK:
         for k in _BYTES:
             _BYTES[k] = 0
+        _DEVICES.clear()

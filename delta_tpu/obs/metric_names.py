@@ -50,6 +50,9 @@ GAUGES = frozenset({
     "table.health.device.scratchBytes",
     "table.health.device.budgetBytes",
     "table.health.device.pressure",
+    "table.health.device.worstDevice",
+    "table.health.device.worstDeviceBytes",
+    "table.health.device.worstDevicePressure",
     # -- device-memory ledger (obs/hbm_ledger, process-wide) -------------
     "device.hbm.keyCacheBytes",
     "device.hbm.stateCacheBytes",
@@ -200,6 +203,18 @@ ENGINE_COUNTERS = frozenset({
     "stateExport.statsLanes.mixed",
     "stateExport.statsLanes.us",
     "streaming.sink.batches",
+    # -- distributed executor + sharded planning (parallel/executor,
+    #    parallel/distributed, ops/state_cache sharded plan) --------------
+    "dist.jobs",                  # sharded jobs launched (run_sharded calls)
+    "dist.items",                 # work items executed across all jobs
+    "dist.steals",                # items stolen from another worker's deque
+    "dist.plan.sharded",          # plan batches served by the shard_map kernel
+    "dist.merge.filesProbed",     # candidate files probed by the distributed
+                                  # MERGE touched-files pass
+    "dist.optimize.groups",       # OPTIMIZE bin-pack groups rewritten by
+                                  # sharded workers
+    "dist.commit.fanin",          # distributed-job commits funneled through
+                                  # the group-commit coordinator
 })
 
 #: Every histogram observed by constant name (``telemetry.observe``).
@@ -211,6 +226,7 @@ HISTOGRAMS = frozenset({
     "delta.scan.planning.duration_ms",
     "delta.streaming.sink.batch_ms",
     "delta.streaming.source.batch_ms",
+    "dist.item.duration_ms",
     "journal.flushKb",
     "router.predicted_ms",
     "router.actual_ms",
@@ -233,6 +249,7 @@ PUBLIC_API = {
     "calibration": ("enabled", "ingest", "state_path", "load_state",
                     "save_state", "apply_state", "current_state", "reset"),
     "hbm_ledger": ("Account", "adjust", "totals", "budget_bytes",
+                   "device_totals", "worst_device",
                    "key_cache_allowance", "column_cache_allowance",
                    "over_budget", "maybe_relieve", "reset"),
     "journal": ("enabled", "journal_dir", "predicate_fingerprint",
@@ -291,6 +308,9 @@ DESCRIPTIONS = {
     "table.health.device.scratchBytes": "Transient probe-scratch bytes resident on device.",
     "table.health.device.budgetBytes": "Configured soft HBM budget (0 = unlimited).",
     "table.health.device.pressure": "Resident bytes over the soft budget (fraction).",
+    "table.health.device.worstDevice": "Index of the most-loaded device in the per-device HBM breakdown.",
+    "table.health.device.worstDeviceBytes": "Resident bytes on the most-loaded device.",
+    "table.health.device.worstDevicePressure": "Worst device's bytes over its fair share of the soft budget.",
     # gauges — device ledger / router / streaming / maintenance
     "device.hbm.keyCacheBytes": "Process-wide key-cache bytes resident on device.",
     "device.hbm.stateCacheBytes": "Process-wide state-cache bytes resident on device.",
@@ -423,6 +443,15 @@ DESCRIPTIONS = {
     "delta.streaming.source.batch_ms": "Streaming source getBatch latency (ms).",
     "router.predicted_ms": "Router-predicted cost of the chosen route (ms).",
     "router.actual_ms": "Measured cost of the chosen route (ms).",
+    # distributed executor + sharded planning
+    "dist.jobs": "Sharded work-item jobs launched by the distributed executor.",
+    "dist.items": "Work items executed across all sharded jobs.",
+    "dist.steals": "Work items stolen from another worker's deque (skew relief).",
+    "dist.plan.sharded": "Scan-plan batches served by the shard_map pruning kernel.",
+    "dist.merge.filesProbed": "Candidate files probed by the distributed MERGE touched-files pass.",
+    "dist.optimize.groups": "OPTIMIZE bin-pack groups rewritten by sharded workers.",
+    "dist.commit.fanin": "Distributed-job commits funneled through the group-commit coordinator.",
+    "dist.item.duration_ms": "Per-work-item wall clock inside the distributed executor (ms).",
 }
 
 

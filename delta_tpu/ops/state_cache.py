@@ -399,7 +399,8 @@ class PlanResult:
     count: int
     rows: np.ndarray
     overflow: bool = False
-    via: str = "host-resident"  # 'device' | 'host-resident' | 'verdict'
+    # 'device' | 'device-sharded' | 'host-resident' | 'verdict'
+    via: str = "host-resident"
 
 
 class ResidentState:
@@ -439,6 +440,7 @@ class ResidentState:
         self.h_size = lanes["size"]  # (n,) int64
         self._dead = 0
         self._dev = None  # lazily-built device arrays
+        self._dev_shards = 1  # mesh shards the residency is placed over
         self._lock = threading.RLock()
         self.last_used = 0.0
         # device-memory accounting (obs/hbm_ledger: gc-backstopped)
@@ -453,37 +455,67 @@ class ResidentState:
         out[:, : a.shape[1]] = a
         return out
 
-    def _build_device(self) -> None:
+    def _build_device(self, shards: int = 1) -> None:
         import jax.numpy as jnp
 
         mins = self._pad2(_f32_down(self.h_lo), np.nan)
         maxs = self._pad2(_f32_up(self.h_hi), np.nan)
         alive = np.zeros(self.capacity, bool)
         alive[: self.num_rows] = self.h_alive[: self.num_rows]
-        self._dev = {
-            "mins": jnp.asarray(mins),
-            "maxs": jnp.asarray(maxs),
-            "alive": jnp.asarray(alive),
-        }
-        self._hbm.on(self, self.device_bytes)
+        per_device = None
+        if shards > 1:
+            # sharded residency: lanes split along the file axis over the
+            # 1-D state mesh, so the shard_map plan kernel reads its slice
+            # locally — each device's slice accounts under ITS ledger entry
+            import jax
+
+            from delta_tpu.parallel.mesh import (NamedSharding, P,
+                                                 state_mesh)
+            from delta_tpu.parallel.mesh import STATE_AXIS as _AX
+
+            mesh = state_mesh(shards)
+            lane = NamedSharding(mesh, P(None, _AX))
+            flat = NamedSharding(mesh, P(_AX))
+            self._dev = {
+                "mins": jax.device_put(mins, lane),
+                "maxs": jax.device_put(maxs, lane),
+                "alive": jax.device_put(alive, flat),
+            }
+            per = self.device_bytes // shards
+            per_device = {i: per for i in range(shards)}
+        else:
+            self._dev = {
+                "mins": jnp.asarray(mins),
+                "maxs": jnp.asarray(maxs),
+                "alive": jnp.asarray(alive),
+            }
+        self._dev_shards = shards
+        self._hbm.on(self, self.device_bytes, per_device=per_device)
 
     @property
     def device_bytes(self) -> int:
         c = len(self.columns)
         return self.capacity * (2 * c * 4 + 1)
 
-    def ensure_resident(self) -> None:
+    def ensure_resident(self, shards: Optional[int] = None) -> None:
         with self._lock:
             if self._dev is None:
-                self._build_device()
+                self._build_device(shards if shards is not None else 1)
 
     @property
     def is_resident(self) -> bool:
         return self._dev is not None
 
+    @property
+    def resident_shards(self) -> int:
+        """Mesh shards the device residency is placed over (1 = unsharded
+        or not resident)."""
+        return self._dev_shards if self._dev is not None else 1
+
     def drop_device(self) -> None:
         with self._lock:
             self._dev = None
+            self._dev_shards = 1
             self._hbm.off()
 
     # -- incremental tail apply ------------------------------------------
@@ -534,7 +566,17 @@ class ResidentState:
                     self.path_to_row[p] = start + i
                 self.num_rows = start + k
             if self._dev is not None:
-                self._apply_tail_device(dead_rows, start, k, add_lo, add_hi)
+                if self._dev_shards > 1:
+                    # sharded lanes: drop and rebuild lazily from the
+                    # updated mirrors on the next device plan — a scatter
+                    # across shard-local index spaces isn't worth its
+                    # compile-cache footprint, and the router already
+                    # prices the cold re-upload honestly (_price_plan)
+                    self._dev = None
+                    self._dev_shards = 1
+                    self._hbm.off()
+                else:
+                    self._apply_tail_device(dead_rows, start, k, add_lo, add_hi)
             self.version = version
             return True
 
@@ -668,11 +710,15 @@ class ResidentState:
                 use_device, priced = self._route_plan(len(real_ix))
             import time as _time
 
+            shards = self._plan_shards(priced, len(real_ix)) if use_device else 1
             t0 = _time.perf_counter_ns()
-            results = (self._plan_device(lo, hi, real_ks) if use_device
+            results = (self._plan_device(lo, hi, real_ks, shards=shards)
+                       if use_device
                        else self._plan_host(lo, hi, real_ks))
             plan_s = (_time.perf_counter_ns() - t0) / 1e9
-            via = "device" if use_device else "host-resident"
+            ran_shards = self._dev_shards if use_device else 1
+            via = ("device-sharded" if ran_shards > 1
+                   else "device" if use_device else "host-resident")
             for j, i in enumerate(real_ix):
                 results[j].via = via
                 out[i] = results[j]
@@ -684,7 +730,7 @@ class ResidentState:
         if priced is not None:
             from delta_tpu.obs import router_audit
 
-            device_s, host_s, cells, device_fixed_s = priced
+            device_s, host_s, cells, device_fixed_s, sharded_s, _ns = priced
             # per-cell calibrator sample with the predictor's FIXED terms
             # (dispatch latency, bitmap download, cold upload) subtracted
             # first — the prediction re-adds them, so a sample that folded
@@ -692,13 +738,20 @@ class ResidentState:
             # device forever
             if use_device:
                 eff = plan_s - device_fixed_s
-                samples = ([("DEVICE_PRUNE_S_PER_CELL", cells, eff)]
+                # a sharded run did cells/shards per-device work: sample the
+                # per-cell rate at the per-shard cell count so calibration
+                # fits the device, not the mesh
+                cal_cells = cells // max(ran_shards, 1)
+                samples = ([("DEVICE_PRUNE_S_PER_CELL", cal_cells, eff)]
                            if eff > 0 else [])
             else:
                 samples = [("HOST_PRUNE_S_PER_CELL", cells, plan_s)]
+            predictions = {"device": device_s, "host-resident": host_s}
+            if sharded_s is not None:
+                predictions["device-sharded"] = sharded_s
             router_audit.record_audit(
                 "scan.plan", self.log_path, via,
-                {"device": device_s, "host-resident": host_s}, plan_s,
+                predictions, plan_s,
                 units={"cells": cells, "queries": len(real_ix)},
                 samples=samples, log_path=self.log_path,
                 # once per planned query: the calibrator state-file write
@@ -707,14 +760,18 @@ class ResidentState:
             )
         return out  # type: ignore[return-value]
 
-    def _price_plan(self, m: int) -> Tuple[float, float, int, float]:
+    def _price_plan(self, m: int) -> Tuple[float, float, int, float,
+                                           Optional[float], int]:
         """The router's cost model for planning ``m`` range queries against
-        this entry: (device_s, host_s, cells, device_fixed_s) where
-        ``device_fixed_s`` is the cell-count-independent part of the device
-        price (dispatch + download + cold upload) — what the calibrator must
-        subtract from a measured sample before fitting the per-cell rate.
-        Constants read through ``link.constant`` so calibration feeds
-        back."""
+        this entry: (device_s, host_s, cells, device_fixed_s, sharded_s,
+        shards). ``device_fixed_s`` is the cell-count-independent part of
+        the device price (dispatch + download + cold upload) — what the
+        calibrator must subtract from a measured sample before fitting the
+        per-cell rate. ``sharded_s`` prices the same plan over the
+        shard_map mesh (None when no multi-device mesh is feasible) with
+        the calibratable per-shard constants, so the audit record carries
+        the sharded-vs-single decision. Constants read through
+        ``link.constant`` so calibration feeds back."""
         from delta_tpu.parallel import link
 
         cells = m * self.num_rows * max(len(self.columns), 1)
@@ -727,14 +784,64 @@ class ResidentState:
             # queries, but charge it to this call for honest routing
             fixed_s += p.upload_s(self.device_bytes)
         device_s = fixed_s + cells * link.constant("DEVICE_PRUNE_S_PER_CELL")
-        return device_s, host_s, cells, fixed_s
+        shards = self._feasible_shards()
+        sharded_s = None
+        if shards > 1:
+            sharded_s = fixed_s + link.sharded_plan_device_s(cells, shards, p)
+        return device_s, host_s, cells, fixed_s, sharded_s, shards
+
+    def _feasible_shards(self) -> int:
+        """Largest pow2 shard count the mesh and the lane layout admit: the
+        capacity must split into whole 1024-file BLOCKs per shard (capacity
+        is pow2, so divisibility is monotone in the shard count). 1 when
+        sharded planning is disabled or there is one device."""
+        if not conf.get_bool("delta.tpu.distributed.plan.enabled", True):
+            return 1
+        if conf.get("delta.tpu.distributed.plan.mode", "auto") == "off":
+            return 1
+        try:
+            import jax
+
+            nd = len(jax.devices())
+        except Exception:
+            return 1
+        s = 1
+        while s * 2 <= nd and self.capacity % (s * 2 * BLOCK) == 0:
+            s *= 2
+        return s
+
+    def _plan_shards(self, priced, m: int) -> int:
+        """Shard count for a device-routed plan batch. Existing residency
+        wins (no placement thrash); otherwise "force" takes the full mesh
+        and "auto" takes it only when the per-shard cost model says the
+        dispatch+gather tax beats the 1/shards cell scan win."""
+        if self._dev is not None:
+            return self._dev_shards
+        s = self._feasible_shards()
+        if s <= 1:
+            return 1
+        if conf.get("delta.tpu.distributed.plan.mode", "auto") == "force":
+            return s
+        from delta_tpu.parallel import link
+
+        if priced is not None:
+            device_s, _h, _c, fixed_s, sharded_s, shards = priced
+            return shards if (sharded_s is not None
+                              and sharded_s < device_s) else 1
+        # pinned device route (devicePlan.mode=force) skipped batch pricing:
+        # price only the sharded-vs-single choice here
+        cells = m * self.num_rows * max(len(self.columns), 1)
+        p = link.profile()
+        single = cells * link.constant("DEVICE_PRUNE_S_PER_CELL")
+        return s if link.sharded_plan_device_s(cells, s, p) < single else 1
 
     def _route_plan(self, m: int):
         """(use_device, priced) for ``m`` range queries: the enabled/mode
         short-circuits run BEFORE any pricing, so a disabled or pinned
         deployment never pays the link probe — and gets no audit record,
         since no priceable decision was made. ``priced`` is the
-        (device_s, host_s, cells) tuple in auto mode, else None."""
+        ``_price_plan`` tuple in auto mode, else None. The device side
+        enters at its best price (sharded when the mesh wins)."""
         if not conf.get_bool("delta.tpu.stateCache.devicePlan.enabled", True):
             return False, None
         mode = conf.get("delta.tpu.stateCache.devicePlan.mode", "auto")
@@ -743,7 +850,9 @@ class ResidentState:
         if mode == "off":
             return False, None
         priced = self._price_plan(m)
-        return priced[0] < priced[1], priced
+        best_device = (priced[0] if priced[4] is None
+                       else min(priced[0], priced[4]))
+        return best_device < priced[1], priced
 
     def _plan_host(self, lo: np.ndarray, hi: np.ndarray,
                    ks: np.ndarray) -> List[PlanResult]:
@@ -764,33 +873,58 @@ class ResidentState:
         return out
 
     def _plan_device(self, lo: np.ndarray, hi: np.ndarray,
-                     ks: np.ndarray) -> List[PlanResult]:
+                     ks: np.ndarray, shards: int = 1) -> List[PlanResult]:
         """Coarse-fine plan: the device culls 1024-file BLOCKS (one dispatch
         over the resident f32 lanes, one tiny packed-bitmap download); the
         host then evaluates exactly (float64 mirrors) inside the surviving
         blocks only. Index extraction never runs on device — measured on a
         v5e, a vmapped ``nonzero``/``top_k`` over (256, 1M) costs 0.7-2.4 s
         where the block-bitmap reduction costs ~0.1 s — and the fine pass
-        erases the f32 slop, so device results equal host results exactly."""
+        erases the f32 slop, so device results equal host results exactly.
+
+        With sharded residency (``shards > 1``) the cull runs as a
+        shard_map over the state mesh: each device evaluates its 1/shards
+        slice of the lanes, the block bitmaps all-gather along the file
+        axis, and the identical host fine pass finishes — so sharded
+        results equal single-device results equal host results exactly,
+        by construction."""
         import jax.numpy as jnp
 
-        self.ensure_resident()
+        self.ensure_resident(shards)
         m = lo.shape[0]
         mb = _next_pow2(m, floor=8)  # bucket the query-batch dim too
         lo_p = np.full((mb, lo.shape[1]), np.nan, np.float32)
         hi_p = np.full((mb, hi.shape[1]), np.nan, np.float32)
         lo_p[:m] = _f32_down(lo)
         hi_p[:m] = _f32_up(hi)
-        bits = _block_kernel(
-            self._dev["mins"], self._dev["maxs"], self._dev["alive"],
-            jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
-        )
-        n_blocks = self.capacity // BLOCK
-        blocks = np.unpackbits(np.asarray(bits)[:m], axis=1, count=n_blocks)
+        if self._dev_shards > 1:
+            from delta_tpu.utils import telemetry
+
+            telemetry.bump_counter("dist.plan.sharded")
+            bl = _sharded_block_kernel(
+                self._dev["mins"], self._dev["maxs"], self._dev["alive"],
+                jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
+                self._dev_shards,
+            )
+            blocks = np.asarray(bl)[:m].astype(bool)
+        else:
+            bits = _block_kernel(
+                self._dev["mins"], self._dev["maxs"], self._dev["alive"],
+                jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
+            )
+            n_blocks = self.capacity // BLOCK
+            blocks = np.unpackbits(np.asarray(bits)[:m], axis=1,
+                                   count=n_blocks)
+        return self._fine_pass(blocks, lo, hi, ks)
+
+    def _fine_pass(self, blocks: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                   ks: np.ndarray) -> List[PlanResult]:
+        """Exact float64 host evaluation inside the device-surviving blocks
+        — shared by the single-device and sharded coarse passes."""
         n = self.num_rows
         mins, maxs, alive = self.h_lo[:, :n], self.h_hi[:, :n], self.h_alive[:n]
         out = []
-        for q in range(m):
+        for q in range(lo.shape[0]):
             hit = np.nonzero(blocks[q])[0]
             if not len(hit):
                 out.append(PlanResult(0, np.empty(0, np.int64)))
@@ -798,6 +932,7 @@ class ResidentState:
             cand = np.concatenate([
                 np.arange(b * BLOCK, min((b + 1) * BLOCK, n)) for b in hit
             ])
+            cand = cand[cand < n]
             keep = alive[cand].copy()
             for c in range(lo.shape[1]):
                 if not np.isnan(lo[q, c]):
@@ -863,6 +998,52 @@ def _block_kernel_fn(block: int):
 
 def _block_kernel(mins, maxs, alive, lo, hi, block: int):
     return _block_kernel_fn(block)(mins, maxs, alive, lo, hi)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_block_kernel_fn(block: int, ncols: int, shards: int):
+    from delta_tpu.utils.jaxcache import ensure_compilation_cache
+
+    ensure_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from delta_tpu.parallel.mesh import P, STATE_AXIS, state_mesh
+    from delta_tpu.utils.jaxcompat import shard_map
+
+    mesh = state_mesh(shards)
+
+    def kernel(mins, maxs, alive, lo, hi):
+        # per-shard slices: mins/maxs (C, cap/shards), alive (cap/shards,);
+        # lo/hi replicated (M, C). Same can-intersect test as _block_kernel
+        # over this shard's files; each shard reduces its own 1024-file
+        # blocks and the out-spec all-gathers the block maps along the
+        # file axis — so the merged map is bit-identical to the
+        # single-device cull.
+        keep = jnp.broadcast_to(alive[None, :], (lo.shape[0], alive.shape[0]))
+        for c in range(ncols):  # static unroll: C is a lane count
+            mn, mx = mins[c][None, :], maxs[c][None, :]
+            lo_c, hi_c = lo[:, c:c + 1], hi[:, c:c + 1]
+            keep = keep & (jnp.isnan(mx) | jnp.isnan(lo_c) | (mx >= lo_c))
+            keep = keep & (jnp.isnan(mn) | jnp.isnan(hi_c) | (mn <= hi_c))
+        blocks = keep.reshape(
+            keep.shape[0], keep.shape[1] // block, block
+        ).any(axis=2)
+        return blocks.astype(jnp.uint8)
+
+    sm = shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(None, STATE_AXIS), P(None, STATE_AXIS), P(STATE_AXIS),
+                  P(), P()),
+        out_specs=P(None, STATE_AXIS),
+    )
+    return jax.jit(sm)
+
+
+def _sharded_block_kernel(mins, maxs, alive, lo, hi, block: int, shards: int):
+    return _sharded_block_kernel_fn(block, lo.shape[1], shards)(
+        mins, maxs, alive, lo, hi
+    )
 
 
 # -- building entries from snapshots ----------------------------------------

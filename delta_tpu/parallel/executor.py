@@ -1,0 +1,209 @@
+"""Sharded work-item executor — LPT assignment + work stealing.
+
+The DCN partitioner (`parallel/distributed`) decides which *host* owns each
+work item; this module is the per-host engine that actually runs a host's
+items: scan decode groups, OPTIMIZE bin-pack rewrites, fused-MERGE probe
+batches, checkpoint part writes. The reference delegates the same role to
+Spark's task scheduler (TaskSchedulerImpl: per-executor queues + speculative
+execution); ours is deliberately smaller:
+
+* **deterministic LPT seed** — items are pre-assigned to worker deques by
+  size-weighted LPT (`distributed.lpt_assign`), so the steady state does no
+  coordination at all;
+* **work stealing** — a worker whose deque drains steals the *tail* item of
+  the worker with the most remaining bytes (the zipf hot-shard case: one
+  deque inherits the head of the distribution and everyone else finishes
+  early). Stealing is conf-gated (`delta.tpu.distributed.workStealing.enabled`)
+  and counted (`dist.steals`);
+* **measured, not asserted** — every item's wall clock is recorded
+  (`dist.item.duration_ms`), and the report carries per-worker totals +
+  the max/mean byte skew so benches and the MULTICHIP artifact can print
+  per-shard timings instead of an "ok" string.
+
+Threads come from one pool named ``delta-dist-exec`` (pool-naming lint).
+Results preserve item order; the first item exception aborts the remaining
+queue and re-raises on the caller thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from delta_tpu.parallel.distributed import bytes_skew, lpt_assign
+
+__all__ = ["ShardReport", "WorkerStats", "run_sharded", "default_workers"]
+
+
+@dataclass
+class WorkerStats:
+    items: int = 0
+    bytes: int = 0
+    busy_s: float = 0.0
+    stolen: int = 0  # items this worker STOLE from another deque
+
+
+@dataclass
+class ShardReport:
+    """What a sharded job actually did — the bench / MULTICHIP evidence."""
+
+    results: List[Any]
+    wall_s: float
+    workers: int
+    steals: int
+    skew: float  # max/mean per-worker bytes of the LPT seed assignment
+    per_worker: Dict[int, WorkerStats] = field(default_factory=dict)
+
+    def timings(self) -> List[Dict[str, Any]]:
+        """Per-shard timing rows for artifacts (sorted by worker id)."""
+        return [
+            {
+                "worker": w,
+                "items": s.items,
+                "bytes": s.bytes,
+                "busy_s": round(s.busy_s, 6),
+                "stolen": s.stolen,
+            }
+            for w, s in sorted(self.per_worker.items())
+        ]
+
+
+def default_workers() -> int:
+    """Worker count for sharded jobs: ``delta.tpu.distributed.workers``
+    when set, else min(8, cpu count) — sized like the 8-way state mesh."""
+    import os
+
+    from delta_tpu.utils.config import conf
+
+    w = conf.get("delta.tpu.distributed.workers", None)
+    if w is not None:
+        return max(int(w), 1)
+    return max(min(8, os.cpu_count() or 1), 1)
+
+
+def run_sharded(
+    items: Sequence,
+    fn: Callable[[Any], Any],
+    *,
+    sizes: Optional[Sequence[int]] = None,
+    workers: Optional[int] = None,
+    label: str = "job",
+) -> ShardReport:
+    """Run ``fn(item)`` for every item over a worker pool with LPT seeding
+    and work stealing; returns an order-preserving :class:`ShardReport`.
+
+    ``sizes`` are per-item byte weights (defaults to uniform). ``workers``
+    defaults to :func:`default_workers`; 1 worker runs inline with no pool,
+    so the single-shard leg of a scaling bench measures the job, not the
+    machinery.
+    """
+    from delta_tpu.utils import telemetry
+    from delta_tpu.utils.config import conf
+
+    n = len(items)
+    results: List[Any] = [None] * n
+    if workers is None:
+        workers = default_workers()
+    workers = max(1, min(int(workers), max(n, 1)))
+    weights = [int(s or 0) for s in sizes] if sizes is not None else [1] * n
+    telemetry.bump_counter("dist.jobs")
+    telemetry.bump_counter("dist.items", n)
+
+    t0 = time.perf_counter()
+    if workers <= 1 or n <= 1:
+        stats = WorkerStats()
+        for j in range(n):
+            it0 = time.perf_counter()
+            results[j] = fn(items[j])
+            d = time.perf_counter() - it0
+            stats.items += 1
+            stats.bytes += weights[j]
+            stats.busy_s += d
+            telemetry.observe("dist.item.duration_ms", d * 1e3, job=label)
+        return ShardReport(
+            results=results,
+            wall_s=time.perf_counter() - t0,
+            workers=1,
+            steals=0,
+            skew=1.0,
+            per_worker={0: stats},
+        )
+
+    seed = lpt_assign(weights, workers)
+    skew = bytes_skew(weights, seed)
+    stealing = conf.get_bool("delta.tpu.distributed.workStealing.enabled", True)
+    deques: List[List[int]] = [list(b) for b in seed]
+    remaining = [sum(weights[j] for j in b) for b in deques]
+    lock = threading.Lock()
+    stop = threading.Event()
+    per_worker = {w: WorkerStats() for w in range(workers)}
+    steals = 0
+    first_error: List[BaseException] = []
+
+    def _take(w: int) -> Optional[int]:
+        nonlocal steals
+        with lock:
+            if stop.is_set():
+                return None
+            if deques[w]:
+                j = deques[w].pop(0)
+                remaining[w] -= weights[j]
+                return j
+            if not stealing:
+                return None
+            # steal the tail of the most-loaded deque: the tail holds that
+            # worker's smallest seeded items, so the victim keeps the head
+            # it is already streaming through
+            victim = max(
+                (v for v in range(workers) if deques[v]),
+                key=lambda v: (remaining[v], -v),
+                default=None,
+            )
+            if victim is None:
+                return None
+            j = deques[victim].pop()
+            remaining[victim] -= weights[j]
+            steals += 1
+            per_worker[w].stolen += 1
+            telemetry.bump_counter("dist.steals")
+            return j
+
+    def _worker(w: int) -> None:
+        stats = per_worker[w]
+        while True:
+            j = _take(w)
+            if j is None:
+                return
+            it0 = time.perf_counter()
+            try:
+                results[j] = fn(items[j])
+            except BaseException as exc:  # propagate the FIRST failure
+                with lock:
+                    if not first_error:
+                        first_error.append(exc)
+                stop.set()
+                return
+            d = time.perf_counter() - it0
+            stats.items += 1
+            stats.bytes += weights[j]
+            stats.busy_s += d
+            telemetry.observe("dist.item.duration_ms", d * 1e3, job=label)
+
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="delta-dist-exec"
+    ) as pool:
+        futures = [pool.submit(_worker, w) for w in range(workers)]
+        for f in futures:
+            f.result()
+    if first_error:
+        raise first_error[0]
+    return ShardReport(
+        results=results,
+        wall_s=time.perf_counter() - t0,
+        workers=workers,
+        steals=steals,
+        skew=skew,
+        per_worker=per_worker,
+    )

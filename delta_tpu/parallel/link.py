@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 __all__ = [
     "LinkProfile", "Estimate", "profile", "estimate_device_s", "reset",
@@ -37,8 +37,10 @@ __all__ = [
     "RESIDENT_PROBE_FIXED_S", "RESIDENT_FINALIZE_S_PER_ROW",
     "RESIDENT_PAIR_S_PER_ROW", "DEVICE_SORT_S_PER_ROW",
     "HOST_RESIDUAL_S_PER_CELL", "DEVICE_RESIDUAL_S_PER_CELL",
+    "SHARD_DISPATCH_S", "SHARD_GATHER_S_PER_SHARD", "DIST_ITEM_S",
     "resident_probe_device_s", "cold_merge_device_s",
     "host_residual_filter_s", "device_residual_mask_s",
+    "sharded_plan_device_s", "dist_execute_s",
     "CALIBRATABLE", "constant", "set_calibrated", "calibrated_constants",
     "clear_calibrated",
 ]
@@ -84,6 +86,17 @@ HOST_RESIDUAL_S_PER_CELL = 1.5e-8
 # the same residual from HBM-resident SoA lanes (`ops/column_cache`), one
 # fused jitted pass: VPU elementwise compares at HBM bandwidth
 DEVICE_RESIDUAL_S_PER_CELL = 5.0e-10
+# fixed per-dispatch overhead of a shard_map launch over the mesh: program
+# dispatch + the all-gather of the surviving-bitmap shards. Dominates tiny
+# plans — the router must not shard a 10k-file table over 8 devices.
+SHARD_DISPATCH_S = 2.0e-3
+# incremental gather cost per participating shard (each shard contributes
+# its packed survivor bitmap to the ICI all-gather)
+SHARD_GATHER_S_PER_SHARD = 2.0e-4
+# per-item scheduling overhead of the distributed executor (deque push/pop,
+# steal checks, timing capture) — charged when pricing a fan-out against
+# running the same items inline
+DIST_ITEM_S = 5.0e-5
 
 
 # -- self-calibration --------------------------------------------------------
@@ -104,6 +117,7 @@ CALIBRATABLE = frozenset({
     "RESIDENT_PROBE_S_PER_ROW", "RESIDENT_PAIR_S_PER_ROW",
     "DEVICE_SORT_S_PER_ROW", "HOST_RESIDUAL_S_PER_CELL",
     "DEVICE_RESIDUAL_S_PER_CELL",
+    "SHARD_DISPATCH_S", "SHARD_GATHER_S_PER_SHARD", "DIST_ITEM_S",
 })
 
 _calibrated: dict = {}
@@ -201,6 +215,44 @@ def device_residual_mask_s(cold_rows: int, resident_rows: int, ncols: int,
         + p.download_s(rows)
         + 2 * p.latency_s
     )
+
+
+def sharded_plan_device_s(cells: int, shards: int, p: "LinkProfile") -> float:
+    """Cost model for the shard_map pruning plan: each device evaluates the
+    predicate over its 1/shards slice of the stat lanes in parallel, then the
+    packed survivor bitmaps all-gather over ICI and the merged bitmap
+    downloads (~cells/8 per predicate batch is already folded into the
+    per-cell constant's fit). Priced against the single-device plan
+    (``cells * DEVICE_PRUNE_S_PER_CELL``) and the host plan — the
+    ``scan.plan`` router audit records which side actually won. ONE
+    definition — `ops/state_cache` routing and the sharded-scan bench both
+    call this, so they cannot drift apart."""
+    shards = max(int(shards), 1)
+    return (
+        (cells / shards) * constant("DEVICE_PRUNE_S_PER_CELL")
+        + constant("SHARD_DISPATCH_S")
+        + shards * constant("SHARD_GATHER_S_PER_SHARD")
+        + p.latency_s
+    )
+
+
+def dist_execute_s(item_s: Sequence[float], workers: int) -> float:
+    """Makespan estimate for fanning per-item costs out over ``workers``
+    via the LPT executor (`parallel/executor`): the max per-worker load of
+    the deterministic LPT assignment plus the per-item scheduling tax.
+    ``workers<=1`` degrades to the inline sum — so the comparison
+    ``dist_execute_s(costs, n) < dist_execute_s(costs, 1)`` is exactly the
+    router's fan-out-or-not question, audited as ``dist.execute``."""
+    costs = [max(float(c), 0.0) for c in item_s]
+    overhead = len(costs) * constant("DIST_ITEM_S")
+    if workers <= 1 or len(costs) <= 1:
+        return sum(costs)
+    from delta_tpu.parallel.distributed import lpt_assign
+
+    scaled = [int(c * 1e9) for c in costs]
+    buckets = lpt_assign(scaled, workers)
+    return max((sum(costs[j] for j in b) for b in buckets), default=0.0) \
+        + overhead
 
 
 @dataclass(frozen=True)

@@ -44,6 +44,10 @@ from delta_tpu.replay.trace import WorkloadTrace, build_trace, _resolve_log
 __all__ = ["Candidate", "ShadowScorecard", "default_candidates",
            "realized_audit", "shadow_run", "shadow_verdicts"]
 
+#: planning-p50 dead-band (ms): deltas below this are scheduler jitter,
+#: not a candidate effect, and contribute nothing to the score
+PLAN_NOISE_MS = 2.0
+
 #: score band treated as noise: |score| below this is ``inconclusive``
 SCORE_EPS = 0.01
 
@@ -186,8 +190,16 @@ def _score(base: Dict[str, Any], cand: Dict[str, Any]) -> Dict[str, Any]:
                  / byte_denom)
     d_rg = ((cand["rowGroupsPruned"] - base["rowGroupsPruned"])
             / max(base["rowGroupsTotal"], 1.0))
-    d_plan = ((cand["planningP50Ms"] - base["planningP50Ms"])
-              / max(base["planningP50Ms"], 1.0))
+    # the planning-latency term is a tie-breaker, not a primary signal:
+    # sub-PLAN_NOISE_MS median shifts are host scheduler jitter (the
+    # baseline replays first, so cold-start noise lands on ITS p50) and
+    # must never outvote the deterministic byte terms — dead-band then
+    # clamp, bounding the term's reach to +/-0.1 score
+    raw_d_plan = cand["planningP50Ms"] - base["planningP50Ms"]
+    if abs(raw_d_plan) < PLAN_NOISE_MS:
+        raw_d_plan = 0.0
+    d_plan = max(-1.0, min(
+        1.0, raw_d_plan / max(base["planningP50Ms"], 1.0)))
     mismatch = cand["rowsOut"] != base["rowsOut"] or cand["errors"] > base["errors"]
     score = (d_read + d_bytes + 0.25 * d_planned + 0.25 * d_rg
              - 0.1 * d_plan)
@@ -346,6 +358,12 @@ def shadow_run(table: Any, trace: Optional[WorkloadTrace] = None,
             except Exception as exc:  # noqa: BLE001
                 failed[i] = f"{type(exc).__name__}: {exc}"
 
+        # one untimed warm-up replay first: the baseline is measured before
+        # any candidate, so process-level cold-start (first-parquet
+        # machinery, lazy imports) would otherwise inflate ITS planning p50
+        # and bias every candidate's timing tie-breaker toward "confirmed"
+        if scans:
+            _replay_scans(os.path.join(sandbox, "baseline"), scans[:1])
         base = _replay_scans(os.path.join(sandbox, "baseline"), scans)
         for i, c in enumerate(candidates):
             telemetry.bump_counter("shadow.candidates")

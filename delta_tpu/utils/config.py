@@ -336,6 +336,27 @@ class SqlConf:
         "delta.tpu.catalog.claimTimeoutMs": 600_000,
         # Multi-host barrier/gather timeout (parallel/distributed).
         "delta.tpu.distributed.timeoutMs": 600_000,
+        # Sharded work-item executor (parallel/executor): worker count
+        # (None = min(8, cpu count)) and deque work stealing for the
+        # zipf hot-shard case.
+        "delta.tpu.distributed.workers": None,
+        "delta.tpu.distributed.workStealing.enabled": True,
+        # shard_map scan planning (ops/state_cache sharded lanes): "auto"
+        # prices sharded-vs-single with the per-shard link constants,
+        # "force"/"off" pin the choice.
+        "delta.tpu.distributed.plan.enabled": True,
+        "delta.tpu.distributed.plan.mode": "auto",
+        # Distributed OPTIMIZE: rewrite bin-pack groups on executor
+        # workers (None = delta.tpu.distributed.workers).
+        "delta.tpu.distributed.optimize.workers": None,
+        # Distributed MERGE: probe candidate files for touched ones on
+        # executor workers before the join (Spark's findTouchedFiles job);
+        # minFiles gates the fan-out below which inline always wins.
+        "delta.tpu.distributed.merge.probe.enabled": True,
+        "delta.tpu.distributed.merge.probe.minFiles": 8,
+        # Funnel distributed-job commits through the group-commit
+        # coordinator (txn/group_commit) as the single-writer fan-in.
+        "delta.tpu.distributed.singleWriterFanIn": True,
         # DML writes per-file deletion vectors instead of rewriting files
         # when the table enables them (commands/dml_common).
         "delta.tpu.deletionVectors.enabled": True,

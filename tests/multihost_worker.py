@@ -11,11 +11,112 @@ coordination model is the store, not RPC (SURVEY §2.8):
                 fragments from the store and commits
   vacuum      — each host deletes its slice of the expired files
 
+``dist`` mode drives the sharded-execution plane instead: each host takes
+its byte-weighted LPT slice of the OPTIMIZE bin-pack groups and commits its
+own rearrange-only transaction, then proc 0 runs a probe-restricted MERGE.
+``dist-crash`` kills proc 1 with a SimulatedCrash mid-OPTIMIZE (no cluster
+join — the store is the coordination model, and a dead peer must not hang
+the survivor's jax.distributed teardown).
+
 Results land in <out>/result-<proc>.json for the parent to assert.
 """
 import json
 import os
 import sys
+import time
+
+
+def _barrier(out_dir: str, name: str, proc: int, n_procs: int) -> None:
+    """Store-based barrier: marker files on the shared directory."""
+    open(os.path.join(out_dir, f"{name}-{proc}"), "w").close()
+    deadline = time.time() + 60
+    while not all(
+        os.path.exists(os.path.join(out_dir, f"{name}-{i}"))
+        for i in range(n_procs)
+    ):
+        if time.time() > deadline:
+            raise TimeoutError(f"barrier {name} timed out on proc {proc}")
+        time.sleep(0.05)
+
+
+def dist_body(proc: int, n_procs: int, table: str, out_dir: str,
+              crash: bool) -> None:
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.exec.scan import scan_to_table
+
+    result = {"proc": proc}
+    log = DeltaLog.for_table(table)
+    snap = log.update()
+
+    # sharded scan: the byte-weighted LPT partitions tile the table
+    part = scan_to_table(snap, distribute=True)
+    result["scan_ids"] = sorted(part.column("id").to_pylist())
+
+    if crash and proc == 1:
+        # SimulatedCrash (a BaseException) mid-job: fires on this host's
+        # SECOND group rewrite, after real work started but before commit
+        from delta_tpu.exec import write as write_exec
+        from delta_tpu.storage.faults import SimulatedCrash
+
+        orig = write_exec.write_files
+        state = {"n": 0}
+
+        def crashing(*a, **k):
+            state["n"] += 1
+            if state["n"] >= 2:
+                raise SimulatedCrash("dist.optimize.rewrite")
+            return orig(*a, **k)
+
+        write_exec.write_files = crashing
+
+    cmd = OptimizeCommand(log, min_file_size=1 << 30, workers=2,
+                          distribute=True)
+    version = cmd.run()
+    result["optimize_version"] = version
+    result["optimize_groups"] = (
+        len(cmd.shard_report.results) if cmd.shard_report else 0)
+    result["shard_timings"] = (
+        cmd.shard_report.timings() if cmd.shard_report else [])
+
+    if not crash:
+        _barrier(out_dir, "opt", proc, n_procs)
+        if proc == 0:
+            from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+            from delta_tpu.utils.config import conf
+
+            DeltaLog.clear_cache()
+            mlog = DeltaLog.for_table(table)
+            src = pa.table({
+                "id": pa.array([3, 75, 1000], pa.int64()),
+                "part": pa.array(["p0", "p3", "p0"]),
+                "v": pa.array([-1.0, -2.0, -3.0]),
+            })
+            with conf.set_temporarily(
+                **{"delta.tpu.distributed.merge.probe.minFiles": 2}
+            ):
+                m = MergeIntoCommand(
+                    mlog, src, "t.id = s.id",
+                    [MergeClause("update", assignments=None)],
+                    [MergeClause("insert", assignments=None)],
+                    source_alias="s", target_alias="t")
+                m.run()
+            result["merge_updated"] = m.metrics["numTargetRowsUpdated"]
+            result["merge_inserted"] = m.metrics["numTargetRowsInserted"]
+            result["merge_probed"] = "probe_ms" in m.phase_ms
+        _barrier(out_dir, "merge", proc, n_procs)
+
+    DeltaLog.clear_cache()
+    fsnap = DeltaLog.for_table(table).update()
+    final = scan_to_table(fsnap)
+    result["final_ids"] = sorted(final.column("id").to_pylist())
+    result["final_files"] = fsnap.num_of_files
+    result["final_version"] = fsnap.version
+
+    with open(os.path.join(out_dir, f"result-{proc}.json"), "w") as f:
+        json.dump(result, f)
 
 
 def main() -> None:
@@ -25,6 +126,7 @@ def main() -> None:
     table = sys.argv[4]
     convert_dir = sys.argv[5]
     out_dir = sys.argv[6]
+    mode = sys.argv[7] if len(sys.argv) > 7 else "classic"
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -32,12 +134,23 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
     from delta_tpu.parallel import distributed as dist
 
+    if mode == "dist-crash":
+        # no cluster join: a peer that dies mid-job must not hang the
+        # survivor's jax.distributed teardown; slicing reads process_info
+        dist.process_info = lambda: (proc, n_procs)
+        dist_body(proc, n_procs, table, out_dir, crash=True)
+        return
+
     pid, count = dist.initialize(
         coordinator_address=f"localhost:{port}",
         num_processes=n_procs,
         process_id=proc,
     )
     assert (pid, count) == (proc, n_procs), (pid, count)
+
+    if mode == "dist":
+        dist_body(proc, n_procs, table, out_dir, crash=False)
+        return
 
     from delta_tpu import DeltaLog
     from delta_tpu.exec.scan import scan_to_table

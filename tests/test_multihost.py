@@ -96,6 +96,136 @@ def test_two_process_cluster_scan_checkpoint_convert(tmp_path):
     assert t == list(range(20))
 
 
+def _mk_dist_table(path: str, parts: int = 4, files_per: int = 3,
+                   rows: int = 16) -> None:
+    log = DeltaLog.for_table(path)
+    for p in range(parts):
+        for f in range(files_per):
+            base = (p * files_per + f) * rows
+            WriteIntoDelta(log, "append", pa.table({
+                "id": np.arange(base, base + rows, dtype=np.int64),
+                "part": pa.array([f"p{p}"] * rows),
+                "v": np.arange(base, base + rows, dtype=np.float64),
+            }), partition_columns=["part"]).run()
+
+
+def _run_workers(tmp_path, table: str, mode: str, out_name: str):
+    out_dir = str(tmp_path / out_name)
+    os.makedirs(out_dir)
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "multihost_worker.py"),
+             str(i), "2", str(port), table, "-", out_dir, mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=150) for p in procs]
+    results = {}
+    for i in range(2):
+        f = os.path.join(out_dir, f"result-{i}.json")
+        if os.path.exists(f):
+            with open(f) as fh:
+                results[i] = json.load(fh)
+    return procs, outs, results
+
+
+def test_two_process_sharded_optimize_merge_identity(tmp_path):
+    """2-process sharded execution over a shared table: each host commits
+    its byte-weighted LPT slice of the OPTIMIZE groups, proc 0 runs the
+    probe-restricted MERGE — and the end state is row-identical to the same
+    OPTIMIZE+MERGE run single-process on a clone."""
+    table = str(tmp_path / "table")
+    solo = str(tmp_path / "solo")
+    _mk_dist_table(table)
+    _mk_dist_table(solo)
+
+    procs, outs, results = _run_workers(tmp_path, table, "dist", "out")
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se.decode()[-3000:]
+    assert sorted(results) == [0, 1]
+
+    # sharded scan: the two hosts' LPT slices tile the table exactly
+    ids = sorted(results[0]["scan_ids"] + results[1]["scan_ids"])
+    assert ids == list(range(192))
+
+    # each host committed a disjoint slice of the 4 partition groups
+    assert results[0]["optimize_groups"] + results[1]["optimize_groups"] == 4
+    assert all(r["optimize_groups"] >= 1 for r in results.values())
+    assert results[0]["optimize_version"] != results[1]["optimize_version"]
+    assert all(r["shard_timings"] for r in results.values())
+
+    # proc 0's MERGE ran the distributed probe and updated/inserted
+    assert results[0]["merge_probed"] is True
+    assert results[0]["merge_updated"] == 2
+    assert results[0]["merge_inserted"] == 1
+
+    # single-process reference on the clone: identical final rows
+    from delta_tpu.commands.merge import MergeClause, MergeIntoCommand
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.exec.scan import scan_to_table
+    from delta_tpu.utils.config import conf
+
+    slog = DeltaLog.for_table(solo)
+    OptimizeCommand(slog, min_file_size=1 << 30).run()
+    src = pa.table({
+        "id": pa.array([3, 75, 1000], pa.int64()),
+        "part": pa.array(["p0", "p3", "p0"]),
+        "v": pa.array([-1.0, -2.0, -3.0]),
+    })
+    with conf.set_temporarily(
+        **{"delta.tpu.distributed.merge.probe.enabled": False}
+    ):
+        MergeIntoCommand(
+            slog, src, "t.id = s.id",
+            [MergeClause("update", assignments=None)],
+            [MergeClause("insert", assignments=None)],
+            source_alias="s", target_alias="t").run()
+    DeltaLog.clear_cache()
+    want = scan_to_table(DeltaLog.for_table(solo).update()).sort_by("id")
+    got = scan_to_table(DeltaLog.for_table(table).update()).sort_by("id")
+    assert got.select(["id", "part", "v"]).to_pylist() == \
+        want.select(["id", "part", "v"]).to_pylist()
+    # both workers read back the same converged state, and the file
+    # topology matches the single-process reference exactly
+    assert results[0]["final_ids"] == results[1]["final_ids"]
+    solo_files = DeltaLog.for_table(solo).update().num_of_files
+    assert results[0]["final_files"] == results[1]["final_files"] == solo_files
+
+
+def test_two_process_optimize_survives_worker_crash(tmp_path):
+    """SimulatedCrash of worker 1 mid-OPTIMIZE: the surviving host completes
+    and commits its slice; the crashed host commits NOTHING (its half-done
+    rewrite leaves only uncommitted orphan data files), and the log replays
+    to a consistent snapshot with every original row intact."""
+    table = str(tmp_path / "table")
+    _mk_dist_table(table)
+    snap0 = DeltaLog.for_table(table).update()
+    v0, files0 = snap0.version, snap0.num_of_files
+
+    procs, outs, results = _run_workers(tmp_path, table, "dist-crash", "out")
+    assert procs[0].returncode == 0, outs[0][1].decode()[-3000:]
+    assert procs[1].returncode != 0
+    assert b"SimulatedCrash" in outs[1][1]
+    assert 0 in results and 1 not in results  # proc 1 died before reporting
+
+    # ledger reconciles: exactly the survivor's commit landed, all rows live
+    DeltaLog.clear_cache()
+    snap = DeltaLog.for_table(table).update()
+    assert snap.version == v0 + 1 == results[0]["final_version"]
+    from delta_tpu.exec.scan import scan_to_table
+
+    t = scan_to_table(snap)
+    assert sorted(t.column("id").to_pylist()) == list(range(192))
+    # survivor compacted its slice: fewer files than before, more than the
+    # fully-compacted 4 (the crashed host's slice is still un-compacted)
+    assert 4 < snap.num_of_files < files0
+    assert results[0]["final_files"] == snap.num_of_files
+
+
 def test_vacuum_composes_with_scan_partitioning():
     """The same strided partitioner drives vacuum's delete fan-out and the
     distributed scan: for any (index, count) the slices tile the work list

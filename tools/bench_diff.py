@@ -28,8 +28,10 @@ from typing import Any, Dict, List, Optional
 __all__ = ["Regression", "compare", "compare_files", "main"]
 
 #: Units where a SMALLER value is better. "findings" is the static-analysis
-#: gate (tools/analyze.py counts riding the bench artifact).
-LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns", "findings"})
+#: gate (tools/analyze.py counts riding the bench artifact); "skew" is a
+#: max/mean balance ratio (1.0 = perfectly even — the sharded-scan config's
+#: LPT assignment gate), so growth is a load-balance regression.
+LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns", "findings", "skew"})
 
 DEFAULT_THRESHOLD_PCT = 20.0
 
@@ -58,6 +60,11 @@ def _configs(round_json: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     allc = round_json.get("all")
     if isinstance(allc, dict):
         return allc
+    # driver-captured artifacts (BENCH_rN.json) wrap the bench line under
+    # "parsed" — unwrap so --compare works against them directly
+    parsed = round_json.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("all"), dict):
+        return parsed["all"]
     # a bare single-config record (bench.py <only> mode) or a config map
     if "value" in round_json and "metric" in round_json:
         return {"_only": round_json}
