@@ -2372,6 +2372,123 @@ def bench_sharded_scan(workdir):
     return result
 
 
+def bench_trace_overhead(workdir):
+    """Config 15 — distributed-tracing overhead on the sharded OPTIMIZE leg
+    (ISSUE 19).
+
+    The same partitioned compaction (pool path: job/worker/item spans) runs
+    under three postures, reps interleaved so clock drift lands on every
+    variant equally:
+
+      sampled    — ``trace.sampleRate=1`` + a spool dir: every span is
+                   serialized and appended to the JSONL spool
+      unsampled  — ``trace.sampleRate=0`` + a spool dir: head sampling says
+                   no; the claim is the sink never runs AND the spool dir
+                   is never created
+      disabled   — telemetry off entirely: the floor the others compare to
+
+    Headline: the tracing plane's marginal cost when sampled — the median
+    of the per-rep ``sampled/unsampled`` wall ratios (pairing adjacent runs
+    cancels the slow drift that dominates run-to-run noise at this scale).
+    ``unsampled/disabled`` is the context number: the whole telemetry plane
+    vs blackout, of which tracing-off must add nothing. The inertness
+    claims are hard-asserted (rate 0 must write NOTHING); the timing claims
+    ride a findings-style gate — ``0`` means both hold (sampled-on < 5%,
+    unsampled-vs-disabled within the disabled variant's own rep spread),
+    and any regression reads as new findings for ``--compare``.
+    """
+    import statistics
+
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.obs import trace_store
+    from delta_tpu.utils.config import conf as _c
+
+    rows_per = max(_rows(240_000) // 24, 500)
+    reps = 6
+
+    def _mk(path):
+        log = DeltaLog.for_table(path)
+        for p in range(8):
+            for f in range(3):
+                base = (p * 3 + f) * rows_per
+                WriteIntoDelta(log, "append", pa.table({
+                    "id": np.arange(base, base + rows_per, dtype=np.int64),
+                    "part": pa.array([f"p{p}"] * rows_per),
+                    "v": np.arange(base, base + rows_per, dtype=np.float64),
+                }), partition_columns=["part"]).run()
+        return log
+
+    spools = {v: os.path.join(workdir, f"c15_spool_{v}")
+              for v in ("sampled", "unsampled")}
+    variants = {
+        "sampled": {"delta.tpu.trace.dir": spools["sampled"],
+                    "delta.tpu.trace.sampleRate": 1.0},
+        "unsampled": {"delta.tpu.trace.dir": spools["unsampled"],
+                      "delta.tpu.trace.sampleRate": 0.0},
+        "disabled": {"delta.tpu.telemetry.enabled": False},
+    }
+    times = {v: [] for v in variants}
+    # rep -1 is an untimed warm-up sweep: the first compaction pays JIT and
+    # first-touch caches, and must not land on whichever variant runs first
+    for rep in range(-1, reps):
+        for v, knobs in variants.items():
+            log = _mk(os.path.join(workdir, f"c15_{v}_{rep}"))
+            cmd = OptimizeCommand(log, min_file_size=1 << 30, workers=4)
+            with _c.set_temporarily(**knobs):
+                t, _ = _timed(cmd.run)
+            if rep >= 0:
+                times[v].append(t)
+            assert cmd.metrics["numRemovedFiles"] == 24
+    trace_store.reset()
+
+    spooled = len(trace_store.read_spools(spools["sampled"]))
+    # the knobs must be provably inert: rate 0 writes NOTHING — the sink
+    # never ran, so the spool directory was never even created
+    assert spooled > 0, "sampled variant spooled no spans"
+    assert not os.path.exists(spools["unsampled"]), \
+        "sampleRate=0 still touched the spool"
+
+    med = {v: statistics.median(ts) for v, ts in times.items()}
+    # paired ratios: within one rep the variants run back to back, so the
+    # slow drift (freq scaling, background load) divides out of the ratio
+    on_pct = (statistics.median(
+        s / u for s, u in zip(times["sampled"], times["unsampled"])
+    ) - 1.0) * 100.0
+    off_pct = (statistics.median(
+        u / d for u, d in zip(times["unsampled"], times["disabled"])
+    ) - 1.0) * 100.0
+    # noise floor: the disabled variant's own interquartile spread (≥ 2%)
+    d_sorted = sorted(times["disabled"])
+    q = max(reps // 4, 1)
+    noise_pct = max((d_sorted[-1 - q] - d_sorted[q]) / med["disabled"]
+                    * 100.0, 2.0)
+    violations = int(on_pct >= 5.0) + int(abs(off_pct) > noise_pct)
+    return {
+        "metric": "trace_overhead_sampled_pct",
+        "value": round(max(on_pct, 0.0), 2),
+        "unit": "pct",
+        "vs_baseline": round(on_pct, 2),
+        "reps": reps,
+        "rows": 24 * rows_per,
+        "files_compacted": 24,
+        "median_s": {v: round(t, 4) for v, t in med.items()},
+        "times_s": {v: [round(t, 4) for t in ts]
+                    for v, ts in times.items()},
+        "sampled_on_overhead_pct": round(on_pct, 2),
+        "sampled_off_overhead_pct": round(off_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "spans_spooled_sampled": spooled,
+        "gate": {
+            "trace_overhead_claims_violated": {
+                "value": violations, "unit": "findings"},
+        },
+    }
+
+
 def _emit(results):
     headline = results.get("2") or next(iter(results.values()))
     print(json.dumps({
@@ -2407,11 +2524,12 @@ def _reset_engine_state():
         from delta_tpu import autopilot
 
         autopilot.reset()
-        from delta_tpu.obs import fleet, slo, timeseries
+        from delta_tpu.obs import fleet, slo, timeseries, trace_store
 
         timeseries.reset()
         slo.reset()
         fleet.reset()
+        trace_store.reset()
     except Exception:
         pass
 
@@ -2472,6 +2590,7 @@ def main():
         "11": lambda: bench_fleet(workdir),
         "13": lambda: bench_shadow(workdir),
         "14": lambda: bench_sharded_scan(workdir),
+        "15": lambda: bench_trace_overhead(workdir),
         "12": lambda: bench_device_scan(workdir),
         "8": lambda: bench_resident_probe(workdir),
         "5": lambda: bench_checkpoint_replay(workdir),

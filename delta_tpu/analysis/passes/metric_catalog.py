@@ -15,7 +15,10 @@ drift. Coverage is identical to the old test-embedded lints:
       ``COUNTERS ∪ ENGINE_COUNTERS`` (the inverse pass);
     * an ``observe`` name missing from ``HISTOGRAMS``.
     Dynamic f-string families (``logstore.{op}.*``) are out of scope by
-    construction.
+    construction. Beyond string literals, a first argument that is a bare
+    name resolves when the file binds it exactly once, as a module-level
+    constant string — the ``_METRIC = "x.y"; bump_counter(_METRIC)`` idiom
+    no longer hides a call site from the catalog.
 ``metric-overlap``
     A counter cataloged in both ``COUNTERS`` and ``ENGINE_COUNTERS``.
 
@@ -62,8 +65,41 @@ def catalog_sets(sf) -> Optional[Dict[str, Dict[str, int]]]:
     return out or None
 
 
+def _module_str_consts(sf) -> Dict[str, str]:
+    """Identifiers that resolve to exactly one value file-wide: bound once
+    in the whole tree (no parameter, loop, or nested-function shadowing —
+    counting bindings sidesteps scope analysis), and that binding is a
+    simple module-level ``NAME = "literal"``."""
+    stores: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            stores[node.id] = stores.get(node.id, 0) + 1
+        elif isinstance(node, ast.arg):
+            stores[node.arg] = stores.get(node.arg, 0) + 1
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            # declared rebindable from another scope: opaque, never resolve
+            for n in node.names:
+                stores[n] = stores.get(n, 0) + 2
+    out: Dict[str, str] = {}
+    for stmt in sf.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            name, value = stmt.targets[0].id, stmt.value
+        elif (isinstance(stmt, ast.AnnAssign)
+              and isinstance(stmt.target, ast.Name)
+              and stmt.value is not None):
+            name, value = stmt.target.id, stmt.value
+        else:
+            continue
+        if (isinstance(value, ast.Constant) and isinstance(value.value, str)
+                and stores.get(name) == 1):
+            out[name] = value.value
+    return out
+
+
 def _const_metric_calls(sf, fn_name: str) -> List[Tuple[str, int]]:
     out = []
+    consts: Optional[Dict[str, str]] = None  # resolved on first Name arg
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -72,6 +108,11 @@ def _const_metric_calls(sf, fn_name: str) -> List[Tuple[str, int]]:
         arg = node.args[0]
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             out.append((arg.value, node.lineno))
+        elif isinstance(arg, ast.Name):
+            if consts is None:
+                consts = _module_str_consts(sf)
+            if arg.id in consts:
+                out.append((consts[arg.id], node.lineno))
     return out
 
 

@@ -583,11 +583,16 @@ class MergeIntoCommand:
 
         probe_t = Timer()
         telemetry.bump_counter("dist.merge.filesProbed", len(candidates))
-        report = run_sharded(
-            candidates, _touched,
-            sizes=[f.size or 0 for f in candidates], label="merge-probe")
+        with telemetry.record_operation(
+            "delta.dist.mergeProbe", {"candidates": len(candidates)}
+        ) as probe_ev:
+            report = run_sharded(
+                candidates, _touched,
+                sizes=[f.size or 0 for f in candidates], label="merge-probe")
+            touched = [f for f, hit in zip(candidates, report.results) if hit]
+            probe_ev.data["touched"] = len(touched)
         self.phase_ms["probe_ms"] = probe_t.lap_ms_f()
-        return [f for f, hit in zip(candidates, report.results) if hit]
+        return touched
 
     # -- join -------------------------------------------------------------
 

@@ -169,6 +169,7 @@ class OptimizeCommand:
         # rearranged files, so the per-host transactions are disjoint
         # rearrange-only commits that cannot conflict.
         fan_in = False
+        slice_info = None
         if self.distribute:
             from delta_tpu.parallel.distributed import (
                 host_shard_indices, process_info)
@@ -179,6 +180,13 @@ class OptimizeCommand:
                 mine = host_shard_indices(
                     len(groups), proc, n_procs, sizes=gsizes)
                 groups = [groups[i] for i in mine]
+                # this host's slice of the groups, as a span: the stitched
+                # trace shows one delta.dist.hostSlice lane per process
+                slice_info = {
+                    "proc": proc, "nProcs": n_procs, "groups": len(groups),
+                    "sliceBytes": sum(
+                        f.size or 0 for _k, g in groups for f in g),
+                }
                 # narrow the recorded read set to THIS host's slice: the
                 # commit's validity depends only on its own files surviving
                 # (the reference's OPTIMIZE pins its read files the same
@@ -215,17 +223,23 @@ class OptimizeCommand:
             return new_adds, [f.remove(data_change=False) for f in group]
 
         if groups:
+            import contextlib
+
             from delta_tpu.parallel.executor import run_sharded
             from delta_tpu.utils import telemetry
 
             telemetry.bump_counter("dist.optimize.groups", len(groups))
-            report = run_sharded(
-                [g for _k, g in groups],
-                _rewrite,
-                sizes=[sum(f.size or 0 for f in g) for _k, g in groups],
-                workers=self._resolve_workers(),
-                label="optimize",
-            )
+            slice_span = (
+                telemetry.record_operation("delta.dist.hostSlice", slice_info)
+                if slice_info is not None else contextlib.nullcontext())
+            with slice_span:
+                report = run_sharded(
+                    [g for _k, g in groups],
+                    _rewrite,
+                    sizes=[sum(f.size or 0 for f in g) for _k, g in groups],
+                    workers=self._resolve_workers(),
+                    label="optimize",
+                )
             self.shard_report = report
             # results are index-ordered, so adds/removes land in the exact
             # order the classic sequential loop produced them
@@ -257,8 +271,14 @@ class OptimizeCommand:
             from delta_tpu.utils import telemetry
 
             telemetry.bump_counter("dist.commit.fanin")
-            with conf.set_temporarily(**{"delta.tpu.commit.group.enabled": True}):
-                version = txn.commit(removes + adds, op)
+            with telemetry.record_operation(
+                "delta.dist.commit.fanIn",
+                {"adds": len(adds), "removes": len(removes)},
+            ):
+                with conf.set_temporarily(
+                    **{"delta.tpu.commit.group.enabled": True}
+                ):
+                    version = txn.commit(removes + adds, op)
         else:
             version = txn.commit(removes + adds, op)
         # file rewrite: bump the resident key-cache epoch so a stale HBM

@@ -17,6 +17,8 @@
 * :mod:`delta_tpu.obs.fleet` — process-wide table registry + ranked sweeps
 * :mod:`delta_tpu.obs.timeseries` — scraped metric rings (windowed series)
 * :mod:`delta_tpu.obs.slo` — SLO objectives with multi-window burn alerts
+* :mod:`delta_tpu.obs.trace_store` — distributed-trace span spool +
+  cross-process stitching and straggler analysis
 
 Importing this package installs the (inert-until-configured) flight-recorder
 failure hook; everything else is pull-by-call.

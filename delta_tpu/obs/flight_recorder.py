@@ -94,6 +94,10 @@ def record_incident(ev, exc: BaseException) -> Optional[str]:
     incident: Dict[str, Any] = {
         "timestamp": ev.timestamp_ms,
         "opType": ev.op_type,
+        # the failing span's trace: errors force-sample, so this links to
+        # a spooled, stitchable /traces/<id> view of the incident
+        "traceId": (getattr(ev, "trace_id", "")
+                    or telemetry.current_trace_id()),
         "error": f"{type(exc).__name__}: {exc}",
         "tags": dict(ev.tags),
         "data": _jsonable(ev.data),

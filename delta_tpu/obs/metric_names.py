@@ -89,6 +89,9 @@ GAUGES = frozenset({
 COUNTERS = frozenset({
     "obs.incidents.written",
     "obs.server.requests",
+    # -- distributed-trace spool (obs/trace_store) ------------------------
+    "trace.spansSpooled",         # spans appended to the JSONL spool
+    "trace.spansDropped",         # spans dropped by the byte cap / IO error
     "commit.conflicts",
     "maintenance.optimize.filesCompacted",
     "maintenance.optimize.filesWritten",
@@ -269,8 +272,10 @@ PUBLIC_API = {
                    "scrape_count", "counter_window", "quantile_window",
                    "histogram_labels", "series_snapshot", "reset"),
     "slo": ("SloObjective", "SloAlert", "SloBreach", "objectives",
-            "evaluate", "active_alerts", "priority_boost", "status",
-            "reset"),
+            "evaluate", "active_alerts", "priority_boost", "firing_count",
+            "status", "reset"),
+    "trace_store": ("install", "uninstall", "read_spools", "recent_traces",
+                    "stitch_trace", "analyze_trace", "reset"),
 }
 
 
@@ -334,6 +339,8 @@ DESCRIPTIONS = {
     # counters — obs layer
     "obs.incidents.written": "Flight-recorder incident files written.",
     "obs.server.requests": "HTTP requests served by the obs endpoint.",
+    "trace.spansSpooled": "Sampled spans appended to the distributed-trace JSONL spool.",
+    "trace.spansDropped": "Sampled spans dropped by the spool byte cap or an IO error.",
     "commit.conflicts": "Commits aborted on a genuine logical conflict.",
     "maintenance.optimize.filesCompacted": "Files removed by OPTIMIZE compaction.",
     "maintenance.optimize.filesWritten": "Files written by OPTIMIZE compaction.",

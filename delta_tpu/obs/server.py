@@ -9,8 +9,17 @@ Route          Payload
 ``/healthz``   ``{"status": "ok", ...}`` liveness JSON
 ``/events``    ring-buffer events as JSON; ``?prefix=delta.commit`` filters
                by dotted-boundary op-type prefix, ``?limit=N`` tails
-``/trace``     Chrome trace-event JSON (open spans included, clamped) —
-               save and load at https://ui.perfetto.dev
+``/trace``     Chrome trace-event JSON of THIS process's ring (open spans
+               included, clamped); ``?op=delta.commit`` filters by
+               dotted-boundary op prefix, ``?limit=N`` keeps the newest N
+               ring events — save and load at https://ui.perfetto.dev
+``/traces``    distributed-trace index from the spool directory
+               (``delta.tpu.trace.dir``): one row per stitched trace,
+               newest first (``?limit=N``, default 20)
+``/traces/<id>``  ONE stitched cross-process trace as Perfetto-loadable
+               Chrome-trace JSON; ``?analyze=1`` serves the critical-path /
+               straggler analysis instead
+               (:func:`delta_tpu.obs.trace_store.analyze_trace`)
 ``/doctor``    ``?path=/data/tbl`` → the table-health report JSON
                (:func:`delta_tpu.obs.doctor.doctor`)
 ``/router``    router audit ledger: miss stats, installed calibration
@@ -118,7 +127,33 @@ class _Handler(BaseHTTPRequestHandler):
                     events = events[-n:] if n else []
                 self._json([json.loads(e.to_json()) for e in events])
             elif route == "/trace":
-                self._json(telemetry.export_chrome_trace())
+                self._json(telemetry.export_chrome_trace(
+                    op_prefix=q.get("op", [""])[0],
+                    limit=_q_int(q, "limit")))
+            elif route == "/traces" or route.startswith("/traces/"):
+                from delta_tpu.obs import trace_store
+
+                tdir = conf.get("delta.tpu.trace.dir")
+                if not tdir:
+                    self._json(
+                        {"error": "delta.tpu.trace.dir is not set — "
+                                  "no spool to collect from"}, 400)
+                    return
+                if route == "/traces":
+                    self._json(trace_store.recent_traces(
+                        str(tdir), limit=_q_int(q, "limit", 20)))
+                    return
+                trace_id = route[len("/traces/"):]
+                if _q_int(q, "analyze", 0):
+                    payload = trace_store.analyze_trace(str(tdir), trace_id)
+                else:
+                    payload = trace_store.stitch_trace(str(tdir), trace_id)
+                if payload is None:
+                    self._json(
+                        {"error": f"no spooled spans for trace "
+                                  f"{trace_id!r}"}, 404)
+                    return
+                self._json(payload)
             elif route == "/doctor":
                 path = q.get("path", [None])[0]
                 if not path:
@@ -206,9 +241,10 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json({"error": f"unknown route {route!r}",
                             "routes": ["/metrics", "/healthz", "/events",
-                                       "/trace", "/doctor", "/router",
-                                       "/advisor", "/autopilot", "/fleet",
-                                       "/slo", "/replay"]}, 404)
+                                       "/trace", "/traces", "/traces/<id>",
+                                       "/doctor", "/router", "/advisor",
+                                       "/autopilot", "/fleet", "/slo",
+                                       "/replay"]}, 404)
         except Exception as e:  # noqa: BLE001 — a bad request must not kill the thread
             self._json({"error": f"{type(e).__name__}: {e}"}, 500)
 

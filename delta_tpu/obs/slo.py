@@ -55,7 +55,8 @@ from delta_tpu.utils import telemetry
 from delta_tpu.utils.config import conf
 
 __all__ = ["SloObjective", "SloAlert", "SloBreach", "objectives", "evaluate",
-           "active_alerts", "priority_boost", "status", "reset"]
+           "active_alerts", "priority_boost", "firing_count", "status",
+           "reset"]
 
 
 class SloBreach(Exception):
@@ -160,6 +161,9 @@ class SloAlert:
     observed: float                 # the fast-window observation that fired
     firing: bool = True
     cleared_at_ms: Optional[int] = None
+    #: exemplar: the last sampled trace id at fire time — the stitched
+    #: /traces/<id> view an operator jumps to from the alert
+    trace_id: Optional[str] = None
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -177,6 +181,7 @@ class SloAlert:
             "burnSlow": round(self.burn_slow, 3),
             "threshold": self.threshold,
             "observed": round(self.observed, 3),
+            "traceId": self.trace_id,
         }
 
 
@@ -266,7 +271,7 @@ def _record_incident(alert: SloAlert) -> None:
     ev = telemetry.UsageEvent(
         "delta.slo.alert", alert.fired_at_ms,
         tags={"objective": alert.objective, "table": alert.table or ""},
-        data=alert.to_dict())
+        data=alert.to_dict(), trace_id=alert.trace_id or "")
     try:
         flight_recorder.record_incident(ev, SloBreach(
             f"SLO {alert.objective} burning: fast {alert.burn_fast:.2f}x / "
@@ -330,7 +335,8 @@ def evaluate(now_ms: Optional[int] = None) -> List[Dict[str, Any]]:
                     path=row["path"], fired_at_ms=now,
                     burn_fast=row["burnFast"], burn_slow=row["burnSlow"],
                     threshold=row["threshold"],
-                    observed=float(row["fast"]["value"] or 0.0))
+                    observed=float(row["fast"]["value"] or 0.0),
+                    trace_id=telemetry.last_sampled_trace_id())
                 _ALERTS[key] = alert
                 fired.append(alert)
                 telemetry.bump_counter("slo.alerts.fired")
@@ -360,6 +366,14 @@ def evaluate(now_ms: Optional[int] = None) -> List[Dict[str, Any]]:
     for alert in fired:  # incidents outside the lock: file IO
         _record_incident(alert)
     return rows
+
+
+def firing_count() -> int:
+    """Currently-firing alerts as one lock-guarded sum — cheap enough for
+    the trace sampler's forced-sampling probe on every new root span
+    (`telemetry._slo_burning`), which must not read conf or build dicts."""
+    with _LOCK:
+        return sum(1 for a in _ALERTS.values() if a.firing)
 
 
 def active_alerts(path: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -35,6 +35,7 @@ __all__ = [
     "host_partition",
     "host_shard_indices",
     "lpt_assign",
+    "lpt_loads",
     "bytes_skew",
 ]
 
@@ -104,10 +105,20 @@ def lpt_assign(sizes: Sequence[int], count: int) -> List[List[int]]:
     return buckets
 
 
+def lpt_loads(sizes: Sequence[int],
+              assignment: Sequence[Sequence[int]]) -> List[int]:
+    """Per-bin byte loads of an assignment — the LPT-predicted cost shares.
+    The executor stamps these on its ``delta.dist.job`` span and the trace
+    analyzer (`obs/trace_store.analyze_trace`) diffs each worker's measured
+    busy time against its share, so a straggler shard is attributable to
+    either byte skew (predicted) or per-byte slowness (not predicted)."""
+    return [sum(int(sizes[j] or 0) for j in b) for b in assignment]
+
+
 def bytes_skew(sizes: Sequence[int], assignment: Sequence[Sequence[int]]) -> float:
     """max/mean per-host bytes ratio of an assignment — 1.0 is perfectly
     balanced; the zipf-100k regression gate in tests/bench watches this."""
-    per_host = [sum(int(sizes[j] or 0) for j in b) for b in assignment]
+    per_host = lpt_loads(sizes, assignment)
     if not per_host or sum(per_host) == 0:
         return 1.0
     mean = sum(per_host) / len(per_host)

@@ -30,9 +30,9 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from delta_tpu.parallel.distributed import bytes_skew, lpt_assign
+from delta_tpu.parallel.distributed import bytes_skew, lpt_assign, lpt_loads
 
 __all__ = ["ShardReport", "WorkerStats", "run_sharded", "default_workers"]
 
@@ -98,6 +98,13 @@ def run_sharded(
     defaults to :func:`default_workers`; 1 worker runs inline with no pool,
     so the single-shard leg of a scaling bench measures the job, not the
     machinery.
+
+    The whole job runs inside a ``delta.dist.job`` span; each pool worker
+    opens a ``delta.dist.worker`` span (adopting the job's span context —
+    pool threads do not inherit contextvars) and each item a
+    ``delta.dist.item`` span carrying its index/bytes/stolen flag, so a
+    distributed trace can attribute the makespan to a specific shard and
+    item (`obs/trace_store.analyze_trace`).
     """
     from delta_tpu.utils import telemetry
     from delta_tpu.utils.config import conf
@@ -111,99 +118,126 @@ def run_sharded(
     telemetry.bump_counter("dist.jobs")
     telemetry.bump_counter("dist.items", n)
 
-    t0 = time.perf_counter()
-    if workers <= 1 or n <= 1:
-        stats = WorkerStats()
-        for j in range(n):
-            it0 = time.perf_counter()
-            results[j] = fn(items[j])
-            d = time.perf_counter() - it0
-            stats.items += 1
-            stats.bytes += weights[j]
-            stats.busy_s += d
-            telemetry.observe("dist.item.duration_ms", d * 1e3, job=label)
-        return ShardReport(
+    with telemetry.record_operation(
+        "delta.dist.job", {"items": n, "workers": workers}, job=label
+    ) as job_ev:
+        t0 = time.perf_counter()
+        if workers <= 1 or n <= 1:
+            job_ev.data.update(skew=1.0, lptBytes=[sum(weights)])
+            stats = WorkerStats()
+            for j in range(n):
+                it0 = time.perf_counter()
+                with telemetry.record_operation(
+                    "delta.dist.item", {"index": j, "bytes": weights[j]},
+                    job=label,
+                ):
+                    results[j] = fn(items[j])
+                d = time.perf_counter() - it0
+                stats.items += 1
+                stats.bytes += weights[j]
+                stats.busy_s += d
+                telemetry.observe("dist.item.duration_ms", d * 1e3, job=label)
+            return ShardReport(
+                results=results,
+                wall_s=time.perf_counter() - t0,
+                workers=1,
+                steals=0,
+                skew=1.0,
+                per_worker={0: stats},
+            )
+
+        seed = lpt_assign(weights, workers)
+        skew = bytes_skew(weights, seed)
+        # the per-worker LPT byte shares: what each shard SHOULD cost if
+        # bytes predicted time perfectly — analyze_trace diffs the worker
+        # spans' measured busy time against exactly these
+        job_ev.data.update(
+            skew=round(skew, 4), lptBytes=lpt_loads(weights, seed))
+        carrier = telemetry.span_context()
+        stealing = conf.get_bool("delta.tpu.distributed.workStealing.enabled", True)
+        deques: List[List[int]] = [list(b) for b in seed]
+        remaining = [sum(weights[j] for j in b) for b in deques]
+        lock = threading.Lock()
+        stop = threading.Event()
+        per_worker = {w: WorkerStats() for w in range(workers)}
+        steals = 0
+        first_error: List[BaseException] = []
+
+        def _take(w: int) -> Optional[Tuple[int, bool]]:
+            nonlocal steals
+            with lock:
+                if stop.is_set():
+                    return None
+                if deques[w]:
+                    j = deques[w].pop(0)
+                    remaining[w] -= weights[j]
+                    return j, False
+                if not stealing:
+                    return None
+                # steal the tail of the most-loaded deque: the tail holds that
+                # worker's smallest seeded items, so the victim keeps the head
+                # it is already streaming through
+                victim = max(
+                    (v for v in range(workers) if deques[v]),
+                    key=lambda v: (remaining[v], -v),
+                    default=None,
+                )
+                if victim is None:
+                    return None
+                j = deques[victim].pop()
+                remaining[victim] -= weights[j]
+                steals += 1
+                per_worker[w].stolen += 1
+                telemetry.bump_counter("dist.steals")
+                return j, True
+
+        def _worker(w: int) -> None:
+            stats = per_worker[w]
+            with telemetry.adopt_span_context(carrier), \
+                    telemetry.record_operation(
+                        "delta.dist.worker", job=label, worker=str(w)):
+                while True:
+                    taken = _take(w)
+                    if taken is None:
+                        return
+                    j, stolen = taken
+                    it0 = time.perf_counter()
+                    try:
+                        with telemetry.record_operation(
+                            "delta.dist.item",
+                            {"index": j, "bytes": weights[j],
+                             "stolen": stolen},
+                            job=label,
+                        ):
+                            results[j] = fn(items[j])
+                    except BaseException as exc:  # propagate the FIRST failure
+                        with lock:
+                            if not first_error:
+                                first_error.append(exc)
+                        stop.set()
+                        return
+                    d = time.perf_counter() - it0
+                    stats.items += 1
+                    stats.bytes += weights[j]
+                    stats.busy_s += d
+                    telemetry.observe("dist.item.duration_ms", d * 1e3,
+                                      job=label)
+
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="delta-dist-exec"
+        ) as pool:
+            futures = [pool.submit(_worker, w) for w in range(workers)]
+            for f in futures:
+                f.result()
+        if first_error:
+            raise first_error[0]
+        report = ShardReport(
             results=results,
             wall_s=time.perf_counter() - t0,
-            workers=1,
-            steals=0,
-            skew=1.0,
-            per_worker={0: stats},
+            workers=workers,
+            steals=steals,
+            skew=skew,
+            per_worker=per_worker,
         )
-
-    seed = lpt_assign(weights, workers)
-    skew = bytes_skew(weights, seed)
-    stealing = conf.get_bool("delta.tpu.distributed.workStealing.enabled", True)
-    deques: List[List[int]] = [list(b) for b in seed]
-    remaining = [sum(weights[j] for j in b) for b in deques]
-    lock = threading.Lock()
-    stop = threading.Event()
-    per_worker = {w: WorkerStats() for w in range(workers)}
-    steals = 0
-    first_error: List[BaseException] = []
-
-    def _take(w: int) -> Optional[int]:
-        nonlocal steals
-        with lock:
-            if stop.is_set():
-                return None
-            if deques[w]:
-                j = deques[w].pop(0)
-                remaining[w] -= weights[j]
-                return j
-            if not stealing:
-                return None
-            # steal the tail of the most-loaded deque: the tail holds that
-            # worker's smallest seeded items, so the victim keeps the head
-            # it is already streaming through
-            victim = max(
-                (v for v in range(workers) if deques[v]),
-                key=lambda v: (remaining[v], -v),
-                default=None,
-            )
-            if victim is None:
-                return None
-            j = deques[victim].pop()
-            remaining[victim] -= weights[j]
-            steals += 1
-            per_worker[w].stolen += 1
-            telemetry.bump_counter("dist.steals")
-            return j
-
-    def _worker(w: int) -> None:
-        stats = per_worker[w]
-        while True:
-            j = _take(w)
-            if j is None:
-                return
-            it0 = time.perf_counter()
-            try:
-                results[j] = fn(items[j])
-            except BaseException as exc:  # propagate the FIRST failure
-                with lock:
-                    if not first_error:
-                        first_error.append(exc)
-                stop.set()
-                return
-            d = time.perf_counter() - it0
-            stats.items += 1
-            stats.bytes += weights[j]
-            stats.busy_s += d
-            telemetry.observe("dist.item.duration_ms", d * 1e3, job=label)
-
-    with ThreadPoolExecutor(
-        max_workers=workers, thread_name_prefix="delta-dist-exec"
-    ) as pool:
-        futures = [pool.submit(_worker, w) for w in range(workers)]
-        for f in futures:
-            f.result()
-    if first_error:
-        raise first_error[0]
-    return ShardReport(
-        results=results,
-        wall_s=time.perf_counter() - t0,
-        workers=workers,
-        steals=steals,
-        skew=skew,
-        per_worker=per_worker,
-    )
+        job_ev.data.update(steals=steals, wallMs=int(report.wall_s * 1e3))
+        return report

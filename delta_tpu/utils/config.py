@@ -165,6 +165,17 @@ class SqlConf:
         "delta.tpu.telemetry.enabled": True,
         # Telemetry ring-buffer capacity (events + spans).
         "delta.tpu.telemetry.bufferSize": 4096,
+        # Distributed-trace plane (utils/telemetry + obs/trace_store).
+        # Head-sampling probability for NEW root traces; errors and
+        # SLO-burn windows force-sample regardless.
+        "delta.tpu.trace.sampleRate": 1.0,
+        # Directory receiving per-process JSONL span spools (and the
+        # collector's stitch source for /traces). None = no spooling —
+        # spans stay in the in-process ring only.
+        "delta.tpu.trace.dir": None,
+        # Per-process spool byte cap; past it spans drop (counted in
+        # trace.spansDropped) instead of filling the disk.
+        "delta.tpu.trace.maxBytes": 32 * 1024 * 1024,
         # Operator HTTP endpoint (obs/server): serve /metrics, /healthz,
         # /events, /trace, /doctor on this port. None = no server; 0 = an
         # ephemeral port (tests). Opt-in only — nothing listens by default.
@@ -421,6 +432,13 @@ class SqlConf:
     def __init__(self):
         self._values: Dict[str, Any] = {}
         self._lock = threading.RLock()
+        self._generation = 0
+
+    def generation(self) -> int:
+        """Monotonic mutation counter, bumped on every set/unset (including
+        ``set_temporarily`` enter/exit). Hot paths cache conf-derived values
+        keyed on this instead of paying a locked lookup per call."""
+        return self._generation
 
     def get(self, key: str, default: Any = None) -> Any:
         with self._lock:
@@ -452,10 +470,12 @@ class SqlConf:
     def set(self, key: str, value: Any) -> None:
         with self._lock:
             self._values[key] = value
+            self._generation += 1
 
     def unset(self, key: str) -> None:
         with self._lock:
             self._values.pop(key, None)
+            self._generation += 1
 
     def set_temporarily(self, **kv: Any):
         """Context manager: ``with conf.set_temporarily(**{'k': v}): ...``"""
@@ -469,6 +489,7 @@ class SqlConf:
                     with outer._lock:
                         self._saved[key] = outer._values.get(key, _MISSING)
                         outer._values[key] = v
+                        outer._generation += 1
                 return outer
 
             def __exit__(self, *exc):
@@ -478,6 +499,7 @@ class SqlConf:
                             outer._values.pop(key, None)
                         else:
                             outer._values[key] = old
+                        outer._generation += 1
                 return False
 
         return _Ctx()

@@ -30,6 +30,18 @@ Everything lands in one in-process ring buffer (size:
 logger. ``delta.tpu.telemetry.enabled=False`` suppresses events and spans
 entirely (zero allocation on the hot path); counters keep working — they are
 cheap and the serving-envelope numbers must survive an event blackout.
+
+Spans are also DISTRIBUTED traces: every root span mints a 128-bit hex
+``trace_id``, span ids are namespaced with a random per-process high word so
+two hosts can never collide, and :func:`span_context(wire=True)` serializes
+the identity as a traceparent-shaped string that
+:func:`adopt_span_context` (and the ``DELTA_TPU_TRACEPARENT`` environment
+variable, for spawned worker processes) accepts — a sharded job's per-item /
+per-worker / per-host spans all parent under the coordinator's root. Sampled
+traces (head sampling via ``delta.tpu.trace.sampleRate``; forced on error
+and while SLO objectives burn) additionally stream each completed span to
+registered span sinks — ``obs/trace_store`` spools them as JSONL for
+cross-process stitching.
 """
 from __future__ import annotations
 
@@ -40,7 +52,9 @@ import itertools
 import json
 import logging
 import os
+import random
 import re
+import sys
 import threading
 import time
 from bisect import bisect_left
@@ -61,6 +75,8 @@ __all__ = [
     "HISTOGRAM_BUCKETS", "span_stack_snapshot", "add_failure_hook",
     "remove_failure_hook", "span_context", "adopt_span_context", "propagated",
     "histogram_rows", "bucket_quantile", "drop_labeled_series",
+    "current_trace_id", "last_sampled_trace_id", "add_span_sink",
+    "remove_span_sink", "TRACEPARENT_ENV",
 ]
 
 
@@ -81,6 +97,11 @@ class UsageEvent:
     duration_us: Optional[int] = None
     thread_id: int = 0
     thread_name: str = ""
+    # distributed-trace identity: 32-hex trace id shared across processes,
+    # plus the span start on the EPOCH clock (µs) — perf_counter is
+    # per-process and cannot order spans from two hosts on one timeline
+    trace_id: str = ""
+    wall_us: int = 0
 
     def to_json(self) -> str:
         return json.dumps(
@@ -93,6 +114,7 @@ class UsageEvent:
                 "error": self.error,
                 "spanId": self.span_id or None,
                 "parentId": self.parent_id,
+                "traceId": self.trace_id or None,
             },
             separators=(",", ":"),
             default=str,
@@ -102,6 +124,10 @@ class UsageEvent:
 _BUFFER: Deque[UsageEvent] = deque(maxlen=4096)
 _LOCK = threading.Lock()
 _SPAN_IDS = itertools.count(1)
+# span ids are globally unique across a distributed job: a random 32-bit
+# per-process namespace in the high word, the local counter in the low —
+# two hosts' spools can stitch into one trace without id collisions
+_SPAN_NS = int.from_bytes(os.urandom(4), "big") << 32
 # innermost-last tuple of active span ids for THIS thread/context
 _SPAN_STACK: "contextvars.ContextVar[Tuple[int, ...]]" = contextvars.ContextVar(
     "delta_telemetry_span_stack", default=()
@@ -115,18 +141,181 @@ _ACTIVE: Dict[int, UsageEvent] = {}
 _FAILURE_HOOKS: List[Any] = []
 
 
+# -- distributed trace identity ----------------------------------------------
+
+#: environment variable a coordinator sets on spawned worker processes so
+#: every root span in the child adopts the coordinator's trace
+TRACEPARENT_ENV = "DELTA_TPU_TRACEPARENT"
+
+
+class _TraceState:
+    """Mutable per-trace identity: the 128-bit hex trace id, the head-sampling
+    decision (mutable — an error anywhere in the trace force-samples it), and
+    the remote parent span id when the trace was adopted over the wire."""
+
+    __slots__ = ("trace_id", "sampled", "remote_parent")
+
+    def __init__(self, trace_id: str, sampled: bool,
+                 remote_parent: Optional[int] = None):
+        self.trace_id = trace_id
+        self.sampled = sampled
+        self.remote_parent = remote_parent
+
+
+# the current trace for THIS context: set by the root span (reset when it
+# closes) or by adopt_span_context, so sequential roots get fresh traces
+_TRACE: "contextvars.ContextVar[Optional[_TraceState]]" = contextvars.ContextVar(
+    "delta_telemetry_trace", default=None
+)
+# process-wide remote parent parsed once from TRACEPARENT_ENV (spawned
+# workers: EVERY root span in the process joins the coordinator's trace)
+_PROCESS_REMOTE: Optional[_TraceState] = None
+_PROCESS_REMOTE_READ = False
+# completed spans of sampled traces stream here: fn(event) after the span
+# closes (obs/trace_store spools them as JSONL). Lazily installed on the
+# first sampled close so importing telemetry never drags in the obs layer.
+_SPAN_SINKS: List[Any] = []
+_SINKS_PROBED = False
+_LAST_SAMPLED_TRACE: str = ""
+
+
+def _parse_traceparent(carrier: str) -> _TraceState:
+    """Parse a ``00-<32hex traceId>-<16hex parentSpanId>-<2hex flags>``
+    wire carrier (traceparent-shaped; flags bit 0 = sampled)."""
+    parts = carrier.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        raise ValueError(f"malformed trace carrier: {carrier!r}")
+    int(parts[1], 16)
+    parent = int(parts[2], 16)
+    sampled = bool(int(parts[3], 16) & 1)
+    return _TraceState(parts[1], sampled, parent or None)
+
+
+def _process_remote() -> Optional[_TraceState]:
+    global _PROCESS_REMOTE, _PROCESS_REMOTE_READ
+    if not _PROCESS_REMOTE_READ:
+        _PROCESS_REMOTE_READ = True
+        raw = os.environ.get(TRACEPARENT_ENV)
+        if raw:
+            try:
+                _PROCESS_REMOTE = _parse_traceparent(raw)
+            except ValueError:
+                logger.warning("ignoring malformed %s=%r", TRACEPARENT_ENV, raw)
+    return _PROCESS_REMOTE
+
+
+def _slo_burning() -> bool:
+    """True while any SLO objective fires — forced sampling during burn
+    windows so the alert always has an exemplar trace. Probed via
+    sys.modules: telemetry must not import the obs layer, and a process
+    that never evaluated SLOs pays one dict lookup."""
+    slo = sys.modules.get("delta_tpu.obs.slo")
+    if slo is None:
+        return False
+    try:
+        return slo.firing_count() > 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _new_trace_state() -> _TraceState:
+    remote = _process_remote()
+    if remote is not None:
+        return _TraceState(remote.trace_id, remote.sampled,
+                           remote.remote_parent)
+    rate = _conf_snapshot()[3]
+    if rate >= 1.0:
+        sampled = True
+    else:
+        sampled = rate > 0.0 and random.random() < rate
+        if not sampled and _slo_burning():
+            sampled = True
+    return _TraceState(os.urandom(16).hex(), sampled)
+
+
+def _emit_span(ev: UsageEvent) -> None:
+    """Stream a completed span of a sampled trace to the sinks (called
+    OUTSIDE ``_LOCK`` — sinks take their own locks and read conf)."""
+    global _SINKS_PROBED
+    if not _SINKS_PROBED:
+        _SINKS_PROBED = True
+        try:
+            from delta_tpu.obs import trace_store
+
+            trace_store.install()
+        except Exception:  # noqa: BLE001 — tracing must never break the op
+            logger.debug("trace spool install failed", exc_info=True)
+    for sink in list(_SPAN_SINKS):
+        try:
+            sink(ev)
+        except Exception:  # noqa: BLE001
+            logger.debug("trace span sink raised", exc_info=True)
+
+
+def add_span_sink(fn) -> None:
+    """Register ``fn(event)`` to receive every completed span/event of a
+    sampled trace. Sinks must be fast and must not raise."""
+    if fn not in _SPAN_SINKS:
+        _SPAN_SINKS.append(fn)
+
+
+def remove_span_sink(fn) -> None:
+    try:
+        _SPAN_SINKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id of the current context (inside a span or an adopted
+    wire context), or None."""
+    t = _TRACE.get()
+    return t.trace_id if t is not None else None
+
+
+def last_sampled_trace_id() -> Optional[str]:
+    """The most recently completed SAMPLED span's trace id — the exemplar
+    an SLO alert or incident attaches when it has no ambient span."""
+    return _LAST_SAMPLED_TRACE or None
+
+
+# (generation, enabled, buffer_size, sample_rate) — the conf reads on the
+# per-span hot path, re-resolved only when conf mutates. Benign race: a
+# stale read costs one redundant resolve, never a wrong value for the
+# generation it is keyed to.
+_CONF_CACHE: Tuple[int, bool, int, float] = (-1, True, 4096, 1.0)
+
+
+def _conf_snapshot() -> Tuple[int, bool, int, float]:
+    global _CONF_CACHE
+    cached = _CONF_CACHE
+    gen = conf.generation()
+    if cached[0] == gen:
+        return cached
+    enabled = conf.get_bool("delta.tpu.telemetry.enabled", True)
+    try:
+        size = int(conf.get("delta.tpu.telemetry.bufferSize", 4096))
+    except (TypeError, ValueError):
+        size = 4096
+    if size <= 0:
+        size = 4096
+    try:
+        rate = float(conf.get("delta.tpu.trace.sampleRate", 1.0))
+    except (TypeError, ValueError):
+        rate = 1.0
+    cached = (gen, enabled, size, rate)
+    _CONF_CACHE = cached
+    return cached
+
+
 def _enabled() -> bool:
-    return conf.get_bool("delta.tpu.telemetry.enabled", True)
+    return _conf_snapshot()[1]
 
 
 def _buffer_size() -> int:
     """Resolve the configured ring size OUTSIDE the telemetry lock — the
     conf lock must never be taken while holding ``_LOCK``."""
-    try:
-        size = int(conf.get("delta.tpu.telemetry.bufferSize", 4096))
-    except (TypeError, ValueError):
-        size = 4096
-    return size if size > 0 else 4096
+    return _conf_snapshot()[2]
 
 
 def _buffer_locked(size: int) -> Deque[UsageEvent]:
@@ -145,16 +334,22 @@ def record_event(op_type: str, data: Optional[Dict[str, Any]] = None, **tags: st
     if not _enabled():
         return
     th = threading.current_thread()
+    tstate = _TRACE.get()
     ev = UsageEvent(op_type, int(time.time() * 1000),
                     tags={k: str(v) for k, v in tags.items()},
                     data=data or {},
                     parent_id=(_SPAN_STACK.get() or (None,))[-1],
                     start_us=_now_us(),
-                    thread_id=th.ident or 0, thread_name=th.name)
+                    thread_id=th.ident or 0, thread_name=th.name,
+                    trace_id=tstate.trace_id if tstate else "",
+                    wall_us=time.time_ns() // 1000)
     size = _buffer_size()
     with _LOCK:
         _buffer_locked(size).append(ev)
-    logger.debug("%s", ev.to_json())
+    if tstate is not None and tstate.sampled:
+        _emit_span(ev)
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug("%s", ev.to_json())
 
 
 @contextlib.contextmanager
@@ -169,14 +364,23 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
         return
     th = threading.current_thread()
     stack = _SPAN_STACK.get()
+    tstate = _TRACE.get()
+    ttoken = None
+    if tstate is None:
+        # this is a trace root: mint the 128-bit trace id (or join the
+        # process-wide remote parent) and decide head sampling once
+        tstate = _new_trace_state()
+        ttoken = _TRACE.set(tstate)
     ev = UsageEvent(op_type, int(time.time() * 1000),
                     tags={k: str(v) for k, v in tags.items()},
                     data=dict(data or {}),
-                    span_id=next(_SPAN_IDS),
-                    parent_id=stack[-1] if stack else None,
+                    span_id=_SPAN_NS | next(_SPAN_IDS),
+                    parent_id=stack[-1] if stack else tstate.remote_parent,
                     depth=len(stack),
                     start_us=_now_us(),
-                    thread_id=th.ident or 0, thread_name=th.name)
+                    thread_id=th.ident or 0, thread_name=th.name,
+                    trace_id=tstate.trace_id,
+                    wall_us=time.time_ns() // 1000)
     with _LOCK:
         _ACTIVE[ev.span_id] = ev
     token = _SPAN_STACK.set(stack + (ev.span_id,))
@@ -186,6 +390,9 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
             yield ev
     except BaseException as e:
         ev.error = f"{type(e).__name__}: {e}"
+        # an error anywhere force-samples the whole trace: the incident the
+        # flight recorder writes must link to a spooled, stitchable trace
+        tstate.sampled = True
         # span still on the stack and in _ACTIVE here: hooks see the full
         # failing span chain via span_stack_snapshot()
         if _FAILURE_HOOKS:
@@ -197,6 +404,8 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
         raise
     finally:
         _SPAN_STACK.reset(token)
+        if ttoken is not None:
+            _TRACE.reset(ttoken)
         dur_us = (time.perf_counter_ns() - start_ns) // 1000
         ev.duration_us = int(dur_us)
         ev.duration_ms = int(dur_us // 1000)
@@ -204,7 +413,14 @@ def record_operation(op_type: str, data: Optional[Dict[str, Any]] = None, **tags
         with _LOCK:
             _ACTIVE.pop(ev.span_id, None)
             _buffer_locked(size).append(ev)
-        logger.debug("%s", ev.to_json())
+        if tstate.sampled:
+            global _LAST_SAMPLED_TRACE
+            _LAST_SAMPLED_TRACE = tstate.trace_id
+            _emit_span(ev)
+        # to_json serialises tags+data — only pay for it when debug logging
+        # is actually on (this is the per-span hot path)
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug("%s", ev.to_json())
 
 
 def current_span() -> Optional[UsageEvent]:
@@ -257,22 +473,55 @@ def span_stack_snapshot() -> List[Dict[str, Any]]:
 # operation while keeping their own thread lane in the trace.
 
 
-def span_context() -> Tuple[int, ...]:
+class SpanContextCarrier(tuple):
+    """In-process carrier: compares and unpacks exactly like the legacy
+    span-id tuple, plus the trace state (``.trace``) so adopting threads
+    keep the trace id and sampling decision."""
+
+    trace: Optional[_TraceState] = None
+
+
+def span_context(wire: bool = False) -> Any:
     """The open span chain of THIS context as an opaque carrier — capture at
     task-submit time, hand to the worker thread, restore with
-    :func:`adopt_span_context`."""
-    return _SPAN_STACK.get()
+    :func:`adopt_span_context`.
+
+    With ``wire=True``, returns instead a serializable traceparent-shaped
+    string (``00-<traceId>-<parentSpanId>-<flags>``) for crossing a PROCESS
+    boundary — put it in a job payload or the ``DELTA_TPU_TRACEPARENT``
+    environment of a spawned worker. None when no trace is active."""
+    stack = _SPAN_STACK.get()
+    tstate = _TRACE.get()
+    if wire:
+        if tstate is None:
+            return None
+        parent = stack[-1] if stack else (tstate.remote_parent or 0)
+        return "00-%s-%016x-%s" % (tstate.trace_id, parent,
+                                   "01" if tstate.sampled else "00")
+    carrier = SpanContextCarrier(stack)
+    carrier.trace = tstate
+    return carrier
 
 
 @contextlib.contextmanager
-def adopt_span_context(carrier: Tuple[int, ...]) -> Iterator[None]:
-    """Run the body under ``carrier`` (a :func:`span_context` capture): spans
-    opened inside parent under the carrier's innermost span instead of
-    starting an orphan root in the worker thread."""
-    token = _SPAN_STACK.set(tuple(carrier))
+def adopt_span_context(carrier) -> Iterator[None]:
+    """Run the body under ``carrier`` (a :func:`span_context` capture, or its
+    ``wire=True`` string form): spans opened inside parent under the
+    carrier's innermost span instead of starting an orphan root in the
+    worker thread — and they join the carrier's trace."""
+    if isinstance(carrier, str):
+        tstate: Optional[_TraceState] = _parse_traceparent(carrier)
+        stack: Tuple[int, ...] = ()
+    else:
+        tstate = getattr(carrier, "trace", None)
+        stack = tuple(carrier)
+    token = _SPAN_STACK.set(stack)
+    ttoken = _TRACE.set(tstate) if tstate is not None else None
     try:
         yield
     finally:
+        if ttoken is not None:
+            _TRACE.reset(ttoken)
         _SPAN_STACK.reset(token)
 
 
@@ -290,13 +539,17 @@ def propagated(fn):
     carrier = _SPAN_STACK.get()
     if not carrier:
         return fn
+    tstate = _TRACE.get()
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         token = _SPAN_STACK.set(carrier)
+        ttoken = _TRACE.set(tstate) if tstate is not None else None
         try:
             return fn(*args, **kwargs)
         finally:
+            if ttoken is not None:
+                _TRACE.reset(ttoken)
             _SPAN_STACK.reset(token)
 
     return wrapper
@@ -670,7 +923,8 @@ def bench_snapshot(top: int = 12,
 _GENERIC_THREAD = re.compile(r"(Thread-\d+.*|ThreadPoolExecutor-\d+_\d+)")
 
 
-def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+def export_chrome_trace(path: Optional[str] = None, op_prefix: str = "",
+                        limit: Optional[int] = None) -> Dict[str, Any]:
     """Export the event ring buffer as Chrome trace-event JSON.
 
     Spans become complete ("X") events with real durations; point events
@@ -681,7 +935,12 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
     keep multi-writer traces readable. Load the result in
     https://ui.perfetto.dev or ``chrome://tracing``; with the JAX profiler
     active, span names also appear as ``delta/...`` named scopes on the
-    device timeline."""
+    device timeline.
+
+    ``op_prefix`` keeps only ops on a dotted-name boundary match
+    (``delta.commit`` matches ``delta.commit.*``); ``limit`` keeps only the
+    NEWEST N ring events (open spans always export — they are the current
+    operation)."""
     pid = os.getpid()
     now_us = _now_us()
     with _LOCK:
@@ -695,7 +954,12 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
              ev.span_id, ev.parent_id, ev.start_us,
              max(0, now_us - ev.start_us))
             for ev in sorted(_ACTIVE.values(), key=lambda e: e.start_us)
+            if _prefix_match(ev.op_type, op_prefix)
         ]
+    if op_prefix:
+        events = [e for e in events if _prefix_match(e.op_type, op_prefix)]
+    if limit is not None and limit >= 0:
+        events = events[-limit:] if limit else []
     rows: List[Dict[str, Any]] = []
     seen_tids: Dict[int, str] = {}
 
@@ -725,6 +989,8 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
             args["spanId"] = ev.span_id
         if ev.parent_id:
             args["parentId"] = ev.parent_id
+        if ev.trace_id:
+            args["traceId"] = ev.trace_id
         row: Dict[str, Any] = {
             "name": ev.op_type,
             "cat": "delta",

@@ -47,6 +47,16 @@ def dist_body(proc: int, n_procs: int, table: str, out_dir: str,
     from delta_tpu.commands.optimize import OptimizeCommand
     from delta_tpu.exec.scan import scan_to_table
 
+    # distributed tracing: the parent exports DELTA_TPU_TRACEPARENT (adopted
+    # lazily by telemetry itself) and the spool directory; with the dir set,
+    # every span this worker runs lands in its own JSONL spool for the
+    # parent's collector to stitch
+    trace_dir = os.environ.get("DELTA_TPU_TRACE_DIR")
+    if trace_dir:
+        from delta_tpu.utils.config import conf as _conf
+
+        _conf.set("delta.tpu.trace.dir", trace_dir)
+
     result = {"proc": proc}
     log = DeltaLog.for_table(table)
     snap = log.update()

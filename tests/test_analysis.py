@@ -465,6 +465,57 @@ def f():
         and "obs.stray" in msgs and "op.stray" in msgs
 
 
+def test_metric_name_constant_resolves_to_catalog():
+    # a bare-name first argument resolves when the file binds it exactly
+    # once as a module-level constant string — the `_METRIC = "x.y"` idiom
+    # can no longer hide an uncataloged call site
+    src = '''
+from delta_tpu.utils import telemetry
+
+_HIT = "obs.hits"
+_STRAY = "obs.veryStray"
+_REBOUND = "obs.rebound"
+_ANN: str = "op.stray"
+
+def f(flag):
+    global _REBOUND
+    telemetry.bump_counter(_HIT)      # cataloged: quiet
+    telemetry.bump_counter(_STRAY)    # resolved, uncataloged: fires
+    telemetry.observe(_ANN, 2.0)      # AnnAssign resolves too: fires
+    telemetry.bump_counter(_REBOUND)  # global-declared: opaque, quiet
+    local = "obs.local"
+    telemetry.bump_counter(local)     # shadowable local binding: quiet
+'''
+    fs = _run(MetricCatalogPass(), {
+        "delta_tpu/obs/metric_names.py": _MINI_CATALOG,
+        "delta_tpu/exec/mod.py": src,
+    })
+    assert _rules(fs) == ["metric-uncataloged"] and len(fs) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "obs.veryStray" in msgs and "op.stray" in msgs
+    assert "obs.hits" not in msgs and "obs.rebound" not in msgs
+
+
+def test_metric_name_shadowed_constant_stays_opaque():
+    # the same identifier bound twice anywhere in the file — a parameter, a
+    # loop variable, a second assign — must not resolve: we count bindings
+    # instead of doing scope analysis, so shadowing means silence, not a
+    # wrong-name finding
+    src = '''
+from delta_tpu.utils import telemetry
+
+_NAME = "obs.aliased"
+
+def f(_NAME):
+    telemetry.bump_counter(_NAME)
+'''
+    fs = _run(MetricCatalogPass(), {
+        "delta_tpu/obs/metric_names.py": _MINI_CATALOG,
+        "delta_tpu/exec/mod.py": src,
+    })
+    assert fs == []
+
+
 def test_metric_overlap_and_obs_feed_counter_rule():
     catalog = _MINI_CATALOG.replace(
         'ENGINE_COUNTERS = frozenset({"scan.files"})',

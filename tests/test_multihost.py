@@ -109,11 +109,13 @@ def _mk_dist_table(path: str, parts: int = 4, files_per: int = 3,
             }), partition_columns=["part"]).run()
 
 
-def _run_workers(tmp_path, table: str, mode: str, out_name: str):
+def _run_workers(tmp_path, table: str, mode: str, out_name: str,
+                 extra_env=None):
     out_dir = str(tmp_path / out_name)
     os.makedirs(out_dir)
     port = _free_port()
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               **(extra_env or {}))
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
@@ -194,6 +196,97 @@ def test_two_process_sharded_optimize_merge_identity(tmp_path):
     assert results[0]["final_ids"] == results[1]["final_ids"]
     solo_files = DeltaLog.for_table(solo).update().num_of_files
     assert results[0]["final_files"] == results[1]["final_files"] == solo_files
+
+
+def _mk_zipf_table(path: str, parts: int = 4, files_per: int = 2) -> int:
+    """Partitioned table with zipf-skewed partition bytes (partition p holds
+    ~1/(p+1) of the head's rows) — the workload where per-shard skew
+    dominates makespan and the straggler analysis has something to name."""
+    log = DeltaLog.for_table(path)
+    base = 0
+    for p in range(parts):
+        rows = max(256 // (p + 1), 16)
+        for _f in range(files_per):
+            WriteIntoDelta(log, "append", pa.table({
+                "id": np.arange(base, base + rows, dtype=np.int64),
+                "part": pa.array([f"p{p}"] * rows),
+                "v": np.arange(base, base + rows, dtype=np.float64),
+            }), partition_columns=["part"]).run()
+            base += rows
+    return base
+
+
+def test_two_process_distributed_optimize_stitches_one_trace(tmp_path):
+    """The tentpole acceptance: a 2-process distributed OPTIMIZE under a
+    coordinator root span produces ONE stitched trace — every span in every
+    process's spool carries the coordinator's trace_id, parents resolve into
+    a single tree, the stitched Chrome-trace span count equals the sum of
+    all spools, and analyze_trace names the straggler shard and its makespan
+    delta vs the LPT byte-share prediction on a zipf-skewed table."""
+    from delta_tpu.obs import trace_store
+    from delta_tpu.utils import telemetry
+    from delta_tpu.utils.config import conf
+
+    table = str(tmp_path / "table")
+    _mk_zipf_table(table)
+    trace_dir = str(tmp_path / "spool")
+    os.makedirs(trace_dir)
+
+    with conf.set_temporarily(**{"delta.tpu.trace.dir": trace_dir,
+                                 "delta.tpu.trace.sampleRate": 1.0}):
+        with telemetry.record_operation("delta.test.coordinator") as root:
+            wire = telemetry.span_context(wire=True)
+            assert wire is not None and wire.split("-")[1] == root.trace_id
+            procs, outs, results = _run_workers(
+                tmp_path, table, "dist", "out",
+                extra_env={"DELTA_TPU_TRACEPARENT": wire,
+                           "DELTA_TPU_TRACE_DIR": trace_dir})
+    trace_store.reset()  # release the coordinator's spool handle
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se.decode()[-3000:]
+    assert sorted(results) == [0, 1]
+
+    trace_id = root.trace_id
+    assert len(trace_id) == 32
+
+    # ONE trace: every spooled span in every process carries the
+    # coordinator's trace id, and parents resolve into a single tree
+    all_rows = trace_store.read_spools(trace_dir)
+    assert {r["traceId"] for r in all_rows} == {trace_id}
+    ids = {r["spanId"] for r in all_rows}
+    orphans = [r for r in all_rows
+               if r["parentId"] is not None and r["parentId"] not in ids]
+    assert orphans == []
+    roots = [r for r in all_rows if r["parentId"] is None]
+    assert [r["op"] for r in roots] == ["delta.test.coordinator"]
+
+    # stitched Chrome trace: span count == sum of both hosts' spools (plus
+    # the coordinator's), three distinct process lanes
+    trace = trace_store.stitch_trace(trace_dir, trace_id)
+    rows = [r for r in trace["traceEvents"] if r.get("cat") == "delta"]
+    assert len(rows) == len(all_rows)
+    assert all(r["args"]["traceId"] == trace_id for r in rows)
+    assert len({r["pid"] for r in rows}) == 3  # coordinator + 2 workers
+
+    # straggler analysis: the sharded OPTIMIZE jobs name their slowest
+    # shard and its delta vs the LPT-predicted byte share
+    analysis = trace_store.analyze_trace(trace_dir, trace_id)
+    assert analysis["rootOp"] == "delta.test.coordinator"
+    assert analysis["spans"] == len(all_rows)
+    assert analysis["criticalPath"][0]["op"] == "delta.test.coordinator"
+    assert len(analysis["criticalPath"]) >= 2
+    jobs = [j for j in analysis["jobs"] if j["label"] == "optimize"]
+    assert len(jobs) == 2  # one sharded job per worker process
+    assert {j["pid"] for j in jobs} == {r["pid"] for r in rows} - \
+        {roots[0]["pid"]}
+    sharded = [j for j in jobs if j["shards"]]
+    assert sharded, "no pool-path OPTIMIZE job produced worker shards"
+    for j in sharded:
+        s = j["straggler"]
+        assert s["busyUs"] == max(x["busyUs"] for x in j["shards"])
+        assert s["busyUs"] - s["predictedUs"] == s["deltaUs"]
+        assert j["lptBytes"] and j["skew"] >= 1.0
+    assert analysis["straggler"] is not None
 
 
 def test_two_process_optimize_survives_worker_crash(tmp_path):

@@ -30,8 +30,11 @@ __all__ = ["Regression", "compare", "compare_files", "main"]
 #: Units where a SMALLER value is better. "findings" is the static-analysis
 #: gate (tools/analyze.py counts riding the bench artifact); "skew" is a
 #: max/mean balance ratio (1.0 = perfectly even — the sharded-scan config's
-#: LPT assignment gate), so growth is a load-balance regression.
-LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns", "findings", "skew"})
+#: LPT assignment gate), so growth is a load-balance regression; "pct" is
+#: an overhead percentage (the tracing-overhead config), so growth means
+#: the instrumentation got more expensive.
+LOWER_IS_BETTER = frozenset({"s", "ms", "us", "ns", "findings", "skew",
+                             "pct"})
 
 DEFAULT_THRESHOLD_PCT = 20.0
 

@@ -1,0 +1,88 @@
+"""Offline distributed-trace inspector.
+
+Reads the JSONL span spools a sharded job's processes wrote under
+``delta.tpu.trace.dir`` (`delta_tpu/obs/trace_store.py`) and stitches them
+without a running obs server::
+
+    python tools/trace_dump.py --dir /tmp/spool list            # trace index
+    python tools/trace_dump.py --dir /tmp/spool show <traceId>  # Chrome JSON
+    python tools/trace_dump.py --dir /tmp/spool show <traceId> -o t.json
+    python tools/trace_dump.py --dir /tmp/spool analyze <traceId>
+
+``list`` prints one JSON row per trace, newest first (pipe into ``jq``);
+``show`` emits the stitched Perfetto-loadable Chrome-trace JSON (load the
+``-o`` file at https://ui.perfetto.dev); ``analyze`` prints the
+critical-path / straggler analysis — which shard set the makespan and by
+how much it overran its LPT-predicted byte share. ``--dir`` defaults to the
+configured ``delta.tpu.trace.dir``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", dest="directory", default=None,
+                    help="spool directory (default: conf delta.tpu.trace.dir)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_list = sub.add_parser("list", help="index of spooled traces, newest first")
+    p_list.add_argument("--limit", type=int, default=20,
+                        help="newest N traces (default 20)")
+    p_show = sub.add_parser("show", help="stitched Chrome-trace JSON")
+    p_show.add_argument("trace_id", help="128-bit hex trace id (see `list`)")
+    p_show.add_argument("-o", "--out", default=None,
+                        help="write to a file instead of stdout")
+    p_an = sub.add_parser("analyze",
+                          help="critical path + straggler analysis")
+    p_an.add_argument("trace_id", help="128-bit hex trace id (see `list`)")
+    args = ap.parse_args(argv)
+
+    from delta_tpu.obs import trace_store
+    from delta_tpu.utils.config import conf
+
+    directory = args.directory or conf.get("delta.tpu.trace.dir")
+    if not directory:
+        print("no spool directory: pass --dir or set delta.tpu.trace.dir",
+              file=sys.stderr)
+        return 2
+    directory = str(directory)
+
+    if args.cmd == "list":
+        for row in trace_store.recent_traces(directory, limit=args.limit):
+            print(json.dumps(row))
+        return 0
+
+    if args.cmd == "show":
+        trace = trace_store.stitch_trace(directory, args.trace_id)
+        if trace is None:
+            print(f"no spooled spans for trace {args.trace_id!r} in "
+                  f"{directory}", file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(trace, f, default=str)
+            rows = sum(1 for r in trace["traceEvents"]
+                       if r.get("cat") == "delta")
+            print(f"wrote {rows} spans to {args.out} "
+                  f"(load at https://ui.perfetto.dev)")
+        else:
+            print(json.dumps(trace, default=str))
+        return 0
+
+    analysis = trace_store.analyze_trace(directory, args.trace_id)
+    if analysis is None:
+        print(f"no spooled spans for trace {args.trace_id!r} in {directory}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(analysis, indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
