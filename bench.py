@@ -2489,6 +2489,257 @@ def bench_trace_overhead(workdir):
     }
 
 
+def bench_dist_faults(workdir):
+    """Config 16 — the price of fault tolerance on the sharded plane
+    (ISSUE 20).
+
+    Three legs, each under its own deadline, record-and-continue:
+
+      retry       — the same partitioned compaction clean vs under 4
+                    scripted transient ``dist.itemExec`` faults: every
+                    fault retries to success (zero quarantine), row and
+                    file-topology identity asserted, the fault run's
+                    overhead over clean measured
+      speculation — a seeded straggler workload on ``run_sharded`` with
+                    speculative re-dispatch on vs off: the supervisor's
+                    rescue must beat waiting out the wedged attempt
+                    (hard-asserted — this is the config's headline)
+      recovery    — 2-host posed OPTIMIZE where host 1 crashes mid-slice
+                    after publishing its lease; the coordinator reconciles
+                    the orphan — end state identical to a single-process
+                    run, recovery overhead over that solo run measured
+
+    Headline: speculation speedup vs no-speculation on the straggler leg.
+    The gate rides two sub-metrics: ``dist_fault_identity_violations``
+    (findings — any leg that errors or diverges from its fault-free
+    reference) and ``recovery_overhead_pct`` (pct — what the crash +
+    lease recovery cost over the solo compaction).
+    """
+    import pyarrow as pa
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.commands.write import WriteIntoDelta
+    from delta_tpu.exec.scan import scan_to_table
+    from delta_tpu.parallel import distributed as dist_mod
+    from delta_tpu.parallel import leases
+    from delta_tpu.parallel.executor import run_sharded
+    from delta_tpu.storage.faults import FaultPlan, SimulatedCrash
+    from delta_tpu.utils import telemetry
+    from delta_tpu.utils.config import conf as _c
+
+    legs = {}
+
+    def _leg(name, budget_s, fn):
+        t0 = time.perf_counter()
+        try:
+            legs[name] = fn(budget_s)
+            legs[name]["wall_s"] = round(time.perf_counter() - t0, 3)
+        except Exception as e:  # noqa: BLE001 — per-leg record-and-continue
+            legs[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    rows_per = max(_rows(96_000) // 32, 500)
+
+    def _mk(path, rng):
+        log = DeltaLog.for_table(path)
+        for p in range(8):
+            for f in range(4):
+                base = (p * 4 + f) * rows_per
+                WriteIntoDelta(log, "append", pa.table({
+                    "id": np.arange(base, base + rows_per, dtype=np.int64),
+                    "part": pa.array([f"p{p}"] * rows_per),
+                    "v": rng.rand(rows_per),
+                }), partition_columns=["part"]).run()
+        return log
+
+    def _rows_files(log):
+        snap = DeltaLog.for_table(log.data_path).update()
+        return (sorted(scan_to_table(snap, [], ["id"])
+                       .column("id").to_pylist()), snap.num_of_files)
+
+    fast_retry = {"delta.tpu.distributed.retry.baseDelayMs": 1,
+                  "delta.tpu.distributed.retry.maxDelayMs": 10}
+
+    def _retry(budget_s):
+        # untimed warm-up: the first compaction pays JIT and first-touch
+        # caches, and must not land on the clean side of the overhead ratio
+        warm = _mk(os.path.join(workdir, "c16_warm"),
+                   np.random.RandomState(5))
+        OptimizeCommand(warm, min_file_size=1 << 30, workers=4).run()
+        clean = _mk(os.path.join(workdir, "c16_clean"),
+                    np.random.RandomState(7))
+        faulted = _mk(os.path.join(workdir, "c16_fault"),
+                      np.random.RandomState(7))
+        c_clean = OptimizeCommand(clean, min_file_size=1 << 30, workers=4)
+        t_clean, _ = _timed(c_clean.run)
+        plan = FaultPlan(script=[("dist.itemExec", "transient")] * 4)
+        with _c.set_temporarily(**fast_retry,
+                                **{"delta.tpu.faults.plan": plan}):
+            c_fault = OptimizeCommand(faulted, min_file_size=1 << 30,
+                                      workers=4, on_failure="quarantine")
+            t_fault, _ = _timed(c_fault.run)
+        assert not plan.script, "scripted faults never fired"
+        # every transient retried to success: no quarantine, and the fault
+        # run's table is indistinguishable from the clean run's
+        assert c_fault.metrics["numQuarantinedGroups"] == 0
+        rep = c_fault.shard_report
+        assert rep.retried >= 4
+        a, a_files = _rows_files(clean)
+        b, b_files = _rows_files(faulted)
+        assert a == b and a_files == b_files, \
+            "faulted OPTIMIZE diverged from clean"
+        return {
+            "rows": 32 * rows_per,
+            "faults_injected": 4,
+            "retried": rep.retried,
+            "quarantined": len(rep.quarantined),
+            "clean_s": round(t_clean, 3),
+            "faulted_s": round(t_fault, 3),
+            "retry_overhead_pct": round(
+                (t_fault / max(t_clean, 1e-9) - 1.0) * 100.0, 2),
+            "identity_ok": True,
+        }
+
+    _leg("retry", 120, _retry)
+
+    def _speculation(budget_s):
+        # the straggler is an injected `slow` fault at dist.itemExec: one
+        # scripted 1.2s stall inside whichever item attempt fires first,
+        # well past the 60ms priced timeout. The script is consumed once,
+        # so the speculative re-dispatch of the stuck item runs clean —
+        # the same one-straggler schedule on both sides of the comparison.
+        straggle_s = 1.2
+        items = list(range(8))
+        want = [i * 10 for i in items]
+
+        def fn(i):
+            time.sleep(0.02)
+            return i * 10
+
+        knobs = {"delta.tpu.distributed.itemTimeoutMs": 60,
+                 "delta.tpu.distributed.supervisor.intervalMs": 5,
+                 "delta.tpu.distributed.speculation.slackFactor": 1.0}
+
+        def run_once(spec_on, lbl):
+            plan = FaultPlan(script=[("dist.itemExec", "slow")],
+                             slow_ms=straggle_s * 1e3)
+            with _c.set_temporarily(
+                    **knobs,
+                    **{"delta.tpu.faults.plan": plan,
+                       "delta.tpu.distributed.speculation.enabled": spec_on}):
+                t, rep = _timed(
+                    lambda: run_sharded(items, fn, workers=4, label=lbl))
+            assert not plan.script, "the scripted straggler never fired"
+            return t, rep
+
+        t_none, rep_none = run_once(False, "bench-nospec")
+        t_spec, rep_spec = run_once(True, "bench-spec")
+        assert rep_none.results == want and rep_spec.results == want
+        assert rep_none.speculated == 0
+        assert rep_spec.speculated >= 1 and rep_spec.rescued >= 1
+        # the acceptance: rescuing the straggler must beat waiting it out
+        assert t_spec < t_none, \
+            f"speculation ({t_spec:.2f}s) did not beat " \
+            f"no-speculation ({t_none:.2f}s)"
+        return {
+            "items": len(items),
+            "straggle_s": straggle_s,
+            "speculation_off_s": round(t_none, 3),
+            "speculation_on_s": round(t_spec, 3),
+            "speedup": round(t_none / max(t_spec, 1e-9), 2),
+            "speculated": rep_spec.speculated,
+            "rescued": rep_spec.rescued,
+            "identity_ok": True,
+        }
+
+    _leg("speculation", 60, _speculation)
+
+    def _posed(log, proc, **kw):
+        cmd = OptimizeCommand(log, min_file_size=1 << 30, workers=4,
+                              distribute=True, **kw)
+        orig = dist_mod.process_info
+        dist_mod.process_info = lambda: (proc, 2)
+        try:
+            cmd.run()
+        finally:
+            dist_mod.process_info = orig
+        return cmd
+
+    def _recovery(budget_s):
+        solo = _mk(os.path.join(workdir, "c16_solo"),
+                   np.random.RandomState(11))
+        crash_path = os.path.join(workdir, "c16_crash")
+        crashed = _mk(crash_path, np.random.RandomState(11))
+        c_solo = OptimizeCommand(solo, min_file_size=1 << 30, workers=4)
+        t_solo, _ = _timed(c_solo.run)
+        ref_rows, ref_files = _rows_files(solo)
+
+        base_recovered = telemetry.counters("dist").get(
+            "dist.slice.recovered", 0)
+        # host 1 dies on its first group rewrite, lease already published
+        plan = FaultPlan(script=[("dist.itemExec", "crash_before_publish")])
+        with _c.set_temporarily(**fast_retry,
+                                **{"delta.tpu.faults.plan": plan}):
+            try:
+                _posed(crashed, proc=1)
+            except SimulatedCrash:
+                pass
+            else:
+                raise AssertionError("host 1 survived its scripted crash")
+        assert len(leases.read_leases(crashed.log_path)) == 1
+        past = time.time() - 120  # age the orphan's heartbeat past the ttl
+        for p, _b, _m in leases.read_leases(crashed.log_path):
+            os.utime(p, (past, past))
+
+        DeltaLog.clear_cache()
+        crashed = DeltaLog.for_table(crash_path)
+        with _c.set_temporarily(
+                **{"delta.tpu.distributed.lease.settleMs": 20}):
+            t_recover, _ = _timed(lambda: _posed(crashed, proc=0))
+
+        got_rows, got_files = _rows_files(crashed)
+        recovered = telemetry.counters("dist").get(
+            "dist.slice.recovered", 0) - base_recovered
+        assert got_rows == ref_rows and got_files == ref_files, \
+            "recovered table diverged from the solo run"
+        assert recovered == 1, f"expected 1 recovered slice, got {recovered}"
+        assert leases.read_leases(crashed.log_path) == []
+        return {
+            "rows": 32 * rows_per,
+            "solo_s": round(t_solo, 3),
+            "crash_recover_s": round(t_recover, 3),
+            "recovery_overhead_pct": round(
+                (t_recover / max(t_solo, 1e-9) - 1.0) * 100.0, 2),
+            "slices_recovered": recovered,
+            "identity_ok": True,
+        }
+
+    _leg("recovery", 150, _recovery)
+
+    violations = sum(1 for leg in legs.values()
+                     if not leg.get("identity_ok"))
+    spec = legs.get("speculation", {})
+    speedup = spec.get("speedup")
+    ok = isinstance(speedup, (int, float)) and speedup > 0
+    rec_pct = legs.get("recovery", {}).get("recovery_overhead_pct")
+    return {
+        "metric": "dist_speculation_speedup_vs_none",
+        "value": round(speedup, 2) if ok else -1,
+        "unit": "x" if ok else "error",
+        "vs_baseline": round(speedup, 2) if ok else 0,
+        "legs": legs,
+        "gate": {
+            "dist_fault_identity_violations": {
+                "value": violations, "unit": "findings"},
+            "recovery_overhead_pct": {
+                "value": (max(round(rec_pct, 2), 0.0)
+                          if isinstance(rec_pct, (int, float)) else -1),
+                "unit": "pct",
+            },
+        },
+    }
+
+
 def _emit(results):
     headline = results.get("2") or next(iter(results.values()))
     print(json.dumps({
@@ -2591,6 +2842,7 @@ def main():
         "13": lambda: bench_shadow(workdir),
         "14": lambda: bench_sharded_scan(workdir),
         "15": lambda: bench_trace_overhead(workdir),
+        "16": lambda: bench_dist_faults(workdir),
         "12": lambda: bench_device_scan(workdir),
         "8": lambda: bench_resident_probe(workdir),
         "5": lambda: bench_checkpoint_replay(workdir),
@@ -2626,7 +2878,7 @@ def main():
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
     default_deadline = float(os.environ.get("BENCH_CONFIG_DEADLINE_S", "480"))
     per_config_deadline = {"2": 900.0, "2x": 540.0, "8": 600.0, "9": 420.0,
-                           "14": 540.0}
+                           "14": 540.0, "16": 360.0}
     t_start = time.perf_counter()
     # deadline forensics: configs run with the flight recorder armed, so a
     # SIGALRM unwinding through the open span stack leaves an incident file

@@ -38,6 +38,7 @@ REGISTERED_POOLS = frozenset({
     "delta-replay-prep",          # replay/shadow.py candidate clone prep
     "delta-dist-exec",            # parallel/executor.py sharded work items
     # dedicated threads (threading.Thread name)
+    "delta-dist-supervisor",      # parallel/executor.py heartbeat watchdog
     "delta-ckpt-async",           # log/checkpointer.py coalescing daemon
     "delta-journal-writer",       # obs/journal.py writer daemon
     "delta-state-update",         # log/deltalog.py async snapshot refresh

@@ -586,10 +586,25 @@ class MergeIntoCommand:
         with telemetry.record_operation(
             "delta.dist.mergeProbe", {"candidates": len(candidates)}
         ) as probe_ev:
-            report = run_sharded(
-                candidates, _touched,
-                sizes=[f.size or 0 for f in candidates], label="merge-probe")
-            touched = [f for f, hit in zip(candidates, report.results) if hit]
+            try:
+                report = run_sharded(
+                    candidates, _touched,
+                    sizes=[f.size or 0 for f in candidates],
+                    label="merge-probe", on_failure="quarantine")
+            except Exception:  # noqa: BLE001 — probe machinery failure:
+                # the probe is an OPTIMIZATION — fall back to the full
+                # conservative candidate set rather than failing the MERGE
+                telemetry.bump_counter("dist.degraded.probe")
+                probe_ev.data["degraded"] = True
+                probe_ev.data["touched"] = len(candidates)
+                return candidates
+            # a quarantined probe item is a file whose keys we could not
+            # read — soundness demands it stays IN the candidate set (the
+            # probe may only drop files proven all-miss, hit is False)
+            touched = [f for f, hit in zip(candidates, report.results)
+                       if hit is not False]
+            if report.quarantined:
+                telemetry.bump_counter("dist.degraded.probe")
             probe_ev.data["touched"] = len(touched)
         self.phase_ms["probe_ms"] = probe_t.lap_ms_f()
         return touched

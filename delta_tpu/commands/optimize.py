@@ -66,7 +66,11 @@ class OptimizeCommand:
         max_rewrite_bytes: Optional[int] = None,
         workers: Optional[int] = None,
         distribute: bool = False,
+        on_failure: str = "raise",
     ):
+        if on_failure not in ("raise", "quarantine"):
+            raise ValueError(
+                f"on_failure must be 'raise' or 'quarantine', got {on_failure!r}")
         self.delta_log = delta_log
         self.predicate = (
             parse_predicate(predicate) if isinstance(predicate, str) else predicate
@@ -91,9 +95,19 @@ class OptimizeCommand:
         # slice, funneled through the group-commit coordinator.
         self.workers = workers
         self.distribute = distribute
+        # item-failure policy for the sharded executor: "raise" aborts the
+        # job on the first exhausted group (classic semantics); "quarantine"
+        # completes the commit WITHOUT the failed groups' rewrites — their
+        # files stay exactly as planned-around, reported in shard_report
+        self.on_failure = on_failure
         # the last run's executor evidence (per-worker timings, steals,
         # skew) — the sharded-scan bench and the MULTICHIP artifact read it
         self.shard_report = None
+        # multihost crash evidence: this host's lease (heartbeated during
+        # the rewrite, cleared after commit) and, on the coordinator, the
+        # post-commit orphan-recovery context (parallel/leases.py)
+        self._lease_path: Optional[str] = None
+        self._recover_info: Optional[Dict] = None
         self.metrics: Dict[str, int] = {}
 
     def _resolve_workers(self) -> int:
@@ -108,7 +122,232 @@ class OptimizeCommand:
         from delta_tpu.utils.telemetry import record_operation
 
         with record_operation("delta.dml.optimize", path=self.delta_log.data_path):
-            return self.delta_log.with_new_transaction(self._body)
+            version = self.delta_log.with_new_transaction(self._body)
+            if self._recover_info is not None:
+                # coordinator fan-in: after our own slice committed, wait
+                # for peer hosts' leases to clear and recover any orphans
+                # (needs fresh transactions — cannot run inside _body's)
+                self._recover_orphan_slices()
+            return version
+
+    def _recover_orphan_slices(self) -> int:
+        """Coordinator-side orphaned-slice recovery: poll peer leases for
+        this job until each clears (host committed and released) or its
+        heartbeat expires past the ttl (host died). An expired lease is
+        reconciled against the log by its recorded ``commitInfo.txnId`` —
+        present means only the *clear* was lost; absent means the slice's
+        work is re-planned from a fresh snapshot restricted to its recorded
+        group keys and re-executed locally. Returns recovered slice count.
+
+        The wait is bounded: with no peer lease in sight the coordinator
+        only lingers ``delta.tpu.distributed.lease.settleMs`` (a peer that
+        died before even publishing its lease lost no committed data — its
+        partitions are merely left uncompacted for the next OPTIMIZE), and
+        a wedged-but-heartbeating peer stops blocking fan-in after 10×ttl.
+        """
+        import time as _time
+
+        from delta_tpu.parallel import leases
+        from delta_tpu.utils.config import conf
+
+        info = self._recover_info
+        self._recover_info = None
+        log_path = self.delta_log.log_path
+        if info is None or not leases.enabled(log_path):
+            return 0
+        ttl_s = leases.lease_ttl_s()
+        try:
+            settle_s = max(float(conf.get(
+                "delta.tpu.distributed.lease.settleMs", 250)), 0.0) / 1000.0
+        except (TypeError, ValueError):
+            settle_s = 0.25
+        poll_s = max(min(ttl_s / 4.0, 0.25), 0.005)
+        start = _time.monotonic()
+        hard_deadline = start + max(10.0 * ttl_s, settle_s)
+        recovered = 0
+        own = self._lease_path
+        while True:
+            now = _time.time()
+            all_leases = [(p, body, mtime)
+                          for p, body, mtime in leases.read_leases(log_path)
+                          if p != own]
+            # an EXPIRED lease is an orphan whatever job wrote it — the
+            # lease is self-describing (txnId + group keys + readVersion),
+            # and hosts that planned across an interleaving commit carry
+            # different job ids for the same fan-out. Only same-job live
+            # peers gate the fan-in wait, though: another job's live lease
+            # is that job's coordinator's problem.
+            orphans = [(p, body) for p, body, mtime in all_leases
+                       if now - mtime > ttl_s]
+            live = [p for p, body, mtime in all_leases
+                    if now - mtime <= ttl_s
+                    and body.get("job") == info["job"]]
+            seen_peer = any(body.get("job") == info["job"]
+                            for _p, body, _m in all_leases)
+            for path, body in orphans:
+                recovered += self._recover_one_slice(path, body, info)
+            if not live and (seen_peer or orphans or
+                             _time.monotonic() - start >= settle_s):
+                break
+            if _time.monotonic() >= hard_deadline:
+                break
+            _time.sleep(poll_s)
+        return recovered
+
+    def _recover_one_slice(self, lease_path: str, body: Dict,
+                           info: Dict) -> int:
+        """Reconcile or re-execute one orphaned slice; returns 1 when its
+        work had to be (and was) re-executed. Exactly-once per group:
+        either the dead host's commit is found by token, or the restricted
+        replan sees its partitions' current files — never both rewrites."""
+        from delta_tpu.obs import journal
+        from delta_tpu.parallel import leases
+        from delta_tpu.utils import telemetry
+
+        log_path = self.delta_log.log_path
+        token = body.get("txnId")
+        with telemetry.record_operation("delta.dist.sliceRecovery", {
+            "job": str(body.get("job")), "proc": body.get("proc"),
+        }) as ev:
+            try:
+                since = int(body.get("readVersion", info["readVersion"]))
+            except (TypeError, ValueError):
+                since = int(info["readVersion"])
+            if token and self._txn_landed(str(token), since):
+                # the host committed; only its lease clear was lost
+                ev.data["outcome"] = "reconciled"
+                leases.clear_lease(lease_path)
+                journal.record_dist(log_path, {
+                    "event": "dist.sliceReconciled",
+                    "proc": body.get("proc"), "job": body.get("job"),
+                })
+                return 0
+            keys = {tuple(tuple(kv) for kv in key)
+                    for key in (body.get("groupKeys") or [])}
+
+            def _recover_body(txn):
+                groups = self._plan_groups(txn, restrict_keys=keys)
+                if not groups:
+                    return 0  # nothing re-plannable: no commit at all
+                removes: List[Action] = []
+                adds: List[Action] = []
+                for _key, group in groups:
+                    new_adds, new_removes = self._rewrite_group(
+                        group, txn.metadata)
+                    adds.extend(new_adds)
+                    removes.extend(new_removes)
+                op = (ops.Reorg(predicate=[]) if self.purge else
+                      ops.Optimize(predicate=[],
+                                   z_order_by=self.z_order_by or None))
+                txn.commit(removes + adds, op)
+                return len(groups)
+
+            self.delta_log.update()  # replan from the freshest snapshot
+            n_groups = self.delta_log.with_new_transaction(_recover_body)
+            ev.data["outcome"] = "recovered" if n_groups else "noop"
+            ev.data["groups"] = n_groups
+            leases.clear_lease(lease_path)
+            journal.record_dist(log_path, {
+                "event": "dist.sliceRecovered",
+                "proc": body.get("proc"), "job": body.get("job"),
+                "groups": n_groups,
+            })
+            if n_groups:
+                telemetry.bump_counter("dist.slice.recovered")
+            return 1 if n_groups else 0
+
+    def _txn_landed(self, token: str, since_version: int) -> bool:
+        """Did a commit carrying ``commitInfo.txnId == token`` land after
+        ``since_version``? Scans the log tail file-by-file — the same
+        token comparison ``_reconcile_ambiguous_commit`` does for one
+        version, widened to the window a dead peer could have written."""
+        import json as _json
+
+        from delta_tpu.protocol import filenames
+
+        self.delta_log.update()
+        current = self.delta_log.snapshot.version
+        for v in range(since_version + 1, current + 1):
+            path = f"{self.delta_log.log_path}/{filenames.delta_file(v)}"
+            try:
+                lines = self.delta_log.store.read(path)
+            except FileNotFoundError:
+                continue
+            if not lines:
+                continue
+            try:
+                got = (_json.loads(lines[0]).get("commitInfo")
+                       or {}).get("txnId")
+            except (ValueError, AttributeError):
+                continue
+            if got == token:
+                return True
+        return False
+
+    def _plan_groups(self, txn, restrict_keys=None
+                     ) -> List[Tuple[Tuple, List[AddFile]]]:
+        """Metadata-only rewrite planning: the selected files per partition
+        key, in deterministic key order. ``restrict_keys`` (a set of
+        partition-key tuples) replans only those partitions — the orphan
+        slice recovery path, where it makes re-execution idempotent: a
+        partition the dead host already compacted yields fewer than two
+        small files and drops out of the plan."""
+        # filter_files evaluates the partition predicate exactly
+        candidates = txn.filter_files(
+            [self.predicate] if self.predicate is not None else None
+        )
+
+        by_partition: Dict[Tuple, List[AddFile]] = defaultdict(list)
+        for f in candidates:
+            key = tuple(sorted((f.partition_values or {}).items()))
+            if restrict_keys is not None and key not in restrict_keys:
+                continue
+            by_partition[key].append(f)
+
+        groups: List[Tuple[Tuple, List[AddFile]]] = []
+        # None-safe ordering: null partition values sort first
+        for key, files in sorted(
+            by_partition.items(),
+            key=lambda kv: [(c, v is not None, v or "") for c, v in kv[0]],
+        ):
+            if self.z_order_by:
+                group = files  # Z-order rewrites every selected file
+            elif self.purge:
+                group = [f for f in files if f.deletion_vector is not None]
+                if not group:
+                    continue
+            else:
+                group = [f for f in files if (f.size or 0) < self.min_file_size]
+                if len(group) < 2:
+                    continue  # nothing to compact
+            groups.append((key, group))
+        return groups
+
+    def _rewrite_group(self, group: List[AddFile], metadata):
+        """Read, (optionally) re-sort, and rewrite one bin-packed group;
+        returns ``(new_adds, removes)``. Runs on executor worker threads —
+        each call heartbeats this host's lease so the coordinator sees the
+        slice as live for as long as it is making progress."""
+        from delta_tpu.parallel import leases
+
+        leases.heartbeat_lease(self._lease_path)
+        table = read_files_as_table(
+            self.delta_log.data_path, group, metadata
+        )
+        if self.z_order_by:
+            cols = [
+                np_col(table, c) for c in self.z_order_by
+            ]
+            perm = morton_order(cols)
+            table = table.take(pa.array(perm))
+        new_adds = write_exec.write_files(
+            self.delta_log.data_path,
+            table,
+            metadata,
+            data_change=False,
+            target_file_rows=self.target_rows,
+        )
+        return new_adds, [f.remove(data_change=False) for f in group]
 
     def _body(self, txn) -> int:
         metadata = txn.metadata
@@ -127,35 +366,9 @@ class OptimizeCommand:
                 raise errors.zorder_on_partition_column(c)
 
         timer = Timer()
-        # filter_files evaluates the partition predicate exactly
-        candidates = txn.filter_files(
-            [self.predicate] if self.predicate is not None else None
-        )
-
-        by_partition: Dict[Tuple, List[AddFile]] = defaultdict(list)
-        for f in candidates:
-            key = tuple(sorted((f.partition_values or {}).items()))
-            by_partition[key].append(f)
-
         # plan first (selection is metadata-only), so the cost cap can
         # abort an over-budget job before ANY file is read or written
-        groups: List[Tuple[Tuple, List[AddFile]]] = []
-        # None-safe ordering: null partition values sort first
-        for key, files in sorted(
-            by_partition.items(),
-            key=lambda kv: [(c, v is not None, v or "") for c, v in kv[0]],
-        ):
-            if self.z_order_by:
-                group = files  # Z-order rewrites every selected file
-            elif self.purge:
-                group = [f for f in files if f.deletion_vector is not None]
-                if not group:
-                    continue
-            else:
-                group = [f for f in files if (f.size or 0) < self.min_file_size]
-                if len(group) < 2:
-                    continue  # nothing to compact
-            groups.append((key, group))
+        groups = self._plan_groups(txn)
         if self.max_rewrite_bytes is not None:
             est = sum(f.size or 0 for _, g in groups for f in g)
             if est > self.max_rewrite_bytes:
@@ -200,27 +413,35 @@ class OptimizeCommand:
                 fan_in = conf.get_bool(
                     "delta.tpu.distributed.singleWriterFanIn", True)
 
+                # publish this host's lease BEFORE executing: the slice id,
+                # its bin-packed group keys, and the txnId its commit will
+                # carry — everything the coordinator needs to reconcile or
+                # re-execute the slice if this host dies past this point
+                from delta_tpu.parallel import leases
+
+                job_id = f"optimize@{txn.read_version}"
+                token = leases.new_token()
+                txn.preset_txn_id = token
+                self._lease_path = leases.write_lease(
+                    self.delta_log.log_path, job_id, proc, {
+                        "txnId": token,
+                        "nProcs": n_procs,
+                        "readVersion": txn.read_version,
+                        "groupKeys": [[list(kv) for kv in key]
+                                      for key, _g in groups],
+                    })
+                if proc == 0:
+                    # the coordinator owns post-commit orphan recovery
+                    # (run() — it needs its own transaction)
+                    self._recover_info = {
+                        "job": job_id, "proc": proc,
+                        "readVersion": txn.read_version,
+                    }
+
         removes: List[Action] = []
         adds: List[Action] = []
-
-        def _rewrite(group: List[AddFile]):
-            table = read_files_as_table(
-                self.delta_log.data_path, group, metadata
-            )
-            if self.z_order_by:
-                cols = [
-                    np_col(table, c) for c in self.z_order_by
-                ]
-                perm = morton_order(cols)
-                table = table.take(pa.array(perm))
-            new_adds = write_exec.write_files(
-                self.delta_log.data_path,
-                table,
-                metadata,
-                data_change=False,
-                target_file_rows=self.target_rows,
-            )
-            return new_adds, [f.remove(data_change=False) for f in group]
+        rewritten_bytes = 0
+        quarantined_groups = 0
 
         if groups:
             import contextlib
@@ -235,24 +456,40 @@ class OptimizeCommand:
             with slice_span:
                 report = run_sharded(
                     [g for _k, g in groups],
-                    _rewrite,
+                    lambda g: self._rewrite_group(g, metadata),
                     sizes=[sum(f.size or 0 for f in g) for _k, g in groups],
                     workers=self._resolve_workers(),
                     label="optimize",
+                    on_failure=self.on_failure,
                 )
             self.shard_report = report
             # results are index-ordered, so adds/removes land in the exact
-            # order the classic sequential loop produced them
-            for new_adds, new_removes in report.results:
+            # order the classic sequential loop produced them; a quarantined
+            # group's slot is None — its files are simply not rewritten
+            # this run (left exactly as planned-around, reported below)
+            for (_key, group), pair in zip(groups, report.results):
+                if pair is None:
+                    quarantined_groups += 1
+                    continue
+                new_adds, new_removes = pair
                 adds.extend(new_adds)
                 removes.extend(new_removes)
+                rewritten_bytes += sum(f.size or 0 for f in group)
+            if report.quarantined:
+                from delta_tpu.obs import journal
+
+                journal.record_dist(self.delta_log.log_path, {
+                    "event": "dist.quarantine", "op": "optimize",
+                    "items": [q.to_dict() for q in report.quarantined],
+                })
 
         self.metrics.update(
             numRemovedFiles=len(removes),
             numAddedFiles=len(adds),
-            numRemovedBytes=sum(f.size or 0 for _k, g in groups for f in g),
+            numRemovedBytes=rewritten_bytes,
             numAddedBytes=sum(a.size or 0 for a in adds
                               if isinstance(a, AddFile)),
+            numQuarantinedGroups=quarantined_groups,
             timeMs=timer.lap_ms(),
         )
         txn.report_metrics(**self.metrics)
@@ -281,6 +518,14 @@ class OptimizeCommand:
                     version = txn.commit(removes + adds, op)
         else:
             version = txn.commit(removes + adds, op)
+        # commit is durable: release this host's lease — a crash between
+        # the commit and here leaves an orphan whose txnId reconciles to
+        # already-committed (cleanup, not re-execution)
+        if self._lease_path is not None:
+            from delta_tpu.parallel import leases
+
+            leases.clear_lease(self._lease_path)
+            self._lease_path = None
         # file rewrite: bump the resident key-cache epoch so a stale HBM
         # slab can never serve a post-OPTIMIZE MERGE (ops/key_cache.py)
         if removes or adds:

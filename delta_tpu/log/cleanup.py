@@ -71,6 +71,12 @@ def cleanup_expired_logs(delta_log, snapshot) -> int:
 
     if "://" not in delta_log.log_path:
         journal_mod.sweep(journal_mod.journal_dir(delta_log.log_path))
+        # dead distributed-execution leases age out here too — same
+        # aged-orphan discipline as .tmp staging files; live hosts' leases
+        # are spared by the shared journal liveness rule
+        from delta_tpu.parallel import leases as leases_mod
+
+        leases_mod.sweep_leases(delta_log.log_path)
 
     last_ckpt = ckpt_mod.read_last_checkpoint(delta_log.store, delta_log.log_path)
     if last_ckpt is None:

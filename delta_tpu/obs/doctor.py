@@ -342,6 +342,39 @@ def _dim_device() -> HealthDimension:
     )
 
 
+def _dim_distributed() -> HealthDimension:
+    """Distributed-execution supervision health (9th dimension):
+    process-wide evidence from the sharded executor's fault handling —
+    retries are routine (transient IO happens), but quarantined items mean
+    committed work is INCOMPLETE (an OPTIMIZE skipped a group's rewrite)
+    and degradations mean a structural capability (device plan, worker
+    pool, merge probe, lease coverage) silently fell back to a slower or
+    more conservative path. Process-wide by nature, like the device
+    dimension — the executor is shared across tables — but surfaced per
+    doctor call so the operator sees WHY a job's output differs from its
+    plan."""
+    c = telemetry.counters("dist")
+    retried = c.get("dist.items.retried", 0)
+    quarantined = c.get("dist.items.quarantined", 0)
+    speculated = c.get("dist.items.speculated", 0)
+    wins = c.get("dist.speculation.wins", 0)
+    recovered = c.get("dist.slice.recovered", 0)
+    degraded = sum(v for k, v in c.items() if k.startswith("dist.degraded."))
+    sev = "ok"
+    if quarantined > 0 or degraded > 0:
+        sev = "warn"
+    return HealthDimension(
+        "distributed", sev,
+        {"itemsRetried": retried, "itemsQuarantined": quarantined,
+         "itemsSpeculated": speculated, "speculationWins": wins,
+         "slicesRecovered": recovered, "degraded": degraded},
+        detail=f"{retried} item retries, {quarantined} quarantined, "
+               f"{speculated} speculative re-dispatches ({wins} won), "
+               f"{recovered} orphaned slices recovered, "
+               f"{degraded} degradations (plan/pool/probe/lease rungs)",
+    )
+
+
 def _dim_protocol(snapshot) -> HealthDimension:
     p = snapshot.protocol
     features = sorted(set(p.reader_features or ()) | set(p.writer_features or ()))
@@ -395,6 +428,7 @@ def doctor(table, snapshot=None, publish_gauges: bool = True) -> TableHealthRepo
             _dim_tombstones(snap, live_bytes),
             _dim_protocol(snap),
             _dim_device(),
+            _dim_distributed(),
         ]
         severity = max((d.severity for d in dims), key=SEVERITY_RANK.get)
         report = TableHealthReport(

@@ -76,8 +76,9 @@ from delta_tpu.utils.config import conf
 
 __all__ = ["enabled", "journal_dir", "predicate_fingerprint", "record_scan",
            "record_commit", "record_dml", "record_router",
-           "record_autopilot", "record_shadow", "attempt_state",
-           "record_attempt", "flush", "read_entries", "sweep", "reset"]
+           "record_autopilot", "record_shadow", "record_dist",
+           "attempt_state", "record_attempt", "flush", "read_entries",
+           "sweep", "live_writer_spared", "reset"]
 
 SEGMENT_PREFIX = "journal-"
 SEGMENT_SUFFIX = ".jsonl"
@@ -374,6 +375,16 @@ def record_shadow(log_path: str, scorecard: Dict[str, Any]) -> bool:
     return _record(log_path, {"kind": "shadow", "scorecard": dict(scorecard)})
 
 
+def record_dist(log_path: str, event: Dict[str, Any]) -> bool:
+    """Journal one distributed-execution supervision event (hooks:
+    ``parallel/leases`` orphan recovery, ``commands/optimize`` quarantine
+    reports) — e.g. ``{"event": "dist.sliceRecovered", "groups": 3}``. The
+    postmortem record of WHY a job's topology differs from its plan."""
+    if not enabled(log_path):
+        return False
+    return _record(log_path, {"kind": "dist", **dict(event)})
+
+
 def _state_path(log_path: str) -> str:
     return os.path.join(journal_dir(log_path), STATE_FILE)
 
@@ -609,6 +620,30 @@ def flush(log_path: Optional[str] = None) -> int:
     return _drain(aged_only=False, only_dir=only)
 
 
+def live_writer_spared(stats: List[Tuple[str, int, float]],
+                       grace_s: float) -> set:
+    """The possibly-live subset of per-process files in a shared directory:
+    among ``(path, size, mtime)`` stats whose basenames embed the creating
+    pid at dash-field 2 (``<prefix>-<ts>-<pid>-...``), the newest file per
+    pid, while touched within ``grace_s`` seconds. A process writes only to
+    ITS newest file (journal segments rotate forward; dist leases heartbeat
+    in place), so anything else — or anything grace-stale, since a live
+    writer touches its file at least every flush/heartbeat interval — is
+    guaranteed dead and fair game for the caller's sweep. One immune file
+    per CI/cron run would make size caps and lease expiry unenforceable.
+    Shared by the journal sweep and ``parallel/leases.sweep_leases`` so the
+    two sweeps cannot drift on what "live" means."""
+    newest_per_pid: Dict[str, str] = {}
+    mtimes: Dict[str, float] = {}
+    for p, _size, mtime in sorted(stats):  # name-sorted oldest → newest
+        parts = os.path.basename(p).split("-")
+        newest_per_pid[parts[2] if len(parts) >= 4 else ""] = p
+        mtimes[p] = mtime
+    now = time.time()
+    return {p for p in newest_per_pid.values()
+            if now - mtimes[p] <= grace_s}
+
+
 def sweep(jdir: str) -> int:
     """Bound the journal directory: segments older than
     ``delta.tpu.journal.retentionMs`` are deleted, then oldest-first until
@@ -638,29 +673,17 @@ def sweep(jdir: str) -> int:
     deleted = 0
     active = _ACTIVE.get(jdir)
     active_path = active[0] if active is not None else None
-    # a process appends only to ITS newest segment (names embed the
-    # creating pid), so the possibly-active set is one segment per pid —
-    # size pressure spares those while RECENTLY written (deleting a live
-    # concurrent writer's file mid-append would lose already-flushed
-    # entries ahead of policy; a live writer touches its segment at least
-    # every flush interval, so anything grace-stale belongs to a dead pid
-    # and stays fair game — one immune segment per CI/cron run would make
-    # the maxBytes cap unenforceable). Age expiry spares nothing: a table
-    # that stopped journaling must shed its final segment too — except
-    # this process's own active file (tests run with tiny retention
-    # windows while entries are still buffered for it).
-    newest_per_pid: Dict[str, str] = {}
-    for p, _size, _mtime in stats:  # name-sorted oldest → newest
-        parts = os.path.basename(p).split("-")
-        newest_per_pid[parts[2] if len(parts) >= 4 else ""] = p
-    maybe_active = set(newest_per_pid.values())
-    now = time.time()
-    grace = max(60.0, 10 * _flush_interval_s())
+    # Age expiry spares nothing: a table that stopped journaling must shed
+    # its final segment too — except this process's own active file (tests
+    # run with tiny retention windows while entries are still buffered for
+    # it). Size pressure additionally spares possibly-live concurrent
+    # writers' newest segments (see live_writer_spared).
+    spared_set = live_writer_spared(stats,
+                                    max(60.0, 10 * _flush_interval_s()))
     for p, size, mtime in stats:
         if p == active_path:
             continue
-        spared = p in maybe_active and now - mtime <= grace
-        if mtime <= cutoff or (total > max_total and not spared):
+        if mtime <= cutoff or (total > max_total and p not in spared_set):
             try:
                 os.remove(p)
                 deleted += 1

@@ -43,6 +43,14 @@ GAUGES = frozenset({
     "table.health.tombstones.bytes",
     "table.health.protocol.minReader",
     "table.health.protocol.minWriter",
+    # -- doctor: distributed-execution supervision (obs/doctor
+    #    ._dim_distributed, process-wide counters) ----------------------
+    "table.health.distributed.itemsRetried",
+    "table.health.distributed.itemsQuarantined",
+    "table.health.distributed.itemsSpeculated",
+    "table.health.distributed.speculationWins",
+    "table.health.distributed.slicesRecovered",
+    "table.health.distributed.degraded",
     # -- doctor: device residency pressure (obs/doctor._dim_device) ------
     "table.health.device.hbmBytes",
     "table.health.device.keyCacheBytes",
@@ -218,6 +226,22 @@ ENGINE_COUNTERS = frozenset({
                                   # sharded workers
     "dist.commit.fanin",          # distributed-job commits funneled through
                                   # the group-commit coordinator
+    # -- distributed-execution supervision (parallel/executor item retry +
+    #    quarantine, heartbeat speculation; parallel/leases slice recovery;
+    #    the graceful-degradation ladder) -------------------------------
+    "dist.items.retried",         # transient item attempts retried in place
+    "dist.items.quarantined",     # poison items quarantined off a job
+    "dist.items.speculated",      # stuck items speculatively re-dispatched
+    "dist.speculation.wins",      # speculative attempts that won the race
+    "dist.slice.recovered",       # orphaned host slices re-executed by the
+                                  # coordinator after lease expiry
+    "dist.lease.swept",           # expired _dist/ lease files swept
+    "dist.degraded.pool",         # sharded jobs degraded to inline execution
+    "dist.degraded.plan",         # shard_map plans degraded to the host pass
+    "dist.degraded.probe",        # MERGE probes degraded to the all-files
+                                  # superset
+    "dist.degraded.lease",        # slices run uncovered after lease-write
+                                  # failure
 })
 
 #: Every histogram observed by constant name (``telemetry.observe``).
@@ -258,8 +282,8 @@ PUBLIC_API = {
     "journal": ("enabled", "journal_dir", "predicate_fingerprint",
                 "record_scan", "record_commit", "record_dml",
                 "record_router", "record_autopilot", "record_shadow",
-                "attempt_state", "record_attempt", "flush", "read_entries",
-                "sweep", "reset"),
+                "record_dist", "attempt_state", "record_attempt", "flush",
+                "read_entries", "sweep", "live_writer_spared", "reset"),
     "advisor": ("Recommendation", "AdvisorReport", "advise"),
     "actions": ("ActionSpec", "MaintenanceAction", "CATALOG", "CATALOG_REF",
                 "RECOMMENDATION_ACTIONS", "COOLDOWN_PHASES", "spec",
@@ -458,7 +482,24 @@ DESCRIPTIONS = {
     "dist.merge.filesProbed": "Candidate files probed by the distributed MERGE touched-files pass.",
     "dist.optimize.groups": "OPTIMIZE bin-pack groups rewritten by sharded workers.",
     "dist.commit.fanin": "Distributed-job commits funneled through the group-commit coordinator.",
+    "dist.items.retried": "Transient work-item attempts retried in place by the executor.",
+    "dist.items.quarantined": "Poison work items quarantined off a sharded job.",
+    "dist.items.speculated": "Stuck work items speculatively re-dispatched by the supervisor.",
+    "dist.speculation.wins": "Speculative re-dispatches that beat the original attempt.",
+    "dist.slice.recovered": "Orphaned host slices re-executed after lease expiry.",
+    "dist.lease.swept": "Expired distributed-lease files swept from _delta_log/_dist.",
+    "dist.degraded.pool": "Sharded jobs that degraded to inline execution after pool failure.",
+    "dist.degraded.plan": "shard_map scan plans that degraded to the host fine pass.",
+    "dist.degraded.probe": "Distributed MERGE probes that degraded to the all-files superset.",
+    "dist.degraded.lease": "Distributed slices run uncovered after a lease-write failure.",
     "dist.item.duration_ms": "Per-work-item wall clock inside the distributed executor (ms).",
+    # doctor distributed-supervision dimension (process-wide)
+    "table.health.distributed.itemsRetried": "Transient item retries seen by this process's sharded jobs.",
+    "table.health.distributed.itemsQuarantined": "Poison items quarantined by this process's sharded jobs.",
+    "table.health.distributed.itemsSpeculated": "Stuck items speculatively re-dispatched in this process.",
+    "table.health.distributed.speculationWins": "Speculative re-dispatches that won in this process.",
+    "table.health.distributed.slicesRecovered": "Orphaned distributed slices recovered by this process.",
+    "table.health.distributed.degraded": "Degradation-ladder rungs taken (pool+plan+probe+lease) in this process.",
 }
 
 

@@ -349,6 +349,24 @@ def _job_analysis(job: Dict[str, Any],
                          for s in stolen),
             "busyUs": sum(int(s.get("durUs") or 0) for s in stolen),
         },
+        # supervision attribution: retries/speculation racing outcomes per
+        # item span — a speculative attempt that is NOT discarded beat the
+        # original (the win the dist.speculation.wins counter records,
+        # here attributed to its item and worker)
+        "supervision": {
+            "retriedAttempts": sum(
+                max(int((s.get("data") or {}).get("attempt") or 1) - 1, 0)
+                for s in items),
+            "speculative": sum(1 for s in items
+                               if (s.get("data") or {}).get("speculative")),
+            "speculationWins": sum(
+                1 for s in items
+                if (s.get("data") or {}).get("speculative")
+                and not (s.get("data") or {}).get("discarded")),
+            "discarded": sum(1 for s in items
+                             if (s.get("data") or {}).get("discarded")),
+            "quarantined": data.get("quarantined") or 0,
+        },
     }
 
 
@@ -374,6 +392,16 @@ def analyze_trace(directory: str,
          if j.get("op") == "delta.dist.job"),
         key=lambda j: -j["durUs"])
     shards = [s for j in jobs for s in j["shards"]]
+    # fault-tolerance spans: orphaned-slice recoveries stitched into the
+    # job trace (the coordinator re-executing a dead host's slice) — the
+    # "why does this trace have an extra commit" answer
+    recoveries = [{
+        "spanId": s.get("spanId"), "pid": s.get("pid"),
+        "durUs": int(s.get("durUs") or 0),
+        "proc": (s.get("data") or {}).get("proc"),
+        "outcome": (s.get("data") or {}).get("outcome"),
+        "groups": (s.get("data") or {}).get("groups"),
+    } for s in closed if s.get("op") == "delta.dist.sliceRecovery"]
     return {
         "traceId": trace_id,
         "rootOp": root.get("op") if root else None,
@@ -385,6 +413,7 @@ def analyze_trace(directory: str,
         "durationUs": max(ends) - min(starts) if starts else 0,
         "criticalPath": _critical_path(closed, root) if root else [],
         "jobs": jobs,
+        "recoveries": recoveries,
         "straggler": max(shards, key=lambda s: s["busyUs"]) if shards
         else None,
     }

@@ -897,24 +897,34 @@ class ResidentState:
         hi_p = np.full((mb, hi.shape[1]), np.nan, np.float32)
         lo_p[:m] = _f32_down(lo)
         hi_p[:m] = _f32_up(hi)
-        if self._dev_shards > 1:
+        try:
+            if self._dev_shards > 1:
+                from delta_tpu.utils import telemetry
+
+                telemetry.bump_counter("dist.plan.sharded")
+                bl = _sharded_block_kernel(
+                    self._dev["mins"], self._dev["maxs"], self._dev["alive"],
+                    jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
+                    self._dev_shards,
+                )
+                blocks = np.asarray(bl)[:m].astype(bool)
+            else:
+                bits = _block_kernel(
+                    self._dev["mins"], self._dev["maxs"], self._dev["alive"],
+                    jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
+                )
+                n_blocks = self.capacity // BLOCK
+                blocks = np.unpackbits(np.asarray(bits)[:m], axis=1,
+                                       count=n_blocks)
+        except Exception:  # noqa: BLE001 — degradation ladder, first rung:
+            # a shard_map/lowering failure (mesh reshape race, OOM on the
+            # coarse cull) must cost latency, not the query — the host fine
+            # pass over every block is the same exact evaluation the device
+            # pass would have narrowed
             from delta_tpu.utils import telemetry
 
-            telemetry.bump_counter("dist.plan.sharded")
-            bl = _sharded_block_kernel(
-                self._dev["mins"], self._dev["maxs"], self._dev["alive"],
-                jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
-                self._dev_shards,
-            )
-            blocks = np.asarray(bl)[:m].astype(bool)
-        else:
-            bits = _block_kernel(
-                self._dev["mins"], self._dev["maxs"], self._dev["alive"],
-                jnp.asarray(lo_p), jnp.asarray(hi_p), BLOCK,
-            )
-            n_blocks = self.capacity // BLOCK
-            blocks = np.unpackbits(np.asarray(bits)[:m], axis=1,
-                                   count=n_blocks)
+            telemetry.bump_counter("dist.degraded.plan")
+            return self._plan_host(lo, hi, ks)
         return self._fine_pass(blocks, lo, hi, ks)
 
     def _fine_pass(self, blocks: np.ndarray, lo: np.ndarray, hi: np.ndarray,

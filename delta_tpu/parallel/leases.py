@@ -1,0 +1,209 @@
+"""Host-slice leases: crash evidence for multihost work distribution.
+
+A distributed OPTIMIZE splits its group list across hosts with no
+scheduler RPC (``parallel/distributed.host_shard_indices``) — which also
+means no scheduler notices a host dying mid-slice. The lease protocol
+makes host death *observable from the shared filesystem*, the only channel
+every host already has:
+
+1. Before executing its slice, a host writes
+   ``_delta_log/_dist/lease-<ts>-<pid>-<proc>.json`` carrying the job id,
+   its slice's bin-packed group keys, and the ``commitInfo.txnId`` token
+   its commit WILL carry (``OptimisticTransaction.preset_txn_id``).
+2. While rewriting, the host heartbeats the lease (mtime touch) — the
+   liveness signal, same convention as a journal writer touching its
+   active segment.
+3. After its commit lands, the host deletes the lease.
+
+A lease still present with a heartbeat older than
+``delta.tpu.distributed.lease.ttlMs`` is an **orphan**: its host died (or
+wedged) somewhere between planning and clearing. The coordinator
+(``commands/optimize.py``) then reconciles: the recorded txnId appearing
+in the log tail means the host committed and only the *clear* was lost
+(delete the lease, done); otherwise the slice's work is re-planned from a
+fresh snapshot restricted to the recorded group keys and re-executed
+locally — idempotent because an already-compacted partition yields no
+plannable group.
+
+Leases are local-filesystem-only (``scheme://`` log paths skip the whole
+protocol, like the journal) and swept with the same aged-orphan discipline
+as ``.tmp`` staging files. The sweep shares the journal's
+newest-per-pid/grace liveness rule (``obs/journal.live_writer_spared``) so
+"this file may belong to a live process" cannot mean two different things
+in the two sweeps.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from delta_tpu.utils import telemetry
+from delta_tpu.utils.config import conf
+
+__all__ = ["enabled", "dist_dir", "lease_ttl_s", "write_lease",
+           "heartbeat_lease", "clear_lease", "read_leases", "sweep_leases",
+           "new_token"]
+
+LEASE_PREFIX = "lease-"
+LEASE_SUFFIX = ".json"
+
+
+def enabled(log_path: Optional[str]) -> bool:
+    """The lease protocol is on: conf-enabled and the log lives on a local
+    filesystem (leases are mtime-heartbeated plain files, meaningless —
+    and unpollable — behind an object store)."""
+    if not conf.get_bool("delta.tpu.distributed.lease.enabled", True):
+        return False
+    if log_path is None or "://" in log_path:
+        return False
+    return True
+
+
+def dist_dir(log_path: str) -> str:
+    """The lease directory for a table's ``_delta_log`` path."""
+    return os.path.join(log_path, "_dist")
+
+
+def lease_ttl_s() -> float:
+    try:
+        ms = float(conf.get("delta.tpu.distributed.lease.ttlMs", 60_000))
+    except (TypeError, ValueError):
+        ms = 60_000.0
+    return max(ms, 1.0) / 1000.0
+
+
+def new_token() -> str:
+    """A fresh commit token to record in a lease and preset on the slice's
+    transaction (``commitInfo.txnId``)."""
+    return uuid.uuid4().hex
+
+
+def _lease_name(proc: int) -> str:
+    # pid at dash-field 2 — the layout journal.live_writer_spared parses,
+    # so the shared liveness rule applies to lease files unchanged
+    return (f"{LEASE_PREFIX}{int(time.time() * 1000):013d}-"
+            f"{os.getpid()}-{int(proc)}{LEASE_SUFFIX}")
+
+
+def write_lease(log_path: str, job: str, proc: int,
+                payload: Dict[str, Any]) -> Optional[str]:
+    """Publish this host's lease for ``job``; returns its path, or None
+    when the protocol is off or the write failed (the slice then proceeds
+    *uncovered* — counted ``dist.degraded.lease`` — rather than failing a
+    job over its own safety net)."""
+    if not enabled(log_path):
+        return None
+    from delta_tpu.storage import faults
+
+    path = os.path.join(dist_dir(log_path), _lease_name(proc))
+    body = dict(payload)
+    body.update(job=job, proc=int(proc), pid=os.getpid(),
+                ts=int(time.time() * 1000))
+    from delta_tpu.utils.retries import TransientIOError
+
+    try:
+        faults.fire("dist.leaseWrite", job)
+        os.makedirs(dist_dir(log_path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(body, f, separators=(",", ":"), default=str)
+    except (TransientIOError, OSError):
+        # transient fault or unwritable dir: the slice proceeds UNCOVERED
+        # (counted) — the lease is a safety net, not a precondition; a
+        # SimulatedCrash pierces like any host death, and a torn lease
+        # file is skipped by read_leases' parse guard
+        telemetry.bump_counter("dist.degraded.lease")
+        return None
+    return path
+
+
+def heartbeat_lease(path: Optional[str]) -> None:
+    """Touch the lease's mtime — the liveness signal the coordinator and
+    the sweep read. Best-effort: a lost heartbeat risks a spurious-looking
+    expiry (recovery is idempotent), never a failed rewrite."""
+    if path is None:
+        return
+    try:
+        os.utime(path)
+    except OSError:
+        pass
+
+
+def clear_lease(path: Optional[str]) -> None:
+    """Delete this host's lease after its commit landed. Best-effort: a
+    lost clear leaves an orphan whose recorded txnId reconciles to
+    already-committed — cleanup, not re-execution."""
+    if path is None:
+        return
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def read_leases(log_path: str) -> List[Tuple[str, Dict[str, Any], float]]:
+    """Every parseable lease under the table's ``_dist/`` directory as
+    ``(path, payload, heartbeat_mtime)``, name-sorted. Torn or malformed
+    files are skipped — a half-written lease from a dying host must not
+    poison the coordinator's reconciliation."""
+    ddir = dist_dir(log_path)
+    try:
+        names = sorted(n for n in os.listdir(ddir)
+                       if n.startswith(LEASE_PREFIX)
+                       and n.endswith(LEASE_SUFFIX))
+    except OSError:
+        return []
+    out: List[Tuple[str, Dict[str, Any], float]] = []
+    for n in names:
+        p = os.path.join(ddir, n)
+        try:
+            mtime = os.stat(p).st_mtime
+            with open(p, encoding="utf-8") as f:
+                body = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(body, dict):
+            out.append((p, body, mtime))
+    return out
+
+
+def sweep_leases(log_path: str) -> int:
+    """Delete dead lease files: everything except possibly-live hosts'
+    newest leases, per the shared journal liveness rule (newest file per
+    embedded pid, heartbeat within the grace window). A dead CI pid's lease
+    goes as soon as its heartbeat is stale — one immune lease per crashed
+    run would grow ``_dist/`` forever — while this process's own live lease
+    is spared exactly the way the journal sweep spares its active segment."""
+    from delta_tpu.obs.journal import live_writer_spared
+
+    ddir = dist_dir(log_path)
+    try:
+        names = [n for n in os.listdir(ddir)
+                 if n.startswith(LEASE_PREFIX) and n.endswith(LEASE_SUFFIX)]
+    except OSError:
+        return 0
+    stats = []
+    for n in names:
+        p = os.path.join(ddir, n)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        stats.append((p, st.st_size, st.st_mtime))
+    # grace = the lease ttl: past it the coordinator already treats the
+    # lease as an orphan to reconcile, so the sweep may reclaim the file
+    spared = live_writer_spared(stats, lease_ttl_s())
+    deleted = 0
+    for p, _size, _mtime in stats:
+        if p in spared:
+            continue
+        try:
+            os.remove(p)
+            deleted += 1
+        except OSError:
+            continue
+    if deleted:
+        telemetry.bump_counter("dist.lease.swept", deleted)
+    return deleted

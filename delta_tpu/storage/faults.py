@@ -47,7 +47,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Dict, Iterable, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 from delta_tpu.protocol import filenames
 from delta_tpu.storage.logstore import FileStatus, LogStore
@@ -111,6 +112,16 @@ _POINT_KINDS: Dict[str, Tuple[str, ...]] = {
     # the build's part writes — fire() has no partial-write to tear).
     "txn.groupLoop": ("transient", "crash_before_publish", "slow"),
     "checkpoint.asyncBuild": ("transient", "crash_before_publish", "slow"),
+    # distributed-execution supervision points (parallel/executor,
+    # parallel/leases): item attempts may die transiently, crash the
+    # "process" (SimulatedCrash pierces the supervisor — only the workload
+    # driver recovers), or stall (the straggler the speculation path
+    # rescues); worker spawns and lease writes fail like any other IO;
+    # heartbeat loss must cost at most a spurious speculation.
+    "dist.itemExec": ("transient", "crash_before_publish", "slow"),
+    "dist.workerSpawn": ("transient",),
+    "dist.heartbeat": ("transient",),
+    "dist.leaseWrite": ("transient", "crash_before_publish", "slow"),
 }
 
 
@@ -281,14 +292,25 @@ def _parse_spec(spec: str) -> FaultPlan:
     return FaultPlan(**kw)  # type: ignore[arg-type]
 
 
-def fire(point: str, name: str = "") -> None:
+_UNPINNED = object()  # sentinel: fire() resolves the plan from conf
+
+
+def fire(point: str, name: str = "",
+         plan: Any = _UNPINNED) -> None:
     """Engine-level fault point — for code paths that are not a single
     store operation (the group-commit leader loop, the async checkpoint
     builder). Consults the session's active plan directly and raises the
     drawn fault; a no-op when no plan is installed (zero overhead: one
     conf read). Crash kinds raise :class:`SimulatedCrash`; ``transient``
-    raises :class:`TransientIOError`; ``slow`` sleeps."""
-    plan = plan_from_conf()
+    raises :class:`TransientIOError`; ``slow`` sleeps.
+
+    Long-lived machinery whose threads can outlive the operation that
+    spawned them (the sharded executor's worker pool) passes ``plan``
+    explicitly — resolved once at job start — so a task that runs late
+    draws from ITS job's plan instead of whatever the session conf holds
+    by then. ``plan=None`` is an explicit no-op."""
+    if plan is _UNPINNED:
+        plan = plan_from_conf()
     if plan is None:
         return
     d = plan.draw(point, name)

@@ -50,6 +50,9 @@ class TortureReport:
     reconciled_ambiguous: int = 0
     stream_replays: int = 0
     op_counts: Dict[str, int] = field(default_factory=dict)
+    quarantined_groups: int = 0
+    items_retried: int = 0
+    slices_recovered: int = 0
     faults_injected: int = 0
     fault_kinds: Dict[str, int] = field(default_factory=dict)
     per_point: Dict[str, List[str]] = field(default_factory=dict)
@@ -67,7 +70,8 @@ class TortureHarness:
                  group_commit: bool = False,
                  async_checkpoint: bool = False,
                  autopilot: bool = False,
-                 autopilot_cooldown_ms: int = 2000):
+                 autopilot_cooldown_ms: int = 2000,
+                 distributed: bool = False):
         self.path = path
         self.seed = seed
         self.plan = plan or FaultPlan(seed=seed, rate=rate, kinds=kinds)
@@ -90,6 +94,17 @@ class TortureHarness:
         # runs with the same autopilot setting.
         self.autopilot = autopilot
         self.autopilot_cooldown_ms = autopilot_cooldown_ms
+        # distributed mode (ISSUE 20): OPTIMIZE runs on the supervised
+        # sharded executor (4 workers, on_failure="quarantine") and, on a
+        # seeded coin flip, as coordinator of a faked 2-host job — which
+        # exercises the lease write/heartbeat/clear path and, when a crash
+        # leaves an orphan lease behind, the coordinator's expired-lease
+        # recovery on a LATER optimize step. The extra faulted points
+        # (dist.itemExec / dist.workerSpawn / dist.heartbeat /
+        # dist.leaseWrite) change the seeded draw sequence, so per_point
+        # determinism is only comparable between runs with the same
+        # distributed setting.
+        self.distributed = distributed
         self._weighted_ops = list(self._WEIGHTED_OPS)
         if autopilot:
             self._weighted_ops.append(("autopilot", 6))
@@ -129,14 +144,20 @@ class TortureHarness:
         snap = self._oracle_snapshot()
         return scan_to_table(snap, [f"{col} = {bid}"], ["id"]).num_rows
 
-    @staticmethod
-    def _rows(ids: List[int], bid: int, stream: bool = False) -> pa.Table:
+    def _rows(self, ids: List[int], bid: int, stream: bool = False) -> pa.Table:
         n = len(ids)
-        return pa.table({
+        cols = {
             "id": pa.array(ids, pa.int64()),
             "batch": pa.array([-1 if stream else bid] * n, pa.int64()),
             "sbatch": pa.array([bid if stream else -1] * n, pa.int64()),
-        })
+        }
+        if self.distributed:
+            # distributed mode partitions by a 4-way shard column so OPTIMIZE
+            # plans SEVERAL groups — the multi-item pool path (work stealing,
+            # heartbeats, speculation) is the whole fault surface under test;
+            # an unpartitioned table collapses to one group and runs inline
+            cols["shard"] = pa.array([i % 4 for i in ids], pa.int64())
+        return pa.table(cols)
 
     def _expected_ids(self) -> List[int]:
         out: List[int] = []
@@ -158,7 +179,9 @@ class TortureHarness:
         from delta_tpu.utils.config import conf
 
         with conf.set_temporarily(delta__tpu__faults__plan=None):
-            DeltaTable.create(self.path, data=self._rows([], -1))
+            DeltaTable.create(
+                self.path, data=self._rows([], -1),
+                partition_columns=["shard"] if self.distributed else ())
         self._log = self._fresh_log()
 
     # -- workload ops -----------------------------------------------------
@@ -262,7 +285,34 @@ class TortureHarness:
     def _op_optimize(self) -> None:
         from delta_tpu.api.tables import DeltaTable
 
-        DeltaTable(self._log).optimize().execute_compaction()
+        if not self.distributed:
+            DeltaTable(self._log).optimize().execute_compaction()
+            return
+        from delta_tpu.commands.optimize import OptimizeCommand
+        from delta_tpu.parallel import distributed as dist_mod
+
+        # seeded coin flip: plain supervised sharded execution, or the same
+        # posing as coordinator of a 2-host job. The phantom peer never
+        # appears (its slice simply stays uncompacted — rearrange-only, so
+        # no row is owed to it), but the pose makes the run write/clear its
+        # own lease and reconcile any expired orphan a crashed earlier step
+        # left behind — sliceRecovered under live fault injection.
+        pose_multihost = self.rng.random() < 0.5
+        cmd = OptimizeCommand(self._log, workers=4,
+                              distribute=pose_multihost,
+                              on_failure="quarantine")
+        if pose_multihost:
+            orig = dist_mod.process_info
+            dist_mod.process_info = lambda: (0, 2)
+            try:
+                cmd.run()
+            finally:
+                dist_mod.process_info = orig
+        else:
+            cmd.run()
+        # retry/quarantine evidence is read from the telemetry counters in
+        # run() — counted the moment they happen, so a job that crashes
+        # AFTER a retry still contributes
 
     def _op_read(self) -> None:
         from delta_tpu.exec.scan import scan_to_table
@@ -391,6 +441,16 @@ class TortureHarness:
             extra["delta.tpu.autopilot.cooldownMs"] = \
                 self.autopilot_cooldown_ms
             extra["delta.tpu.autopilot.contentionBackoffMs"] = 500
+        if self.distributed:
+            # fast supervision: retries back off in single-digit ms, the
+            # supervisor polls every 10ms, and leases expire after 1s so a
+            # crashed step's orphan is recoverable within the same run
+            extra["delta.tpu.distributed.retry.baseDelayMs"] = 1
+            extra["delta.tpu.distributed.retry.maxDelayMs"] = 20
+            extra["delta.tpu.distributed.retry.deadlineMs"] = 2_000
+            extra["delta.tpu.distributed.supervisor.intervalMs"] = 10
+            extra["delta.tpu.distributed.lease.ttlMs"] = 1_000
+            extra["delta.tpu.distributed.lease.settleMs"] = 20
         with conf.set_temporarily(
             delta__tpu__faults__plan=self.plan,
             delta__tpu__storage__retry__baseDelayMs=1,
@@ -402,11 +462,24 @@ class TortureHarness:
         ):
             # re-wrap under the plan now that it is installed
             self._log = self._fresh_log()
+            from delta_tpu.utils import telemetry
+
+            def _dist_counts():
+                c = telemetry.counters("dist")
+                return (c.get("dist.items.retried", 0),
+                        c.get("dist.items.quarantined", 0),
+                        c.get("dist.slice.recovered", 0))
+
+            base = _dist_counts()
             for i in range(steps):
                 self.step()
                 if (i + 1) % check_every == 0:
                     self.check_invariants()
             self.check_invariants()
+            end = _dist_counts()
+            self.report.items_retried = end[0] - base[0]
+            self.report.quarantined_groups = end[1] - base[1]
+            self.report.slices_recovered = end[2] - base[2]
         self.report.steps = steps
         self.report.faults_injected = self.plan.total_injected()
         self.report.fault_kinds = self.plan.kinds_seen()
@@ -419,10 +492,12 @@ def run_torture(path: str, seed: int, steps: int,
                 check_every: int = 10,
                 group_commit: bool = False,
                 async_checkpoint: bool = False,
-                autopilot: bool = False) -> TortureReport:
+                autopilot: bool = False,
+                distributed: bool = False) -> TortureReport:
     """One-call torture run: fresh harness, seeded plan, invariants on."""
     h = TortureHarness(path, seed, rate=rate, kinds=kinds,
                        group_commit=group_commit,
                        async_checkpoint=async_checkpoint,
-                       autopilot=autopilot)
+                       autopilot=autopilot,
+                       distributed=distributed)
     return h.run(steps, check_every=check_every)

@@ -171,6 +171,12 @@ class OptimisticTransaction:
         self.post_commit_hooks: List = []
         self.operation_metrics: Dict[str, str] = {}
         self.user_metadata: Optional[str] = None
+        # caller-supplied commit token (commitInfo.txnId): a distributed
+        # slice records it in its lease BEFORE executing, so a coordinator
+        # can later decide "did that host's commit land?" from the log alone
+        # (parallel/leases.py orphan recovery) — the same ambiguous-outcome
+        # reconciliation the token already serves inside _do_commit_retry
+        self.preset_txn_id: Optional[str] = None
         self.stats = CommitStats(start_version=self.read_version)
 
     # -- ambient active transaction (scala:99-144) ----------------------
@@ -359,7 +365,7 @@ class OptimisticTransaction:
             # per-commit ownership token: if the log-entry create returns an
             # indeterminate error, re-reading version N and comparing this
             # token decides won/lost (never double-commit, never false-fail)
-            self._commit_token = uuid.uuid4().hex
+            self._commit_token = self.preset_txn_id or uuid.uuid4().hex
             # stamp any maintenance attempts cap now: the group-commit
             # leader runs on ANOTHER thread, where the contextvar is unset
             self._attempts_cap = _commit_attempts_cap.get()

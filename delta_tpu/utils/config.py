@@ -368,6 +368,39 @@ class SqlConf:
         # Funnel distributed-job commits through the group-commit
         # coordinator (txn/group_commit) as the single-writer fan-in.
         "delta.tpu.distributed.singleWriterFanIn": True,
+        # Per-item transient retry inside the sharded executor
+        # (parallel/executor): bounded attempts + a total per-item
+        # deadline via the shared utils/retries.RetryPolicy. Only
+        # Exceptions classified transient retry; permanent failures
+        # quarantine or abort per the job's on_failure policy.
+        "delta.tpu.distributed.retry.maxAttempts": 3,
+        "delta.tpu.distributed.retry.baseDelayMs": 10,
+        "delta.tpu.distributed.retry.maxDelayMs": 200,
+        "delta.tpu.distributed.retry.deadlineMs": 10_000,
+        # Stuck-item supervision: the delta-dist-supervisor thread marks
+        # items whose heartbeat age exceeds max(itemTimeoutMs, measured
+        # ms/byte x LPT byte estimate x slackFactor) — the floor is a
+        # conf, the effective timeout is priced per item — and
+        # speculatively re-dispatches them to an idle worker,
+        # first-completion-wins. itemTimeoutMs <= 0 disables supervision.
+        "delta.tpu.distributed.itemTimeoutMs": 120_000,
+        "delta.tpu.distributed.speculation.enabled": True,
+        "delta.tpu.distributed.speculation.slackFactor": 4.0,
+        "delta.tpu.distributed.supervisor.intervalMs": 25,
+        # Multihost orphaned-slice recovery (parallel/leases): hosts in a
+        # distributed OPTIMIZE write heartbeat lease files under
+        # _delta_log/_dist/; after fan-in the coordinator re-executes
+        # slices whose lease expired (ttlMs past the last heartbeat)
+        # without being cleared. Leases are local-file IO like the
+        # journal; object-store tables skip them.
+        "delta.tpu.distributed.lease.enabled": True,
+        "delta.tpu.distributed.lease.ttlMs": 60_000,
+        # How long the coordinator lingers after its own commit waiting
+        # for peer leases to APPEAR before concluding there are none — a
+        # peer that dies pre-lease lost no committed data, so the wait is
+        # deliberately short; once a lease is seen, it is tracked to
+        # clear/expiry regardless of this window.
+        "delta.tpu.distributed.lease.settleMs": 250,
         # DML writes per-file deletion vectors instead of rewriting files
         # when the table enables them (commands/dml_common).
         "delta.tpu.deletionVectors.enabled": True,

@@ -16,7 +16,13 @@ its byte-weighted LPT slice of the OPTIMIZE bin-pack groups and commits its
 own rearrange-only transaction, then proc 0 runs a probe-restricted MERGE.
 ``dist-crash`` kills proc 1 with a SimulatedCrash mid-OPTIMIZE (no cluster
 join — the store is the coordination model, and a dead peer must not hang
-the survivor's jax.distributed teardown).
+the survivor's jax.distributed teardown; leases are disabled so the
+survivor-only semantics stay isolated from the recovery path below).
+``dist-recover`` is the lease-recovery flavor (ISSUE 20): proc 1 crashes
+mid-slice AFTER publishing its lease; proc 0 — launched by the parent once
+the lease's heartbeat has been aged past the ttl — commits its own slice,
+then reconciles the orphan via the coordinator recovery path and reports
+the recovered end state.
 
 Results land in <out>/result-<proc>.json for the parent to assert.
 """
@@ -64,6 +70,15 @@ def dist_body(proc: int, n_procs: int, table: str, out_dir: str,
     # sharded scan: the byte-weighted LPT partitions tile the table
     part = scan_to_table(snap, distribute=True)
     result["scan_ids"] = sorted(part.column("id").to_pylist())
+
+    if crash:
+        # the crash flavor isolates SURVIVOR semantics: a dead peer commits
+        # nothing and must not hang the survivor. Leases stay off so the
+        # coordinator does not block on (and then recover) the orphaned
+        # slice — that path is the `dist-recover` mode's subject.
+        from delta_tpu.utils.config import conf as _cconf
+
+        _cconf.set("delta.tpu.distributed.lease.enabled", False)
 
     if crash and proc == 1:
         # SimulatedCrash (a BaseException) mid-job: fires on this host's
@@ -129,6 +144,74 @@ def dist_body(proc: int, n_procs: int, table: str, out_dir: str,
         json.dump(result, f)
 
 
+def dist_recover_body(proc: int, n_procs: int, table: str,
+                      out_dir: str) -> None:
+    trace_dir = os.environ.get("DELTA_TPU_TRACE_DIR")
+    if trace_dir:
+        from delta_tpu.utils.config import conf as _conf
+
+        _conf.set("delta.tpu.trace.dir", trace_dir)
+
+    from delta_tpu import DeltaLog
+    from delta_tpu.commands.optimize import OptimizeCommand
+
+    log = DeltaLog.for_table(table)
+
+    if proc == 1:
+        # die on the SECOND group rewrite: the lease is already published
+        # (written before slice execution) and real work has started — the
+        # classic orphaned-slice shape. The SimulatedCrash (a BaseException)
+        # pierces the executor and kills this process with a traceback.
+        from delta_tpu.exec import write as write_exec
+        from delta_tpu.storage.faults import SimulatedCrash
+
+        orig = write_exec.write_files
+        state = {"n": 0}
+
+        def crashing(*a, **k):
+            state["n"] += 1
+            if state["n"] >= 2:
+                raise SimulatedCrash("dist.itemExec")
+            return orig(*a, **k)
+
+        write_exec.write_files = crashing
+        OptimizeCommand(log, min_file_size=1 << 30, workers=2,
+                        distribute=True).run()
+        raise AssertionError("proc 1 must have crashed mid-slice")
+
+    # proc 0 — the coordinator: commit our slice, then recover the orphan
+    from delta_tpu.obs import journal
+    from delta_tpu.parallel import leases
+    from delta_tpu.utils import telemetry
+    from delta_tpu.utils.config import conf
+    from delta_tpu.exec.scan import scan_to_table
+
+    with conf.set_temporarily(
+            **{"delta.tpu.distributed.lease.settleMs": 20}):
+        cmd = OptimizeCommand(log, min_file_size=1 << 30, workers=2,
+                              distribute=True)
+        version = cmd.run()
+
+    journal.flush(log.log_path)
+    DeltaLog.clear_cache()
+    fsnap = DeltaLog.for_table(table).update()
+    final = scan_to_table(fsnap)
+    result = {
+        "proc": proc,
+        "optimize_version": version,
+        "final_ids": sorted(final.column("id").to_pylist()),
+        "final_files": fsnap.num_of_files,
+        "final_version": fsnap.version,
+        "recovered": telemetry.counters("dist").get(
+            "dist.slice.recovered", 0),
+        "leases_left": len(leases.read_leases(log.log_path)),
+        "dist_events": [e.get("event") for e in journal.read_entries(
+            log.log_path, kinds=("dist",))],
+    }
+    with open(os.path.join(out_dir, f"result-{proc}.json"), "w") as f:
+        json.dump(result, f)
+
+
 def main() -> None:
     proc = int(sys.argv[1])
     n_procs = int(sys.argv[2])
@@ -149,6 +232,14 @@ def main() -> None:
         # survivor's jax.distributed teardown; slicing reads process_info
         dist.process_info = lambda: (proc, n_procs)
         dist_body(proc, n_procs, table, out_dir, crash=True)
+        return
+
+    if mode == "dist-recover":
+        # no cluster join either: the two phases run sequentially (the
+        # parent ages the dead host's lease between them), so there is no
+        # live cluster to coordinate with
+        dist.process_info = lambda: (proc, n_procs)
+        dist_recover_body(proc, n_procs, table, out_dir)
         return
 
     pid, count = dist.initialize(

@@ -319,6 +319,110 @@ def test_two_process_optimize_survives_worker_crash(tmp_path):
     assert results[0]["final_files"] == snap.num_of_files
 
 
+def test_two_process_crash_recovery_acceptance(tmp_path):
+    """ISSUE 20 acceptance: a worker host is killed mid-OPTIMIZE after
+    publishing its lease; the coordinator recovers the orphaned slice. The
+    end state is row- AND topology-identical to a single-process run, every
+    group was committed exactly once (disjoint remove sets across exactly
+    two commits), and the stitched trace shows the recovery span."""
+    import time
+
+    from delta_tpu.obs import trace_store
+    from delta_tpu.parallel import leases
+    from delta_tpu.utils import telemetry
+    from delta_tpu.utils.config import conf
+
+    table = str(tmp_path / "table")
+    solo = str(tmp_path / "solo")
+    _mk_dist_table(table)
+    _mk_dist_table(solo)
+    log_path = DeltaLog.for_table(table).log_path
+    snap0 = DeltaLog.for_table(table).update()
+    v0, files0 = snap0.version, snap0.num_of_files
+
+    trace_dir = str(tmp_path / "spool")
+    out_dir = str(tmp_path / "out")
+    os.makedirs(trace_dir)
+    os.makedirs(out_dir)
+
+    def run_worker(i, extra_env=None):
+        # only the traced phase (the coordinator) gets the spool dir —
+        # phase 1 has no traceparent and would spool under its own trace id
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   **(extra_env or {}))
+        env.pop("XLA_FLAGS", None)
+        p = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "multihost_worker.py"),
+             str(i), "2", "0", table, "-", out_dir, "dist-recover"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        return p, p.communicate(timeout=150)
+
+    # phase 1: host 1 dies mid-slice, leaving its lease orphaned
+    p1, out1 = run_worker(1)
+    assert p1.returncode != 0
+    assert b"SimulatedCrash" in out1[1]
+    orphans = leases.read_leases(log_path)
+    assert len(orphans) == 1
+    assert orphans[0][1]["proc"] == 1 and orphans[0][1]["txnId"]
+    assert DeltaLog.for_table(table).update().version == v0  # no commit
+    past = time.time() - 120  # the dead host's heartbeat goes stale
+    os.utime(orphans[0][0], (past, past))
+
+    # phase 2: the coordinator, under a traced root span
+    with conf.set_temporarily(**{"delta.tpu.trace.dir": trace_dir,
+                                 "delta.tpu.trace.sampleRate": 1.0}):
+        with telemetry.record_operation("delta.test.recovery") as root:
+            wire = telemetry.span_context(wire=True)
+            p0, out0 = run_worker(
+                0, extra_env={"DELTA_TPU_TRACEPARENT": wire,
+                              "DELTA_TPU_TRACE_DIR": trace_dir})
+    trace_store.reset()
+    assert p0.returncode == 0, out0[1].decode()[-3000:]
+    with open(os.path.join(out_dir, "result-0.json")) as f:
+        result = json.load(f)
+
+    # end state: row- and topology-identical to a single-process run
+    from delta_tpu.commands.optimize import OptimizeCommand
+    from delta_tpu.exec.scan import scan_to_table
+
+    OptimizeCommand(DeltaLog.for_table(solo), min_file_size=1 << 30).run()
+    DeltaLog.clear_cache()
+    ssnap = DeltaLog.for_table(solo).update()
+    want = sorted(scan_to_table(ssnap).column("id").to_pylist())
+    assert result["final_ids"] == want == list(range(192))
+    assert result["final_files"] == ssnap.num_of_files < files0
+
+    # the worker recovered exactly one slice and cleared its lease
+    assert result["recovered"] == 1
+    assert result["leases_left"] == 0
+    assert leases.read_leases(log_path) == []
+    assert "dist.sliceRecovered" in result["dist_events"]
+    assert "dist.sliceReconciled" not in result["dist_events"]
+
+    # exactly one commit per group: two commits (coordinator's slice + the
+    # recovery), whose remove sets are disjoint and tile the original files
+    snap = DeltaLog.for_table(table).update()
+    assert snap.version == v0 + 2 == result["final_version"]
+    removed = []
+    for v in (v0 + 1, v0 + 2):
+        with open(os.path.join(log_path, f"{v:020d}.json")) as f:
+            removed.append({json.loads(line)["remove"]["path"]
+                            for line in f if '"remove"' in line})
+    assert removed[0] & removed[1] == set()
+    assert len(removed[0] | removed[1]) == files0
+
+    # the stitched trace carries the recovery span under the one trace id
+    rows = trace_store.read_spools(trace_dir)
+    assert {r["traceId"] for r in rows} == {root.trace_id}
+    recovery_spans = [r for r in rows if r["op"] == "delta.dist.sliceRecovery"]
+    assert len(recovery_spans) == 1
+    analysis = trace_store.analyze_trace(trace_dir, root.trace_id)
+    [rec] = analysis["recoveries"]
+    assert rec["outcome"] == "recovered"
+    assert rec["proc"] == 1 and rec["groups"] >= 1
+
+
 def test_vacuum_composes_with_scan_partitioning():
     """The same strided partitioner drives vacuum's delete fan-out and the
     distributed scan: for any (index, count) the slices tile the work list

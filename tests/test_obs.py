@@ -176,7 +176,7 @@ def test_describe_detail_gains_health_columns(tmp_table):
     assert d["healthSeverity"] in ("ok", "warn", "critical")
     assert set(d["health"]) == {
         "checkpoint", "smallFiles", "dv", "stats", "partition",
-        "tombstones", "protocol", "device",
+        "tombstones", "protocol", "device", "distributed",
     }
     assert d["numCommitsSinceCheckpoint"] >= 1
     assert d["statsCoveragePct"] == 1.0

@@ -132,6 +132,60 @@ def test_torture_grouped_crash_diet_recovers(tmp_path):
     assert report.recoveries >= report.crashes
 
 
+# -- fault-tolerant distributed execution (ISSUE 20) -------------------------
+
+
+def test_torture_distributed_fixed_seed_subset(tmp_path):
+    """Tier-1 subset with the supervised sharded executor in the loop:
+    OPTIMIZE runs on 4 workers with on_failure="quarantine" (half the time
+    posing as coordinator of a 2-host job, covering the lease path), and the
+    dist.* fault points draw alongside the storage points. Every ledger
+    invariant holds — a quarantined group changes no rows."""
+    report = run_torture(str(tmp_path / "t"), seed=TIER1_SEED, steps=60,
+                         rate=0.10, distributed=True)
+    assert report.steps == 60
+    assert report.faults_injected >= 10
+    assert report.invariant_checks >= 6
+    assert report.op_counts.get("optimize", 0) >= 1
+    assert report.max_step_s < 60.0
+    # the supervised executor is a real fault surface in this mode
+    assert any(k.startswith("dist.") for k in report.per_point), \
+        sorted(report.per_point)
+
+
+@pytest.mark.slow
+def test_torture_distributed_acceptance(tmp_path):
+    """ISSUE 20 acceptance: a fixed-seed >= 200-step distributed run with
+    kills across all four dist fault points (scripted prefix guarantees
+    coverage; seeded rate pressure carries the rest) loses no committed
+    row, never double-commits a recovered slice (both enforced by the
+    ledger + snapshot invariants after every recovery), and completes
+    every job fully or with an explicit quarantine report."""
+    script = [
+        ("dist.workerSpawn", "transient"),
+        ("dist.heartbeat", "transient"),
+        ("dist.itemExec", "transient"),
+        ("dist.itemExec", "crash_before_publish"),
+        ("dist.leaseWrite", "crash_before_publish"),
+    ]
+    plan = FaultPlan(seed=424242, rate=0.12, script=script)
+    h = TortureHarness(str(tmp_path / "t"), seed=424242, plan=plan,
+                       distributed=True)
+    r = h.run(steps=240, check_every=10)
+    assert r.steps == 240
+    assert not plan.script, "scripted dist faults must all have fired"
+    for prefix in ("dist.workerSpawn", "dist.heartbeat",
+                   "dist.itemExec", "dist.leaseWrite"):
+        assert any(k.startswith(prefix) for k in r.per_point), \
+            (prefix, sorted(r.per_point))
+    assert r.crashes >= 2            # itemExec + leaseWrite kills pierced
+    assert r.recoveries >= r.crashes
+    # transient item faults surfaced as retries or explicit quarantines —
+    # never as silently dropped work (the ledger check would catch that)
+    assert r.items_retried + r.quarantined_groups >= 1
+    assert r.max_step_s < 60.0
+
+
 @pytest.mark.slow
 def test_torture_grouped_acceptance(tmp_path):
     """Long grouped+async run at the PR 5 acceptance seed: sustained fault
